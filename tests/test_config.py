@@ -1,0 +1,120 @@
+"""Config system tests, incl. the schema drift lock.
+
+Reference analogs: TestTonyConfigurationFields.java:74 (keys<->defaults
+bijection), TestUtils.java conf parsing, TestTonyClient conf processing.
+"""
+
+import json
+
+import pytest
+
+from tony_tpu.config import ConfError, TonyConf, build_conf, keys, role_key
+
+
+def test_defaults_loaded():
+    conf = TonyConf()
+    assert conf.get("tony.application.framework") == "jax"
+    assert conf.get_int("tony.task.heartbeat-interval-ms") == 1000
+    assert conf.get_bool("tony.application.security.enabled") is True
+
+
+def test_schema_drift_lock():
+    """Every key has a doc and a default of the declared type (ref:
+    TestTonyConfigurationFields keys<->xml bijection)."""
+    for name, spec in {**keys.KEYS, **keys.ROLE_SUFFIXES}.items():
+        assert spec.doc, f"{name} missing doc"
+        assert isinstance(spec.default, spec.type), name
+    # defaults() covers exactly KEYS
+    assert set(keys.defaults()) == set(keys.KEYS)
+
+
+def test_role_regex_arbitrary_names():
+    conf = TonyConf()
+    conf.set("tony.head.instances", "1")
+    conf.set("tony.worker.instances", 2)
+    conf.set("tony.worker.chips", 4)
+    assert conf.roles() == ["head", "worker"]
+    assert conf.role_get("worker", "chips") == 4
+    # unset role keys fall back to suffix defaults
+    assert conf.role_get("head", "memory") == "2g"
+    assert conf.role_get("head", "depends-on") == ""
+
+
+def test_reserved_namespaces_not_roles():
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 1)
+    assert "application" not in conf.roles()
+    assert "task" not in conf.roles()
+
+
+def test_type_coercion():
+    conf = TonyConf()
+    conf.set("tony.task.max-missed-heartbeats", "7")
+    assert conf.get("tony.task.max-missed-heartbeats") == 7
+    conf.set("tony.application.fail-on-worker-failure-enabled", "TRUE")
+    assert conf.get_bool("tony.application.fail-on-worker-failure-enabled") is True
+
+
+def test_layering_precedence(tmp_path):
+    f = tmp_path / "tony.toml"
+    f.write_text(
+        '[tony.application]\nname = "from-file"\n\n[tony.worker]\ninstances = 3\n'
+    )
+    site_dir = tmp_path / "site"
+    site_dir.mkdir()
+    (site_dir / "tony-site.json").write_text(json.dumps({"tony.worker.instances": 5}))
+    conf = build_conf(str(f), ["tony.application.name=from-cli"], str(site_dir))
+    assert conf.get("tony.application.name") == "from-cli"  # cli > file
+    assert conf.get_int("tony.worker.instances") == 5  # site > cli/file
+
+
+def test_multi_value_append():
+    conf = TonyConf()
+    conf.apply_overrides(
+        ["tony.application.untracked.jobtypes=a", "tony.application.untracked.jobtypes=b"]
+    )
+    assert conf.get_list("tony.application.untracked.jobtypes") == ["ps", "a", "b"]
+
+
+def test_final_roundtrip(tmp_path):
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 2)
+    conf.set("tony.application.name", "rt")
+    p = tmp_path / "tony-final.json"
+    conf.write_final(str(p))
+    back = TonyConf.from_final(str(p))
+    assert back.get_int("tony.worker.instances") == 2
+    assert back.get("tony.application.name") == "rt"
+    assert back.get_int("tony.task.heartbeat-interval-ms") == 1000
+
+
+def test_validation_limits():
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 4)
+    conf.set("tony.worker.chips", 8)
+    conf.set("tony.application.max-total-chips", 16)
+    with pytest.raises(ConfError):
+        conf.validate()
+    conf.set("tony.application.max-total-chips", 32)
+    conf.validate()
+
+
+def test_validation_max_instances():
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 4)
+    conf.set("tony.worker.max-instances", 2)
+    with pytest.raises(ConfError):
+        conf.validate()
+
+
+def test_bad_distributed_mode():
+    conf = TonyConf()
+    conf.set("tony.application.distributed-mode", "RING")
+    with pytest.raises(ConfError):
+        conf.validate()
+
+
+def test_role_key_helper():
+    assert role_key("worker", "instances") == "tony.worker.instances"
+    with pytest.raises(KeyError):
+        role_key("worker", "nope")

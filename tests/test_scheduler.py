@@ -1,0 +1,99 @@
+"""DAG scheduler tests (ref: TestTaskScheduler.java: DAG detection,
+dependency release; TestTonyE2E job-type DAG scheduling :271)."""
+
+import pytest
+
+from tony_tpu.config import TonyConf
+from tony_tpu.scheduler import CycleError, TaskScheduler
+from tony_tpu.session import Session
+
+
+def make(roles: dict, deps: dict | None = None, stages: dict | None = None):
+    conf = TonyConf()
+    for role, n in roles.items():
+        conf.set(f"tony.{role}.instances", n)
+    for role, d in (deps or {}).items():
+        conf.set(f"tony.{role}.depends-on", d)
+    for k, v in (stages or {}).items():
+        conf.set(k, v)
+    session = Session(conf)
+    allocated = []
+    sched = TaskScheduler(session, lambda req: allocated.append(req.role), conf)
+    return session, sched, allocated
+
+
+def complete_role(session, sched, role):
+    for i in range(len(session.tasks[role])):
+        session.init_task(role)
+        session.on_task_completed(role, i, 0)
+    return sched.on_role_instance_completed(role)
+
+
+def test_no_deps_all_scheduled():
+    _, sched, allocated = make({"worker": 2, "ps": 1})
+    sched.schedule()
+    assert sorted(allocated) == ["ps", "worker"]
+    assert sched.all_scheduled()
+
+
+def test_dependency_release():
+    session, sched, allocated = make(
+        {"prep": 1, "worker": 2}, deps={"worker": "prep"}
+    )
+    sched.schedule()
+    assert allocated == ["prep"]
+    released = complete_role(session, sched, "prep")
+    assert released == ["worker"]
+    assert sched.all_scheduled()
+
+
+def test_chain_release_partial_not_enough():
+    session, sched, allocated = make({"a": 2, "b": 1}, deps={"b": "a"})
+    sched.schedule()
+    session.init_task("a")
+    session.on_task_completed("a", 0, 0)
+    assert sched.on_role_instance_completed("a") == []  # a:1 still pending
+    session.init_task("a")
+    session.on_task_completed("a", 1, 0)
+    assert sched.on_role_instance_completed("a") == ["b"]
+
+
+def test_cycle_detected():
+    with pytest.raises(CycleError):
+        make({"a": 1, "b": 1}, deps={"a": "b", "b": "a"})
+
+
+def test_unknown_dependency():
+    with pytest.raises(CycleError):
+        make({"a": 1}, deps={"a": "ghost"})
+
+
+def test_stage_split_implicit_deps():
+    """prepare/training stages add implicit edges (ref: Utils.java:377-403)."""
+    session, sched, allocated = make(
+        {"etl": 1, "worker": 2},
+        stages={
+            "tony.application.prepare-stage": "etl",
+            "tony.application.training-stage": "worker",
+        },
+    )
+    sched.schedule()
+    assert allocated == ["etl"]
+    assert sched.blocked_roles() == {"worker"}
+    complete_role(session, sched, "etl")
+    assert sched.all_scheduled()
+
+
+def test_diamond_dag():
+    session, sched, allocated = make(
+        {"a": 1, "b": 1, "c": 1, "d": 1},
+        deps={"b": "a", "c": "a", "d": "b,c"},
+    )
+    sched.schedule()
+    assert allocated == ["a"]
+    complete_role(session, sched, "a")
+    assert set(allocated) == {"a", "b", "c"}
+    complete_role(session, sched, "b")
+    assert "d" not in allocated
+    complete_role(session, sched, "c")
+    assert "d" in allocated

@@ -1,0 +1,147 @@
+"""Session model tests (ref: tensorflow/TestTonySession.java + policy paths
+exercised by TestTonyE2E chief-kill / non-chief / ps-crash cases)."""
+
+from tony_tpu.config import TonyConf
+from tony_tpu.session import Session, SessionStatus, TaskStatus
+
+
+def make_conf(**roles):
+    conf = TonyConf()
+    for role, n in roles.items():
+        conf.set(f"tony.{role}.instances", n)
+    return conf
+
+
+def test_lazy_allocation_and_ids():
+    s = Session(make_conf(worker=2, ps=1))
+    t0 = s.init_task("worker")
+    t1 = s.init_task("worker")
+    assert (t0.id, t1.id) == ("worker:0", "worker:1")
+    assert s.init_task("worker") is None  # slots exhausted
+    assert s.init_task("nope") is None
+
+
+def test_registration_and_cluster_spec():
+    s = Session(make_conf(worker=2))
+    s.init_task("worker")
+    s.init_task("worker")
+    assert not s.all_registered()
+    s.register("worker:0", "hostA:1111")
+    s.register("worker:1", "hostB:2222")
+    assert s.all_registered()
+    assert s.cluster_spec() == {"worker": ["hostA:1111", "hostB:2222"]}
+
+
+def test_chief_semantics():
+    s = Session(make_conf(chief=1, worker=2))
+    assert s.is_chief("chief", 0)
+    assert not s.is_chief("worker", 0)
+    s2 = Session(make_conf(worker=2, ps=1))
+    assert s2.is_chief("worker", 0)
+    assert not s2.is_chief("worker", 1)
+    s3 = Session(make_conf(head=1, actor=2))
+    assert s3.is_chief("head", 0)
+
+
+def test_chief_failure_short_circuits():
+    s = Session(make_conf(worker=2))
+    s.init_task("worker")
+    s.init_task("worker")
+    s.on_task_completed("worker", 0, 1)
+    assert s.status == SessionStatus.FAILED
+    assert "chief" in s.failure_reason
+
+
+def test_non_chief_failure_tolerated():
+    """Ref: TestTonyE2E testNonChiefWorkerFailureTolerated (:323)."""
+    s = Session(make_conf(worker=2))
+    s.init_task("worker")
+    s.init_task("worker")
+    s.on_task_completed("worker", 1, 1)  # non-chief fails
+    assert s.status == SessionStatus.RUNNING
+    s.on_task_completed("worker", 0, 0)
+    assert s.training_finished()
+    assert s.update_session_status() == SessionStatus.SUCCEEDED
+
+
+def test_untracked_ps_crash_fails_fast():
+    """Ref: TestTonyE2E testPSCrashShouldFailFast (:467)."""
+    conf = make_conf(worker=1, ps=1)
+    s = Session(conf)
+    s.init_task("worker")
+    s.init_task("ps")
+    assert s.is_untracked("ps")
+    s.on_task_completed("ps", 0, 1)
+    assert s.status == SessionStatus.FAILED
+
+
+def test_sidecar_crash_tolerated():
+    """Ref: TestTonyE2E testSidecarCrashTolerated (:499)."""
+    conf = make_conf(worker=1, tensorboard=1)
+    s = Session(conf)
+    s.init_task("worker")
+    s.init_task("tensorboard")
+    s.on_task_completed("tensorboard", 0, 1)
+    assert s.status == SessionStatus.RUNNING
+    s.on_task_completed("worker", 0, 0)
+    assert s.update_session_status() == SessionStatus.SUCCEEDED
+
+
+def test_stop_on_failure_roles():
+    conf = make_conf(worker=2, reader=1)
+    conf.set("tony.application.stop-on-failure.jobtypes", "reader")
+    s = Session(conf)
+    for _ in range(2):
+        s.init_task("worker")
+    s.init_task("reader")
+    s.on_task_completed("reader", 0, 3)
+    assert s.status == SessionStatus.FAILED
+
+
+def test_fail_on_any_worker():
+    conf = make_conf(worker=3)
+    conf.set("tony.application.fail-on-worker-failure-enabled", True)
+    s = Session(conf)
+    for _ in range(3):
+        s.init_task("worker")
+    s.on_task_completed("worker", 2, 1)
+    assert s.status == SessionStatus.FAILED
+
+
+def test_all_tracked_failed_fails():
+    s = Session(make_conf(worker=2))
+    s.init_task("worker")
+    s.init_task("worker")
+    s.on_task_completed("worker", 1, 1)
+    s.tasks["worker"][0].set_exit_status(1)  # chief marked failed w/o policy
+    assert s.update_session_status() == SessionStatus.FAILED
+
+
+def test_zero_instance_chief_role_disables_chief_semantics():
+    """A chief role configured with 0 instances still occupies the role map,
+    so no other task inherits chief status."""
+    s = Session(make_conf(worker=1, chief=0))
+    assert not s.is_chief("worker", 0)
+    s.init_task("worker")
+    s.on_task_completed("worker", 0, 1)  # non-chief failure tolerated
+    assert s.status == SessionStatus.RUNNING
+    assert s.update_session_status() == SessionStatus.FAILED  # but nothing succeeded
+
+
+def test_task_infos_attention_sorted():
+    s = Session(make_conf(worker=2))
+    s.init_task("worker")
+    s.init_task("worker")
+    s.register("worker:0", "h:1")
+    s.on_task_completed("worker", 1, 1)
+    infos = s.task_infos()
+    assert infos[0].status == "FAILED"  # failures sort first
+    assert infos[0].index == 1
+
+
+def test_exit_status_idempotent():
+    s = Session(make_conf(worker=1))
+    t = s.init_task("worker")
+    t.set_exit_status(0)
+    t.set_exit_status(1)  # second completion ignored
+    assert t.status == TaskStatus.FINISHED

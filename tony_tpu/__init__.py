@@ -1,0 +1,21 @@
+"""tony-tpu: a TPU-native cluster-orchestration framework for distributed ML.
+
+Rebuilds the capabilities of LinkedIn's TonY (reference: /root/reference,
+~17.6k LoC Java over YARN) as a TPU-first system:
+
+- Control plane: a coordinator process (ApplicationMaster equivalent,
+  ``tony_tpu.coordinator``) gang-schedules role tasks onto per-host agents
+  (``tony_tpu.agent``), rendezvouses them via injected ``jax.distributed``
+  env, monitors heartbeats/liveness, applies chief/untracked/sidecar
+  exit-status policy, and persists a browsable job history.
+- Data plane: *not* delegated to NCCL/Gloo/MPI like the reference — emitted
+  as XLA collectives over ICI/DCN by jax/pjit (``tony_tpu.parallel``),
+  with pallas kernels for hot ops (``tony_tpu.ops``) and flagship models
+  (``tony_tpu.models``).
+
+Reference layer map: SURVEY.md section 1; component parity: SURVEY.md section 2.
+"""
+
+from tony_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,4 @@
+from tony_tpu.config.config import ConfError, TonyConf, build_conf, role_key
+from tony_tpu.config import keys
+
+__all__ = ["TonyConf", "ConfError", "build_conf", "role_key", "keys"]

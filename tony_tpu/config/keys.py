@@ -1,0 +1,164 @@
+"""Config key schema: every global key, its default, type, and doc line.
+
+Reference: TonyConfigurationKeys.java:13-337 + tony-default.xml (60 keys),
+drift-locked by TestTonyConfigurationFields (SURVEY.md section 4.3). Here the
+schema *is* the default source (no separate XML to drift), and
+tests/test_config.py locks KEYS <-> DEFAULTS bijection plus doc coverage.
+
+Per-role keys are regex-driven (reference: TonyConfigurationKeys.java:189-257):
+``tony.<role>.instances|chips|memory|command|resources|depends-on|...`` —
+see ROLE_KEY_RE / role_key() in config.py. Any role name is legal.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+
+class Key(NamedTuple):
+    default: Any
+    type: type
+    doc: str
+
+
+# ---------------------------------------------------------------------------
+# Global (non-role) keys. Names keep the reference's tony.* namespace with
+# TPU-flavored semantics (chips instead of gpus, coordinator instead of am).
+# ---------------------------------------------------------------------------
+KEYS: dict[str, Key] = {
+    # application
+    "tony.application.name": Key("tony-tpu", str, "Display name of the job"),
+    "tony.application.framework": Key(
+        "jax", str, "Runtime adapter: jax|tensorflow|pytorch|standalone|ray"
+    ),
+    "tony.application.distributed-mode": Key(
+        "GANG", str, "GANG (all tasks rendezvous before start) or FCFS"
+    ),
+    "tony.application.security.enabled": Key(
+        True, bool, "HMAC-authenticate control-plane RPC with a per-job token"
+    ),
+    "tony.application.timeout-ms": Key(
+        0, int, "Whole-job timeout in ms; 0 = unlimited (ref: tony.application.timeout)"
+    ),
+    "tony.application.node-label": Key(
+        "", str, "Placement label for all roles unless overridden per-role"
+    ),
+    "tony.application.prepare-stage": Key(
+        "", str, "Comma list of roles scheduled in the prepare stage (ref: Utils.java:377-403)"
+    ),
+    "tony.application.training-stage": Key(
+        "", str, "Comma list of roles gated on prepare-stage completion"
+    ),
+    "tony.application.untracked.jobtypes": Key(
+        "ps", str, "Comma list of roles whose exit does not gate job completion"
+    ),
+    "tony.application.sidecar.jobtypes": Key(
+        "tensorboard", str, "Untracked helper roles whose failure is tolerated"
+    ),
+    "tony.application.stop-on-failure.jobtypes": Key(
+        "", str, "Roles whose single-task failure fails the whole job immediately"
+    ),
+    "tony.application.fail-on-worker-failure-enabled": Key(
+        False, bool, "If true any tracked task failure fails the job"
+    ),
+    "tony.application.enable-preprocess": Key(
+        False, bool, "Run the chief command inside the coordinator first (ref: doPreprocessingJob)"
+    ),
+    "tony.application.single-node-mode": Key(
+        False, bool, "0-instance mode: the coordinator itself hosts the user process"
+    ),
+    # coordinator (reference: tony.am.*)
+    "tony.coordinator.memory": Key("2g", str, "Coordinator process memory hint"),
+    "tony.coordinator.retry-count": Key(
+        0, int, "Times the coordinator rebuilds the session after failure (ref: tony.am.retry-count)"
+    ),
+    "tony.coordinator.monitor-interval-ms": Key(
+        1000, int, "Coordinator monitor loop cadence (ref AM 5s; faster since no YARN)"
+    ),
+    "tony.coordinator.registration-timeout-ms": Key(
+        900_000, int, "Task allocated but never registered => fail (ref: 15 min)"
+    ),
+    "tony.coordinator.host": Key("127.0.0.1", str, "Bind host for control-plane RPC"),
+    # task / agent
+    "tony.task.heartbeat-interval-ms": Key(
+        1000, int, "Agent->coordinator heartbeat cadence (ref: same default)"
+    ),
+    "tony.task.max-missed-heartbeats": Key(
+        25, int, "Liveness expiry = interval * max(3, this) (ref: same)"
+    ),
+    "tony.task.metrics-interval-ms": Key(
+        5000, int, "Resource-metrics sampling cadence (ref: same)"
+    ),
+    "tony.task.executor.execution-timeout-ms": Key(
+        0, int, "Per-task user-process timeout; 0 = unlimited (ref: same)"
+    ),
+    "tony.task.reuse-port": Key(
+        False, bool, "Reserve rendezvous ports with SO_REUSEPORT across exec (ref: TF_GRPC_REUSE_PORT)"
+    ),
+    # python environment shipped with the job
+    "tony.application.python-venv": Key("", str, "Path to a venv zip shipped to tasks"),
+    "tony.application.python-command": Key(
+        "", str, "Python interpreter override used to build task commands"
+    ),
+    "tony.application.src-dir": Key(
+        "", str, "User source dir zipped + shipped to every task (ref: src_dir)"
+    ),
+    # staging / history
+    "tony.staging-dir": Key(
+        "", str, "Shared staging root; default ~/.tony (ref: HDFS ~/.tony/<uuid>)"
+    ),
+    "tony.history.location": Key(
+        "", str, "History root holding intermediate/ and finished/ (ref: tony.history.location)"
+    ),
+    "tony.history.retention-sec": Key(
+        2_592_000, int, "Purge finished history older than this (ref: 30 days)"
+    ),
+    "tony.history.mover-interval-ms": Key(
+        300_000, int, "History mover/purger cadence (ref: portal 5 min)"
+    ),
+    "tony.keytab.user": Key("", str, "Principal for secure deployments (slot only)"),
+    # portal
+    "tony.portal.port": Key(19885, int, "History portal HTTP port"),
+    # client
+    "tony.client.poll-interval-ms": Key(
+        1000, int, "Client job-status poll cadence (ref: TonyClient 1s)"
+    ),
+    # limits (reference: tony.application.max-total-instances etc.)
+    "tony.application.max-total-instances": Key(
+        -1, int, "Cap on total task instances; -1 = unlimited"
+    ),
+    "tony.application.max-total-chips": Key(
+        -1, int, "Cap on total TPU chips requested; -1 = unlimited"
+    ),
+    # TPU topology (new territory: replaces YARN gpus/vcores resource model)
+    "tony.tpu.topology": Key(
+        "", str, "Requested TPU slice topology, e.g. v5p-32; empty = local devices"
+    ),
+    "tony.tpu.chips-per-host": Key(4, int, "TPU chips per agent host"),
+    # test fault injection via conf (reference: tony.horovod.mode.test etc.)
+    "tony.test.crash-coordinator": Key(
+        False, bool, "Crash the coordinator once after start (ref: TEST_AM_CRASH conf twin)"
+    ),
+}
+
+# Per-role key suffixes (reference: TonyConfigurationKeys.java:189-257)
+ROLE_SUFFIXES: dict[str, Key] = {
+    "instances": Key(0, int, "Number of task instances for the role"),
+    "max-instances": Key(-1, int, "Upper bound on instances; -1 = unlimited"),
+    "chips": Key(0, int, "TPU chips per instance (ref: tony.<role>.gpus)"),
+    "memory": Key("2g", str, "Memory per instance (ref: tony.<role>.memory)"),
+    "vcores": Key(1, int, "CPU cores per instance"),
+    "command": Key("", str, "Role-specific command overriding the global task command"),
+    "resources": Key("", str, "Comma list of path[::localName][#archive] to localize"),
+    "node-label": Key("", str, "Placement label for this role"),
+    "depends-on": Key("", str, "Comma list of roles that must complete first (DAG)"),
+}
+
+MULTI_VALUE_KEYS = frozenset({"tony.application.untracked.jobtypes"})
+"""Keys where repeated --conf occurrences append rather than replace
+(reference: TonyConfigurationKeys.MULTI_VALUE_CONF / TonyClient.java:672-684)."""
+
+
+def defaults() -> dict[str, Any]:
+    """Flat {key: default} map for all global keys."""
+    return {k: v.default for k, v in KEYS.items()}

@@ -1,0 +1,3 @@
+from tony_tpu.scheduler.dag import CycleError, TaskScheduler
+
+__all__ = ["TaskScheduler", "CycleError"]

@@ -1,0 +1,4 @@
+from tony_tpu.session.session import RoleRequest, Session, SessionStatus
+from tony_tpu.session.task import Task, TaskInfo, TaskStatus
+
+__all__ = ["Session", "SessionStatus", "RoleRequest", "Task", "TaskInfo", "TaskStatus"]
