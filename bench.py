@@ -1,0 +1,128 @@
+"""Headline benchmark: ResNet-50 images/sec/chip through the tony-tpu
+trainer vs a hand-rolled native-JAX train step (BASELINE.json north star:
+framework >= 90% of native JAX).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = framework_throughput / native_jax_throughput (1.0 = parity;
+>= 0.9 meets the north star; > 1.0 beats it).
+
+On TPU runs ResNet-50 at a production batch; off-TPU (CI boxes) it shrinks
+to ResNet-18 / tiny batch so the line still prints quickly.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _platform() -> str:
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "cpu"
+
+
+def make_model(on_tpu: bool):
+    from tony_tpu.models import ResNet18, ResNet50
+
+    if on_tpu:
+        return ResNet50(num_classes=1000), 128, 224
+    return ResNet18(num_classes=100, num_filters=16), 8, 32
+
+
+def bench_fn(fn, args, steps: int, warmup: int = 2) -> float:
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    start = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return time.perf_counter() - start
+
+
+def main() -> None:
+    on_tpu = _platform() == "tpu"
+    steps = 20 if on_tpu else 3
+    model, batch, size = make_model(on_tpu)
+    rng = jax.random.PRNGKey(0)
+    images = jnp.ones((batch, size, size, 3), jnp.float32)
+    labels = jnp.zeros((batch,), jnp.int32)
+    variables = model.init(rng, images, train=False)
+    params, batch_stats = variables["params"], variables.get("batch_stats", {})
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    # ---- native JAX step (the baseline): plain jit, hand-rolled update ----
+    opt_state = tx.init(params)
+
+    def native_loss(p, bs, x, y):
+        logits, new_model_state = model.apply(
+            {"params": p, "batch_stats": bs}, x, train=True,
+            mutable=["batch_stats"])
+        onehot = jax.nn.one_hot(y, logits.shape[-1])
+        loss = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+        return loss, new_model_state["batch_stats"]
+
+    @jax.jit
+    def native_step(p, bs, o, x, y):
+        (loss, new_bs), grads = jax.value_and_grad(native_loss, has_aux=True)(
+            p, bs, x, y)
+        updates, o = tx.update(grads, o, p)
+        p = optax.apply_updates(p, updates)
+        return p, new_bs, o, loss
+
+    t_native = bench_fn(
+        lambda: native_step(params, batch_stats, opt_state, images, labels),
+        (), steps)
+    native_ips = batch * steps / t_native
+
+    # ---- framework step: tony_tpu Trainer over a mesh ---------------------
+    from tony_tpu.parallel import data_parallel_mesh
+    from tony_tpu.train import Trainer
+
+    mesh = data_parallel_mesh()
+
+    def apply_fn(state_params, train_batch):
+        x, y, bs = train_batch["x"], train_batch["y"], train_batch["bs"]
+        logits, _ = model.apply({"params": state_params, "batch_stats": bs},
+                                x, train=True, mutable=["batch_stats"])
+        onehot = jax.nn.one_hot(y, logits.shape[-1])
+        return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+    trainer = Trainer(mesh=mesh, apply_fn=apply_fn, optimizer=tx, donate=False)
+    state = trainer.init_state(params)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tony_tpu.parallel.sharding import batch_sharding
+
+    b_sh = batch_sharding(mesh)
+    train_batch = {
+        "x": jax.device_put(images, b_sh),
+        "y": jax.device_put(labels, b_sh),
+        "bs": jax.device_put(batch_stats, NamedSharding(mesh, P())),
+    }
+    step_fn, placed = trainer.build_step(state)
+
+    def fw_once():
+        new_state, metrics = step_fn(placed, train_batch)
+        return metrics["loss"]
+
+    t_fw = bench_fn(fw_once, (), steps)
+    fw_ips = batch * steps / t_fw
+
+    n_chips = max(1, jax.device_count())
+    print(json.dumps({
+        "metric": "resnet_images_per_sec_per_chip"
+                  + ("" if on_tpu else "_cpu_proxy"),
+        "value": round(fw_ips / n_chips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(fw_ips / native_ips, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+// tony_proxy: threaded TCP byte-pump proxy (native implementation).
+//
+// Reference behavior: tony-proxy ProxyServer.java:21-91 — accept on a local
+// gateway port, dial the cluster host, pump bytes both ways, one thread per
+// direction. Used by the notebook submitter to tunnel Jupyter/TensorBoard
+// from outside the TPU-VM network. Prints "LISTENING <port>" on stdout once
+// bound so the Python wrapper (tony_tpu/proxy/proxy.py) can pick up an
+// ephemeral port.
+//
+// Usage: tony_proxy <local_port|0> <remote_host> <remote_port>
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+
+namespace {
+
+void pump(int src, int dst) {
+  char buf[65536];
+  for (;;) {
+    ssize_t n = ::recv(src, buf, sizeof(buf), 0);
+    if (n <= 0) break;
+    ssize_t off = 0;
+    while (off < n) {
+      ssize_t w = ::send(dst, buf + off, n - off, 0);
+      if (w <= 0) { ::shutdown(src, SHUT_RDWR); goto done; }
+      off += w;
+    }
+  }
+done:
+  ::shutdown(dst, SHUT_RDWR);
+  ::close(src);
+}
+
+int dial(const char* host, int port) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  if (::getaddrinfo(host, port_s.c_str(), &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  return fd;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    std::fprintf(stderr, "usage: %s <local_port|0> <remote_host> <remote_port>\n",
+                 argv[0]);
+    return 2;
+  }
+  int local_port = std::atoi(argv[1]);
+  const char* remote_host = argv[2];
+  int remote_port = std::atoi(argv[3]);
+
+  int srv = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(local_port));
+  if (::bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(srv, 16) != 0) {
+    std::perror("bind/listen");
+    return 1;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(srv, reinterpret_cast<sockaddr*>(&addr), &len);
+  std::printf("LISTENING %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+
+  for (;;) {
+    int client = ::accept(srv, nullptr, nullptr);
+    if (client < 0) continue;
+    ::setsockopt(client, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    int upstream = dial(remote_host, remote_port);
+    if (upstream < 0) {
+      ::close(client);
+      continue;
+    }
+    ::setsockopt(upstream, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(pump, client, upstream).detach();
+    std::thread(pump, upstream, client).detach();
+  }
+}

@@ -8,9 +8,20 @@ separately dry-runs __graft_entry__.dryrun_multichip).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set, not setdefault: the session env carries JAX_PLATFORMS=axon (the
+# TPU tunnel) and a sitecustomize hook that re-registers it via
+# jax.config.update("jax_platforms", "axon,cpu") at interpreter startup —
+# the env var alone cannot win. Tests must never dial the TPU relay:
+# (1) fix the config in this process, (2) drop the sitecustomize trigger
+# env so subprocesses (agents, payload scripts) skip registration entirely.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,24 @@
+"""Assert the jax runtime rendezvous env (TPU-native TF_CONFIG analog)."""
+import json
+import os
+import sys
+
+addr = os.environ.get("TONY_JAX_COORDINATOR")
+pid = os.environ.get("TONY_PROCESS_ID")
+num = os.environ.get("TONY_NUM_PROCESSES")
+if not addr or pid is None or num is None:
+    print("missing jax env")
+    sys.exit(1)
+spec = json.loads(os.environ["CLUSTER_SPEC"])
+total = sum(len(v) for v in spec.values())
+if int(num) != total:
+    print("bad num_processes", num, total)
+    sys.exit(2)
+if not (0 <= int(pid) < total):
+    print("bad process_id", pid)
+    sys.exit(3)
+host, _, port = addr.rpartition(":")
+if not host or not port.isdigit():
+    print("bad coordinator addr", addr)
+    sys.exit(4)
+sys.exit(0)

@@ -1,0 +1,11 @@
+"""Assert DDP env (ref: exit_0_check_pytorchenv.py)."""
+import os
+import sys
+
+for k in ("RANK", "WORLD", "INIT_METHOD", "MASTER_ADDR", "MASTER_PORT"):
+    if k not in os.environ:
+        print("missing", k)
+        sys.exit(1)
+if not os.environ["INIT_METHOD"].startswith("tcp://"):
+    sys.exit(2)
+sys.exit(0)

@@ -1,0 +1,4 @@
+"""Trivial success payload (ref: tony-core test/resources/scripts/exit_0.py)."""
+import sys
+
+sys.exit(0)

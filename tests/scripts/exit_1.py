@@ -1,0 +1,4 @@
+"""Trivial failure payload (ref: exit_1.py)."""
+import sys
+
+sys.exit(1)

@@ -1,0 +1,5 @@
+"""Long-running payload for kill/liveness tests (ref: sleep_30.py, shortened
+for a 1-cpu test box)."""
+import time
+
+time.sleep(5)

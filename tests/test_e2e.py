@@ -1,0 +1,228 @@
+"""End-to-end tests: real client -> coordinator -> agent subprocesses on
+localhost, payload scripts as assertions.
+
+Reference: TestTonyE2E.java (679 LoC, 27 cases) over MiniCluster. Each test
+submits a real job; the job's final status IS the assertion.
+"""
+
+import os
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.mini import MiniTonyCluster, script_conf
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "scripts")
+
+
+def script(name: str) -> str:
+    return os.path.join(SCRIPTS, name)
+
+
+@pytest.fixture
+def cluster():
+    with MiniTonyCluster() as c:
+        yield c
+
+
+def run_job(cluster, conf):
+    client = cluster.make_client(conf)
+    ok = client.run()
+    return ok, client
+
+
+# -- happy paths -------------------------------------------------------------
+
+
+def test_single_worker_pass(cluster):
+    """Ref: testSingleNodeTrainingShouldPass."""
+    ok, client = run_job(cluster, script_conf(cluster, script("exit_0.py"),
+                                              {"worker": 1}))
+    assert ok, client.final_status
+    assert client.final_status["status"] == "SUCCEEDED"
+
+
+def test_single_worker_fail(cluster):
+    """Ref: testSingleNodeTrainingShouldFail."""
+    ok, client = run_job(cluster, script_conf(cluster, script("exit_1.py"),
+                                              {"worker": 1}))
+    assert not ok
+    assert client.final_status["status"] == "FAILED"
+
+
+def test_gang_env_contract(cluster):
+    """2 workers check the full injected env (ref: testPSWorkerTraining +
+    exit_0_check_env payloads)."""
+    ok, client = run_job(cluster, script_conf(cluster, script("check_env.py"),
+                                              {"worker": 2}))
+    assert ok, client.final_status
+
+
+def test_jax_rendezvous_env(cluster):
+    """The TPU-native TF_CONFIG analog reaches tasks correctly."""
+    ok, client = run_job(cluster, script_conf(cluster, script("check_jax_env.py"),
+                                              {"worker": 2}))
+    assert ok, client.final_status
+
+
+def test_pytorch_runtime_env(cluster):
+    """Ref: testPyTorchEnv (:195)."""
+    ok, client = run_job(
+        cluster,
+        script_conf(cluster, script("check_pytorch_env.py"), {"worker": 2},
+                    framework="pytorch"),
+    )
+    assert ok, client.final_status
+
+
+def test_tb_port_only_on_chief(cluster):
+    """Ref: testTBPortSetOnlyOnChief (:359)."""
+    ok, client = run_job(
+        cluster,
+        script_conf(cluster, script("check_tb_port_set_in_chief_only.py"),
+                    {"worker": 2}),
+    )
+    assert ok, client.final_status
+
+
+def test_standalone_runtime(cluster):
+    """Ref: testStandaloneRuntimePass (:375)."""
+    ok, client = run_job(
+        cluster,
+        script_conf(cluster, script("exit_0.py"), {"worker": 1},
+                    framework="standalone"),
+    )
+    assert ok, client.final_status
+
+
+# -- failure policy ----------------------------------------------------------
+
+
+def test_chief_failure_fails_job(cluster):
+    """worker:0 (chief) fails -> job fails even though worker:1 passes.
+
+    Payload: chief exits 1, other exits 0, via a role command split."""
+    conf = cluster.base_conf()
+    conf.set("tony.chief.instances", 1)
+    conf.set("tony.worker.instances", 1)
+    conf.set("tony.chief.command", f"python {script('exit_1.py')}")
+    conf.set("tony.worker.command", f"python {script('exit_0.py')}")
+    ok, client = run_job(cluster, conf)
+    assert not ok
+    assert "chief" in (client.final_status.get("reason") or "")
+
+
+def test_non_chief_failure_tolerated(cluster):
+    """Ref: testNonChiefWorkerFailureTolerated (:323)."""
+    conf = cluster.base_conf()
+    conf.set("tony.chief.instances", 1)
+    conf.set("tony.failing.instances", 1)
+    conf.set("tony.chief.command", f"python {script('exit_0.py')}")
+    conf.set("tony.failing.command", f"python {script('exit_1.py')}")
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+
+
+def test_untracked_failure_fails_fast(cluster):
+    """Ref: testPSCrashShouldFailFast (:467) — untracked 'ps' crash."""
+    conf = cluster.base_conf()
+    conf.set("tony.worker.instances", 1)
+    conf.set("tony.ps.instances", 1)
+    conf.set("tony.worker.command", f"python {script('sleep_5.py')}")
+    conf.set("tony.ps.command", f"python {script('exit_1.py')}")
+    ok, client = run_job(cluster, conf)
+    assert not ok
+
+
+def test_sidecar_failure_tolerated(cluster):
+    """Ref: testSidecarCrashTolerated (:499)."""
+    conf = cluster.base_conf()
+    conf.set("tony.worker.instances", 1)
+    conf.set("tony.tensorboard.instances", 1)
+    conf.set("tony.worker.command", f"python {script('exit_0.py')}")
+    conf.set("tony.tensorboard.command", f"python {script('exit_1.py')}")
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+
+
+# -- DAG scheduling ----------------------------------------------------------
+
+
+def test_role_dag_scheduling(cluster):
+    """Ref: testJobTypeDAGScheduling (:271): prep must complete before
+    worker starts; worker checks a file prep wrote."""
+    marker = os.path.join(cluster.root, "prep_done")
+    conf = cluster.base_conf()
+    conf.set("tony.prep.instances", 1)
+    conf.set("tony.worker.instances", 1)
+    conf.set("tony.worker.depends-on", "prep")
+    conf.set("tony.prep.command", f"touch {marker}")
+    conf.set("tony.worker.command", f"test -f {marker}")
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+
+
+# -- fault injection ---------------------------------------------------------
+
+
+def test_missed_heartbeats_fail_job(cluster, monkeypatch):
+    """Ref: testMissedHeartbeats (:143)."""
+    monkeypatch.setenv(C.TEST_TASK_NUM_HB_MISS, "1000")
+    conf = script_conf(cluster, script("sleep_5.py"), {"worker": 1})
+    conf.set("tony.task.max-missed-heartbeats", 3)
+    ok, client = run_job(cluster, conf)
+    assert not ok
+    assert "heartbeat" in (client.final_status.get("reason") or "")
+
+
+def test_worker_skew(cluster, monkeypatch):
+    """Ref: testTaskExecutorSkew (:162) — one straggler still succeeds."""
+    monkeypatch.setenv(C.TEST_TASK_SKEW, "worker#1#1500")
+    ok, client = run_job(cluster, script_conf(cluster, script("check_env.py"),
+                                              {"worker": 2}))
+    assert ok, client.final_status
+
+
+def test_chief_kill_mid_run(cluster, monkeypatch):
+    """Ref: testChiefWorkerKilled (:298) via TEST_WORKER_TERMINATION."""
+    monkeypatch.setenv(C.TEST_WORKER_TERMINATION, "1")
+    ok, client = run_job(cluster, script_conf(cluster, script("sleep_5.py"),
+                                              {"worker": 2}))
+    assert not ok
+
+
+def test_coordinator_exception_retry(cluster, monkeypatch):
+    """Ref: testAMCrashShouldRetry-style (:241-256): first attempt throws,
+    retry succeeds."""
+    monkeypatch.setenv(C.TEST_COORD_THROW, "1")
+    conf = script_conf(cluster, script("exit_0.py"), {"worker": 1})
+    conf.set("tony.coordinator.retry-count", 1)
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+
+
+def test_coordinator_exception_no_retry_fails(cluster, monkeypatch):
+    monkeypatch.setenv(C.TEST_COORD_THROW, "1")
+    conf = script_conf(cluster, script("exit_0.py"), {"worker": 1})
+    ok, client = run_job(cluster, conf)
+    assert not ok
+
+
+# -- history -----------------------------------------------------------------
+
+
+def test_history_written(cluster):
+    from tony_tpu.events import history
+
+    conf = script_conf(cluster, script("exit_0.py"), {"worker": 1})
+    ok, client = run_job(cluster, conf)
+    assert ok
+    jobs = history.list_jobs(os.path.join(cluster.root, "history"))
+    assert len(jobs) == 1
+    assert jobs[0]["status"] == "SUCCEEDED"
+    events = history.parse_events(jobs[0]["jhist"])
+    types = [e.type.value for e in events]
+    assert types[0] == "APPLICATION_INITED"
+    assert "TASK_STARTED" in types
+    assert "TASK_FINISHED" in types
+    assert types[-1] == "APPLICATION_FINISHED"

@@ -1,0 +1,115 @@
+"""Event/history pipeline tests (ref: TestEventHandler, TestParserUtils,
+TestHistoryFileUtils, portal mover/purger behavior)."""
+
+import os
+import time
+
+from tony_tpu.events import (
+    EventHandler,
+    EventType,
+    application_finished,
+    application_inited,
+    task_finished,
+    task_started,
+)
+from tony_tpu.events import history
+from tony_tpu.events.mover import move_finished_jobs, purge_old_history
+
+
+def test_handler_writes_and_renames(tmp_path):
+    root = str(tmp_path)
+    h = EventHandler(root, "application_abc123", user="alice").start()
+    h.emit(application_inited("application_abc123", 2, "host0"))
+    h.emit(task_started("worker", 0, "host0"))
+    h.emit(task_finished("worker", 0, "FINISHED", {"rss": 1.0}))
+    h.emit(application_finished("application_abc123", "SUCCEEDED", 0))
+    final = h.stop("SUCCEEDED")
+    assert os.path.exists(final)
+    assert "SUCCEEDED" in os.path.basename(final)
+    events = history.parse_events(final)
+    assert [e.type for e in events] == [
+        EventType.APPLICATION_INITED,
+        EventType.TASK_STARTED,
+        EventType.TASK_FINISHED,
+        EventType.APPLICATION_FINISHED,
+    ]
+    assert events[2].payload["metrics"] == {"rss": 1.0}
+    meta = history.parse_metadata(os.path.dirname(final))
+    assert meta.user == "alice"
+    assert meta.status == "SUCCEEDED"
+    assert meta.completed > 0
+
+
+def test_emit_after_stop_is_noop(tmp_path):
+    h = EventHandler(str(tmp_path), "application_x1").start()
+    final = h.stop("FAILED")
+    h.emit(task_started("w", 0, "h"))  # must not raise or write
+    assert history.parse_events(final) == []
+
+
+def test_history_name_codec():
+    name = history.finished_name("application_1_2", 100, 200, "bob", "FAILED")
+    parsed = history.parse_history_name(name)
+    assert parsed == {
+        "app_id": "application_1_2",
+        "started": 100,
+        "completed": 200,
+        "user": "bob",
+        "status": "FAILED",
+        "inprogress": False,
+    }
+    ip = history.inprogress_name("application_9", 55)
+    p2 = history.parse_history_name(ip)
+    assert p2["inprogress"] and p2["started"] == 55
+    assert history.parse_history_name("garbage.txt") is None
+    assert history.is_valid_history_name(name)
+    assert not history.is_valid_history_name("application_1-abc.jhist.jsonl")
+
+
+def test_list_jobs_and_mover(tmp_path):
+    root = str(tmp_path)
+    # one finished job still in intermediate/, one running
+    h1 = EventHandler(root, "application_done")
+    h1.start()
+    h1.emit(task_started("w", 0, "h"))
+    h1.stop("SUCCEEDED")
+    h2 = EventHandler(root, "application_running").start()
+    h2.emit(task_started("w", 0, "h"))
+    time.sleep(0.05)
+
+    jobs = history.list_jobs(root)
+    assert {j["app_id"] for j in jobs} == {"application_done", "application_running"}
+
+    moved = move_finished_jobs(root, stale_after_s=3600)
+    assert len(moved) == 1 and "finished" in moved[0]
+    # running job untouched; finished job discoverable in finished tree
+    jobs = history.list_jobs(root)
+    byid = {j["app_id"]: j for j in jobs}
+    assert byid["application_done"]["status"] == "SUCCEEDED"
+    assert "finished" in byid["application_done"]["dir"]
+    assert byid["application_running"]["inprogress"]
+    h2.stop("FAILED")
+
+
+def test_mover_finalizes_stale_inprogress(tmp_path):
+    root = str(tmp_path)
+    h = EventHandler(root, "application_dead").start()
+    h.emit(task_started("w", 0, "h"))
+    time.sleep(0.1)
+    # simulate a killed coordinator: inprogress file goes stale
+    moved = move_finished_jobs(root, stale_after_s=0.01)
+    assert len(moved) == 1
+    jobs = history.list_jobs(root)
+    assert jobs[0]["status"] == "KILLED"
+
+
+def test_purger(tmp_path):
+    root = str(tmp_path)
+    h = EventHandler(root, "application_old")
+    h.start()
+    h.stop("SUCCEEDED")
+    move_finished_jobs(root, stale_after_s=3600)
+    assert purge_old_history(root, retention_sec=10**9) == []
+    purged = purge_old_history(root, retention_sec=-10)
+    assert len(purged) == 1
+    assert history.list_jobs(root) == []

@@ -1,0 +1,63 @@
+"""Pallas kernel tests (interpreter mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.ops import add_rmsnorm, flash_attention, rmsnorm
+from tony_tpu.parallel import reference_attention
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_matches_reference(causal):
+    key = jax.random.PRNGKey(0)
+    b, l, h, d = 2, 128, 2, 32
+    q, k, v = (jax.random.normal(kk, (b, l, h, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    out = flash_attention(q, k, v, causal, 64, 64)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_flash_attention_grad():
+    key = jax.random.PRNGKey(1)
+    b, l, h, d = 1, 64, 2, 16
+    q, k, v = (jax.random.normal(kk, (b, l, h, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+
+    g_flash = jax.grad(lambda q, k, v: flash_attention(q, k, v, True, 32, 32)
+                       .sum())(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: reference_attention(q, k, v, causal=True)
+                     .sum())(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_ref),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_flash_attention_bad_block():
+    q = jnp.zeros((1, 100, 2, 16))
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention(q, q, q, True, 64, 64)
+
+
+def test_rmsnorm_matches():
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 32, 64))
+    scale = jax.random.normal(jax.random.PRNGKey(3), (64,)) + 1.0
+    out = rmsnorm(x, scale)
+    x32 = x.astype(jnp.float32)
+    ref = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + 1e-6) * scale
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5,
+                               rtol=1e-5)
+
+
+def test_add_rmsnorm():
+    x = jax.random.normal(jax.random.PRNGKey(4), (8, 32))
+    r = jax.random.normal(jax.random.PRNGKey(5), (8, 32))
+    scale = jnp.ones((32,))
+    normed, summed = add_rmsnorm(x, r, scale)
+    np.testing.assert_allclose(np.asarray(summed), np.asarray(x + r), atol=1e-6)
+    s = (x + r).astype(jnp.float32)
+    ref = s * jax.lax.rsqrt(jnp.mean(s * s, -1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(np.asarray(normed), np.asarray(ref), atol=1e-5,
+                               rtol=1e-5)

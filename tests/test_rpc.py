@@ -1,0 +1,135 @@
+"""RPC layer tests: framing, auth, dispatch, reconnect, concurrency."""
+
+import threading
+
+import pytest
+
+from tony_tpu.rpc import RpcClient, RpcError, RpcServer
+from tony_tpu.rpc import wire
+
+
+class Handler:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counter = 0
+
+    def echo(self, value):
+        return value
+
+    def add(self, a, b):
+        return a + b
+
+    def boom(self):
+        raise ValueError("kaput")
+
+    def bump(self):
+        with self.lock:
+            self.counter += 1
+            return self.counter
+
+    def _private(self):
+        return "secret"
+
+
+@pytest.fixture
+def server():
+    s = RpcServer(Handler(), secret="tok").start()
+    yield s
+    s.stop()
+
+
+def test_basic_call(server):
+    c = RpcClient(server.host, server.port, secret="tok")
+    assert c.call("echo", value={"a": [1, 2]}) == {"a": [1, 2]}
+    assert c.call("add", a=2, b=3) == 5
+    c.close()
+
+
+def test_handler_exception_returns_error(server):
+    c = RpcClient(server.host, server.port, secret="tok")
+    with pytest.raises(RpcError, match="kaput"):
+        c.call("boom")
+    # connection still usable afterwards
+    assert c.call("add", a=1, b=1) == 2
+    c.close()
+
+
+def test_unknown_and_private_methods(server):
+    c = RpcClient(server.host, server.port, secret="tok")
+    with pytest.raises(RpcError, match="unknown method"):
+        c.call("nope")
+    with pytest.raises(RpcError, match="unknown method"):
+        c.call("_private")
+    c.close()
+
+
+def test_bad_token_rejected(server):
+    c = RpcClient(server.host, server.port, secret="WRONG")
+    with pytest.raises(RpcError, match="authentication failed"):
+        c.call("add", a=1, b=2)
+    c.close()
+
+
+def test_missing_token_rejected(server):
+    c = RpcClient(server.host, server.port, secret=None)
+    with pytest.raises(RpcError, match="authentication failed"):
+        c.call("add", a=1, b=2)
+    c.close()
+
+
+def test_no_auth_server():
+    s = RpcServer(Handler()).start()
+    try:
+        c = RpcClient(s.host, s.port)
+        assert c.call("add", a=1, b=1) == 2
+        c.close()
+    finally:
+        s.stop()
+
+
+def test_concurrent_clients(server):
+    results = []
+
+    def work():
+        c = RpcClient(server.host, server.port, secret="tok")
+        for _ in range(10):
+            results.append(c.call("bump"))
+        c.close()
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(results) == list(range(1, 41))
+
+
+def test_reconnect_after_server_restart():
+    handler = Handler()
+    s = RpcServer(handler, secret="tok").start()
+    c = RpcClient(s.host, s.port, secret="tok")
+    assert c.call("add", a=1, b=1) == 2
+    port = s.port
+    s.stop()
+    s2 = RpcServer(handler, port=port, secret="tok").start()
+    try:
+        assert c.call("add", a=2, b=2, retries=5) == 4
+    finally:
+        c.close()
+        s2.stop()
+
+
+def test_sign_verify_tamper():
+    sig = wire.sign("sec", "m", {"a": 1})
+    assert wire.verify("sec", "m", {"a": 1}, sig)
+    assert not wire.verify("sec", "m", {"a": 2}, sig)  # tampered params
+    assert not wire.verify("sec", "m2", {"a": 1}, sig)  # tampered method
+    assert not wire.verify("other", "m", {"a": 1}, sig)
+
+
+def test_poll_till_non_null():
+    vals = iter([None, None, "ready"])
+    c = RpcClient("localhost", 1)
+    assert c.poll_till_non_null(lambda: next(vals), interval_s=0.01) == "ready"
+    with pytest.raises(TimeoutError):
+        c.poll_till_non_null(lambda: None, interval_s=0.01, timeout_s=0.05)

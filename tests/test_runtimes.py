@@ -1,0 +1,198 @@
+"""Runtime adapter tests.
+
+Reference analogs: TestMLGenericRuntime (TB-port policy), TestHorovodRuntime
+(cluster spec/env), TestUtils TF_CONFIG construction, runtime validations.
+"""
+
+import json
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.config import ConfError, TonyConf
+from tony_tpu.runtime import TaskContext, get_am_adapter, get_task_adapter, get_runtime
+from tony_tpu.runtime.jax_runtime import coordinator_address
+from tony_tpu.runtime.tf_runtime import construct_tf_config
+from tony_tpu.session import Session
+
+
+def ctx_for(framework="jax", role="worker", index=0, spec=None, conf=None, **kw):
+    conf = conf or TonyConf()
+    spec = spec or {"worker": ["h0:1000", "h1:1001"]}
+    return TaskContext(
+        conf=conf,
+        role=role,
+        index=index,
+        task_num=len(spec.get(role, [])),
+        is_chief=(role in ("chief", "worker") and index == 0),
+        cluster_spec=spec,
+        command="true",
+        **kw,
+    )
+
+
+# -- jax ---------------------------------------------------------------------
+
+
+def test_jax_env_injection():
+    env = get_task_adapter("jax").build_task_env(ctx_for(index=1))
+    assert env[C.COORDINATOR_ADDRESS] == "h0:1000"
+    assert env[C.PROCESS_ID] == "1"
+    assert env[C.NUM_PROCESSES] == "2"
+    assert json.loads(env[C.CLUSTER_SPEC]) == {"worker": ["h0:1000", "h1:1001"]}
+    assert env[C.JOB_NAME] == "worker"
+    assert env[C.IS_CHIEF] == "false"
+
+
+def test_jax_flat_index_across_roles():
+    spec = {"ps": ["p0:1"], "worker": ["w0:2", "w1:3"]}
+    env = get_task_adapter("jax").build_task_env(ctx_for(role="worker", index=1, spec=spec))
+    assert env[C.PROCESS_ID] == "2"  # ps:0 -> 0, worker:0 -> 1, worker:1 -> 2
+    assert env[C.NUM_PROCESSES] == "3"
+
+
+def test_jax_coordinator_prefers_chief():
+    assert coordinator_address({"ps": ["p:1"], "chief": ["c:9"], "worker": ["w:2"]}) == "c:9"
+    assert coordinator_address({"ps": ["p:1"], "worker": ["w:2"]}) == "w:2"
+    assert coordinator_address({"head": ["h:3"]}) == "h:3"
+    with pytest.raises(ValueError):
+        coordinator_address({})
+
+
+def test_jax_requires_gang():
+    conf = TonyConf()
+    conf.set("tony.application.distributed-mode", "FCFS")
+    with pytest.raises(ConfError):
+        get_am_adapter("jax").validate_and_update_config(conf)
+
+
+# -- tensorflow --------------------------------------------------------------
+
+
+def test_tf_config_strips_tensorboard_and_evaluator():
+    spec = {
+        "worker": ["w0:1", "w1:2"],
+        "ps": ["p0:3"],
+        "tensorboard": ["t:4"],
+        "evaluator": ["e:5"],
+    }
+    cfg = json.loads(construct_tf_config(spec, "worker", 1))
+    assert "tensorboard" not in cfg["cluster"]
+    assert "evaluator" not in cfg["cluster"]
+    assert cfg["task"] == {"type": "worker", "index": 1}
+    # evaluator keeps itself in its own spec
+    cfg_e = json.loads(construct_tf_config(spec, "evaluator", 0))
+    assert "evaluator" in cfg_e["cluster"]
+
+
+def test_tf_env_gang_only():
+    conf = TonyConf()
+    env = get_task_adapter("tensorflow").build_task_env(ctx_for("tensorflow", conf=conf))
+    assert C.TF_CONFIG in env
+    conf.set("tony.application.distributed-mode", "FCFS")
+    env = get_task_adapter("tensorflow").build_task_env(ctx_for("tensorflow", conf=conf))
+    assert C.TF_CONFIG not in env
+
+
+# -- pytorch -----------------------------------------------------------------
+
+
+def test_pytorch_env():
+    env = get_task_adapter("pytorch").build_task_env(ctx_for("pytorch", index=1))
+    assert env[C.PT_INIT_METHOD] == "tcp://h0:1000"
+    assert env["MASTER_ADDR"] == "h0"
+    assert env["MASTER_PORT"] == "1000"
+    assert env[C.PT_RANK] == "1"
+    assert env[C.PT_WORLD] == "2"
+    assert env["WORLD_SIZE"] == "2"
+
+
+# -- mxnet -------------------------------------------------------------------
+
+
+def test_mxnet_env():
+    spec = {
+        "scheduler": ["127.0.0.1:5000"],
+        "server": ["s0:1", "s1:2"],
+        "worker": ["w0:3"],
+    }
+    env = get_task_adapter("mxnet").build_task_env(ctx_for("mxnet", role="server",
+                                                           index=1, spec=spec))
+    assert env[C.MX_DMLC_PS_ROOT_URI] == "127.0.0.1"
+    assert env[C.MX_DMLC_PS_ROOT_PORT] == "5000"
+    assert env[C.MX_DMLC_ROLE] == "server"
+    assert env[C.MX_DMLC_NUM_SERVER] == "2"
+    assert env[C.MX_DMLC_NUM_WORKER] == "1"
+    assert env[C.MX_DMLC_LOCAL] == "0"
+
+
+def test_mxnet_single_scheduler():
+    conf = TonyConf()
+    conf.set("tony.scheduler.instances", 2)
+    with pytest.raises(ConfError):
+        get_am_adapter("mxnet").validate_and_update_config(conf)
+
+
+# -- standalone / ray --------------------------------------------------------
+
+
+def test_standalone_single_instance_only():
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 2)
+    with pytest.raises(ConfError):
+        get_am_adapter("standalone").validate_and_update_config(conf)
+    conf.set("tony.worker.instances", 1)
+    get_am_adapter("standalone").validate_and_update_config(conf)
+
+
+def test_ray_env_and_validation():
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 2)
+    with pytest.raises(ConfError):
+        get_am_adapter("ray").validate_and_update_config(conf)
+    conf.set("tony.head.instances", 1)
+    get_am_adapter("ray").validate_and_update_config(conf)
+    spec = {"head": ["hd:6379"], "worker": ["w0:1", "w1:2"]}
+    env = get_task_adapter("ray").build_task_env(ctx_for("ray", spec=spec))
+    assert env["RAY_HEAD_ADDRESS"] == "hd:6379"
+    assert env["RAY_HEAD_PORT"] == "6379"
+
+
+# -- gating + TB port policy -------------------------------------------------
+
+
+def test_gang_gating():
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 2)
+    session = Session(conf)
+    session.add_expected(2)
+    am = get_am_adapter("jax")
+    am.set_session(session)
+    session.init_task("worker")
+    session.init_task("worker")
+    session.register("worker:0", "h0:1")
+    assert not am.can_start_task(C.GANG, "worker:0")
+    assert am.can_start_task(C.FCFS, "worker:0")
+    session.register("worker:1", "h1:2")
+    assert am.can_start_task(C.GANG, "worker:0")
+    spec = json.loads(am.construct_cluster_spec("worker:0"))
+    assert spec == {"worker": ["h0:1", "h1:2"]}
+
+
+def test_tb_port_policy():
+    """Ref: MLGenericRuntime.needReserveTBPort :161-178 + E2E tests :359."""
+    adapter = get_task_adapter("jax")
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 1)
+    # no tensorboard role: chief reserves
+    assert adapter.need_reserve_tb_port("worker", True, conf)
+    assert not adapter.need_reserve_tb_port("worker", False, conf)
+    # sidecar tensorboard role present: chief does NOT reserve, tb executor does
+    conf.set("tony.tensorboard.instances", 1)
+    assert not adapter.need_reserve_tb_port("worker", True, conf)
+    assert adapter.need_reserve_tb_port("tensorboard", False, conf)
+
+
+def test_unknown_framework():
+    with pytest.raises(ValueError, match="unknown framework"):
+        get_runtime("caffe")

@@ -84,6 +84,40 @@ def test_stage_split_implicit_deps():
     assert sched.all_scheduled()
 
 
+def test_stage_autofill_training_when_only_prepare_set():
+    """Ref: Utils.ensureStagedTasksIntegrity — one stage set auto-fills the
+    other with the remaining roles."""
+    session, sched, allocated = make(
+        {"etl": 1, "worker": 2},
+        stages={"tony.application.prepare-stage": "etl"},
+    )
+    sched.schedule()
+    assert allocated == ["etl"]
+    complete_role(session, sched, "etl")
+    assert "worker" in allocated
+
+
+def test_stage_untracked_roles_do_not_gate_training():
+    """Untracked prepare roles (long-running ps) must not block training
+    (ref: Utils.java:380 excludes untrackedJobTypes)."""
+    session, sched, allocated = make(
+        {"etl": 1, "ps": 1, "worker": 1},
+        stages={
+            "tony.application.prepare-stage": "etl,ps",
+            "tony.application.training-stage": "worker",
+        },
+    )
+    sched.schedule()
+    assert set(allocated) == {"etl", "ps"}
+    complete_role(session, sched, "etl")  # ps never completes
+    assert "worker" in allocated
+
+
+def test_stage_unknown_role_rejected():
+    with pytest.raises(CycleError, match="unknown roles"):
+        make({"worker": 1}, stages={"tony.application.prepare-stage": "et1"})
+
+
 def test_diamond_dag():
     session, sched, allocated = make(
         {"a": 1, "b": 1, "c": 1, "d": 1},

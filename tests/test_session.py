@@ -23,6 +23,7 @@ def test_lazy_allocation_and_ids():
 
 def test_registration_and_cluster_spec():
     s = Session(make_conf(worker=2))
+    s.add_expected(2)
     s.init_task("worker")
     s.init_task("worker")
     assert not s.all_registered()
@@ -137,6 +138,25 @@ def test_task_infos_attention_sorted():
     infos = s.task_infos()
     assert infos[0].status == "FAILED"  # failures sort first
     assert infos[0].index == 1
+
+
+def test_late_registration_after_completion_ignored():
+    s = Session(make_conf(worker=1))
+    s.init_task("worker")
+    s.register("worker:0", "h:1")
+    s.on_task_completed("worker", 0, 0)
+    assert s.register("worker:0", "h2:2") is None
+    assert s.tasks["worker"][0].status == TaskStatus.FINISHED
+
+
+def test_malformed_registrations_rejected():
+    s = Session(make_conf(worker=1))
+    s.init_task("worker")
+    assert s.register("worker:0", "hostA:-5") is None  # negative port
+    assert s.register("worker:0", "hostA") is None  # no port
+    assert s.register("worker:-1", "h:1") is None  # negative index
+    assert not s.tasks["worker"][0].registered
+    assert s.get_task("worker", -1) is None
 
 
 def test_exit_status_idempotent():

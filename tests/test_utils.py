@@ -1,0 +1,109 @@
+"""Utils tests (ref: TestUtils.java zip/shell/resource parsing,
+TestLocalizableResource, TestPortAllocation)."""
+
+import os
+import socket
+
+from tony_tpu.utils import (
+    LocalizableResource,
+    execute_shell,
+    parse_resources,
+    python_interpreter,
+    reserve_port,
+    unzip,
+    zip_dir,
+)
+
+
+def test_execute_shell_env_and_exit(tmp_path):
+    log = tmp_path / "out.log"
+    code = execute_shell('test "$FOO" = bar', env={"FOO": "bar"}, log_path=str(log))
+    assert code == 0
+    assert execute_shell("exit 3") == 3
+
+
+def test_execute_shell_timeout_kills_tree(tmp_path):
+    code = execute_shell("sleep 30", timeout_ms=200)
+    assert code == 124
+
+
+def test_execute_shell_logs_output(tmp_path):
+    log = tmp_path / "o.log"
+    execute_shell("echo hello; echo err >&2", log_path=str(log))
+    text = log.read_text()
+    assert "hello" in text and "err" in text
+
+
+def test_zip_roundtrip(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.py").write_text("A")
+    (src / "sub" / "b.py").write_text("B")
+    z = zip_dir(str(src), str(tmp_path / "src.zip"))
+    out = unzip(z, str(tmp_path / "out"))
+    assert (tmp_path / "out" / "a.py").read_text() == "A"
+    assert (tmp_path / "out" / "sub" / "b.py").read_text() == "B"
+
+
+def test_localizable_resource_parsing():
+    r = LocalizableResource.parse("/data/file.txt")
+    assert (r.source, r.local_name, r.is_archive) == ("/data/file.txt", "file.txt", False)
+    r = LocalizableResource.parse("/data/file.txt::renamed.txt")
+    assert r.local_name == "renamed.txt"
+    r = LocalizableResource.parse("/data/stuff.zip#archive")
+    assert r.is_archive and r.local_name == "stuff.zip"
+    assert len(parse_resources("/a,/b::c, /d#archive")) == 3
+
+
+def test_localize_file_dir_archive(tmp_path):
+    f = tmp_path / "x.txt"
+    f.write_text("x")
+    dest = tmp_path / "dest"
+    LocalizableResource.parse(str(f)).localize(str(dest))
+    assert (dest / "x.txt").read_text() == "x"
+    d = tmp_path / "adir"
+    d.mkdir()
+    (d / "in.txt").write_text("y")
+    LocalizableResource.parse(str(d)).localize(str(dest))
+    assert (dest / "adir" / "in.txt").read_text() == "y"
+    z = zip_dir(str(d), str(tmp_path / "z.zip"))
+    LocalizableResource.parse(f"{z}#archive").localize(str(dest))
+    assert (dest / "z.zip" / "in.txt").read_text() == "y"
+
+
+def test_reserve_port_and_release():
+    p = reserve_port()
+    assert p.port > 0
+    # bound while held
+    s = socket.socket()
+    try:
+        s.bind(("", p.port))
+        bound = True
+    except OSError:
+        bound = False
+    finally:
+        s.close()
+    assert not bound
+    p.release()
+    s = socket.socket()
+    s.bind(("", p.port))  # rebindable after release
+    s.close()
+
+
+def test_reusable_port_allows_concurrent_bind():
+    """SO_REUSEPORT mode: user process can bind while the reservation is
+    held (ref: TestPortAllocation SO_REUSEPORT contention)."""
+    p = reserve_port(reuse=True)
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    s.bind(("", p.port))
+    s.close()
+    p.release()
+
+
+def test_python_interpreter_fallback(tmp_path):
+    assert python_interpreter(None)
+    venv = tmp_path / "venv" / "bin"
+    venv.mkdir(parents=True)
+    (venv / "python").write_text("")
+    assert python_interpreter(str(tmp_path / "venv")).endswith("venv/bin/python")

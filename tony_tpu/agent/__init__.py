@@ -1,0 +1,3 @@
+from tony_tpu.agent.executor import Heartbeater, TaskAgent
+
+__all__ = ["Heartbeater", "TaskAgent"]

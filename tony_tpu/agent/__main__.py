@@ -1,0 +1,3 @@
+from tony_tpu.agent.executor import main
+
+raise SystemExit(main())

@@ -1,0 +1,213 @@
+"""Per-task agent process — TaskExecutor equivalent.
+
+Reference: TaskExecutor.java (452 LoC): reads identity env, connects the
+control-plane + metrics RPC proxies, reserves rendezvous/TensorBoard ports,
+registers its worker spec and polls until the runtime's gate opens, runs a
+heartbeater thread (with fault-injected miss support) and the metrics
+sampler, releases ports, delegates to the runtime task adapter to exec the
+user process, and registers the exit code back to the coordinator.
+
+Process entry: ``python -m tony_tpu.agent`` with env injected by the
+coordinator's launcher (ref: TaskExecutor.main :189 / initConfigs :240-281).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+from tony_tpu import constants as C
+from tony_tpu.config import TonyConf
+from tony_tpu.metrics import TaskMetricsMonitor
+from tony_tpu.rpc import RpcClient
+from tony_tpu.runtime import TaskContext, get_task_adapter
+from tony_tpu.utils import local_host_name, reserve_port
+
+log = logging.getLogger(__name__)
+
+
+class Heartbeater(threading.Thread):
+    """Ref: inner Heartbeater (TaskExecutor.java:322-362): pings every
+    interval, tolerates 5 consecutive send failures, supports the
+    TEST_TONY_NUM_HB_MISS injection that skips N pings."""
+
+    MAX_SEND_FAILURES = 5
+
+    def __init__(self, client: RpcClient, task_id: str, interval_ms: int):
+        super().__init__(name="heartbeater", daemon=True)
+        self.client = client
+        self.task_id = task_id
+        self.interval_s = max(interval_ms, 50) / 1000
+        self.misses_to_skip = int(os.environ.get(C.TEST_TASK_NUM_HB_MISS, "0"))
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        failures = 0
+        while not self._stop.wait(self.interval_s):
+            if self.misses_to_skip > 0:
+                self.misses_to_skip -= 1
+                log.info("skipping heartbeat (fault injection, %d left)",
+                         self.misses_to_skip)
+                continue
+            try:
+                self.client.call("task_executor_heartbeat", retries=0,
+                                 task_id=self.task_id)
+                failures = 0
+            except Exception:
+                failures += 1
+                log.warning("heartbeat send failure %d/%d", failures,
+                            self.MAX_SEND_FAILURES)
+                if failures >= self.MAX_SEND_FAILURES:
+                    log.error("too many heartbeat failures; giving up")
+                    return
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class TaskAgent:
+    def __init__(self, env: dict[str, str] | None = None):
+        e = env or os.environ
+        self.role = e[C.JOB_NAME]
+        self.index = int(e[C.TASK_INDEX])
+        self.task_num = int(e.get(C.TASK_NUM, "1"))
+        self.is_chief = e.get(C.IS_CHIEF, "false") == "true"
+        self.app_id = e.get(C.JOB_ID, "")
+        self.session_id = int(e.get(C.SESSION_ID, "0"))
+        self.mode = e.get(C.DISTRIBUTED_MODE, C.GANG)
+        self.coord_host = e[C.COORDINATOR_HOST]
+        self.coord_port = int(e[C.COORDINATOR_PORT])
+        self.metrics_port = int(e.get(C.METRICS_PORT, "0"))
+        self.secret = e.get(C.JOB_TOKEN) or None
+        self.command = e.get("TONY_TASK_COMMAND", "")
+        self.job_dir = e.get("TONY_JOB_DIR", ".")
+        conf_path = e.get("TONY_CONF_PATH", "")
+        self.conf = TonyConf.from_final(conf_path) if conf_path and \
+            os.path.exists(conf_path) else TonyConf()
+        self.task_id = f"{self.role}:{self.index}"
+        self.client = RpcClient(self.coord_host, self.coord_port, secret=self.secret)
+        self.metrics_client = RpcClient(self.coord_host, self.metrics_port,
+                                        secret=self.secret) if self.metrics_port else None
+        self.adapter = get_task_adapter(str(self.conf.get("tony.application.framework")))
+        self._user_pid: int | None = None
+
+    # -- fault injection (ref: skewAndHangIfTesting :364-384) ---------------
+    def _skew_if_testing(self) -> None:
+        spec = os.environ.get(C.TEST_TASK_SKEW, "")
+        if not spec:
+            return
+        try:
+            role, idx, ms = spec.split("#")
+            if role == self.role and int(idx) == self.index:
+                log.info("skew injection: sleeping %s ms", ms)
+                time.sleep(int(ms) / 1000)
+        except ValueError:
+            log.warning("bad skew spec %r", spec)
+
+    # -- main flow ----------------------------------------------------------
+    def run(self) -> int:
+        """Ref: TaskExecutor.main :189-237."""
+        self._skew_if_testing()
+        reuse = self.conf.get_bool("tony.task.reuse-port", False)
+        rdzv = None
+        tb = None
+        if self.adapter.need_reserve_rdzv_port(self.role, self.conf):
+            rdzv = reserve_port(reuse=reuse)
+        if self.adapter.need_reserve_tb_port(self.role, self.is_chief, self.conf):
+            tb = reserve_port(reuse=reuse)
+
+        hb = Heartbeater(
+            self.client, self.task_id,
+            self.conf.get_int("tony.task.heartbeat-interval-ms", 1000))
+        hb.start()
+        monitor = None
+        if self.metrics_client is not None:
+            monitor = TaskMetricsMonitor(
+                lambda: self._user_pid or os.getpid(),
+                lambda m: self.metrics_client.call(
+                    "update_metrics", retries=0, task_id=self.task_id, metrics=m),
+                self.conf.get_int("tony.task.metrics-interval-ms", 5000),
+            ).start()
+
+        host = local_host_name()
+        port = rdzv.port if rdzv else 0
+        spec_str = f"{host}:{port}"
+        log.info("registering %s at %s", self.task_id, spec_str)
+        cluster_spec_json = self.client.poll_till_non_null(
+            lambda: self.client.call("register_worker_spec",
+                                     task_id=self.task_id, spec=spec_str),
+            interval_s=0.3,
+        )
+        cluster_spec = json.loads(cluster_spec_json)
+        log.info("gang ready; cluster spec: %s", cluster_spec)
+
+        # release before exec so the user process can bind (ref:
+        # TaskExecutor.java:202-215; SO_REUSEPORT mode skips the release)
+        if rdzv and not reuse:
+            rdzv.release()
+        if tb and not reuse:
+            tb.release()
+
+        ctx = TaskContext(
+            conf=self.conf,
+            role=self.role,
+            index=self.index,
+            task_num=self.task_num,
+            is_chief=self.is_chief,
+            cluster_spec=cluster_spec,
+            command=self.command,
+            app_id=self.app_id,
+            session_id=self.session_id,
+            rdzv_port=port,
+            tb_port=tb.port if tb else -1,
+            log_path=os.path.join(self.job_dir, "logs",
+                                  f"{self.role}-{self.index}-user{C.LOG_SUFFIX}"),
+            workdir=self.job_dir,
+            extra_env={
+                C.JOB_ID: self.app_id,
+                C.SESSION_ID: str(self.session_id),
+                C.DISTRIBUTED_MODE: self.mode,
+                C.ATTEMPT_NUMBER: os.environ.get(C.ATTEMPT_NUMBER, "0"),
+            },
+        )
+        try:
+            exit_code = self.adapter.run(ctx)
+        except Exception:
+            log.exception("task adapter run failed")
+            exit_code = C.EXIT_FAIL
+        finally:
+            if monitor:
+                monitor.stop()
+            hb.stop()
+            if rdzv:
+                rdzv.release()
+            if tb:
+                tb.release()
+
+        try:
+            self.client.call("register_execution_result",
+                             task_id=self.task_id, exit_code=exit_code)
+        except Exception:
+            # coordinator's launcher exit-watch is the backup path
+            log.exception("failed to register execution result")
+        self.client.close()
+        if self.metrics_client:
+            self.metrics_client.close()
+        return exit_code
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    agent = TaskAgent()
+    code = agent.run()
+    log.info("agent for %s exiting with %d", agent.task_id, code)
+    return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

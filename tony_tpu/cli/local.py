@@ -1,0 +1,38 @@
+"""``tony-tpu local`` — LocalSubmitter equivalent.
+
+Reference: tony-cli LocalSubmitter.java: boots a MiniCluster, runs a job
+against it with security off, tears down. Here: isolated temp staging +
+fast timings + CPU jax, then a normal submission.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from tony_tpu import constants as C
+from tony_tpu.cli.submit import build_parser, conf_from_args
+from tony_tpu.client import TonyClient
+from tony_tpu.mini import MiniTonyCluster
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    parser = build_parser()
+    parser.prog = "tony-tpu local"
+    args = parser.parse_args(argv)
+    with MiniTonyCluster() as mini:
+        conf = conf_from_args(args)
+        base = mini.base_conf()
+        for key in ("tony.staging-dir", "tony.history.location",
+                    "tony.task.heartbeat-interval-ms",
+                    "tony.coordinator.monitor-interval-ms",
+                    "tony.client.poll-interval-ms"):
+            conf.set(key, base.get(key))
+        conf.set("tony.application.security.enabled", False)
+        ok = TonyClient(conf).run()
+    return C.EXIT_SUCCESS if ok else C.EXIT_FAIL
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,67 @@
+"""``tony-tpu notebook`` — NotebookSubmitter equivalent.
+
+Reference: tony-cli NotebookSubmitter.java:46-152: submits a single-task
+app hosting e.g. Jupyter, watches task infos to discover the notebook's
+host, and starts a local TCP proxy tunneling a gateway port to it; 24 h
+default timeout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+
+from tony_tpu import constants as C
+from tony_tpu.client import TonyClient
+from tony_tpu.config import build_conf
+from tony_tpu.proxy import ProxyServer
+
+log = logging.getLogger(__name__)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tony-tpu notebook")
+    parser.add_argument("--executes", required=True,
+                        help="notebook command, e.g. 'jupyter lab --port $TB_PORT'")
+    parser.add_argument("--conf", action="append", default=[])
+    parser.add_argument("--conf_file")
+    parser.add_argument("--port", type=int, default=0,
+                        help="local gateway port (0 = ephemeral)")
+    parser.add_argument("--timeout_hours", type=float, default=24.0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    conf = build_conf(args.conf_file, args.conf)
+    conf.set("tony.application.executes", args.executes)
+    conf.set("tony.application.framework", "standalone")
+    conf.set(f"tony.{C.NOTEBOOK_JOB_NAME}.instances", 1)
+    conf.set("tony.application.untracked.jobtypes", "")
+    conf.set("tony.application.timeout-ms", int(args.timeout_hours * 3600 * 1000))
+
+    client = TonyClient(conf)
+    proxy_holder: dict = {}
+
+    def on_update(infos):
+        """Discover the notebook host and start the proxy (ref:
+        NotebookSubmitter proxy wiring :112-133)."""
+        if proxy_holder:
+            return
+        for info in infos:
+            if info.name == C.NOTEBOOK_JOB_NAME and info.status == "RUNNING" and info.host:
+                proxy = ProxyServer(info.host, 8888, local_port=args.port).start()
+                proxy_holder["proxy"] = proxy
+                print(f"notebook tunnel ready: http://localhost:{proxy.local_port}")
+
+    client.add_listener(on_update)
+    ok = False
+    try:
+        ok = client.run()
+    finally:
+        if "proxy" in proxy_holder:
+            proxy_holder["proxy"].stop()
+    return C.EXIT_SUCCESS if ok else C.EXIT_FAIL
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,3 @@
+from tony_tpu.client.client import TaskUpdateListener, TonyClient
+
+__all__ = ["TonyClient", "TaskUpdateListener"]

@@ -1,0 +1,237 @@
+"""Job-submission client — TonyClient equivalent.
+
+Reference: TonyClient.java (1417 LoC): merges config layers, stages the
+user's src dir / venv / resources into the job dir, writes tony-final.json,
+launches the coordinator (YARN AM submission becomes a subprocess or remote
+exec), polls application status + task infos on a 1 s cadence, streams
+status tables to the console and listeners, and signals the coordinator to
+finish (ref: monitorApplication :1031-1099, signalAMToFinish :1101-1111).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import secrets as pysecrets
+import shutil
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable
+
+from tony_tpu import constants as C
+from tony_tpu.config import TonyConf
+from tony_tpu.rpc import RpcClient
+from tony_tpu.runtime import get_am_adapter
+from tony_tpu.session import TaskInfo
+from tony_tpu.utils import (
+    app_staging_dir,
+    new_app_id,
+    parse_resources,
+    staging_root,
+    unzip,
+    zip_dir,
+)
+
+log = logging.getLogger(__name__)
+
+TaskUpdateListener = Callable[[list[TaskInfo]], None]
+"""Ref: client/TaskUpdateListener.java:11."""
+
+
+class TonyClient:
+    def __init__(self, conf: TonyConf):
+        self.conf = conf
+        self.app_id: str = ""
+        self.job_dir: str = ""
+        self.secret: str | None = None
+        self.listeners: list[TaskUpdateListener] = []
+        self.coordinator_proc: subprocess.Popen | None = None
+        self.rpc: RpcClient | None = None
+        self.final_status: dict | None = None
+        self.tensorboard_url = ""
+
+    def add_listener(self, listener: TaskUpdateListener) -> None:
+        self.listeners.append(listener)
+
+    # ------------------------------------------------------------ submission
+    def init(self) -> None:
+        """Validate conf + runtime preflight (ref: TonyClient.init :442 /
+        validateTonyConf :788)."""
+        self.conf.validate()
+        framework = str(self.conf.get("tony.application.framework"))
+        get_am_adapter(framework).validate_and_update_config(self.conf)
+
+    def stage(self) -> str:
+        """Create the job dir and localize src/venv/resources into it
+        (ref: processFinalTonyConf :229-310 + processTonyConfResources
+        :701-780 — HDFS upload becomes shared-filesystem copy)."""
+        self.app_id = new_app_id()
+        root = staging_root(str(self.conf.get("tony.staging-dir", "")))
+        self.job_dir = app_staging_dir(root, self.app_id)
+        src_dir = str(self.conf.get("tony.application.src-dir", ""))
+        if src_dir:
+            z = zip_dir(src_dir, os.path.join(self.job_dir, C.TONY_SRC_ZIP))
+            unzip(z, self.job_dir)  # agents exec with cwd=job_dir
+        venv = str(self.conf.get("tony.application.python-venv", ""))
+        if venv:
+            if venv.endswith(".zip"):
+                unzip(venv, os.path.join(self.job_dir, "venv"))
+            else:
+                shutil.copytree(venv, os.path.join(self.job_dir, "venv"),
+                                dirs_exist_ok=True)
+        for role in self.conf.roles():
+            spec = str(self.conf.role_get(role, "resources"))
+            for res in parse_resources(spec):
+                res.localize(self.job_dir)
+        if self.conf.get_bool("tony.application.security.enabled"):
+            self.secret = pysecrets.token_hex(32)
+        self.conf.write_final(os.path.join(self.job_dir, C.TONY_FINAL_CONF))
+        return self.job_dir
+
+    def start_coordinator(self) -> None:
+        """Launch the coordinator process (ref: submitApplication :314-349 +
+        buildCommand :900-919 — the AM container spec becomes a subprocess)."""
+        env = dict(os.environ)
+        if self.secret:
+            env[C.JOB_TOKEN] = self.secret
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        log_path = os.path.join(self.job_dir, "logs", "coordinator.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        out = open(log_path, "ab", buffering=0)
+        try:
+            self.coordinator_proc = subprocess.Popen(
+                [sys.executable, "-m", "tony_tpu.coordinator",
+                 "--conf", os.path.join(self.job_dir, C.TONY_FINAL_CONF),
+                 "--app-id", self.app_id,
+                 "--job-dir", self.job_dir],
+                env=env,
+                stdout=out,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        finally:
+            out.close()
+        log.info("coordinator launched for %s (pid %d)", self.app_id,
+                 self.coordinator_proc.pid)
+
+    # ------------------------------------------------------------ monitoring
+    def _connect_rpc(self, timeout_s: float = 60) -> RpcClient:
+        """Poll for coordinator.json then connect (ref: initRpcClientAndLog-
+        AMUrl :1208-1229 — RPC port appears in the application report)."""
+        path = os.path.join(self.job_dir, "coordinator.json")
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                with open(path) as f:
+                    info = json.load(f)
+                return RpcClient(info["host"], info["port"], secret=self.secret)
+            if self.coordinator_proc and self.coordinator_proc.poll() is not None:
+                raise RuntimeError(
+                    f"coordinator exited ({self.coordinator_proc.returncode}) "
+                    f"before serving RPC; see {self.job_dir}/logs/coordinator.log")
+            time.sleep(0.2)
+        raise TimeoutError("coordinator endpoint never appeared")
+
+    def monitor(self) -> bool:
+        """Poll status until terminal (ref: monitorApplication :1031-1099).
+        Returns True on SUCCEEDED."""
+        self.rpc = self._connect_rpc()
+        interval = self.conf.get_int("tony.client.poll-interval-ms", 1000) / 1000
+        last_rendered = ""
+        status: dict = {"status": "RUNNING"}
+        while True:
+            try:
+                status = self.rpc.call("get_application_status")
+                infos = [TaskInfo.from_dict(d) for d in self.rpc.call("get_task_infos")]
+            except (ConnectionError, TimeoutError):
+                if self.coordinator_proc and self.coordinator_proc.poll() is not None:
+                    status = self._status_from_file() or {
+                        "status": "FAILED",
+                        "reason": "coordinator process died",
+                    }
+                    break
+                time.sleep(interval)
+                continue
+            rendered = self._render_tasks(infos)
+            if rendered != last_rendered:
+                print(rendered)
+                last_rendered = rendered
+            for listener in self.listeners:
+                try:
+                    listener(infos)
+                except Exception:
+                    log.exception("task update listener failed")
+            if status.get("tensorboard_url"):
+                self.tensorboard_url = status["tensorboard_url"]
+            if status["status"] != "RUNNING":
+                break
+            time.sleep(interval)
+        self.final_status = status
+        self._signal_finish()
+        ok = status["status"] == "SUCCEEDED"
+        log.info("application %s: %s (%s)", self.app_id, status["status"],
+                 status.get("reason") or "ok")
+        return ok
+
+    def _status_from_file(self) -> dict | None:
+        path = os.path.join(self.job_dir, "status.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                return json.load(f)
+        return None
+
+    def _signal_finish(self) -> None:
+        """Ref: signalAMToFinish :1101-1111."""
+        if self.rpc is None:
+            return
+        try:
+            self.rpc.call("finish_application", retries=0)
+        except (ConnectionError, TimeoutError, Exception):
+            pass
+        if self.coordinator_proc:
+            try:
+                self.coordinator_proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                log.warning("coordinator slow to exit; killing")
+                self.force_kill()
+        self.rpc.close()
+
+    @staticmethod
+    def _render_tasks(infos: list[TaskInfo]) -> str:
+        """Ref: client status tables TonyClient.java:1123-1183."""
+        if not infos:
+            return "(no tasks scheduled yet)"
+        width = max(len(f"{i.name}:{i.index}") for i in infos)
+        lines = [f"  {f'{i.name}:{i.index}'.ljust(width)}  {i.status:<9} {i.url}"
+                 for i in infos]
+        return "\n".join(["Task status:"] + lines)
+
+    # ---------------------------------------------------------------- control
+    def force_kill(self) -> None:
+        """Ref: forceKillApplication :1268."""
+        if self.rpc is not None:
+            try:
+                self.rpc.call("force_kill", retries=0)
+            except Exception:
+                pass
+        if self.coordinator_proc and self.coordinator_proc.poll() is None:
+            try:
+                os.killpg(self.coordinator_proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                self.coordinator_proc.kill()
+
+    def run(self) -> bool:
+        """Full submission flow (ref: TonyClient.run :195 / start :1290)."""
+        self.init()
+        self.stage()
+        self.start_coordinator()
+        try:
+            return self.monitor()
+        except BaseException:
+            self.force_kill()
+            raise

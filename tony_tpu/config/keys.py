@@ -95,6 +95,13 @@ KEYS: dict[str, Key] = {
     "tony.task.reuse-port": Key(
         False, bool, "Reserve rendezvous ports with SO_REUSEPORT across exec (ref: TF_GRPC_REUSE_PORT)"
     ),
+    # task command construction (ref: TonyClient.buildTaskCommand :618-635)
+    "tony.application.executes": Key(
+        "", str, "User training entrypoint (script or shell command) run by every task"
+    ),
+    "tony.application.task-params": Key(
+        "", str, "Extra CLI args appended to the task entrypoint"
+    ),
     # python environment shipped with the job
     "tony.application.python-venv": Key("", str, "Path to a venv zip shipped to tasks"),
     "tony.application.python-command": Key(

@@ -1,0 +1,16 @@
+from tony_tpu.coordinator.coordinator import ClientRpcHandler, Coordinator
+from tony_tpu.coordinator.launcher import (
+    Launcher,
+    LocalProcessLauncher,
+    SshLauncher,
+)
+from tony_tpu.coordinator.liveness import LivenessMonitor
+
+__all__ = [
+    "ClientRpcHandler",
+    "Coordinator",
+    "Launcher",
+    "LivenessMonitor",
+    "LocalProcessLauncher",
+    "SshLauncher",
+]

@@ -1,0 +1,3 @@
+from tony_tpu.coordinator.coordinator import main
+
+raise SystemExit(main())

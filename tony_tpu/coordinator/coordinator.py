@@ -1,0 +1,455 @@
+"""The coordinator process — ApplicationMaster equivalent.
+
+Reference: ApplicationMaster.java (1347 LoC): registers control-plane RPC +
+metrics RPC servers, builds the session, gang-schedules tasks through the
+DAG scheduler, launches per-task agents, runs a heartbeat liveness monitor
+and a monitor loop (timeout / registration-timeout / startup-failure /
+training-finished / client stop), retries the whole session on failure
+(session epoch++), emits history events, and supports a preprocess /
+single-node mode where the coordinator itself hosts the user process
+(doPreprocessingJob :780-832).
+
+Process entry: ``python -m tony_tpu.coordinator --conf <tony-final.json>
+--app-id <id> --job-dir <dir>``. The client discovers the RPC endpoint via
+``coordinator.json`` written into the job dir (stands in for the YARN
+application report's host:port).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import threading
+import time
+
+from tony_tpu import constants as C
+from tony_tpu.config import ConfError, TonyConf
+from tony_tpu.coordinator.launcher import Launcher, LocalProcessLauncher
+from tony_tpu.coordinator.liveness import LivenessMonitor
+from tony_tpu.events import (
+    EventHandler,
+    application_finished,
+    application_inited,
+    task_finished,
+    task_started,
+)
+from tony_tpu.metrics import MetricsStore
+from tony_tpu.rpc import RpcServer
+from tony_tpu.runtime import get_am_adapter
+from tony_tpu.scheduler import TaskScheduler
+from tony_tpu.session import Session, SessionStatus
+from tony_tpu.utils import execute_shell, local_host_name, python_interpreter
+
+log = logging.getLogger(__name__)
+
+
+class ClientRpcHandler:
+    """The 8 control-plane verbs (ref: inner RpcForClient,
+    ApplicationMaster.java:854-970; proto service
+    tensorflow_cluster_service_protos.proto:11-20)."""
+
+    def __init__(self, coord: "Coordinator"):
+        self._coord = coord
+
+    def get_task_infos(self):
+        return [i.to_dict() for i in self._coord.session.task_infos()]
+
+    def get_cluster_spec(self, task_id: str):
+        return self._coord.cluster_spec_if_ready(task_id)
+
+    def register_worker_spec(self, task_id: str, spec: str):
+        """Ref: registerWorkerSpec :907-926 — returns the cluster spec only
+        once the runtime's gate opens; agents poll until non-null."""
+        return self._coord.register_worker_spec(task_id, spec)
+
+    def register_tensorboard_url(self, url: str):
+        self._coord.tensorboard_url = url
+        log.info("TensorBoard registered at %s", url)
+        return True
+
+    def register_execution_result(self, task_id: str, exit_code: int):
+        return self._coord.register_execution_result(task_id, int(exit_code))
+
+    def finish_application(self):
+        self._coord.client_done.set()
+        return self._coord.application_status()
+
+    def task_executor_heartbeat(self, task_id: str):
+        self._coord.liveness.ping(task_id)
+        return True
+
+    def register_callback_info(self, task_id: str, info: str):
+        self._coord.am_adapter.receive_task_callback_info(task_id, info)
+        return True
+
+    # rebuild extra: no RM exists to serve the application report, so status
+    # is a first-class verb (ref: client polls YarnClient.getApplicationReport)
+    def get_application_status(self):
+        return self._coord.application_status()
+
+    def force_kill(self):
+        log.warning("client requested force kill")
+        self._coord.killed.set()
+        return True
+
+
+class Coordinator:
+    def __init__(self, conf: TonyConf, app_id: str, job_dir: str,
+                 launcher: Launcher | None = None):
+        self.conf = conf
+        self.app_id = app_id
+        self.job_dir = job_dir
+        os.makedirs(job_dir, exist_ok=True)
+        self.secret = os.environ.get(C.JOB_TOKEN) or None
+        if not conf.get_bool("tony.application.security.enabled"):
+            self.secret = None
+        self.framework = str(conf.get("tony.application.framework"))
+        self.mode = str(conf.get("tony.application.distributed-mode"))
+        self.am_adapter = get_am_adapter(self.framework)
+        self.am_adapter.validate_and_update_config(conf)
+        self.session = Session(conf, session_id=0)
+        self.scheduler: TaskScheduler | None = None
+        self.launcher = launcher or LocalProcessLauncher(self._on_task_process_exit,
+                                                         workdir=job_dir)
+        self.metrics = MetricsStore()
+        self.liveness = LivenessMonitor(
+            conf.get_int("tony.task.heartbeat-interval-ms", 1000),
+            conf.get_int("tony.task.max-missed-heartbeats", 25),
+            self._on_task_deemed_dead,
+        )
+        host = str(conf.get("tony.coordinator.host", "127.0.0.1"))
+        self.rpc = RpcServer(ClientRpcHandler(self), host=host, secret=self.secret)
+        self.metrics_rpc = RpcServer(self.metrics, host=host, secret=self.secret)
+        history_root = str(conf.get("tony.history.location") or
+                           os.path.join(job_dir, "history"))
+        self.events = EventHandler(history_root, app_id)
+        self.client_done = threading.Event()
+        self.killed = threading.Event()
+        self.tensorboard_url = ""
+        self.attempt = 0
+        self._launch_time: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._worker_termination_done = False
+
+    # ------------------------------------------------------------------ rpc
+    def cluster_spec_if_ready(self, task_id: str) -> str | None:
+        if self.am_adapter.can_start_task(self.mode, task_id):
+            return self.am_adapter.construct_cluster_spec(task_id)
+        return None
+
+    def register_worker_spec(self, task_id: str, spec: str) -> str | None:
+        task = self.session.register(task_id, spec)
+        if task is None:
+            log.warning("registration for unknown task %s", task_id)
+            return None
+        self.liveness.register(task_id)
+        log.info("registered %s at %s (%d/%d)", task_id, spec,
+                 self.session.num_registered, self.session.total_expected)
+        return self.cluster_spec_if_ready(task_id)
+
+    def register_execution_result(self, task_id: str, exit_code: int) -> bool:
+        log.info("task %s registered exit code %d", task_id, exit_code)
+        self._complete_task(task_id, exit_code)
+        return True
+
+    # ---------------------------------------------------------- completions
+    def _complete_task(self, task_id: str, exit_code: int) -> None:
+        delay = os.environ.get(C.TEST_COMPLETION_DELAY)
+        if delay:  # fault injection (ref: ApplicationMaster.java:1074-1083)
+            time.sleep(int(delay) / 1000)
+        with self._lock:
+            task = self.session.get_task_by_id(task_id)
+            if task is None or task.completed:
+                return
+            # unregister first: a completed task must not expire later
+            # (ref: 3-way race comment, ApplicationMaster.java:928-956)
+            self.liveness.unregister(task_id)
+            was_registered = task.registered
+            self.session.on_task_completed(task.role, task.index, exit_code)
+            self.events.emit(task_finished(
+                task.role, task.index, task.status.name,
+                self.metrics.get_metrics(task_id)))
+            if not was_registered:
+                # completed without ever registering -> startup failure
+                # (ref: startupFailed :1271-1301)
+                self.session.fail(
+                    f"task {task_id} exited ({exit_code}) before registering")
+        if self.scheduler is not None:
+            self.scheduler.on_role_instance_completed(task.role)
+
+    def _on_task_process_exit(self, task_id: str, exit_code: int) -> None:
+        """Launcher backup path (ref: onContainersCompleted ->
+        processFinishedContainer :1234-1268). Idempotent with the RPC result
+        registration."""
+        self._complete_task(task_id, exit_code)
+
+    def _on_task_deemed_dead(self, task_id: str) -> None:
+        """Ref: onTaskDeemedDead :1225-1232 — fail the application."""
+        self.session.fail(f"task {task_id} missed heartbeats; deemed dead")
+        self.launcher.kill_task(task_id)
+
+    # ------------------------------------------------------------ lifecycle
+    def prepare(self) -> None:
+        """Ref: prepare :443-527."""
+        self.rpc.start()
+        self.metrics_rpc.start()
+        self.liveness.start()
+        self.events.start()
+        self._write_endpoint_file()
+        log.info("coordinator for %s listening on %s:%d (metrics %d)",
+                 self.app_id, self.rpc.host, self.rpc.port, self.metrics_rpc.port)
+
+    def _write_endpoint_file(self) -> None:
+        info = {
+            "app_id": self.app_id,
+            "host": self.rpc.host,
+            "port": self.rpc.port,
+            "metrics_port": self.metrics_rpc.port,
+            "pid": os.getpid(),
+        }
+        path = os.path.join(self.job_dir, "coordinator.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(info, f)
+        os.replace(path + ".tmp", path)
+
+    def _start_attempt(self) -> None:
+        """Ref: start() :578-609 — build session, schedule the gang."""
+        if os.environ.get(C.TEST_COORD_THROW) and self.attempt == 0:
+            raise RuntimeError("injected coordinator exception (TEST_COORD_THROW)")
+        if self.conf.get_bool("tony.application.enable-preprocess") or \
+                not self.session.requests:
+            self._run_preprocess()
+            return
+        self.am_adapter.set_session(self.session)
+        self.scheduler = TaskScheduler(self.session, self._allocate_role, self.conf)
+        self.events.emit(application_inited(
+            self.app_id, self.session.total_expected, local_host_name()))
+        self.scheduler.schedule()
+
+    def _allocate_role(self, req) -> None:
+        """Launch every instance of a role (ref: RMCallbackHandler +
+        ContainerLauncher collapsed: no container negotiation on TPU)."""
+        for i in range(req.instances):
+            task = self.session.init_task(req.role, i)
+            if task is None:
+                continue
+            env = self._task_env(req, task)
+            log_path = os.path.join(self.job_dir, "logs",
+                                    f"{task.role}-{task.index}{C.LOG_SUFFIX}")
+            task.log_url = log_path
+            self._launch_time[task.id] = time.monotonic()
+            self.launcher.launch(task, env, log_path)
+            self.events.emit(task_started(task.role, task.index, local_host_name()))
+
+    def _task_env(self, req, task) -> dict[str, str]:
+        """Agent env (ref: ContainerLauncher env :1168-1188)."""
+        retries = self.conf.get_int("tony.coordinator.retry-count", 0)
+        env = {
+            C.JOB_NAME: task.role,
+            C.TASK_INDEX: str(task.index),
+            C.TASK_NUM: str(req.instances),
+            C.IS_CHIEF: "true" if self.session.is_chief(task.role, task.index) else "false",
+            C.JOB_ID: self.app_id,
+            C.SESSION_ID: str(self.session.session_id),
+            C.DISTRIBUTED_MODE: self.mode,
+            C.ATTEMPT_NUMBER: str(self.attempt),
+            C.NUM_AM_RETRIES: str(retries),
+            C.COORDINATOR_HOST: self.rpc.host,
+            C.COORDINATOR_PORT: str(self.rpc.port),
+            C.METRICS_PORT: str(self.metrics_rpc.port),
+            "TONY_CONF_PATH": os.path.join(self.job_dir, C.TONY_FINAL_CONF),
+            "TONY_JOB_DIR": self.job_dir,
+            "TONY_TASK_COMMAND": self._task_command(req),
+        }
+        if self.secret:
+            env[C.JOB_TOKEN] = self.secret
+        return env
+
+    def _task_command(self, req) -> str:
+        """Ref: TonyClient.buildTaskCommand :618-635 — role command override,
+        else venv python + executes + task params."""
+        if req.command:
+            return req.command
+        executes = str(self.conf.get("tony.application.executes", ""))
+        if not executes:
+            return ""
+        params = str(self.conf.get("tony.application.task-params", ""))
+        venv = str(self.conf.get("tony.application.python-command", "")) or \
+            python_interpreter(os.path.join(self.job_dir, "venv"))
+        if executes.endswith(".py"):
+            return f"{venv} {executes} {params}".strip()
+        return f"{executes} {params}".strip()
+
+    def _run_preprocess(self) -> None:
+        """Single-node / preprocess mode: the coordinator hosts the user
+        process itself (ref: doPreprocessingJob :780-832)."""
+        cmd = self._task_command_single()
+        log.info("running preprocess/single-node command: %s", cmd)
+        code = execute_shell(
+            cmd,
+            self.conf.get_int("tony.task.executor.execution-timeout-ms", 0),
+            env={C.JOB_ID: self.app_id, C.JOB_NAME: "coordinator"},
+            log_path=os.path.join(self.job_dir, "logs", "coordinator-task.log"),
+        )
+        if code != 0:
+            self.session.fail(f"preprocess/single-node task exited {code}")
+        else:
+            self.session.status = SessionStatus.SUCCEEDED
+        self._preprocess_ran = True
+
+    def _task_command_single(self) -> str:
+        executes = str(self.conf.get("tony.application.executes", ""))
+        params = str(self.conf.get("tony.application.task-params", ""))
+        if executes.endswith(".py"):
+            return f"{python_interpreter(None)} {executes} {params}".strip()
+        return f"{executes} {params}".strip()
+
+    # --------------------------------------------------------------- monitor
+    def _monitor(self) -> SessionStatus:
+        """Ref: monitor() :634-715."""
+        interval = self.conf.get_int("tony.coordinator.monitor-interval-ms", 1000) / 1000
+        timeout_ms = self.conf.get_int("tony.application.timeout-ms", 0)
+        reg_timeout_s = self.conf.get_int(
+            "tony.coordinator.registration-timeout-ms", 900_000) / 1000
+        start = time.monotonic()
+        while True:
+            if getattr(self, "_preprocess_ran", False):
+                return self.session.status
+            if self.killed.is_set():
+                self.session.fail("killed by client")
+                return self.session.status
+            if timeout_ms and (time.monotonic() - start) * 1000 > timeout_ms:
+                self.session.fail(f"application timed out after {timeout_ms} ms")
+                return self.session.status
+            if self.session.status != SessionStatus.RUNNING:
+                return self.session.status
+            if self.session.training_finished():
+                return self.session.update_session_status()
+            self._check_registration_timeouts(reg_timeout_s)
+            self._maybe_kill_chief_for_test()
+            time.sleep(interval)
+
+    def _check_registration_timeouts(self, reg_timeout_s: float) -> None:
+        """Ref: registrationTimeout :1309-1329."""
+        now = time.monotonic()
+        for task in self.session.all_tasks():
+            if task.registered or task.completed:
+                continue
+            launched = self._launch_time.get(task.id)
+            if launched is not None and now - launched > reg_timeout_s:
+                self.session.fail(
+                    f"task {task.id} failed to register within {reg_timeout_s:.0f}s")
+                return
+
+    def _maybe_kill_chief_for_test(self) -> None:
+        """Fault injection (ref: killChiefWorkerIfTesting :1333-1344)."""
+        if self._worker_termination_done or not os.environ.get(C.TEST_WORKER_TERMINATION):
+            return
+        if not self.session.all_registered():
+            return
+        for task in self.session.all_tasks():
+            if self.session.is_chief(task.role, task.index):
+                log.warning("TEST_WORKER_TERMINATION: killing chief %s", task.id)
+                self.launcher.kill_task(task.id)
+                self._worker_termination_done = True
+                return
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> bool:
+        """Ref: run() :357-435 with the retry loop :382-422."""
+        self.prepare()
+        retries = self.conf.get_int("tony.coordinator.retry-count", 0)
+        status = SessionStatus.FAILED
+        try:
+            for self.attempt in range(retries + 1):
+                try:
+                    self._start_attempt()
+                    if os.environ.get(C.TEST_COORD_CRASH) and self.attempt == 0:
+                        log.error("TEST_COORD_CRASH: hard-exiting coordinator")
+                        os._exit(1)
+                    status = self._monitor()
+                except ConfError:
+                    raise
+                except Exception as e:
+                    log.exception("coordinator attempt %d crashed", self.attempt)
+                    self.session.fail(f"coordinator exception: {e}")
+                    status = SessionStatus.FAILED
+                if status == SessionStatus.SUCCEEDED or self.killed.is_set():
+                    break
+                if self.attempt < retries:
+                    log.warning("attempt %d failed (%s); retrying",
+                                self.attempt, self.session.failure_reason)
+                    self._reset_session()
+            return self._stop(status)
+        finally:
+            self.rpc.stop()
+            self.metrics_rpc.stop()
+            self.liveness.stop()
+
+    def _reset_session(self) -> None:
+        """Ref: reset() :612-628 — stop containers, rebuild session epoch."""
+        self.launcher.stop_all()
+        old_id = self.session.session_id
+        self.session = Session(self.conf, session_id=old_id + 1)
+        self._launch_time.clear()
+        self._worker_termination_done = False
+        self.am_adapter = get_am_adapter(self.framework)
+        self.am_adapter.validate_and_update_config(self.conf)
+
+    def _stop(self, status: SessionStatus) -> bool:
+        """Ref: stop() :735-777 — stop containers, emit final event, wait
+        briefly for the client's finish signal, finalize history."""
+        self.launcher.stop_all()
+        final = "SUCCEEDED" if status == SessionStatus.SUCCEEDED else "FAILED"
+        failed = sum(1 for t in self.session.all_tasks() if t.status.name == "FAILED")
+        self.events.emit(application_finished(self.app_id, final, failed))
+        self._write_status_file(final)
+        self.am_adapter.destroy()
+        self.client_done.wait(timeout=30)
+        self.events.stop(final)
+        log.info("application %s finished: %s (%s)", self.app_id, final,
+                 self.session.failure_reason or "ok")
+        return status == SessionStatus.SUCCEEDED
+
+    def _write_status_file(self, final: str) -> None:
+        path = os.path.join(self.job_dir, "status.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump({
+                "status": final,
+                "reason": self.session.failure_reason,
+                "tensorboard_url": self.tensorboard_url,
+                "tasks": [i.to_dict() for i in self.session.task_infos()],
+            }, f, indent=2)
+        os.replace(path + ".tmp", path)
+
+    def application_status(self) -> dict:
+        status = self.session.status
+        return {
+            "status": status.value,
+            "reason": self.session.failure_reason,
+            "session_id": self.session.session_id,
+            "attempt": self.attempt,
+            "tensorboard_url": self.tensorboard_url,
+        }
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Ref: ApplicationMaster.main :332."""
+    parser = argparse.ArgumentParser(prog="tony-tpu-coordinator")
+    parser.add_argument("--conf", required=True, help="path to tony-final.json")
+    parser.add_argument("--app-id", required=True)
+    parser.add_argument("--job-dir", required=True)
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    conf = TonyConf.from_final(args.conf)
+    coord = Coordinator(conf, args.app_id, args.job_dir)
+    ok = coord.run()
+    return C.EXIT_SUCCESS if ok else C.EXIT_FAIL
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,168 @@
+"""Task launchers: how the coordinator places agent processes on hosts.
+
+Reference split: YARN RM allocates containers (TaskScheduler ->
+amRMClient.addContainerRequest) and the AM's ContainerLauncher starts the
+TaskExecutor on the NM (ApplicationMaster.ContainerLauncher.run :1154-1222).
+On TPU there is no incremental container negotiation — a slice's hosts are
+created *together* (SURVEY.md section 7.9a) — so a Launcher simply places
+one agent process per task instance:
+
+- ``LocalProcessLauncher``: agents as local subprocesses (MiniCluster-style
+  in-process cluster; also the single-TPU-VM mode where every task shares
+  the host and gets a device subset).
+- ``SshLauncher``: agents on remote TPU-VM hosts over ssh, one host per
+  task round-robin (the gcloud `tpu-vm ssh --worker=all` shape).
+
+Launchers also watch for process exit so a task that dies before
+registering its result is still detected (the onContainersCompleted
+backup path, ApplicationMaster.java:1050-1068).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+from typing import Callable
+
+from tony_tpu.session import Task
+
+log = logging.getLogger(__name__)
+
+OnExit = Callable[[str, int], None]  # (task_id, exit_code)
+
+
+class Launcher:
+    def launch(self, task: Task, env: dict[str, str], log_path: str) -> None:
+        raise NotImplementedError
+
+    def stop_all(self) -> None:
+        raise NotImplementedError
+
+    def kill_task(self, task_id: str) -> bool:
+        raise NotImplementedError
+
+
+class LocalProcessLauncher(Launcher):
+    """Spawn ``python -m tony_tpu.agent`` per task on this host."""
+
+    def __init__(self, on_exit: OnExit, workdir: str | None = None):
+        self.on_exit = on_exit
+        self.workdir = workdir
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+        self._stopping = False
+
+    def launch(self, task: Task, env: dict[str, str], log_path: str) -> None:
+        full_env = dict(os.environ)
+        full_env.update(env)
+        os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+        out = open(log_path, "ab", buffering=0)
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "tony_tpu.agent"],
+                env=full_env,
+                cwd=self.workdir,
+                stdout=out,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        finally:
+            out.close()
+        with self._lock:
+            self._procs[task.id] = proc
+        threading.Thread(
+            target=self._wait, args=(task.id, proc), daemon=True,
+            name=f"wait-{task.id}",
+        ).start()
+        log.info("launched %s as pid %d (log: %s)", task.id, proc.pid, log_path)
+
+    def _wait(self, task_id: str, proc: subprocess.Popen) -> None:
+        code = proc.wait()
+        with self._lock:
+            self._procs.pop(task_id, None)
+            if self._stopping:
+                return
+        self.on_exit(task_id, code)
+
+    def kill_task(self, task_id: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(task_id)
+        if proc is None:
+            return False
+        _kill_tree(proc)
+        return True
+
+    def stop_all(self) -> None:
+        with self._lock:
+            self._stopping = True
+            procs = list(self._procs.values())
+        for proc in procs:
+            _kill_tree(proc)
+
+
+def _kill_tree(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        try:
+            proc.kill()
+        except ProcessLookupError:
+            pass
+
+
+class SshLauncher(Launcher):
+    """Place agents on remote hosts over ssh, round-robin per task.
+
+    The remote host needs the same repo importable at ``remote_pythonpath``
+    (TPU-VM images share a disk image, the NFS/GCS-fuse staging dir carries
+    the job files). Exit detection rides the local ssh process's exit code.
+    """
+
+    def __init__(self, hosts: list[str], on_exit: OnExit,
+                 remote_pythonpath: str = "", ssh_opts: list[str] | None = None):
+        if not hosts:
+            raise ValueError("SshLauncher needs at least one host")
+        self.hosts = hosts
+        self.on_exit = on_exit
+        self.remote_pythonpath = remote_pythonpath
+        self.ssh_opts = ssh_opts or ["-o", "StrictHostKeyChecking=no",
+                                     "-o", "BatchMode=yes"]
+        self._next = 0
+        self._local = LocalProcessLauncher(on_exit)
+
+    def launch(self, task: Task, env: dict[str, str], log_path: str) -> None:
+        host = self.hosts[self._next % len(self.hosts)]
+        self._next += 1
+        exports = " ".join(
+            f"export {k}={shlex.quote(str(v))};" for k, v in env.items()
+        )
+        pp = f"export PYTHONPATH={shlex.quote(self.remote_pythonpath)}:$PYTHONPATH;" \
+            if self.remote_pythonpath else ""
+        remote_cmd = f"{exports} {pp} exec python3 -m tony_tpu.agent"
+        os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+        out = open(log_path, "ab", buffering=0)
+        try:
+            proc = subprocess.Popen(
+                ["ssh", *self.ssh_opts, host, remote_cmd],
+                stdout=out,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        finally:
+            out.close()
+        with self._local._lock:
+            self._local._procs[task.id] = proc
+        threading.Thread(target=self._local._wait, args=(task.id, proc),
+                         daemon=True).start()
+        log.info("launched %s on %s via ssh (pid %d)", task.id, host, proc.pid)
+
+    def kill_task(self, task_id: str) -> bool:
+        return self._local.kill_task(task_id)
+
+    def stop_all(self) -> None:
+        self._local.stop_all()

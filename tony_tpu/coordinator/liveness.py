@@ -1,0 +1,72 @@
+"""Heartbeat liveness monitor.
+
+Reference: Hadoop AbstractLivelinessMonitor wired in
+ApplicationMaster.java:202-222 — a task expires after
+``heartbeat-interval * max(3, max-missed-heartbeats)`` without a ping;
+expiry fires ``onTaskDeemedDead`` (:1225-1232) which fails the app.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+log = logging.getLogger(__name__)
+
+
+class LivenessMonitor:
+    def __init__(self, interval_ms: int, max_missed: int,
+                 on_expired: Callable[[str], None]):
+        self.expiry_s = (interval_ms / 1000) * max(3, max_missed)
+        self.check_s = max(interval_ms / 1000, 0.05)
+        self.on_expired = on_expired
+        self._last: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def register(self, task_id: str) -> None:
+        with self._lock:
+            self._last[task_id] = time.monotonic()
+
+    def unregister(self, task_id: str) -> None:
+        """Stop watching a task — called when its result is registered, to
+        close the completion-vs-heartbeat race (ref: ApplicationMaster.java
+        :928-956 three-way race comment)."""
+        with self._lock:
+            self._last.pop(task_id, None)
+
+    def ping(self, task_id: str) -> None:
+        with self._lock:
+            if task_id in self._last:
+                self._last[task_id] = time.monotonic()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.check_s):
+            now = time.monotonic()
+            expired = []
+            with self._lock:
+                for task_id, last in list(self._last.items()):
+                    if now - last > self.expiry_s:
+                        expired.append(task_id)
+                        del self._last[task_id]
+            for task_id in expired:
+                log.error("task %s missed heartbeats for %.1fs; deemed dead",
+                          task_id, self.expiry_s)
+                try:
+                    self.on_expired(task_id)
+                except Exception:
+                    log.exception("on_expired callback failed for %s", task_id)
+
+    def start(self) -> "LivenessMonitor":
+        self._thread = threading.Thread(target=self._loop, name="liveness",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)

@@ -1,0 +1,21 @@
+from tony_tpu.events.event import (
+    Event,
+    EventType,
+    JobMetadata,
+    application_finished,
+    application_inited,
+    task_finished,
+    task_started,
+)
+from tony_tpu.events.handler import EventHandler
+
+__all__ = [
+    "Event",
+    "EventType",
+    "EventHandler",
+    "JobMetadata",
+    "application_finished",
+    "application_inited",
+    "task_finished",
+    "task_started",
+]

@@ -1,0 +1,15 @@
+from tony_tpu.metrics.sampler import (
+    AVG_MEMORY_RSS,
+    MAX_MEMORY_RSS,
+    MetricsStore,
+    TaskMetricsMonitor,
+    process_tree_rss_bytes,
+)
+
+__all__ = [
+    "AVG_MEMORY_RSS",
+    "MAX_MEMORY_RSS",
+    "MetricsStore",
+    "TaskMetricsMonitor",
+    "process_tree_rss_bytes",
+]

@@ -1,0 +1,3 @@
+from tony_tpu.mini.cluster import MiniTonyCluster, script_conf
+
+__all__ = ["MiniTonyCluster", "script_conf"]

@@ -1,0 +1,20 @@
+from tony_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+from tony_tpu.models.transformer import Transformer, TransformerConfig
+
+__all__ = [
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "Transformer",
+    "TransformerConfig",
+]

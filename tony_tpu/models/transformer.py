@@ -1,0 +1,187 @@
+"""GPT-style decoder-only transformer — the long-context flagship.
+
+No reference analog (TonY has no model code); built TPU-first:
+
+- logical-axis param annotations ("embed", "heads", "mlp", "vocab") so the
+  parallel.sharding presets (dp/fsdp/tp/fsdp_tp) apply unchanged
+- attention backend selectable: "reference" (O(L^2)), "blockwise"
+  (chunked online-softmax), "ring" (sequence-parallel over the seq mesh
+  axis), or "pallas" (fused TPU kernel, tony_tpu.ops.attention)
+- bfloat16 activations / float32 params + optimizer, MXU-sized dims
+- optional remat (jax.checkpoint) per block to trade FLOPs for HBM
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import partitioning as nn_partitioning
+
+from tony_tpu.parallel.ring_attention import (
+    blockwise_attention,
+    reference_attention,
+    ring_attention,
+)
+
+param_with_axes = nn_partitioning.param_with_axes
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attention_backend: str = "blockwise"  # reference|blockwise|ring|pallas
+    attention_block_size: int = 512
+    remat: bool = False
+    mesh: Any = None  # required for the ring backend
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _attention(cfg: TransformerConfig, q, k, v):
+    if cfg.attention_backend == "reference":
+        return reference_attention(q, k, v, causal=True)
+    if cfg.attention_backend == "blockwise":
+        return blockwise_attention(q, k, v, block_size=cfg.attention_block_size,
+                                   causal=True)
+    if cfg.attention_backend == "ring":
+        if cfg.mesh is None:
+            raise ValueError("ring attention needs cfg.mesh")
+        return ring_attention(q, k, v, cfg.mesh, causal=True)
+    if cfg.attention_backend == "pallas":
+        from tony_tpu.ops.attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    raise ValueError(f"unknown attention backend {cfg.attention_backend}")
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones_init(), (x.shape[-1],),
+                           jnp.float32)
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True)
+                                   + 1e-6)
+        return (norm * scale).astype(self.dtype)
+
+
+def rotary_embedding(x, positions):
+    """RoPE over head_dim (TPU-friendly: pure elementwise, fuses away)."""
+    d = x.shape[-1]
+    half = d // 2
+    freq = 1.0 / (10_000 ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[:, None].astype(jnp.float32) * freq[None, :]  # [L, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, l, _ = x.shape
+        dense = lambda name, feats, axes: nn.DenseGeneral(  # noqa: E731
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name,
+            kernel_init=nn.initializers.normal(0.02))
+        q = dense("q", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
+        k = dense("k", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
+        v = dense("v", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
+        positions = jnp.arange(l)
+        q = rotary_embedding(q, positions)
+        k = rotary_embedding(k, positions)
+        out = _attention(cfg, q, k, v)
+        out = nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="o",
+            kernel_init=nn.initializers.normal(0.02))(out)
+        return out
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="wi",
+                     kernel_init=nn.initializers.normal(0.02))(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name="wo",
+                        kernel_init=nn.initializers.normal(0.02))(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + Attention(self.cfg, name="attn")(RMSNorm(self.cfg.dtype,
+                                                         name="ln1")(x))
+        x = x + MLP(self.cfg, name="mlp")(RMSNorm(self.cfg.dtype,
+                                                  name="ln2")(x))
+        return x
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        embed = self.param("embedding", nn.initializers.normal(0.02),
+                           (cfg.vocab_size, cfg.d_model), jnp.float32)
+        x = embed[tokens].astype(cfg.dtype)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block)
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"block_{i}")(x)
+        x = RMSNorm(cfg.dtype, name="ln_f")(x)
+        logits = jnp.einsum("bld,vd->blv", x.astype(jnp.float32), embed)
+        return logits
+
+
+def logical_axis_rules_tree(params: Any) -> Any:
+    """Best-effort logical axes for the transformer param tree, consumed by
+    parallel.sharding.tree_shardings. Derived from param path names."""
+
+    def axes_for(path: tuple, x) -> tuple:
+        names = [getattr(p, "key", str(p)) for p in path]
+        leaf_dims = x.ndim
+        joined = "/".join(names)
+        if "embedding" in joined:
+            return ("vocab", "embed")
+        if any(s in joined for s in ("/q/", "/k/", "/v/")) or \
+                joined.endswith(("q/kernel", "k/kernel", "v/kernel")):
+            return ("embed", "heads", "kv")[:leaf_dims]
+        if "/o/" in joined or joined.endswith("o/kernel"):
+            return ("heads", "kv", "embed")[:leaf_dims]
+        if "wi" in joined:
+            return ("embed", "mlp")
+        if "wo" in joined:
+            return ("mlp", "embed")
+        return tuple([None] * leaf_dims)
+
+    return jax.tree_util.tree_map_with_path(axes_for, params)

@@ -1,0 +1,4 @@
+from tony_tpu.ops.attention import flash_attention
+from tony_tpu.ops.fused import add_rmsnorm, rmsnorm
+
+__all__ = ["flash_attention", "rmsnorm", "add_rmsnorm"]

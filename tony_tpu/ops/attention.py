@@ -1,0 +1,169 @@
+"""Fused flash attention as a pallas TPU kernel.
+
+The hot op of the transformer stack (no reference analog — TonY has no
+kernels; this is the TPU-first replacement for what torch users get from
+SDPA/FlashAttention-CUDA). Design per the pallas TPU playbook:
+
+- grid = (batch*heads, q_blocks, kv_blocks); kv is the innermost
+  "arbitrary" (sequential) dimension so VMEM scratch carries the online-
+  softmax running state (m, l) and the fp32 output accumulator across kv
+  steps
+- q/k/v blocks are DMA'd HBM->VMEM by BlockSpec; matmuls hit the MXU in
+  fp32 accumulation; block sizes default to MXU/VPU-friendly 128
+- causal masking prunes fully-masked kv blocks via @pl.when
+
+Falls back to the interpreter off-TPU (tests run it on CPU), and exposes a
+custom_vjp whose backward recomputes attention blockwise (memory-efficient
+remat backward; forward stays fused).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from tony_tpu.parallel.ring_attention import blockwise_attention
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  causal: bool, block_q: int, block_k: int, scale: float):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _body():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            pos_q = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            pos_k = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(pos_q >= pos_k, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[:] = m_new
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        # skip kv blocks strictly above the diagonal
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _run():
+            _body()
+    else:
+        _body()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    if lq % block_q or lk % block_k:
+        raise ValueError(
+            f"seq lens ({lq},{lk}) must divide block sizes ({block_q},{block_k})")
+    scale = d ** -0.5
+    # [B, L, H, D] -> [B*H, L, D]
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    grid = (b * h, lq // block_q, lk // block_k)
+    kernel = functools.partial(_flash_kernel, causal=causal, block_q=block_q,
+                               block_k=block_k, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        scratch_shapes=[
+            pl.pallas_tpu_scratch_vmem((block_q, 1), jnp.float32)
+            if hasattr(pl, "pallas_tpu_scratch_vmem") else _vmem((block_q, 1)),
+            _vmem((block_q, 1)),
+            _vmem((block_q, d)),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+
+
+def _vmem(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+def _compiler_params():
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    except Exception:
+        return None
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Fused attention. q/k/v: [B, L, H, D] -> [B, L, H, D].
+
+    interpret=None auto-selects: compiled on TPU, interpreter elsewhere.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    bq = min(block_q, q.shape[1])
+    bk = min(block_k, k.shape[1])
+    return _flash_forward(q, k, v, causal=causal, block_q=bq, block_k=bk,
+                          interpret=interpret)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    """Remat backward through the blockwise implementation — O(L) memory,
+    numerically identical attention math."""
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(q, k, v, block_size=block_k,
+                                            causal=causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,43 @@
+from tony_tpu.parallel.mesh import (
+    ALL_AXES,
+    DATA,
+    EXPERT,
+    FSDP,
+    PIPE,
+    SEQ,
+    TENSOR,
+    MeshSpec,
+    data_parallel_mesh,
+    make_mesh,
+)
+from tony_tpu.parallel.ring_attention import (
+    blockwise_attention,
+    reference_attention,
+    ring_attention,
+)
+from tony_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from tony_tpu.parallel.moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_layer,
+    moe_logical_axes,
+    top_k_gating,
+)
+from tony_tpu.parallel.sharding import (
+    RULES,
+    batch_sharding,
+    replicated,
+    shard_params_by_size,
+    spec_for,
+    tree_shardings,
+)
+
+__all__ = [
+    "ALL_AXES", "DATA", "EXPERT", "FSDP", "PIPE", "SEQ", "TENSOR",
+    "MeshSpec", "MoEConfig", "RULES",
+    "batch_sharding", "blockwise_attention", "data_parallel_mesh",
+    "init_moe_params", "make_mesh", "moe_layer", "moe_logical_axes",
+    "pipeline_apply", "reference_attention", "replicated", "ring_attention",
+    "shard_params_by_size", "spec_for", "stack_stage_params",
+    "top_k_gating", "tree_shardings",
+]

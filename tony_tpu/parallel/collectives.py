@@ -1,0 +1,43 @@
+"""Thin named-axis collective helpers for shard_map code.
+
+The data plane of the rebuild: where the reference delegated gradient
+exchange to NCCL/Gloo/ps-lite (SURVEY.md section 2.5), here everything is
+an XLA collective over ICI/DCN. These wrappers exist for readability and
+for the cross-slice (DCN) helpers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce_mean(x, axis_name: str):
+    """Gradient averaging for data parallelism (the Horovod-ring analog)."""
+    return lax.pmean(x, axis_name)
+
+
+def all_reduce_sum(x, axis_name: str):
+    return lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def ring_shift(x, axis_name: str, shift: int = 1):
+    """Rotate shards around the axis ring (building block of ring attention
+    and pipeline flow)."""
+    n = lax.psum(1, axis_name)
+    perm = [(j, (j + shift) % n) for j in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def grad_sync_tree(grads, axis_name: str):
+    """pmean every leaf of a gradient pytree."""
+    return jax.tree.map(lambda g: lax.pmean(g, axis_name), grads)

@@ -1,0 +1,93 @@
+"""Device-mesh construction for DP/FSDP/TP/PP/SP/EP parallelism.
+
+New territory relative to the reference (SURVEY.md section 2.4: TonY has no
+tensor/pipeline/sequence parallelism — it only orchestrates processes).
+Here parallelism is expressed the TPU way: a named ``jax.sharding.Mesh``
+over the slice, PartitionSpec annotations, and XLA-inserted collectives
+riding ICI (scaling-book recipe: pick a mesh, annotate, let XLA insert
+collectives).
+
+Canonical axis names used across the framework:
+
+  data    - data parallelism (batch sharding; gradient psum)
+  fsdp    - fully-sharded data parallelism (param/optimizer sharding)
+  tensor  - tensor/model parallelism (head & mlp sharding)
+  pipe    - pipeline stages
+  seq     - sequence/context parallelism (ring attention)
+  expert  - expert parallelism (MoE all-to-all)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA, FSDP, TENSOR, PIPE, SEQ, EXPERT = "data", "fsdp", "tensor", "pipe", "seq", "expert"
+ALL_AXES = (DATA, FSDP, TENSOR, PIPE, SEQ, EXPERT)
+
+
+@dataclass
+class MeshSpec:
+    """Sizes per logical axis; -1 on exactly one axis means "absorb the
+    remaining devices" (like a reshape wildcard)."""
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    pipe: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def sizes(self) -> dict[str, int]:
+        return {
+            DATA: self.data,
+            FSDP: self.fsdp,
+            TENSOR: self.tensor,
+            PIPE: self.pipe,
+            SEQ: self.seq,
+            EXPERT: self.expert,
+        }
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = self.sizes()
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one wildcard axis, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh axes product {fixed} != device count {n_devices}")
+        return sizes
+
+
+def make_mesh(spec: MeshSpec | None = None, devices=None,
+              drop_trivial: bool = False) -> Mesh:
+    """Build the named mesh. Axis order is (data, fsdp, tensor, pipe, seq,
+    expert) — outer axes map to DCN/slower links, inner axes to ICI, which
+    is the layout that keeps tensor/seq collectives on the fastest rings.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    spec = spec or MeshSpec()
+    sizes = spec.resolve(len(devices))
+    names = [a for a in ALL_AXES if not (drop_trivial and sizes[a] == 1)]
+    shape = [sizes[a] for a in names]
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(names))
+
+
+def data_parallel_mesh(devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (DATA,))
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1)

@@ -1,0 +1,100 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the ``pipe``
+mesh axis.
+
+Absent from the reference (its TaskScheduler DAG sequences *jobs*, not
+micro-batches — SURVEY.md section 2.4). Here each pipe-axis device holds
+one stage's parameters (stacked along a leading "layers" dim sharded on
+``pipe``); activations flow stage-to-stage via ``lax.ppermute`` inside a
+``lax.scan`` bubble schedule. Differentiable; jit-compatible (static
+schedule length n_micro + n_stages - 1).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from tony_tpu.parallel.mesh import PIPE
+
+
+def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name):
+    """Body under shard_map.
+
+    stage_params: this stage's param tree (leading stacked dim stripped
+      to size 1 by sharding; squeezed before use).
+    x_micro: [n_micro, mb, ...] full microbatched input (replicated).
+    Returns [n_micro, mb, ...] outputs (valid on every device after psum).
+    """
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    params = jax.tree.map(lambda p: p[0], stage_params)  # strip stacked dim
+    n_micro = x_micro.shape[0]
+    total = n_micro + n_stages - 1
+    out_buf = jnp.zeros_like(x_micro)
+    carry_act = jnp.zeros_like(x_micro[0])
+
+    def step(state, t):
+        carry_act, out_buf = state
+        # stage 0 ingests microbatch t (clamped; masked later)
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        inp = jnp.where(stage == 0, x_micro[mb_idx], carry_act)
+        y = stage_fn(params, inp)
+        # last stage writes finished microbatch t-(n_stages-1)
+        out_idx = t - (n_stages - 1)
+        valid_out = (stage == n_stages - 1) & (out_idx >= 0) & (out_idx < n_micro)
+        out_buf = lax.cond(
+            valid_out,
+            lambda b: lax.dynamic_update_index_in_dim(b, y, jnp.maximum(out_idx, 0), 0),
+            lambda b: b,
+            out_buf,
+        )
+        # shift activations to the next stage
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+        carry_act = lax.ppermute(y, axis_name, perm)
+        return (carry_act, out_buf), None
+
+    (carry_act, out_buf), _ = lax.scan(step, (carry_act, out_buf),
+                                       jnp.arange(total))
+    # outputs only live on the last stage; broadcast over the ring
+    mask = (stage == n_stages - 1).astype(out_buf.dtype)
+    return lax.psum(out_buf * mask, axis_name)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh: Mesh,
+                   n_microbatches: int, axis_name: str = PIPE):
+    """Run ``x`` through ``n_stages`` pipeline stages.
+
+    stage_fn(params, x_mb) -> y_mb with y_mb.shape == x_mb.shape (uniform
+      inter-stage activation shape, standard for decoder stacks).
+    stacked_params: pytree whose leaves have leading dim n_stages (sharded
+      along ``axis_name``).
+    x: [batch, ...]; batch must divide by n_microbatches.
+    """
+    n_stages = mesh.shape[axis_name]
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise ValueError(f"batch {batch} % n_microbatches {n_microbatches} != 0")
+    x_micro = x.reshape(n_microbatches, batch // n_microbatches, *x.shape[1:])
+
+    params_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    fn = shard_map(
+        functools.partial(_pipeline_local, stage_fn=stage_fn,
+                          axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(params_specs, P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+    out = fn(stacked_params, x_micro)
+    return out.reshape(batch, *x.shape[1:])
+
+
+def stack_stage_params(per_stage_params: list) -> dict:
+    """Stack per-stage param trees along a new leading dim for pipe sharding."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)

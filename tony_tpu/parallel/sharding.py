@@ -1,0 +1,121 @@
+"""Sharding presets: logical-axis rules -> PartitionSpecs for model states.
+
+The framework's models annotate arrays with *logical* axis names
+("batch", "seq", "embed", "heads", "mlp", "vocab", "expert", "layers");
+a preset maps logical names to mesh axes. This is the pjit idiom: the same
+model runs DP, FSDP, TP, or combinations by swapping the rule set, and XLA
+inserts the collectives (no NCCL-style explicit comms as in the reference's
+delegated data plane, SURVEY.md section 2.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tony_tpu.parallel.mesh import DATA, EXPERT, FSDP, PIPE, SEQ, TENSOR
+
+# logical axis -> mesh axis (or None = replicated) per strategy
+RULES: dict[str, dict[str, Any]] = {
+    # pure data parallelism: params replicated, batch sharded
+    "dp": {
+        "batch": (DATA, FSDP),
+        "seq": None, "embed": None, "heads": None, "kv": None,
+        "mlp": None, "vocab": None, "expert": None, "layers": None,
+    },
+    # fsdp: params sharded on the fsdp axis along their largest dim
+    "fsdp": {
+        "batch": (DATA, FSDP),
+        "embed": FSDP,
+        "seq": None, "heads": None, "kv": None, "mlp": None,
+        "vocab": None, "expert": None, "layers": None,
+    },
+    # tensor parallelism (megatron-style): heads + mlp sharded
+    "tp": {
+        "batch": (DATA, FSDP),
+        "heads": TENSOR, "mlp": TENSOR, "vocab": TENSOR,
+        "seq": None, "embed": None, "kv": None, "expert": None, "layers": None,
+    },
+    # fsdp + tp combined (the common large-model preset)
+    "fsdp_tp": {
+        "batch": (DATA, FSDP),
+        "embed": FSDP, "heads": TENSOR, "mlp": TENSOR, "vocab": TENSOR,
+        "seq": None, "kv": None, "expert": None, "layers": None,
+    },
+    # sequence/context parallelism: activations sharded along seq
+    "sp": {
+        "batch": (DATA, FSDP),
+        "act_seq": SEQ,
+        "seq": None, "embed": None, "heads": None, "kv": None,
+        "mlp": None, "vocab": None, "expert": None, "layers": None,
+    },
+    # expert parallelism for MoE blocks
+    "ep": {
+        "batch": (DATA, FSDP),
+        "expert": EXPERT,
+        "seq": None, "embed": None, "heads": None, "kv": None,
+        "mlp": None, "vocab": None, "layers": None,
+    },
+    # pipeline: layers sharded across stages (used with parallel.pipeline)
+    "pp": {
+        "batch": (DATA, FSDP),
+        "layers": PIPE,
+        "seq": None, "embed": None, "heads": None, "kv": None,
+        "mlp": None, "vocab": None, "expert": None,
+    },
+}
+
+
+def spec_for(logical_axes: tuple[str | None, ...], rules: dict[str, Any]) -> P:
+    """PartitionSpec from per-dimension logical names."""
+    parts = []
+    for name in logical_axes:
+        if name is None:
+            parts.append(None)
+        else:
+            parts.append(rules.get(name))
+    # trailing Nones can be dropped but keeping them is harmless
+    return P(*parts)
+
+
+def tree_shardings(mesh: Mesh, logical_tree: Any, preset: str) -> Any:
+    """Map a pytree of logical-axis tuples to NamedShardings."""
+    rules = RULES[preset]
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, spec_for(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def shard_params_by_size(mesh: Mesh, params: Any, axis: str = FSDP,
+                         min_size: int = 2**14) -> Any:
+    """Heuristic FSDP sharding for arbitrary param trees (when a model has
+    no logical annotations): shard each large array along its largest
+    dimension divisible by the axis size; replicate the rest."""
+    n = mesh.shape.get(axis, 1)
+
+    def spec(x):
+        if n <= 1 or x.size < min_size:
+            return NamedSharding(mesh, P())
+        dims = sorted(range(x.ndim), key=lambda d: -x.shape[d])
+        for d in dims:
+            if x.shape[d] % n == 0:
+                parts: list = [None] * x.ndim
+                parts[d] = axis
+                return NamedSharding(mesh, P(*parts))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(spec, params)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Inputs: batch dim sharded over (data, fsdp)."""
+    axes = tuple(a for a in (DATA, FSDP) if mesh.shape.get(a, 1) > 1)
+    return NamedSharding(mesh, P(axes if axes else None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,3 @@
+from tony_tpu.portal.app import Portal
+
+__all__ = ["Portal"]

@@ -1,0 +1,3 @@
+from tony_tpu.portal.app import main
+
+raise SystemExit(main())

@@ -1,0 +1,226 @@
+"""Job-history web portal.
+
+Reference: tony-portal (Play Framework app, 1216 LoC Java + Scala templates):
+jobs-metadata index, per-job config/events/logs pages, caches, and the
+background history mover/purger. Rebuilt on the stdlib http.server (no Play
+in the image) with the same four pages:
+
+  /                     jobs index (ref: conf/routes:1 JobsMetadataPageController)
+  /job/<id>/config      merged conf   (ref: JobConfigPageController)
+  /job/<id>/events      event log     (ref: JobEventsPageController)
+  /job/<id>/logs        task log list (ref: JobLogsPageController)
+
+plus JSON twins under /api/... for tooling.
+
+Entry: ``python -m tony_tpu.portal --history <dir> [--port N]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tony_tpu.events import history
+from tony_tpu.events.mover import move_finished_jobs, purge_old_history
+
+log = logging.getLogger(__name__)
+
+_PAGE = """<!doctype html><html><head><title>tony-tpu history</title>
+<style>
+body {{ font-family: monospace; margin: 2em; }}
+table {{ border-collapse: collapse; }}
+td, th {{ border: 1px solid #999; padding: 4px 10px; text-align: left; }}
+th {{ background: #eee; }}
+.SUCCEEDED {{ color: green; }} .FAILED {{ color: red; }} .RUNNING {{ color: orange; }}
+</style></head><body><h2>{title}</h2>{body}</body></html>"""
+
+
+class PortalState:
+    """Cached history scan (ref: cache/CacheWrapper.java Guava caches)."""
+
+    def __init__(self, history_root: str, ttl_s: float = 5.0):
+        self.history_root = history_root
+        self.ttl_s = ttl_s
+        self._jobs: list[dict] = []
+        self._scanned = 0.0
+        self._lock = threading.Lock()
+
+    def jobs(self) -> list[dict]:
+        with self._lock:
+            if time.monotonic() - self._scanned > self.ttl_s:
+                self._jobs = history.list_jobs(self.history_root)
+                self._scanned = time.monotonic()
+            return list(self._jobs)
+
+    def find(self, app_id: str) -> dict | None:
+        for j in self.jobs():
+            if j["app_id"] == app_id:
+                return j
+        return None
+
+
+class PortalHandler(BaseHTTPRequestHandler):
+    state: PortalState  # set by serve()
+
+    def log_message(self, fmt, *args):  # quiet
+        log.debug(fmt, *args)
+
+    def do_GET(self):
+        try:
+            self._route()
+        except Exception as e:
+            log.exception("portal error")
+            self._send(500, f"internal error: {e}", "text/plain")
+
+    def _route(self):
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        api = bool(parts) and parts[0] == "api"
+        if api:
+            parts = parts[1:]
+        if not parts:
+            return self._jobs_index(api)
+        if parts[0] == "job" and len(parts) >= 3:
+            app_id, page = parts[1], parts[2]
+            job = self.state.find(app_id)
+            if job is None:
+                return self._send(404, "no such job", "text/plain")
+            if page == "config":
+                return self._job_config(job, api)
+            if page == "events":
+                return self._job_events(job, api)
+            if page == "logs":
+                return self._job_logs(job, api)
+        return self._send(404, "not found", "text/plain")
+
+    # -- pages --------------------------------------------------------------
+    def _jobs_index(self, api: bool):
+        jobs = self.state.jobs()
+        if api:
+            return self._send(200, json.dumps(jobs), "application/json")
+        rows = "".join(
+            f"<tr><td><a href='/job/{j['app_id']}/config'>{j['app_id']}</a></td>"
+            f"<td class='{j['status']}'>{j['status']}</td>"
+            f"<td>{j['user'] or '-'}</td>"
+            f"<td>{_ts(j['started'])}</td><td>{_ts(j['completed'])}</td>"
+            f"<td><a href='/job/{j['app_id']}/events'>events</a> "
+            f"<a href='/job/{j['app_id']}/logs'>logs</a></td></tr>"
+            for j in jobs
+        )
+        body = (f"<table><tr><th>application</th><th>status</th><th>user</th>"
+                f"<th>started</th><th>completed</th><th>links</th></tr>{rows}</table>")
+        self._send(200, _PAGE.format(title="tony-tpu job history", body=body))
+
+    def _job_config(self, job: dict, api: bool):
+        conf = history.parse_config(job["dir"]) or {}
+        if api:
+            return self._send(200, json.dumps(conf), "application/json")
+        rows = "".join(
+            f"<tr><td>{html.escape(str(k))}</td><td>{html.escape(str(v))}</td></tr>"
+            for k, v in sorted(conf.items()))
+        body = f"<p><a href='/'>&larr; jobs</a></p><table>{rows}</table>"
+        self._send(200, _PAGE.format(title=f"{job['app_id']} config", body=body))
+
+    def _job_events(self, job: dict, api: bool):
+        events = [e.to_dict() for e in history.parse_events(job["jhist"])]
+        if api:
+            return self._send(200, json.dumps(events), "application/json")
+        rows = "".join(
+            f"<tr><td>{_ts(e['timestamp'])}</td><td>{e['type']}</td>"
+            f"<td>{html.escape(json.dumps(e['event']))}</td></tr>" for e in events)
+        body = f"<p><a href='/'>&larr; jobs</a></p><table>{rows}</table>"
+        self._send(200, _PAGE.format(title=f"{job['app_id']} events", body=body))
+
+    def _job_logs(self, job: dict, api: bool):
+        """Task log files staged alongside history (ref: JobLogPageController
+        links out to YARN log URLs; here logs are local files)."""
+        logs_dir = os.path.join(os.path.dirname(job["dir"]), "..", "..")
+        found = []
+        for j in (job["dir"], os.path.join(job["dir"], "logs")):
+            if os.path.isdir(j):
+                for f in sorted(os.listdir(j)):
+                    if f.endswith(".log"):
+                        found.append(os.path.join(j, f))
+        if api:
+            return self._send(200, json.dumps(found), "application/json")
+        items = "".join(f"<li>{html.escape(p)}</li>" for p in found) or "<li>none</li>"
+        body = f"<p><a href='/'>&larr; jobs</a></p><ul>{items}</ul>"
+        self._send(200, _PAGE.format(title=f"{job['app_id']} logs", body=body))
+
+    def _send(self, code: int, body: str, ctype: str = "text/html"):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+def _ts(ms: int) -> str:
+    if ms is None or ms < 0:
+        return "-"
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ms / 1000))
+
+
+class Portal:
+    def __init__(self, history_root: str, port: int = 0, host: str = "127.0.0.1",
+                 mover_interval_ms: int = 300_000, retention_sec: int = 2_592_000):
+        self.state = PortalState(history_root)
+        handler = type("BoundHandler", (PortalHandler,), {"state": self.state})
+        self.server = ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self.server.server_address[:2]
+        self.mover_interval_s = mover_interval_ms / 1000
+        self.retention_sec = retention_sec
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> "Portal":
+        t = threading.Thread(target=self.server.serve_forever, name="portal",
+                             daemon=True)
+        t.start()
+        m = threading.Thread(target=self._housekeeping, name="history-mover",
+                             daemon=True)
+        m.start()
+        self._threads = [t, m]
+        log.info("portal at http://%s:%d", self.host, self.port)
+        return self
+
+    def _housekeeping(self) -> None:
+        """Ref: HistoryFileMover + HistoryFilePurger background loops."""
+        while not self._stop.wait(self.mover_interval_s):
+            try:
+                move_finished_jobs(self.state.history_root)
+                purge_old_history(self.state.history_root, self.retention_sec)
+            except Exception:
+                log.exception("history housekeeping failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tony-tpu portal")
+    parser.add_argument("--history", required=True)
+    parser.add_argument("--port", type=int, default=19885)
+    parser.add_argument("--host", default="0.0.0.0")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    portal = Portal(args.history, port=args.port, host=args.host).start()
+    print(f"tony-tpu portal at http://{portal.host}:{portal.port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        portal.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,3 @@
+from tony_tpu.proxy.proxy import ProxyServer
+
+__all__ = ["ProxyServer"]

@@ -1,0 +1,114 @@
+"""TCP proxy — tony-proxy equivalent.
+
+Reference: tony-proxy ProxyServer.java:21-91: a threaded byte-pump proxying
+a local gateway port to a host inside the cluster, used by the notebook
+submitter to tunnel Jupyter. A native C++ implementation (native/proxy.cc)
+is used when built (``make -C native``); this module is the fallback and
+the control wrapper.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import socket
+import subprocess
+import threading
+
+log = logging.getLogger(__name__)
+
+_NATIVE_BIN = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native", "build", "tony_proxy")
+
+
+class ProxyServer:
+    def __init__(self, remote_host: str, remote_port: int, local_port: int = 0,
+                 prefer_native: bool = True):
+        self.remote_host = remote_host
+        self.remote_port = remote_port
+        self._native_proc: subprocess.Popen | None = None
+        self._server: socket.socket | None = None
+        self._stop = threading.Event()
+        self.local_port = local_port
+        self.prefer_native = prefer_native and os.path.exists(_NATIVE_BIN) and \
+            shutil.which(_NATIVE_BIN) is not None
+
+    def start(self) -> "ProxyServer":
+        if self.prefer_native:
+            return self._start_native()
+        return self._start_python()
+
+    def _start_native(self) -> "ProxyServer":
+        # native binary prints "LISTENING <port>" then serves until killed
+        self._native_proc = subprocess.Popen(
+            [_NATIVE_BIN, str(self.local_port), self.remote_host,
+             str(self.remote_port)],
+            stdout=subprocess.PIPE, text=True)
+        line = self._native_proc.stdout.readline().strip()
+        if line.startswith("LISTENING"):
+            self.local_port = int(line.split()[1])
+            log.info("native proxy :%d -> %s:%d", self.local_port,
+                     self.remote_host, self.remote_port)
+            return self
+        log.warning("native proxy failed to start (%r); falling back", line)
+        self._native_proc.kill()
+        self._native_proc = None
+        return self._start_python()
+
+    def _start_python(self) -> "ProxyServer":
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("", self.local_port))
+        self._server.listen(16)
+        self.local_port = self._server.getsockname()[1]
+        threading.Thread(target=self._accept_loop, name="proxy-accept",
+                         daemon=True).start()
+        log.info("proxy :%d -> %s:%d", self.local_port, self.remote_host,
+                 self.remote_port)
+        return self
+
+    def _accept_loop(self) -> None:
+        assert self._server is not None
+        while not self._stop.is_set():
+            try:
+                client, _ = self._server.accept()
+            except OSError:
+                return
+            try:
+                upstream = socket.create_connection(
+                    (self.remote_host, self.remote_port), timeout=10)
+            except OSError:
+                log.warning("proxy: upstream %s:%d unreachable",
+                            self.remote_host, self.remote_port)
+                client.close()
+                continue
+            for a, b in ((client, upstream), (upstream, client)):
+                threading.Thread(target=self._pump, args=(a, b),
+                                 daemon=True).start()
+
+    @staticmethod
+    def _pump(src: socket.socket, dst: socket.socket) -> None:
+        """Ref: ProxyServer's per-direction copy threads."""
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                s.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._native_proc is not None:
+            self._native_proc.kill()
+        if self._server is not None:
+            self._server.close()

@@ -1,0 +1,4 @@
+from tony_tpu.rpc.client import RpcClient, RpcError
+from tony_tpu.rpc.server import RpcServer
+
+__all__ = ["RpcClient", "RpcError", "RpcServer"]

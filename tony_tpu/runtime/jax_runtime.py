@@ -1,0 +1,63 @@
+"""JAX runtime: the flagship, TPU-native rendezvous.
+
+This is the in-tree replacement for the reference's HorovodRuntime + NCCL
+path (runtime/HorovodRuntime.java, 357 LoC + HorovodDriver + rendezvous
+server): on TPU there is no rendezvous *server* at all — the chief task's
+registered host:port becomes the jax.distributed coordinator address, each
+task's global process id is its flat index in the cluster spec, and all
+collectives are XLA over ICI/DCN. The entire HorovodDriver/slot-plan
+machinery collapses into env injection (SURVEY.md section 5.8).
+
+User scripts call ``tony_tpu.distributed.initialize()`` (reads this env) or
+``jax.distributed.initialize()`` with the values below.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tony_tpu import constants as C
+from tony_tpu.config import ConfError, TonyConf
+from tony_tpu.runtime.base import AMAdapter, Runtime, TaskAdapter, TaskContext
+
+
+def coordinator_address(cluster_spec: dict[str, list[str]]) -> str:
+    """The chief's host:port doubles as the jax coordinator address: chief
+    role's task 0 if present, else the first role's task 0."""
+    for role in (C.CHIEF_JOB_NAME, C.WORKER_JOB_NAME):
+        slots = cluster_spec.get(role)
+        if slots and slots[0]:
+            return slots[0]
+    for slots in cluster_spec.values():
+        if slots and slots[0]:
+            return slots[0]
+    raise ValueError("empty cluster spec: no coordinator candidate")
+
+
+class JaxAMAdapter(AMAdapter):
+    def validate_and_update_config(self, conf: TonyConf) -> None:
+        if conf.get("tony.application.distributed-mode") != C.GANG:
+            # jax.distributed barrier-initializes: every process must attend
+            raise ConfError("jax runtime requires GANG distributed mode")
+
+
+class JaxTaskAdapter(TaskAdapter):
+    def build_task_env(self, ctx: TaskContext) -> dict[str, str]:
+        env = super().build_task_env(ctx)
+        addr = coordinator_address(ctx.cluster_spec)
+        pid = ctx.flat_index()
+        num = ctx.total_tasks()
+        env[C.COORDINATOR_ADDRESS] = addr
+        env[C.PROCESS_ID] = str(pid)
+        env[C.NUM_PROCESSES] = str(num)
+        # ICI-topology hints for multi-host TPU slices
+        topology = str(ctx.conf.get("tony.tpu.topology", ""))
+        if topology:
+            env["TONY_TPU_TOPOLOGY"] = topology
+        return env
+
+
+class JaxRuntime(Runtime):
+    name = "jax"
+    am_adapter_cls = JaxAMAdapter
+    task_adapter_cls = JaxTaskAdapter

@@ -1,0 +1,48 @@
+"""Runtime registry — ServiceLoader equivalent.
+
+Reference: FrameworkRuntimeProvider.java:29-61 resolves the configured
+framework type to a runtime via Java ServiceLoader
+(META-INF/services/...AbstractFrameworkRuntime). Here it's an explicit
+registry plus ``register()`` for out-of-tree runtimes.
+"""
+
+from __future__ import annotations
+
+from tony_tpu.runtime.base import AMAdapter, Runtime, TaskAdapter
+from tony_tpu.runtime.jax_runtime import JaxRuntime
+from tony_tpu.runtime.mxnet_runtime import MXNetRuntime
+from tony_tpu.runtime.pytorch_runtime import PyTorchRuntime
+from tony_tpu.runtime.ray_runtime import RayRuntime
+from tony_tpu.runtime.standalone import StandaloneRuntime
+from tony_tpu.runtime.tf_runtime import TFRuntime
+
+_REGISTRY: dict[str, type[Runtime]] = {}
+
+
+def register(runtime_cls: type[Runtime]) -> type[Runtime]:
+    _REGISTRY[runtime_cls.name] = runtime_cls
+    return runtime_cls
+
+
+for _rt in (JaxRuntime, TFRuntime, PyTorchRuntime, MXNetRuntime,
+            StandaloneRuntime, RayRuntime):
+    register(_rt)
+
+
+def get_runtime(framework: str) -> type[Runtime]:
+    try:
+        return _REGISTRY[framework.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown framework {framework!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def get_am_adapter(framework: str) -> AMAdapter:
+    """Ref: FrameworkRuntimeProvider.getAMAdapter :53."""
+    return get_runtime(framework).get_am_adapter()
+
+
+def get_task_adapter(framework: str) -> TaskAdapter:
+    """Ref: FrameworkRuntimeProvider.getTaskAdapter :61."""
+    return get_runtime(framework).get_task_adapter()

@@ -47,11 +47,25 @@ class TaskScheduler:
             role: set(req.depends_on) for role, req in self.requests.items()
         }
         # stage split: every training-stage role implicitly depends on every
-        # prepare-stage role (ref: Utils.java:377-403)
+        # *tracked* prepare-stage role — untracked roles (long-running ps/
+        # sidecars) never "complete" and must not gate training (ref:
+        # Utils.java:380 tasksToDependOn excludes untrackedJobTypes)
         if conf is not None:
-            prepare = [r for r in conf.get_list("tony.application.prepare-stage") if r in deps]
-            training = [r for r in conf.get_list("tony.application.training-stage") if r in deps]
-            for t in training:
+            prepare_conf = conf.get_list("tony.application.prepare-stage")
+            training_conf = conf.get_list("tony.application.training-stage")
+            unknown = (set(prepare_conf) | set(training_conf)) - set(deps)
+            if unknown:
+                raise CycleError(
+                    f"stage lists name unknown roles: {sorted(unknown)}")
+            # one stage set, the other empty: auto-fill with the remaining
+            # roles (ref: Utils.ensureStagedTasksIntegrity :431-449)
+            if prepare_conf and not training_conf:
+                training_conf = [r for r in deps if r not in prepare_conf]
+            elif training_conf and not prepare_conf:
+                prepare_conf = [r for r in deps if r not in training_conf]
+            untracked = self.session.untracked | self.session.sidecars
+            prepare = [r for r in prepare_conf if r not in untracked]
+            for t in training_conf:
                 deps[t].update(prepare)
         for role, ds in deps.items():
             unknown = ds - set(self.requests)
@@ -89,6 +103,7 @@ class TaskScheduler:
                 continue
             if self.deps[role] <= self.completed_roles:
                 log.info("scheduling role %s (%d instances)", role, req.instances)
+                self.session.add_expected(req.instances)
                 self.allocate(req)
                 self.scheduled.add(role)
                 newly.append(role)

@@ -69,6 +69,11 @@ class Session:
         # getAndInitMatchingTaskByPriority (TonySession.java:219)
         self.tasks: dict[str, list[Task | None]] = {}
         self.requests: dict[str, RoleRequest] = {}
+        # expected tasks = instances of *scheduled* roles; the GANG gate
+        # compares registrations against this, not the full config, so DAG
+        # stages each form their own gang (ref: TonySession.numExpectedTasks
+        # :69,204-210 incremented as the scheduler requests containers)
+        self.num_expected = 0
         self.untracked = set(conf.get_list("tony.application.untracked.jobtypes"))
         self.sidecars = set(conf.get_list("tony.application.sidecar.jobtypes"))
         self.stop_on_failure = set(
@@ -105,7 +110,7 @@ class Session:
 
     def get_task(self, role: str, index: int) -> Task | None:
         slots = self.tasks.get(role)
-        if slots is None or index >= len(slots):
+        if slots is None or index < 0 or index >= len(slots):
             return None
         return slots[index]
 
@@ -122,6 +127,10 @@ class Session:
     def register(self, task_id: str, host_port: str) -> Task | None:
         task = self.get_task_by_id(task_id)
         if task is None:
+            return None
+        if task.completed:
+            # late/duplicate registration must not erase a terminal status
+            log.warning("ignoring registration for completed task %s", task_id)
             return None
         try:
             task.set_host_port(host_port)
@@ -140,8 +149,14 @@ class Session:
     def num_registered(self) -> int:
         return sum(1 for t in self.all_tasks() if t.registered)
 
+    def add_expected(self, n: int) -> None:
+        """Ref: TonySession.addNumExpectedTask :208."""
+        self.num_expected += n
+
     def all_registered(self) -> bool:
-        return self.num_registered == self.total_expected
+        """All *scheduled* tasks registered (ref: MLGenericRuntime GANG gate
+        compares getNumRegisteredTasks to getNumExpectedTasks :83-87)."""
+        return self.num_expected > 0 and self.num_registered >= self.num_expected
 
     def cluster_spec(self) -> dict[str, list[str]]:
         """{role: ["host:port" per index]} — the rendezvous contract."""
@@ -194,6 +209,12 @@ class Session:
             # untracked non-sidecar failure fails the app fast
             # (ref: ApplicationMaster.java:1260-1264)
             self._fail(f"untracked task {role}:{index} failed ({exit_code})")
+
+    def fail(self, reason: str) -> None:
+        """External failure injection point: liveness expiry, registration
+        timeout, startup failure (ref: onTaskDeemedDead / registrationTimeout
+        / startupFailed in ApplicationMaster.java)."""
+        self._fail(reason)
 
     def _fail(self, reason: str) -> None:
         if self.status == SessionStatus.RUNNING:

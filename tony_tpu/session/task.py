@@ -51,7 +51,7 @@ class Task:
 
     def set_host_port(self, host_port: str) -> None:
         host, sep, port = host_port.rpartition(":")
-        if not sep or not host or not port.lstrip("-").isdigit():
+        if not sep or not host or not port.isdigit():
             raise ValueError(f"malformed host:port: {host_port!r}")
         self.host = host
         self.port = int(port)

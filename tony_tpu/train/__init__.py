@@ -1,0 +1,16 @@
+from tony_tpu.train.checkpoint import CheckpointManager, restore_or_init
+from tony_tpu.train.trainer import (
+    Trainer,
+    TrainState,
+    build_train_step,
+    cross_entropy_loss,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "Trainer",
+    "TrainState",
+    "build_train_step",
+    "cross_entropy_loss",
+    "restore_or_init",
+]

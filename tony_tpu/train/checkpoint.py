@@ -1,0 +1,71 @@
+"""Checkpoint/resume via orbax.
+
+The reference has NO in-tree checkpointing (SURVEY.md section 5.4 — its
+"resume" is the AM retry loop restarting user scripts that must checkpoint
+themselves). tony-tpu makes it first-class: the coordinator's retry loop
+plus these helpers give restart-with-checkpoint resume, which the
+launch->first-step-latency metric rewards.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    """Thin orbax wrapper: numbered step checkpoints + latest-restore."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+            ),
+        )
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        saved = self.manager.save(
+            step, args=self._ocp.args.StandardSave(state), force=force)
+        if saved:
+            log.info("checkpoint saved at step %d", step)
+        return bool(saved)
+
+    def latest_step(self) -> int | None:
+        return self.manager.latest_step()
+
+    def restore(self, state_template: Any, step: int | None = None) -> Any:
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        restored = self.manager.restore(
+            step, args=self._ocp.args.StandardRestore(state_template))
+        log.info("restored checkpoint step %d", step)
+        return restored
+
+    def wait(self) -> None:
+        self.manager.wait_until_finished()
+
+    def close(self) -> None:
+        self.manager.close()
+
+
+def restore_or_init(directory: str, init_fn, state_template=None):
+    """Resume-if-possible entry: returns (state, manager, resumed: bool)."""
+    manager = CheckpointManager(directory)
+    template = state_template if state_template is not None else init_fn()
+    if manager.latest_step() is not None:
+        restored = manager.restore(template)
+        if restored is not None:
+            return restored, manager, True
+    return template, manager, False

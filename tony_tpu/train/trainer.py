@@ -1,0 +1,134 @@
+"""Training-step builders: pjit'd SPMD train loops over a named mesh.
+
+The compute-side counterpart of the control plane: where the reference
+delegates "training" entirely to the user script + NCCL/Gloo
+(SURVEY.md section 2.5), tony-tpu ships an in-tree trainer whose gradient
+exchange is XLA collectives inserted by pjit from sharding annotations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tony_tpu.parallel.sharding import batch_sharding, shard_params_by_size
+
+
+@dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+    def tree_flatten(self):
+        return (self.step, self.params, self.opt_state), None
+
+
+jax.tree_util.register_pytree_node(
+    TrainState,
+    lambda s: ((s.step, s.params, s.opt_state), None),
+    lambda _, c: TrainState(*c),
+)
+
+
+def cross_entropy_loss(logits, labels):
+    """logits: [..., V], labels: [...] int."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+@dataclass
+class Trainer:
+    """Builds a jitted SPMD train step.
+
+    apply_fn(params, batch) -> loss (scalar). Shardings: params via the
+    FSDP-by-size heuristic (or replicated), batch sharded on (data, fsdp).
+    """
+
+    mesh: Mesh
+    apply_fn: Callable[[Any, Any], jnp.ndarray]
+    optimizer: optax.GradientTransformation
+    fsdp: bool = False
+    donate: bool = True
+
+    def init_state(self, params) -> TrainState:
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=self.optimizer.init(params),
+        )
+
+    def state_shardings(self, state: TrainState):
+        if self.fsdp:
+            p_sh = shard_params_by_size(self.mesh, state.params)
+        else:
+            p_sh = jax.tree.map(
+                lambda _: NamedSharding(self.mesh, P()), state.params)
+        o_sh = _opt_shardings_like(self.mesh, state.opt_state, p_sh,
+                                   state.params)
+        return TrainState(
+            step=NamedSharding(self.mesh, P()),
+            params=p_sh,
+            opt_state=o_sh,
+        )
+
+    def build_step(self, state: TrainState):
+        """Returns (step_fn, placed_state). step_fn(state, batch) ->
+        (state, metrics)."""
+        shardings = self.state_shardings(state)
+        b_sh = batch_sharding(self.mesh)
+
+        def step_fn(state: TrainState, batch):
+            loss, grads = jax.value_and_grad(self.apply_fn)(state.params, batch)
+            updates, opt_state = self.optimizer.update(
+                grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            gnorm = optax.global_norm(grads)
+            new_state = TrainState(step=state.step + 1, params=params,
+                                   opt_state=opt_state)
+            return new_state, {"loss": loss, "grad_norm": gnorm}
+
+        placed = jax.device_put(state, shardings)
+        metric_sh = {"loss": NamedSharding(self.mesh, P()),
+                     "grad_norm": NamedSharding(self.mesh, P())}
+        # b_sh is a pytree prefix: one sharding broadcast over the batch tree
+        jit_step = jax.jit(
+            step_fn,
+            in_shardings=(shardings, b_sh),
+            out_shardings=(shardings, metric_sh),
+            donate_argnums=(0,) if self.donate else (),
+        )
+        return jit_step, placed
+
+
+def build_train_step(mesh: Mesh, apply_fn, optimizer, params, fsdp=False):
+    """One-call convenience: returns (step_fn, state)."""
+    trainer = Trainer(mesh=mesh, apply_fn=apply_fn, optimizer=optimizer,
+                      fsdp=fsdp)
+    state = trainer.init_state(params)
+    return trainer.build_step(state)
+
+
+def _opt_shardings_like(mesh, opt_state, param_shardings, params):
+    """Optimizer-state shardings: leaves shaped like a param get that
+    param's sharding (momentum/adam moments); everything else replicated."""
+    flat_params, _ = jax.tree_util.tree_flatten(params)
+    flat_shard, _ = jax.tree_util.tree_flatten(param_shardings)
+    by_shape = {}
+    for p, s in zip(flat_params, flat_shard):
+        by_shape.setdefault((p.shape, p.dtype), s)
+
+    def pick(leaf):
+        if hasattr(leaf, "shape"):
+            s = by_shape.get((leaf.shape, leaf.dtype))
+            if s is not None:
+                return s
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(pick, opt_state)

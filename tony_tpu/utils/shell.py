@@ -1,0 +1,85 @@
+"""User-process execution — the single exec point for task commands.
+
+Reference: Utils.executeShell (util/Utils.java:299-329): ``bash -c <cmd>``
+with injected env, optional timeout, output streamed to the task log.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+log = logging.getLogger(__name__)
+
+
+def execute_shell(
+    command: str,
+    timeout_ms: int = 0,
+    env: dict[str, str] | None = None,
+    log_path: str | None = None,
+    cwd: str | None = None,
+) -> int:
+    """Run ``bash -c command``; returns the exit code (124 on timeout, like
+    coreutils timeout). The child gets its own process group so a timeout
+    kills the whole user-process tree."""
+    full_env = dict(os.environ)
+    if env:
+        full_env.update({k: str(v) for k, v in env.items()})
+    out = open(log_path, "ab", buffering=0) if log_path else None
+    try:
+        proc = subprocess.Popen(
+            ["bash", "-c", command],
+            env=full_env,
+            cwd=cwd,
+            stdout=out if out else None,
+            stderr=subprocess.STDOUT if out else None,
+            start_new_session=True,
+        )
+        try:
+            return proc.wait(timeout=timeout_ms / 1000 if timeout_ms > 0 else None)
+        except subprocess.TimeoutExpired:
+            log.error("command timed out after %d ms: %s", timeout_ms, command)
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+            return 124
+    finally:
+        if out:
+            out.close()
+
+
+def tee_output(proc: subprocess.Popen, log_path: str, scan=None) -> threading.Thread:
+    """Stream a child's stdout to a log file (and optionally a scanner
+    callback per line — used by the preprocessing path that scrapes
+    output, ref: ApplicationMaster.doPreprocessingJob :780-832)."""
+
+    def pump():
+        with open(log_path, "ab", buffering=0) as f:
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                f.write(line)
+                if scan is not None:
+                    try:
+                        scan(line.decode(errors="replace"))
+                    except Exception:
+                        log.exception("output scanner failed")
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    return t
+
+
+def python_interpreter(venv_dir: str | None = None) -> str:
+    """Pick the task python: shipped venv's bin/python if present, else the
+    current interpreter (ref: TonyClient.buildTaskCommand :618-635)."""
+    if venv_dir:
+        cand = os.path.join(venv_dir, "bin", "python")
+        if os.path.exists(cand):
+            return cand
+    return sys.executable
