@@ -1,0 +1,34 @@
+"""Payload assertion: the horovod runtime's worker env contract
+(ref: tony-core test script check_horovod_env.py — exits non-zero if the
+injected HOROVOD_* rendezvous env is missing or inconsistent)."""
+
+import os
+import sys
+
+
+def main() -> int:
+    required = [
+        "HOROVOD_CONTROLLER", "HOROVOD_CPU_OPERATIONS",
+        "HOROVOD_GLOO_RENDEZVOUS_ADDR", "HOROVOD_GLOO_RENDEZVOUS_PORT",
+        "HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+        "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK", "HOROVOD_CROSS_SIZE",
+        "HOROVOD_HOSTNAME",
+    ]
+    missing = [k for k in required if k not in os.environ]
+    if missing:
+        print(f"missing env: {missing}", file=sys.stderr)
+        return 1
+    if os.environ["HOROVOD_CONTROLLER"] != "gloo":
+        return 2
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    if not (0 <= rank < size):
+        print(f"bad rank {rank} of {size}", file=sys.stderr)
+        return 3
+    if int(os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]) <= 0:
+        return 4
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

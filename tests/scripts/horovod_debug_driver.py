@@ -1,0 +1,24 @@
+"""Debug-mode rendezvous driver: user-supplied replacement for the built-in
+bootstrap (ref: TestTonyE2E horovod debug-mode case :567 +
+test resources horovod_debug_driver.py). Writes the port file in cwd with a
+fake plan, then stays alive."""
+
+import json
+import time
+
+from tony_tpu.runtime.horovod_driver import (
+    PORT_FILE_SUFFIX,
+    build_fake_slot_plan,
+)
+
+
+def main() -> int:
+    port = 9876
+    with open(f"{port}{PORT_FILE_SUFFIX}", "w") as f:
+        json.dump({"port": port, "slots": build_fake_slot_plan()}, f)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

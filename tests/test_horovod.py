@@ -1,0 +1,249 @@
+"""Horovod-compat runtime tests.
+
+Reference analogs: runtime/TestHorovodRuntime.java (worker list, cluster
+spec), horovod/TestHorovodDriver.java (driver wrapper in fake mode — no
+horovod installed), and the TestTonyE2E horovod cases (:531-567: driver
+crash, pass, debug mode).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.config import ConfError, TonyConf
+from tony_tpu.runtime.base import TaskContext
+from tony_tpu.runtime.horovod_driver import (
+    FAKE_SERVER_PORT,
+    build_fake_slot_plan,
+    build_slot_plan,
+    parse_worker_list,
+)
+from tony_tpu.runtime.horovod_runtime import (
+    HorovodAMAdapter,
+    HorovodDriver,
+    HorovodTaskAdapter,
+    build_worker_list,
+)
+from tony_tpu.session import Session
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "scripts")
+
+
+# -- slot plan math ----------------------------------------------------------
+
+
+def test_parse_worker_list():
+    assert parse_worker_list("h1:2, h2:1") == [("h1", 2), ("h2", 1)]
+    with pytest.raises(ValueError):
+        parse_worker_list("")
+
+
+def test_slot_plan_ranks_and_sizes():
+    plan = build_slot_plan([("h1", 2), ("h2", 1)])
+    assert [s["rank"] for s in plan] == [0, 1, 2]
+    assert all(s["size"] == 3 for s in plan)
+    # h1 slots: local 0,1; h2: local 0
+    assert [s["local_rank"] for s in plan] == [0, 1, 0]
+    assert plan[0]["local_size"] == 2 and plan[2]["local_size"] == 1
+    # cross rank/size: local_rank 0 exists on both hosts, local_rank 1 only h1
+    assert plan[0]["cross_rank"] == 0 and plan[0]["cross_size"] == 2
+    assert plan[2]["cross_rank"] == 1 and plan[2]["cross_size"] == 2
+    assert plan[1]["cross_rank"] == 0 and plan[1]["cross_size"] == 1
+
+
+def test_fake_plan_is_two_local_slots():
+    plan = build_fake_slot_plan()
+    assert len(plan) == 2
+    assert all(s["hostname"] == "localhost" for s in plan)
+
+
+def test_build_worker_list_groups_hosts():
+    spec = {"worker": ["h1:100", "h1:101", "h2:102"]}
+    assert build_worker_list(spec) == "h1:2,h2:1"
+    with pytest.raises(ValueError):
+        build_worker_list({"worker": []})
+
+
+# -- driver wrapper (fake + fail modes; ref: TestHorovodDriver) --------------
+
+
+def test_driver_fake_mode(tmp_path):
+    driver = HorovodDriver.create("localhost:2", str(tmp_path), fake=True)
+    try:
+        assert driver.port == FAKE_SERVER_PORT
+        assert len(driver.slots) == 2
+        info = json.loads(driver.callback_info("myhost"))
+        assert info["host"] == "myhost"
+        assert info["port"] == FAKE_SERVER_PORT
+    finally:
+        driver.kill()
+
+
+def test_driver_fast_fail(tmp_path):
+    with pytest.raises(RuntimeError):
+        HorovodDriver.create("localhost:2", str(tmp_path), fail=True)
+
+
+def test_driver_real_server(tmp_path):
+    """Real mode starts an HTTP KV rendezvous server on a live port."""
+    import urllib.request
+
+    driver = HorovodDriver.create("localhost:2", str(tmp_path))
+    try:
+        assert driver.port > 0
+        url = f"http://127.0.0.1:{driver.port}/rdzv/k1"
+        req = urllib.request.Request(url, data=b"v1", method="PUT")
+        assert urllib.request.urlopen(req).status == 200
+        assert urllib.request.urlopen(url).read() == b"v1"
+    finally:
+        driver.kill()
+
+
+# -- AM adapter --------------------------------------------------------------
+
+
+def _gang_conf(workers: int = 2) -> TonyConf:
+    conf = TonyConf()
+    conf.set("tony.application.framework", "horovod")
+    conf.set("tony.worker.instances", workers)
+    conf.set("tony.worker.command", "true")
+    return conf
+
+
+def test_am_injects_untracked_driver_role():
+    conf = _gang_conf()
+    am = HorovodAMAdapter()
+    am.validate_and_update_config(conf)
+    assert C.DRIVER_JOB_NAME in conf.roles()
+    assert conf.role_get(C.DRIVER_JOB_NAME, "instances") == 1
+    assert C.DRIVER_JOB_NAME in conf.get_list(
+        "tony.application.untracked.jobtypes")
+
+
+def test_am_rejects_user_driver_role():
+    conf = _gang_conf()
+    conf.set("tony.driver.instances", 1)
+    with pytest.raises(ConfError):
+        HorovodAMAdapter().validate_and_update_config(conf)
+
+
+def test_am_gating_driver_then_workers():
+    conf = _gang_conf(workers=2)
+    am = HorovodAMAdapter()
+    am.validate_and_update_config(conf)
+    session = Session(conf)
+    for role in session.requests:
+        for i in range(session.requests[role].instances):
+            session.init_task(role, i)
+    session.add_expected(3)
+    am.set_session(session)
+
+    # nothing registered: neither driver nor workers may start
+    assert not am.can_start_task(C.GANG, "driver:0")
+    assert not am.can_start_task(C.GANG, "worker:0")
+    session.register("worker:0", "h1:100")
+    session.register("worker:1", "h1:101")
+    # all non-driver registered -> driver may start; workers still gated
+    assert am.can_start_task(C.GANG, "driver:0")
+    assert not am.can_start_task(C.GANG, "worker:0")
+    session.register("driver:0", "h1:99")
+    assert not am.can_start_task(C.GANG, "worker:0")  # await callback
+    am.receive_task_callback_info("driver:0", json.dumps(
+        {"host": "h1", "port": 4242, "slots": build_slot_plan([("h1", 2)])}))
+    assert am.can_start_task(C.GANG, "worker:0")
+    spec = json.loads(am.construct_cluster_spec("worker:0"))
+    assert spec["__aux__"]["rendezvous_port"] == 4242
+    assert len(spec["__aux__"]["slots"]) == 2
+    # the driver's own spec carries no aux payload
+    assert "__aux__" not in json.loads(am.construct_cluster_spec("driver:0"))
+
+
+# -- worker env --------------------------------------------------------------
+
+
+def _worker_ctx(index: int, aux: dict) -> TaskContext:
+    return TaskContext(
+        conf=TonyConf(),
+        role="worker",
+        index=index,
+        task_num=2,
+        is_chief=index == 0,
+        cluster_spec={"worker": ["h1:100", "h1:101"], "driver": ["h1:99"]},
+        command="true",
+        aux=aux,
+    )
+
+
+def test_worker_env_slot_assignment():
+    aux = {"rendezvous_host": "h1", "rendezvous_port": 4242,
+           "slots": build_slot_plan([("h1", 2)])}
+    adapter = HorovodTaskAdapter()
+    env0 = adapter.build_task_env(_worker_ctx(0, aux))
+    env1 = adapter.build_task_env(_worker_ctx(1, aux))
+    assert env0[C.HOROVOD_CONTROLLER] == "gloo"
+    assert env0[C.HOROVOD_GLOO_RENDEZVOUS_ADDR] == "h1"
+    assert env0[C.HOROVOD_GLOO_RENDEZVOUS_PORT] == "4242"
+    assert env0[C.HOROVOD_RANK] == "0" and env1[C.HOROVOD_RANK] == "1"
+    assert env0[C.HOROVOD_LOCAL_RANK] == "0" and env1[C.HOROVOD_LOCAL_RANK] == "1"
+    assert env0[C.HOROVOD_SIZE] == "2"
+
+
+def test_driver_role_env_has_no_horovod_vars():
+    adapter = HorovodTaskAdapter()
+    ctx = TaskContext(
+        conf=TonyConf(), role="driver", index=0, task_num=1, is_chief=False,
+        cluster_spec={"worker": ["h1:100"], "driver": ["h1:99"]},
+        command=":")
+    env = adapter.build_task_env(ctx)
+    assert C.HOROVOD_RANK not in env
+
+
+# -- e2e over the mini cluster (ref: TestTonyE2E :531-567) -------------------
+
+
+from tony_tpu.mini import MiniTonyCluster, script_conf  # noqa: E402
+
+
+@pytest.fixture
+def cluster():
+    with MiniTonyCluster() as c:
+        yield c
+
+
+def _horovod_conf(cluster, script_name: str, **extra) -> TonyConf:
+    conf = script_conf(
+        cluster, os.path.join(SCRIPTS, script_name), {"worker": 2},
+        framework="horovod")
+    conf.set("tony.horovod.test-mode", True)
+    for k, v in extra.items():
+        conf.set(k, v)
+    return conf
+
+
+def test_horovod_e2e_pass(cluster):
+    """Ref: testHorovodTrainingShouldPass — fake rendezvous, env checked by
+    the payload."""
+    conf = _horovod_conf(cluster, "check_horovod_env.py")
+    client = cluster.submit(conf)
+    assert client.final_status["status"] == "SUCCEEDED", client.final_status
+
+
+def test_horovod_driver_crash_fails_job(cluster):
+    """Ref: testHorovodModeShouldFailOnDriverFailure — fast-fail driver."""
+    conf = _horovod_conf(cluster, "exit_0.py")
+    conf.set("tony.horovod.test-fast-fail", True)
+    client = cluster.submit(conf)
+    assert client.final_status["status"] == "FAILED"
+
+
+def test_horovod_debug_driver(cluster):
+    """Ref: testHorovodDebugModeShouldPass — user-supplied driver command."""
+    conf = _horovod_conf(cluster, "check_horovod_env.py")
+    conf.set("tony.horovod.test-mode", False)
+    conf.set("tony.horovod.driver.debug-command",
+             f"{sys.executable} {os.path.join(SCRIPTS, 'horovod_debug_driver.py')}")
+    client = cluster.submit(conf)
+    assert client.final_status["status"] == "SUCCEEDED", client.final_status

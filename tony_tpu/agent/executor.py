@@ -142,6 +142,9 @@ class TaskAgent:
             interval_s=0.3,
         )
         cluster_spec = json.loads(cluster_spec_json)
+        # runtime-private payload rides the spec under "__aux__" (e.g. the
+        # horovod rendezvous/slot plan); strip it so role->hosts stays pure
+        aux = cluster_spec.pop("__aux__", {})
         log.info("gang ready; cluster spec: %s", cluster_spec)
 
         # release before exec so the user process can bind (ref:
@@ -166,6 +169,9 @@ class TaskAgent:
             log_path=os.path.join(self.job_dir, "logs",
                                   f"{self.role}-{self.index}-user{C.LOG_SUFFIX}"),
             workdir=self.job_dir,
+            aux=aux,
+            callback_to_am=lambda info: self.client.call(
+                "register_callback_info", task_id=self.task_id, info=info),
             extra_env={
                 C.JOB_ID: self.app_id,
                 C.SESSION_ID: str(self.session_id),

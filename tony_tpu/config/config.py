@@ -40,6 +40,7 @@ _NON_ROLE_SEGMENTS = frozenset(
         "keytab",
         "tpu",
         "test",
+        "horovod",
     }
 )
 

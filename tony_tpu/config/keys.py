@@ -29,7 +29,7 @@ KEYS: dict[str, Key] = {
     # application
     "tony.application.name": Key("tony-tpu", str, "Display name of the job"),
     "tony.application.framework": Key(
-        "jax", str, "Runtime adapter: jax|tensorflow|pytorch|standalone|ray"
+        "jax", str, "Runtime adapter: jax|tensorflow|pytorch|mxnet|horovod|standalone|ray"
     ),
     "tony.application.distributed-mode": Key(
         "GANG", str, "GANG (all tasks rendezvous before start) or FCFS"
@@ -145,6 +145,26 @@ KEYS: dict[str, Key] = {
     # test fault injection via conf (reference: tony.horovod.mode.test etc.)
     "tony.test.crash-coordinator": Key(
         False, bool, "Crash the coordinator once after start (ref: TEST_AM_CRASH conf twin)"
+    ),
+    # horovod-compat runtime (reference: TonyConfigurationKeys.java:313-316)
+    "tony.horovod.test-mode": Key(
+        False, bool,
+        "Rendezvous driver emits a fake 2-slot plan on a fake port "
+        "(ref: tony.horovod.mode.test)"
+    ),
+    "tony.horovod.test-fast-fail": Key(
+        False, bool,
+        "Rendezvous driver exits 1 immediately (ref: tony.horovod.mode.test.fast.fail)"
+    ),
+    "tony.horovod.driver-injected": Key(
+        False, bool,
+        "Internal marker: the hidden driver role was already injected "
+        "(keeps validateAndUpdateConfig idempotent across client+coordinator)"
+    ),
+    "tony.horovod.driver.debug-command": Key(
+        "", str,
+        "User-supplied command replacing the built-in rendezvous driver "
+        "(ref: HorovodDriver debug mode :189-216)"
     ),
 }
 

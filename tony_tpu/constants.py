@@ -53,6 +53,20 @@ MX_DMLC_NUM_SERVER = "DMLC_NUM_SERVER"
 MX_DMLC_NUM_WORKER = "DMLC_NUM_WORKER"
 MX_DMLC_LOCAL = "DMLC_LOCAL"
 
+# Horovod-compat env (emitted by the horovod runtime's worker adapter;
+# reference: runtime/HorovodRuntime.java setHorovodRunEnv :312-350)
+HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"
+HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
+HOROVOD_GLOO_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+HOROVOD_GLOO_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+HOROVOD_RANK = "HOROVOD_RANK"
+HOROVOD_SIZE = "HOROVOD_SIZE"
+HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+HOROVOD_LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
+HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+HOROVOD_HOSTNAME = "HOROVOD_HOSTNAME"
+
 # ---------------------------------------------------------------------------
 # Canonical role names (reference: Constants.java:111-118). Arbitrary role
 # names are allowed via the config regex; these get special semantics.

@@ -13,6 +13,7 @@ import json
 import logging
 import time
 from dataclasses import dataclass, field
+from typing import Callable
 
 from tony_tpu import constants as C
 from tony_tpu.config import TonyConf
@@ -41,6 +42,13 @@ class TaskContext:
     log_path: str | None = None
     workdir: str | None = None
     extra_env: dict[str, str] = field(default_factory=dict)
+    # runtime-private payload the AM adapter attached to the cluster spec
+    # under "__aux__" (ref: HorovodClusterSpec carried alongside the task
+    # spec, runtime/HorovodRuntime.java:87-120)
+    aux: dict = field(default_factory=dict)
+    # channel back to the coordinator's receive_task_callback_info (ref:
+    # TaskExecutor.callbackInfoToAM -> rpc registerCallbackInfo)
+    callback_to_am: Callable[[str], None] | None = None
 
     def flat_index(self) -> int:
         """Global process index: offset of this role in config order + local

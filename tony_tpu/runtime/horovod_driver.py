@@ -1,0 +1,194 @@
+"""Rendezvous bootstrap for the horovod-compat runtime.
+
+Reference: ``src/main/resources/horovod_driver.py`` (189 LoC) — TonY forks
+this script on the hidden ``driver`` task; it starts horovod's gloo
+``RendezvousServer``, computes the host/slot assignment plan, and announces
+the server port by *writing a file* named
+``{port}____HOROVOD_RENDEZVOUS_SERVER____`` whose body is the slot-plan
+JSON (``create_port_file`` :130-136, ``static_driver_fn`` :32-42).
+
+The rebuild has no horovod dependency: the slot math
+(rank/local_rank/cross_rank, horovod's ``get_host_assignments`` semantics)
+is implemented in-tree, and the rendezvous server is a minimal HTTP KV
+store speaking the gloo rendezvous GET/PUT contract. On TPU none of this
+is needed for the flagship path — jax.distributed replaces it wholesale
+(see runtime/jax_runtime.py) — this exists for capability parity with
+gloo/horovod-style user payloads.
+
+Test modes mirror the reference (`_build_fake_host_plan` :44-66, fast-fail
+exit :164-167): ``--fake`` writes a fake plan on a fake port with no
+server; ``--fail`` exits 1 immediately.
+
+Usage: ``python -m tony_tpu.runtime.horovod_driver -w host1:2,host2:1``
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.server
+import json
+import os
+import sys
+import threading
+import time
+
+PORT_FILE_SUFFIX = "____HOROVOD_RENDEZVOUS_SERVER____"
+FAKE_SERVER_PORT = 9999
+
+
+# ---------------------------------------------------------------------------
+# Slot plan (horovod get_host_assignments semantics)
+# ---------------------------------------------------------------------------
+
+def parse_worker_list(worker_list: str) -> list[tuple[str, int]]:
+    """``"h1:2,h2:1"`` -> ``[("h1", 2), ("h2", 1)]`` (ref: parse_hosts)."""
+    hosts: list[tuple[str, int]] = []
+    for part in worker_list.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, n = part.rpartition(":")
+        if not host:
+            raise ValueError(f"bad worker entry {part!r} (want host:nproc)")
+        hosts.append((host, int(n)))
+    if not hosts:
+        raise ValueError("empty worker list")
+    return hosts
+
+
+def build_slot_plan(hosts: list[tuple[str, int]]) -> list[dict]:
+    """Host-major rank assignment with horovod's slot-info fields:
+
+    - ``rank``: global, host-major then slot order
+    - ``local_rank`` / ``local_size``: position / count on the host
+    - ``cross_rank``: index of the host among hosts that have a slot at
+      this local_rank; ``cross_size``: count of such hosts
+    (ref: horovod get_host_assignments, consumed at
+    runtime/HorovodRuntime.java:312-350).
+    """
+    plan: list[dict] = []
+    size = sum(n for _, n in hosts)
+    rank = 0
+    for host, nproc in hosts:
+        for local_rank in range(nproc):
+            cross_hosts = [h for h, n in hosts if n > local_rank]
+            plan.append({
+                "hostname": host,
+                "rank": rank,
+                "size": size,
+                "local_rank": local_rank,
+                "local_size": nproc,
+                "cross_rank": cross_hosts.index(host),
+                "cross_size": len(cross_hosts),
+            })
+            rank += 1
+    return plan
+
+
+def build_fake_slot_plan() -> list[dict]:
+    """Ref: _build_fake_host_plan :44-66 — a 2-slot localhost plan used by
+    the conf-gated test mode so CI needs no real rendezvous."""
+    return build_slot_plan([("localhost", 2)])
+
+
+# ---------------------------------------------------------------------------
+# Minimal gloo-style rendezvous KV server
+# ---------------------------------------------------------------------------
+
+class _KVHandler(http.server.BaseHTTPRequestHandler):
+    """PUT stores the body under the path, GET returns it (404 until set),
+    DELETE removes it — the gloo rendezvous contract shape."""
+
+    store: dict[str, bytes] = {}
+    lock = threading.Lock()
+
+    def do_PUT(self) -> None:  # noqa: N802
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        with self.lock:
+            self.store[self.path] = body
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self) -> None:  # noqa: N802
+        with self.lock:
+            body = self.store.get(self.path)
+        if body is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        with self.lock:
+            self.store.pop(self.path, None)
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, fmt: str, *args) -> None:  # quiet
+        pass
+
+
+def start_rendezvous_server() -> tuple[http.server.ThreadingHTTPServer, int]:
+    server = http.server.ThreadingHTTPServer(("0.0.0.0", 0), _KVHandler)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="rendezvous-http", daemon=True)
+    thread.start()
+    return server, server.server_address[1]
+
+
+# ---------------------------------------------------------------------------
+# Port-file announcement (the TonY driver contract)
+# ---------------------------------------------------------------------------
+
+def create_port_file(directory: str, port: int, plan: list[dict]) -> str:
+    """Atomically write ``{port}____HOROVOD_RENDEZVOUS_SERVER____`` holding
+    the slot-plan JSON (ref: create_port_file :130-136)."""
+    os.makedirs(directory, exist_ok=True)
+    final = os.path.join(directory, f"{port}{PORT_FILE_SUFFIX}")
+    tmp = final + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"port": port, "slots": plan}, f)
+    os.replace(tmp, final)
+    return final
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-w", "--worker-list", required=True,
+                    help="comma list of host:nproc")
+    ap.add_argument("-d", "--dir", default=".",
+                    help="directory for the port file (default cwd)")
+    ap.add_argument("--fake", action="store_true",
+                    help="test mode: fake plan + fake port, no server")
+    ap.add_argument("--fail", action="store_true",
+                    help="test mode: exit 1 immediately (fast-fail)")
+    args = ap.parse_args(argv)
+
+    if args.fail:
+        print("driver fast-fail test mode", file=sys.stderr)
+        return 1
+
+    if args.fake:
+        plan = build_fake_slot_plan()
+        create_port_file(args.dir, FAKE_SERVER_PORT, plan)
+        while True:  # stay alive like a real rendezvous server; AM kills us
+            time.sleep(3600)
+
+    hosts = parse_worker_list(args.worker_list)
+    plan = build_slot_plan(hosts)
+    server, port = start_rendezvous_server()
+    create_port_file(args.dir, port, plan)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

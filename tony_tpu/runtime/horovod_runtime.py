@@ -1,0 +1,308 @@
+"""Horovod-compat runtime: gang + gloo-style rendezvous orchestration.
+
+Reference: runtime/HorovodRuntime.java (357 LoC) + horovod/HorovodDriver.java
+(331 LoC). The reference's most complex runtime path (SURVEY.md §3.4):
+
+- AM side injects a hidden, untracked ``driver`` role
+  (``validateAndUpdateConfig`` :210-232), gates workers until the driver's
+  rendezvous callback arrives (``canStartTask`` :181-207), and attaches the
+  slot plan to the cluster spec handed to workers (:87-120).
+- The driver task forks the rendezvous bootstrap
+  (tony_tpu/runtime/horovod_driver.py), polls for its
+  ``{port}____HOROVOD_RENDEZVOUS_SERVER____`` announcement file
+  (HorovodDriver.java ``waitTillServerStarted`` :128), and reports
+  ``{host, port, slots}`` back over ``register_callback_info``
+  (:285-288).
+- Worker tasks receive the plan and export ``HOROVOD_*`` rendezvous/rank
+  env (``setHorovodRunEnv`` :312-350).
+
+On TPU the flagship path is runtime/jax_runtime.py (no rendezvous server
+at all); this runtime exists for capability parity with horovod/gloo-style
+payloads and as the reference's hardest lifecycle test case (driver crash,
+debug driver, fake-mode CI — TestTonyE2E :531-567).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import shlex
+import subprocess
+import sys
+import time
+
+from tony_tpu import constants as C
+from tony_tpu.config import ConfError, TonyConf
+from tony_tpu.config.config import role_key
+from tony_tpu.runtime.base import AMAdapter, Runtime, TaskAdapter, TaskContext
+from tony_tpu.runtime.horovod_driver import PORT_FILE_SUFFIX
+
+log = logging.getLogger(__name__)
+
+AUX_KEY = "__aux__"
+
+
+def build_worker_list(cluster_spec: dict[str, list[str]],
+                      role: str = C.WORKER_JOB_NAME) -> str:
+    """``{"worker": ["h1:p", "h1:p2", "h2:p"]}`` -> ``"h1:2,h2:1"``
+    (ref: HorovodRuntime.buildWorkerList :133-157 groups worker hosts and
+    counts procs per host, order-preserving)."""
+    counts: dict[str, int] = {}
+    for host_port in cluster_spec.get(role, []):
+        host = host_port.rsplit(":", 1)[0]
+        counts[host] = counts.get(host, 0) + 1
+    if not counts:
+        raise ValueError(f"no {role!r} tasks in cluster spec")
+    return ",".join(f"{h}:{n}" for h, n in counts.items())
+
+
+class HorovodDriver:
+    """Forks + babysits the rendezvous bootstrap process (ref:
+    horovod/HorovodDriver.java: ``create`` :97, ``startRendezvousServer``
+    :189, ``waitTillServerStarted`` :128, ``getCallbackInfo`` :317)."""
+
+    POLL_INTERVAL_S = 0.2
+    START_TIMEOUT_S = 30.0
+
+    def __init__(self, proc: subprocess.Popen, port: int, slots: list[dict],
+                 workdir: str):
+        self.proc = proc
+        self.port = port
+        self.slots = slots
+        self.workdir = workdir
+
+    @classmethod
+    def create(cls, worker_list: str, workdir: str, fake: bool = False,
+               fail: bool = False, debug_command: str = "") -> "HorovodDriver":
+        """Fork the driver script (or a user debug command, ref: debug mode
+        HorovodDriver.java:189-216) and wait for the port file."""
+        os.makedirs(workdir, exist_ok=True)
+        for stale in glob.glob(os.path.join(workdir, f"*{PORT_FILE_SUFFIX}")):
+            os.remove(stale)
+        if debug_command:
+            cmd = shlex.split(debug_command)
+        else:
+            cmd = [sys.executable, "-m", "tony_tpu.runtime.horovod_driver",
+                   "-w", worker_list, "-d", workdir]
+            if fake:
+                cmd.append("--fake")
+            if fail:
+                cmd.append("--fail")
+        # the driver runs from the job workdir; make sure the package stays
+        # importable there (agents may run from an unpacked staging dir)
+        env = dict(os.environ)
+        import tony_tpu
+        pkg_parent = os.path.dirname(os.path.dirname(tony_tpu.__file__))
+        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(cmd, cwd=workdir, env=env)
+        deadline = time.time() + cls.START_TIMEOUT_S
+        while time.time() < deadline:
+            files = glob.glob(os.path.join(workdir, f"*{PORT_FILE_SUFFIX}"))
+            if files:
+                # the in-tree driver writes atomically (os.replace), but a
+                # user debug command may not — treat a torn/partial file as
+                # "not announced yet" and keep polling until the deadline
+                try:
+                    name = os.path.basename(files[0])
+                    port = int(name[: -len(PORT_FILE_SUFFIX)])
+                    with open(files[0]) as f:
+                        slots = json.load(f)["slots"]
+                    return cls(proc, port, slots, workdir)
+                except (ValueError, KeyError, OSError):
+                    pass
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"rendezvous driver exited {proc.returncode} before "
+                    "announcing its port")
+            time.sleep(cls.POLL_INTERVAL_S)
+        proc.kill()
+        raise TimeoutError("rendezvous driver did not announce a port in "
+                           f"{cls.START_TIMEOUT_S}s")
+
+    def callback_info(self, host: str) -> str:
+        """JSON shipped to the AM (ref: DriverCallbackInfo {port, host,
+        slotInfos})."""
+        return json.dumps(
+            {"host": host, "port": self.port, "slots": self.slots})
+
+    def wait(self) -> int:
+        return self.proc.wait()
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+
+
+class HorovodAMAdapter(AMAdapter):
+    def __init__(self) -> None:
+        super().__init__()
+        self.driver_ready = False
+        self.rendezvous_host = ""
+        self.rendezvous_port = 0
+        self.slots: list[dict] = []
+
+    def validate_and_update_config(self, conf: TonyConf) -> None:
+        """Inject the hidden untracked driver role (ref:
+        validateAndUpdateConfig :210-232). Runs in both the client and the
+        coordinator (TonyClient.validateTonyConf + AM init), so it must be
+        idempotent: a marker key distinguishes our own injected driver role
+        from a user-declared one."""
+        if conf.get_bool("tony.horovod.driver-injected", False):
+            return
+        if C.DRIVER_JOB_NAME in conf.roles():
+            raise ConfError(
+                "role name 'driver' is reserved by the horovod runtime")
+        if C.WORKER_JOB_NAME not in conf.roles():
+            raise ConfError(
+                "horovod runtime requires a 'worker' role (the rendezvous "
+                "plan is built from worker hosts)")
+        conf.set("tony.horovod.driver-injected", True)
+        conf.set(role_key(C.DRIVER_JOB_NAME, "instances"), 1)
+        # ":" is a no-op shell command; the task adapter intercepts the
+        # driver role before exec, but the launcher requires a command
+        conf.set(role_key(C.DRIVER_JOB_NAME, "command"), ":")
+        untracked = conf.get_list("tony.application.untracked.jobtypes")
+        if C.DRIVER_JOB_NAME not in untracked:
+            conf.append("tony.application.untracked.jobtypes",
+                        C.DRIVER_JOB_NAME)
+
+    def can_start_task(self, mode: str, task_id: str) -> bool:
+        """Driver starts once every *other* task has registered (it needs
+        their hosts for the worker list); workers start once the driver's
+        rendezvous callback arrived (ref: canStartTask :181-207)."""
+        assert self.session is not None
+        role = task_id.split(":")[0]
+        if role == C.DRIVER_JOB_NAME:
+            # the driver only needs the *worker* hosts (build_worker_list
+            # covers the worker role alone), so gate on the worker role's
+            # expected instance count — not allocated Task objects (with
+            # DAG staging, unallocated slots are None and an allocated-only
+            # check is vacuously true) and not every conf role (a role
+            # scheduled in a later stage would deadlock the gate forever)
+            req = self.session.requests.get(C.WORKER_JOB_NAME)
+            if req is None:
+                return False
+            registered = sum(
+                1 for t in self.session.all_tasks()
+                if t.role == C.WORKER_JOB_NAME and t.registered)
+            return registered >= req.instances
+        return self.driver_ready and self.session.all_registered()
+
+    def construct_cluster_spec(self, task_id: str) -> str:
+        assert self.session is not None
+        spec: dict = dict(self.session.cluster_spec())
+        role = task_id.split(":")[0]
+        if role != C.DRIVER_JOB_NAME:
+            spec[AUX_KEY] = {
+                "rendezvous_host": self.rendezvous_host,
+                "rendezvous_port": self.rendezvous_port,
+                "slots": self.slots,
+            }
+        return json.dumps(spec)
+
+    def receive_task_callback_info(self, task_id: str, info: str) -> None:
+        """Ref: receiveTaskCallbackInfo :161-178."""
+        data = json.loads(info)
+        self.rendezvous_host = data["host"]
+        self.rendezvous_port = int(data["port"])
+        self.slots = list(data["slots"])
+        self.driver_ready = True
+        log.info("rendezvous ready at %s:%d with %d slots (from %s)",
+                 self.rendezvous_host, self.rendezvous_port,
+                 len(self.slots), task_id)
+
+
+class HorovodTaskAdapter(TaskAdapter):
+    def need_reserve_tb_port(self, ctx_role: str, is_chief: bool,
+                             conf: TonyConf) -> bool:
+        if ctx_role == C.DRIVER_JOB_NAME:
+            return False
+        return super().need_reserve_tb_port(ctx_role, is_chief, conf)
+
+    # -- driver task ---------------------------------------------------------
+    def _run_driver(self, ctx: TaskContext) -> int:
+        """Ref: HorovodRuntime.Task.run driver branch :268-296."""
+        worker_list = build_worker_list(ctx.cluster_spec)
+        fake = ctx.conf.get_bool("tony.horovod.test-mode", False)
+        fail = ctx.conf.get_bool("tony.horovod.test-fast-fail", False)
+        debug_cmd = str(ctx.conf.get("tony.horovod.driver.debug-command", ""))
+        try:
+            driver = HorovodDriver.create(
+                worker_list, workdir=ctx.workdir or ".", fake=fake, fail=fail,
+                debug_command=debug_cmd)
+        except Exception:
+            log.exception("rendezvous driver failed to start")
+            return C.EXIT_FAIL
+        host = ctx.cluster_spec[C.DRIVER_JOB_NAME][0].rsplit(":", 1)[0] \
+            if ctx.cluster_spec.get(C.DRIVER_JOB_NAME) else "localhost"
+        # everything after the fork is under try/finally so a failed
+        # callback RPC can't orphan the rendezvous server process
+        try:
+            if ctx.callback_to_am:
+                ctx.callback_to_am(driver.callback_info(host))
+            # stay up serving rendezvous until the coordinator tears us
+            # down (driver is untracked; ref: driver.waitFor() :291)
+            return driver.wait()
+        finally:
+            driver.kill()
+
+    # -- worker task ---------------------------------------------------------
+    def _my_slot(self, ctx: TaskContext) -> dict:
+        """Pick this worker's slot: group plan slots by host, take the Nth
+        slot of our host where N = our position among same-host workers in
+        the cluster spec (ref: setHorovodRunEnv matches slots by host
+        :312-350)."""
+        me = ctx.cluster_spec[ctx.role][ctx.index]
+        my_host = me.rsplit(":", 1)[0]
+        same_host_position = sum(
+            1 for hp in ctx.cluster_spec[ctx.role][: ctx.index]
+            if hp.rsplit(":", 1)[0] == my_host)
+        workers = ctx.cluster_spec[ctx.role]
+        host_slots = [s for s in ctx.aux.get("slots", [])
+                      if s["hostname"] == my_host]
+        if host_slots and same_host_position < len(host_slots):
+            return host_slots[same_host_position]
+        # fake/test plans use "localhost" hostnames that won't match real
+        # hosts: fall back to flat worker order (never per-host position,
+        # which would hand distinct workers the same slot)
+        flat = list(ctx.aux.get("slots", []))
+        if ctx.index < len(flat):
+            return flat[ctx.index]
+        return {"hostname": my_host, "rank": ctx.index,
+                "size": len(workers), "local_rank": same_host_position,
+                "local_size": len(host_slots) or 1, "cross_rank": 0,
+                "cross_size": 1}
+
+    def build_task_env(self, ctx: TaskContext) -> dict[str, str]:
+        env = super().build_task_env(ctx)
+        # only workers hold slots in the plan (build_worker_list covers the
+        # worker role alone) — a co-located chief/evaluator must not match
+        # by hostname and steal a worker's rank
+        if ctx.role != C.WORKER_JOB_NAME or not ctx.aux:
+            return env
+        slot = self._my_slot(ctx)
+        env[C.HOROVOD_CONTROLLER] = "gloo"
+        env[C.HOROVOD_CPU_OPERATIONS] = "gloo"
+        env[C.HOROVOD_GLOO_RENDEZVOUS_ADDR] = str(ctx.aux["rendezvous_host"])
+        env[C.HOROVOD_GLOO_RENDEZVOUS_PORT] = str(ctx.aux["rendezvous_port"])
+        env[C.HOROVOD_HOSTNAME] = str(slot["hostname"])
+        env[C.HOROVOD_RANK] = str(slot["rank"])
+        env[C.HOROVOD_SIZE] = str(slot["size"])
+        env[C.HOROVOD_LOCAL_RANK] = str(slot["local_rank"])
+        env[C.HOROVOD_LOCAL_SIZE] = str(slot["local_size"])
+        env[C.HOROVOD_CROSS_RANK] = str(slot["cross_rank"])
+        env[C.HOROVOD_CROSS_SIZE] = str(slot["cross_size"])
+        return env
+
+    def run(self, ctx: TaskContext) -> int:
+        if ctx.role == C.DRIVER_JOB_NAME:
+            return self._run_driver(ctx)
+        return super().run(ctx)
+
+
+class HorovodRuntime(Runtime):
+    name = "horovod"
+    am_adapter_cls = HorovodAMAdapter
+    task_adapter_cls = HorovodTaskAdapter

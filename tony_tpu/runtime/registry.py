@@ -9,6 +9,7 @@ registry plus ``register()`` for out-of-tree runtimes.
 from __future__ import annotations
 
 from tony_tpu.runtime.base import AMAdapter, Runtime, TaskAdapter
+from tony_tpu.runtime.horovod_runtime import HorovodRuntime
 from tony_tpu.runtime.jax_runtime import JaxRuntime
 from tony_tpu.runtime.mxnet_runtime import MXNetRuntime
 from tony_tpu.runtime.pytorch_runtime import PyTorchRuntime
@@ -25,7 +26,7 @@ def register(runtime_cls: type[Runtime]) -> type[Runtime]:
 
 
 for _rt in (JaxRuntime, TFRuntime, PyTorchRuntime, MXNetRuntime,
-            StandaloneRuntime, RayRuntime):
+            HorovodRuntime, StandaloneRuntime, RayRuntime):
     register(_rt)
 
 
