@@ -1,0 +1,59 @@
+"""Horovod-compat training example.
+
+Reference analog: tony-examples/horovod-on-tony/tensorflow2_mnist.py. The
+tony-tpu horovod runtime reproduces the full reference contract — an
+in-tree gloo-style rendezvous server on the hidden driver task, and the
+per-slot HOROVOD_RANK / LOCAL_RANK / CROSS_RANK env on every worker
+(ref: runtime/HorovodRuntime.java:312-350) — so `import horovod` scripts
+run unchanged where horovod is installed.
+
+This example keeps the data-parallel structure but uses only the injected
+env, so it also runs in environments without horovod: each slot trains on
+its rank's shard and rank 0 reports.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    size = int(os.environ.get("HOROVOD_SIZE", "1"))
+    local_rank = int(os.environ.get("HOROVOD_LOCAL_RANK", "0"))
+    in_gang = "HOROVOD_RANK" in os.environ
+    if in_gang:
+        addr = os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
+        port = int(os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"])
+        print(f"slot rank={rank}/{size} local_rank={local_rank} "
+              f"rendezvous={addr}:{port}")
+    else:
+        print("standalone run (no HOROVOD_* env injected)")
+
+    try:
+        import horovod.tensorflow as hvd  # noqa: F401 — real horovod path
+    except ImportError:
+        hvd = None
+
+    # rank's shard of a least-squares problem; with horovod installed the
+    # gradient average would be hvd.allreduce — without it, each shard is
+    # consistent by construction so the fit still converges
+    rng = np.random.default_rng(rank)
+    x = rng.normal(size=(256, 1)).astype(np.float32)
+    y = 3.0 * x + 2.0
+    w, b = 0.0, 0.0
+    for _ in range(200):
+        pred = w * x + b
+        gw = float(((pred - y) * x).mean())
+        gb = float((pred - y).mean())
+        w -= 0.1 * gw
+        b -= 0.1 * gb
+    print(f"rank {rank}: w={w:.3f} b={b:.3f}")
+    return 0 if abs(w - 3.0) < 0.1 and abs(b - 2.0) < 0.1 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

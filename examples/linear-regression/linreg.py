@@ -1,0 +1,58 @@
+"""Distributed least-squares — the smallest possible tony-tpu job.
+
+Reference analog: tony-examples/linearregression-mxnet, which fits a
+linear model with MXNet's KVStore parameter server (DMLC_* roles). On TPU
+the KVStore disappears: each worker computes the gradient on its shard and
+one cross-process gather-and-mean averages them — no server role.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))  # repo root, for standalone runs
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> int:
+    import tony_tpu.distributed as dist
+
+    spec = dist.initialize()
+    role, index = dist.task_identity()
+    nproc = spec["num_processes"] if spec else 1
+
+    # each worker's private shard of y = 3x + 2 + noise
+    rng = np.random.default_rng(index)
+    x = rng.normal(size=(512, 1)).astype(np.float32)
+    y = 3.0 * x + 2.0 + 0.01 * rng.normal(size=x.shape).astype(np.float32)
+
+    def local_grad(w, b, x, y):
+        pred = x @ w + b
+        err = pred - y
+        return (x.T @ err / len(x)), jnp.mean(err)
+
+    w, b = jnp.zeros((1, 1)), jnp.zeros(())
+    step = jax.jit(lambda w, b, x, y: local_grad(w, b, x, y))
+    for _ in range(200):
+        gw, gb = step(w, b, jnp.asarray(x), jnp.asarray(y))
+        # gradient averaging across the gang rides process-level psum when
+        # launched multi-process; standalone it is the identity
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+            gw = multihost_utils.process_allgather(gw).mean(axis=0)
+            gb = multihost_utils.process_allgather(gb).mean(axis=0)
+        w -= 0.1 * gw
+        b -= 0.1 * gb
+
+    w_hat, b_hat = float(w[0, 0]), float(b)
+    print(f"{role}:{index} fitted w={w_hat:.3f} b={b_hat:.3f}")
+    return 0 if abs(w_hat - 3.0) < 0.1 and abs(b_hat - 2.0) < 0.1 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,70 @@
+"""Distributed PyTorch training from tony-tpu's injected DDP env.
+
+Reference analog: tony-examples/mnist-pytorch/mnist_distributed.py, which
+reads RANK / WORLD / INIT_METHOD (set by the reference PyTorchRuntime,
+runtime/PyTorchRuntime.java:45-57) and calls
+``torch.distributed.init_process_group``. tony-tpu's pytorch runtime
+injects the same contract, so this script is what a migrating user keeps
+running unchanged. Gloo backend on CPU hosts; on TPU VMs swap the backend
+for torch-xla's ``xla://`` init.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import torch
+import torch.distributed as td
+import torch.nn as nn
+
+
+def make_dataset(n: int, seed: int):
+    g = torch.Generator().manual_seed(seed)
+    labels = torch.randint(0, 10, (n,), generator=g)
+    images = 0.1 + torch.randn(n, 28, 28, generator=g)
+    for k in range(10):
+        images[labels == k, k * 2:k * 2 + 2, :] += 2.0
+    return images.reshape(n, 784), labels
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch", type=int, default=128)
+    args = p.parse_args()
+
+    rank = int(os.environ.get("RANK", "0"))
+    world = int(os.environ.get("WORLD", os.environ.get("WORLD_SIZE", "1")))
+    init_method = os.environ.get("INIT_METHOD", "")
+    distributed = world > 1 and init_method
+    if distributed:
+        td.init_process_group("gloo", init_method=init_method,
+                              rank=rank, world_size=world)
+        print(f"rank {rank}/{world} joined via {init_method}")
+
+    model = nn.Sequential(nn.Linear(784, 128), nn.ReLU(), nn.Linear(128, 10))
+    if distributed:
+        model = nn.parallel.DistributedDataParallel(model)
+    opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
+    loss_fn = nn.CrossEntropyLoss()
+
+    images, labels = make_dataset(args.batch * 4, seed=rank)
+    loss = None
+    for step in range(args.steps):
+        lo = (step * args.batch) % (images.shape[0] - args.batch)
+        opt.zero_grad()
+        loss = loss_fn(model(images[lo:lo + args.batch]),
+                       labels[lo:lo + args.batch])
+        loss.backward()  # DDP averages grads across the gang here
+        opt.step()
+        if rank == 0:
+            print(f"step {step}: loss={loss.item():.4f}")
+
+    if distributed:
+        td.destroy_process_group()
+    return 0 if loss is not None and loss.item() < 2.3 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,52 @@
+"""Ray head/worker example.
+
+Reference analog: tony-examples/ray-on-tony — ray runs as plain roles with
+custom commands, and `discovery.py` digs the head address out of the
+CLUSTER_SPEC env. tony-tpu's ray runtime promotes discovery to first-class
+env: every task gets RAY_HEAD_ADDRESS / RAY_HEAD_IP / RAY_HEAD_PORT.
+
+With ray installed the head role runs `ray start --head` and workers run
+`ray start --address=$RAY_HEAD_ADDRESS`; this script validates the
+discovery contract (and submits a trivial task when ray is importable).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))  # repo root, for standalone runs
+
+import tony_tpu.distributed as dist
+
+
+def main() -> int:
+    role, index = dist.task_identity()
+    if not role:
+        print("standalone run (not launched by tony-tpu); nothing to discover")
+        return 0
+    head_addr = os.environ.get("RAY_HEAD_ADDRESS", "")
+    head_ip = os.environ.get("RAY_HEAD_IP", "")
+    head_port = os.environ.get("RAY_HEAD_PORT", "")
+    if not head_addr or not head_ip or not head_port.isdigit():
+        print(f"{role}:{index} missing ray discovery env", file=sys.stderr)
+        return 1
+    print(f"{role}:{index} discovered head at {head_addr}")
+
+    try:
+        import ray
+    except ImportError:
+        return 0  # env contract validated; no ray in this image
+
+    if role == "head":
+        ray.init()
+        print(ray.cluster_resources())
+    else:
+        ray.init(address=head_addr)
+    ray.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

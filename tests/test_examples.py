@@ -1,0 +1,105 @@
+"""The examples are functional baselines (BASELINE.json "configs"): each
+job.toml must run green through the mini cluster, TestTonyE2E-style —
+the job's exit status is the assertion.
+
+Reference analog: tony-examples/* exercised in docs; here promoted to CI.
+"""
+
+import os
+
+import pytest
+
+from tony_tpu.config import build_conf
+from tony_tpu.mini import MiniTonyCluster
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+@pytest.fixture
+def cluster():
+    with MiniTonyCluster() as c:
+        yield c
+
+
+def example_conf(cluster, name, **overrides):
+    conf = cluster.adopt(build_conf(os.path.join(EXAMPLES, name, "job.toml")))
+    # resolve the entrypoint relative to the repo root
+    conf.set("tony.application.executes",
+             os.path.join(REPO, str(conf.get("tony.application.executes"))))
+    for k, v in overrides.items():
+        conf.set(k, v)
+    return conf
+
+
+def test_linear_regression_example(cluster):
+    client = cluster.submit(example_conf(cluster, "linear-regression"))
+    assert client.final_status["status"] == "SUCCEEDED", client.final_status
+
+
+def test_mnist_jax_example(cluster):
+    conf = example_conf(
+        cluster, "mnist-jax",
+        **{"tony.application.task-params": "--steps 8 --global-batch 64"})
+    client = cluster.submit(conf)
+    assert client.final_status["status"] == "SUCCEEDED", client.final_status
+
+
+def test_mnist_pytorch_example(cluster):
+    conf = example_conf(
+        cluster, "mnist-pytorch",
+        **{"tony.application.task-params": "--steps 8 --batch 64"})
+    client = cluster.submit(conf)
+    assert client.final_status["status"] == "SUCCEEDED", client.final_status
+
+
+def test_ray_example(cluster):
+    client = cluster.submit(example_conf(cluster, "ray-on-tony"))
+    assert client.final_status["status"] == "SUCCEEDED", client.final_status
+
+
+def test_horovod_example(cluster):
+    client = cluster.submit(example_conf(cluster, "horovod-on-tony"))
+    assert client.final_status["status"] == "SUCCEEDED", client.final_status
+
+
+def test_examples_run_standalone():
+    """The documented degrade-gracefully contract: every example script
+    exits 0 outside a gang."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    for rel, args in [
+        ("linear-regression/linreg.py", []),
+        ("horovod-on-tony/mnist_hvd.py", []),
+        ("ray-on-tony/example.py", []),
+        ("mnist-pytorch/mnist_ddp.py", ["--steps", "8", "--batch", "64"]),
+    ]:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(EXAMPLES, rel), *args],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, (rel, proc.stdout, proc.stderr)
+
+
+def test_tpu_pod_conf_selects_ssh_launcher():
+    """launch-mode=ssh must reach the SshLauncher (not silently fall back
+    to local subprocesses)."""
+    from tony_tpu.coordinator.coordinator import Coordinator
+    from tony_tpu.coordinator.launcher import SshLauncher
+    import tempfile
+
+    conf = build_conf(os.path.join(EXAMPLES, "tpu-pod", "job.toml"))
+    conf.set("tony.application.hosts", "h1,h2")
+    conf.set("tony.application.security.enabled", False)
+    with tempfile.TemporaryDirectory() as tmp:
+        conf.set("tony.staging-dir", tmp)
+        conf.set("tony.history.location", os.path.join(tmp, "hist"))
+        coord = Coordinator(conf, "application_test_ssh", os.path.join(tmp, "job"))
+        try:
+            assert isinstance(coord.launcher, SshLauncher)
+            assert coord.launcher.hosts == ["h1", "h2"]
+        finally:
+            coord.rpc.stop()
+            coord.metrics_rpc.stop()

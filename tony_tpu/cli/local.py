@@ -22,13 +22,7 @@ def main(argv: list[str] | None = None) -> int:
     parser.prog = "tony-tpu local"
     args = parser.parse_args(argv)
     with MiniTonyCluster() as mini:
-        conf = conf_from_args(args)
-        base = mini.base_conf()
-        for key in ("tony.staging-dir", "tony.history.location",
-                    "tony.task.heartbeat-interval-ms",
-                    "tony.coordinator.monitor-interval-ms",
-                    "tony.client.poll-interval-ms"):
-            conf.set(key, base.get(key))
+        conf = mini.adopt(conf_from_args(args))
         conf.set("tony.application.security.enabled", False)
         ok = TonyClient(conf).run()
     return C.EXIT_SUCCESS if ok else C.EXIT_FAIL

@@ -67,6 +67,15 @@ KEYS: dict[str, Key] = {
     "tony.application.single-node-mode": Key(
         False, bool, "0-instance mode: the coordinator itself hosts the user process"
     ),
+    "tony.application.launch-mode": Key(
+        "local", str, "Agent placement: local (subprocesses) or ssh (remote TPU-VM hosts)"
+    ),
+    "tony.application.hosts": Key(
+        "", str, "Comma list of TPU-VM hosts for launch-mode=ssh, round-robin per task"
+    ),
+    "tony.application.remote-pythonpath": Key(
+        "", str, "PYTHONPATH exported on ssh-launched hosts (repo/install location)"
+    ),
     # coordinator (reference: tony.am.*)
     "tony.coordinator.memory": Key("2g", str, "Coordinator process memory hint"),
     "tony.coordinator.retry-count": Key(

@@ -111,8 +111,7 @@ class Coordinator:
         self.am_adapter.validate_and_update_config(conf)
         self.session = Session(conf, session_id=0)
         self.scheduler: TaskScheduler | None = None
-        self.launcher = launcher or LocalProcessLauncher(self._on_task_process_exit,
-                                                         workdir=job_dir)
+        self.launcher = launcher or self._launcher_from_conf()
         self.metrics = MetricsStore()
         self.liveness = LivenessMonitor(
             conf.get_int("tony.task.heartbeat-interval-ms", 1000),
@@ -178,6 +177,28 @@ class Coordinator:
                     f"task {task_id} exited ({exit_code}) before registering")
         if self.scheduler is not None:
             self.scheduler.on_role_instance_completed(task.role)
+
+    def _launcher_from_conf(self) -> Launcher:
+        """Pick agent placement from tony.application.launch-mode (local
+        subprocesses, or ssh onto the slice's TPU-VM hosts)."""
+        mode = str(self.conf.get("tony.application.launch-mode", "local"))
+        if mode == "ssh":
+            from tony_tpu.coordinator.launcher import SshLauncher
+
+            hosts = [h.strip() for h in
+                     str(self.conf.get("tony.application.hosts", "")).split(",")
+                     if h.strip()]
+            if not hosts:
+                raise ValueError(
+                    "launch-mode=ssh requires tony.application.hosts")
+            return SshLauncher(
+                hosts, self._on_task_process_exit,
+                remote_pythonpath=str(
+                    self.conf.get("tony.application.remote-pythonpath", "")))
+        if mode != "local":
+            raise ValueError(f"unknown tony.application.launch-mode: {mode}")
+        return LocalProcessLauncher(self._on_task_process_exit,
+                                    workdir=self.job_dir)
 
     def _on_task_process_exit(self, task_id: str, exit_code: int) -> None:
         """Launcher backup path (ref: onContainersCompleted ->

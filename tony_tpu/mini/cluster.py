@@ -46,6 +46,19 @@ class MiniTonyCluster:
         conf.set("tony.coordinator.registration-timeout-ms", 60_000)
         return conf
 
+    def adopt(self, conf: TonyConf) -> TonyConf:
+        """Overlay this cluster's staging/history/timing keys onto an
+        externally-built conf (the one merge both `tony-tpu local` and the
+        test harness use)."""
+        base = self.base_conf()
+        for key in ("tony.staging-dir", "tony.history.location",
+                    "tony.task.heartbeat-interval-ms",
+                    "tony.coordinator.monitor-interval-ms",
+                    "tony.client.poll-interval-ms",
+                    "tony.coordinator.registration-timeout-ms"):
+            conf.set(key, base.get(key))
+        return conf
+
     def make_client(self, conf: TonyConf) -> TonyClient:
         return TonyClient(conf)
 
