@@ -1,0 +1,5 @@
+"""Payload assertion: worker_env./shell-env props must reach the task env."""
+import os
+import sys
+
+sys.exit(0 if os.environ.get("WF_CANARY") == "present" else 1)

@@ -1,0 +1,30 @@
+"""Payload: a tiny training loop polling StepProfiler; exits 0 only if an
+on-demand capture actually happened (driven by the coordinator's
+request_profile command through the heartbeat channel)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ["TONY_REPO_ROOT"])
+
+import jax.numpy as jnp
+
+from tony_tpu.profiler import StepProfiler
+
+
+def main() -> int:
+    prof = StepProfiler()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        (jnp.ones((16, 16)) @ jnp.ones((16, 16))).block_until_ready()
+        prof.poll()
+        if prof.captures >= 1 and prof.active_steps_left == 0:
+            print("capture complete")
+            return 0
+        time.sleep(0.05)
+    print("no capture before deadline", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -76,6 +76,7 @@ def test_examples_run_standalone():
         ("horovod-on-tony/mnist_hvd.py", []),
         ("ray-on-tony/example.py", []),
         ("mnist-pytorch/mnist_ddp.py", ["--steps", "8", "--batch", "64"]),
+        ("mnist-jax/mnist_spmd.py", ["--steps", "8", "--global-batch", "64"]),
     ]:
         proc = subprocess.run(
             [sys.executable, os.path.join(EXAMPLES, rel), *args],

@@ -1,0 +1,68 @@
+"""Profiler subsystem tests (greenfield vs reference; SURVEY.md §5.1)."""
+
+import glob
+import json
+import os
+
+import jax.numpy as jnp
+
+from tony_tpu.profiler import StepProfiler, trigger_path, write_trigger
+
+
+def test_trigger_roundtrip(tmp_path):
+    path = write_trigger(str(tmp_path), num_steps=3, task_id="worker:1")
+    assert path == trigger_path(str(tmp_path), "worker:1")
+    with open(path) as f:
+        assert json.load(f)["num_steps"] == 3
+    # per-task isolation: a different task's poller must not see it
+    assert not os.path.exists(trigger_path(str(tmp_path), "worker:0"))
+
+
+def test_step_profiler_captures_trace(tmp_path):
+    prof = StepProfiler(workdir=str(tmp_path), task_id="worker:0")
+    assert prof.poll() is False  # idle poll is cheap + false
+    write_trigger(str(tmp_path), num_steps=2, task_id="worker:0",
+                  logdir=str(tmp_path / "prof"))
+    for _ in range(4):
+        (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+        prof.poll()
+    assert prof.captures == 1
+    assert prof.active_steps_left == 0
+    # trigger consumed; xplane artifacts written
+    assert not os.path.exists(trigger_path(str(tmp_path), "worker:0"))
+    artifacts = glob.glob(str(tmp_path / "prof" / "**" / "*"), recursive=True)
+    assert any(os.path.isfile(a) for a in artifacts), artifacts
+
+
+def test_step_profiler_ignores_foreign_trigger(tmp_path):
+    prof = StepProfiler(workdir=str(tmp_path), task_id="worker:0")
+    write_trigger(str(tmp_path), num_steps=1, task_id="worker:1")
+    assert prof.poll() is False
+    assert prof.captures == 0
+
+
+def test_coordinator_command_queue():
+    """request_profile -> queued -> drained exactly once on heartbeat."""
+    import tempfile
+
+    from tony_tpu.config import TonyConf
+    from tony_tpu.coordinator.coordinator import ClientRpcHandler, Coordinator
+
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 1)
+    conf.set("tony.application.security.enabled", False)
+    with tempfile.TemporaryDirectory() as tmp:
+        conf.set("tony.staging-dir", tmp)
+        conf.set("tony.history.location", os.path.join(tmp, "hist"))
+        coord = Coordinator(conf, "application_cmdq", os.path.join(tmp, "job"))
+        try:
+            handler = ClientRpcHandler(coord)
+            assert handler.request_profile("worker:0", 7) is True
+            assert handler.request_profile("ghost:9", 1) is False
+            resp = handler.task_executor_heartbeat("worker:0")
+            assert resp["commands"] == [{"type": "profile", "num_steps": 7}]
+            # drained: second heartbeat is empty
+            assert handler.task_executor_heartbeat("worker:0")["commands"] == []
+        finally:
+            coord.rpc.stop()
+            coord.metrics_rpc.stop()

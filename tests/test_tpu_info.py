@@ -1,0 +1,104 @@
+"""TPU device discovery tests — the TestGpuDiscoverer /
+TestGpuDeviceInformationParser analogs (canned outputs, error cap)."""
+
+import json
+import os
+import stat
+
+import pytest
+
+from tony_tpu.utils import tpu_info as T
+
+CANNED = {
+    "accelerator_type": "v5p-32",
+    "chips": [
+        {"device_id": 0, "hbm_used_bytes": 1024, "hbm_total_bytes": 95 * 2**30,
+         "duty_cycle_pct": 93.5},
+        {"device_id": 1, "hbm_used_bytes": 2048, "hbm_total_bytes": 95 * 2**30,
+         "duty_cycle_pct": 86.5},
+    ],
+}
+
+
+def fake_info_binary(tmp_path, payload: str, exit_code: int = 0) -> str:
+    path = tmp_path / "tpu-info"
+    path.write_text("#!/bin/sh\n"
+                    f"cat <<'EOF'\n{payload}\nEOF\n"
+                    f"exit {exit_code}\n")
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return str(path)
+
+
+def test_parse_canned_json():
+    info = T.parse_tpu_info_json(json.dumps(CANNED))
+    assert info.accelerator_type == "v5p-32"
+    assert info.chip_count == 2
+    assert info.chips[1].hbm_used_bytes == 2048
+    assert info.source == "info-command"
+
+
+@pytest.mark.parametrize("bad", ["not json", "[]", '{"chips": 3}',
+                                 '{"chips": ["x"]}'])
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(T.TpuInfoException):
+        T.parse_tpu_info_json(bad)
+
+
+def test_discoverer_runs_info_command(tmp_path):
+    binary = fake_info_binary(tmp_path, json.dumps(CANNED))
+    d = T.TpuDiscoverer(info_exec_path=binary)
+    info = d.get_device_information()
+    assert info.source == "info-command"
+    assert info.chip_count == 2
+    metrics = d.device_metrics()
+    assert metrics["util"] == pytest.approx(90.0)
+    assert metrics["hbm"] == 3072.0
+
+
+def test_discoverer_error_cap(tmp_path, monkeypatch):
+    """Ref: GpuDiscoverer gives up after 10 consecutive failures."""
+    monkeypatch.setattr(T, "ACCEL_DEVICE_GLOBS", ())
+    monkeypatch.delenv("TPU_CHIPS_PER_HOST_BOUNDS", raising=False)
+    binary = fake_info_binary(tmp_path, "garbage", exit_code=0)
+    d = T.TpuDiscoverer(info_exec_path=binary)
+    for _ in range(T.MAX_REPEATED_ERRORS + 2):
+        d.get_device_information()
+    assert d.error_count == T.MAX_REPEATED_ERRORS
+    # capped: no more subprocess attempts
+    assert d._run_info_command() is None
+
+
+def test_fallback_to_device_files(tmp_path, monkeypatch):
+    for i in range(4):
+        (tmp_path / f"accel{i}").touch()
+    monkeypatch.setattr(T, "ACCEL_DEVICE_GLOBS",
+                        (str(tmp_path / "accel*"),))
+    d = T.TpuDiscoverer(info_exec_path=str(tmp_path / "missing"))
+    info = d.get_device_information()
+    assert info.source == "device-files"
+    assert info.chip_count == 4
+    assert d.device_metrics() == {}  # presence only, no counters
+
+
+def test_fallback_to_env(monkeypatch):
+    monkeypatch.setattr(T, "ACCEL_DEVICE_GLOBS", ())
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-8")
+    d = T.TpuDiscoverer(info_exec_path="/nonexistent")
+    info = d.get_device_information()
+    assert info.source == "env"
+    assert info.chip_count == 4
+    assert info.accelerator_type == "v5p-8"
+
+
+def test_sampler_folds_tpu_metrics(tmp_path):
+    """TaskMetricsMonitor integrates discoverer output into max/avg."""
+    from tony_tpu.metrics import sampler as S
+
+    binary = fake_info_binary(tmp_path, json.dumps(CANNED))
+    mon = S.TaskMetricsMonitor(lambda: os.getpid(), lambda m: None,
+                               tpu_info_exec_path=binary)
+    mon.sample_once()
+    assert mon.metrics[S.MAX_TPU_UTIL] == pytest.approx(90.0)
+    assert mon.metrics[S.AVG_TPU_HBM] == pytest.approx(3072.0)
+    assert mon.metrics[S.MAX_MEMORY_RSS] > 0

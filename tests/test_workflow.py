@@ -1,0 +1,117 @@
+"""Workflow jobtype tests (tony-azkaban equivalent).
+
+Reference analog: tony-azkaban's TonyJob prop collection + tag injection
+(TonyJob.java:55-70) and TonyJobArg prop->arg mapping.
+"""
+
+import json
+import os
+
+import pytest
+
+from tony_tpu.mini import MiniTonyCluster
+from tony_tpu.workflow import FlowContext, TonyTpuOperator, WorkflowJob
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "scripts")
+
+
+def test_collects_tony_props_and_standard_args(tmp_path):
+    job = WorkflowJob(
+        job_id="train",
+        props={
+            "tony.worker.instances": "3",
+            "tony.application.framework": "pytorch",
+            "executes": "train.py",
+            "task_params": "--epochs 2",
+            "python_binary_path": "python3.12",
+            "unrelated.prop": "ignored",
+        },
+        working_dir=str(tmp_path),
+    )
+    conf = job.build_conf()
+    assert conf.get_int("tony.worker.instances") == 3
+    assert conf.get("tony.application.framework") == "pytorch"
+    assert conf.get("tony.application.executes") == "train.py"
+    assert conf.get("tony.application.task-params") == "--epochs 2"
+    assert conf.get("tony.application.python-command") == "python3.12"
+    assert conf.get("unrelated.prop") is None
+
+
+def test_flow_tags_injected(tmp_path):
+    job = WorkflowJob(
+        job_id="j1", props={}, working_dir=str(tmp_path),
+        flow=FlowContext(execution_id="42", flow_id="nightly",
+                         project_name="ml", scheduler_host="sched:8081"))
+    conf = job.build_conf()
+    tags = str(conf.get("tony.application.tags"))
+    assert "execution_id:42" in tags
+    assert "flow_id:nightly" in tags
+    assert "project_name:ml" in tags
+    # flow id becomes the app name when the user didn't set one
+    assert conf.get("tony.application.name") == "nightly"
+
+
+def test_worker_env_props_become_shell_env(tmp_path):
+    job = WorkflowJob(
+        job_id="j2",
+        props={"worker_env.FOO": "bar", "worker_env.BAZ": "1",
+               "shell_env": "USER_SET=x"},
+        working_dir=str(tmp_path))
+    conf = job.build_conf()
+    shell_env = str(conf.get("tony.application.shell-env"))
+    assert "USER_SET=x" in shell_env
+    assert "FOO=bar" in shell_env
+    assert "BAZ=1" in shell_env
+
+
+def test_generated_conf_written(tmp_path):
+    job = WorkflowJob(job_id="j3", props={"tony.worker.instances": "2"},
+                      working_dir=str(tmp_path))
+    path = job.write_generated_conf(job.build_conf())
+    assert os.path.exists(path)
+    with open(path) as f:
+        data = json.load(f)
+    assert data["tony.worker.instances"] == 2
+
+
+def test_operator_end_to_end_submits():
+    """The operator runs a real job through the mini cluster, and the
+    shell-env prop reaches the task (payload asserts it)."""
+    check = os.path.join(SCRIPTS, "check_shell_env.py")
+    with MiniTonyCluster() as cluster:
+        base = cluster.base_conf()
+        op = TonyTpuOperator(
+            task_id="wf-train",
+            executes=check,
+            props={
+                "tony.worker.instances": "1",
+                "worker_env.WF_CANARY": "present",
+                "tony.staging-dir": str(base.get("tony.staging-dir")),
+                "tony.history.location": str(base.get("tony.history.location")),
+                "tony.task.heartbeat-interval-ms": "100",
+                "tony.coordinator.monitor-interval-ms": "100",
+                "tony.client.poll-interval-ms": "100",
+            },
+            working_dir=os.path.join(cluster.root, "wf"),
+        )
+        assert op.execute({"dag_run": None, "dag": None}) is True
+
+
+def test_operator_raises_on_failure():
+    with MiniTonyCluster() as cluster:
+        base = cluster.base_conf()
+        op = TonyTpuOperator(
+            task_id="wf-fail",
+            executes=os.path.join(SCRIPTS, "exit_1.py"),
+            props={
+                "tony.worker.instances": "1",
+                "tony.staging-dir": str(base.get("tony.staging-dir")),
+                "tony.history.location": str(base.get("tony.history.location")),
+                "tony.task.heartbeat-interval-ms": "100",
+                "tony.coordinator.monitor-interval-ms": "100",
+                "tony.client.poll-interval-ms": "100",
+            },
+            working_dir=os.path.join(cluster.root, "wf"),
+        )
+        with pytest.raises(RuntimeError):
+            op.execute()

@@ -36,13 +36,29 @@ class Heartbeater(threading.Thread):
 
     MAX_SEND_FAILURES = 5
 
-    def __init__(self, client: RpcClient, task_id: str, interval_ms: int):
+    def __init__(self, client: RpcClient, task_id: str, interval_ms: int,
+                 workdir: str | None = None):
         super().__init__(name="heartbeater", daemon=True)
         self.client = client
         self.task_id = task_id
         self.interval_s = max(interval_ms, 50) / 1000
         self.misses_to_skip = int(os.environ.get(C.TEST_TASK_NUM_HB_MISS, "0"))
+        self.workdir = workdir
         self._stop = threading.Event()
+
+    def _handle_commands(self, response) -> None:
+        """Coordinator->agent commands piggybacked on the heartbeat ack."""
+        if not isinstance(response, dict):
+            return
+        for cmd in response.get("commands") or []:
+            if cmd.get("type") == "profile" and self.workdir:
+                from tony_tpu.profiler import write_trigger
+
+                write_trigger(self.workdir, int(cmd.get("num_steps", 5)),
+                              task_id=self.task_id)
+                log.info("profile trigger dropped for %s", self.task_id)
+            else:
+                log.warning("unknown coordinator command: %s", cmd)
 
     def run(self) -> None:
         failures = 0
@@ -53,9 +69,15 @@ class Heartbeater(threading.Thread):
                          self.misses_to_skip)
                 continue
             try:
-                self.client.call("task_executor_heartbeat", retries=0,
-                                 task_id=self.task_id)
+                response = self.client.call("task_executor_heartbeat",
+                                            retries=0, task_id=self.task_id)
                 failures = 0
+                try:
+                    self._handle_commands(response)
+                except Exception:
+                    # a bad command must not count against liveness — the
+                    # ping itself already landed
+                    log.exception("coordinator command failed")
             except Exception:
                 failures += 1
                 log.warning("heartbeat send failure %d/%d", failures,
@@ -121,7 +143,8 @@ class TaskAgent:
 
         hb = Heartbeater(
             self.client, self.task_id,
-            self.conf.get_int("tony.task.heartbeat-interval-ms", 1000))
+            self.conf.get_int("tony.task.heartbeat-interval-ms", 1000),
+            workdir=self.job_dir)
         hb.start()
         monitor = None
         if self.metrics_client is not None:
@@ -130,6 +153,8 @@ class TaskAgent:
                 lambda m: self.metrics_client.call(
                     "update_metrics", retries=0, task_id=self.task_id, metrics=m),
                 self.conf.get_int("tony.task.metrics-interval-ms", 5000),
+                tpu_info_exec_path=str(
+                    self.conf.get("tony.tpu.info-exec-path", "")),
             ).start()
 
         host = local_host_name()

@@ -30,6 +30,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--conf", action="append", default=[],
                    help="override, k=v (repeatable)")
     p.add_argument("--python_venv", help="venv dir or zip shipped to tasks")
+    p.add_argument("--shell_env", help="comma K=V pairs exported to tasks")
     p.add_argument("--framework",
                    help="runtime: jax|tensorflow|pytorch|mxnet|standalone|ray")
     p.add_argument("--app_name", help="display name")
@@ -48,6 +49,8 @@ def conf_from_args(args: argparse.Namespace):
         conf.set("tony.application.task-params", args.task_params)
     if args.python_venv:
         conf.set("tony.application.python-venv", args.python_venv)
+    if args.shell_env:
+        conf.set("tony.application.shell-env", args.shell_env)
     if args.framework:
         conf.set("tony.application.framework", args.framework)
     if args.app_name:

@@ -104,6 +104,10 @@ KEYS: dict[str, Key] = {
     "tony.task.reuse-port": Key(
         False, bool, "Reserve rendezvous ports with SO_REUSEPORT across exec (ref: TF_GRPC_REUSE_PORT)"
     ),
+    "tony.task.profiler-port": Key(
+        0, int, "Base port for per-task jax profiler servers (0 = off); "
+        "task flat-index is added so shared hosts don't collide"
+    ),
     # task command construction (ref: TonyClient.buildTaskCommand :618-635)
     "tony.application.executes": Key(
         "", str, "User training entrypoint (script or shell command) run by every task"
@@ -113,6 +117,12 @@ KEYS: dict[str, Key] = {
     ),
     # python environment shipped with the job
     "tony.application.python-venv": Key("", str, "Path to a venv zip shipped to tasks"),
+    "tony.application.shell-env": Key(
+        "", str, "Comma list of K=V pairs exported into every task's env (ref: --shell_env)"
+    ),
+    "tony.application.tags": Key(
+        "", str, "Workflow tags (exec id, flow, project) attached by scheduler integrations"
+    ),
     "tony.application.python-command": Key(
         "", str, "Python interpreter override used to build task commands"
     ),
@@ -151,6 +161,10 @@ KEYS: dict[str, Key] = {
         "", str, "Requested TPU slice topology, e.g. v5p-32; empty = local devices"
     ),
     "tony.tpu.chips-per-host": Key(4, int, "TPU chips per agent host"),
+    "tony.tpu.info-exec-path": Key(
+        "", str, "Path to a tpu-info-style command emitting chip metrics JSON "
+        "(ref: tony.gpu-exec-path for nvidia-smi)"
+    ),
     # test fault injection via conf (reference: tony.horovod.mode.test etc.)
     "tony.test.crash-coordinator": Key(
         False, bool, "Crash the coordinator once after start (ref: TEST_AM_CRASH conf twin)"

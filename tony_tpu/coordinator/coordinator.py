@@ -77,8 +77,17 @@ class ClientRpcHandler:
         return self._coord.application_status()
 
     def task_executor_heartbeat(self, task_id: str):
+        """Liveness ping; the response piggybacks queued coordinator->agent
+        commands (profile requests etc.) — the rebuild's channel for
+        on-demand actions the reference lacks."""
         self._coord.liveness.ping(task_id)
-        return True
+        return {"commands": self._coord.drain_commands(task_id)}
+
+    def request_profile(self, task_id: str, num_steps: int = 5):
+        """Queue an on-demand xplane trace of a task (greenfield vs the
+        reference; SURVEY.md section 5.1)."""
+        return self._coord.queue_command(
+            task_id, {"type": "profile", "num_steps": int(num_steps)})
 
     def register_callback_info(self, task_id: str, info: str):
         self._coord.am_adapter.receive_task_callback_info(task_id, info)
@@ -131,6 +140,20 @@ class Coordinator:
         self._launch_time: dict[str, float] = {}
         self._lock = threading.Lock()
         self._worker_termination_done = False
+        self._pending_commands: dict[str, list[dict]] = {}
+
+    # -------------------------------------------------- agent command queue
+    def queue_command(self, task_id: str, command: dict) -> bool:
+        """Queue a command for delivery on the task's next heartbeat."""
+        with self._lock:
+            if not self.session.has_slot(task_id):
+                return False
+            self._pending_commands.setdefault(task_id, []).append(command)
+        return True
+
+    def drain_commands(self, task_id: str) -> list[dict]:
+        with self._lock:
+            return self._pending_commands.pop(task_id, [])
 
     # ------------------------------------------------------------------ rpc
     def cluster_spec_if_ready(self, task_id: str) -> str | None:

@@ -40,9 +40,12 @@ def env_spec() -> dict | None:
 def initialize(timeout_s: int | None = None) -> dict | None:
     """Call jax.distributed.initialize from injected env. No-op (returns
     None) when running outside a gang or with a single process."""
+    from tony_tpu.profiler import maybe_start_server
+
     spec = env_spec()
     if spec is None or spec["num_processes"] <= 1:
         log.info("single-process run; skipping jax.distributed.initialize")
+        maybe_start_server()  # the profiler port applies at any gang size
         return spec
     import jax
 
@@ -59,6 +62,7 @@ def initialize(timeout_s: int | None = None) -> dict | None:
         "jax.distributed initialized: process %d/%d via %s",
         spec["process_id"], spec["num_processes"], spec["coordinator_address"],
     )
+    maybe_start_server()  # TONY_PROFILER_PORT-gated; no-op otherwise
     return spec
 
 

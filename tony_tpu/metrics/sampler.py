@@ -68,20 +68,18 @@ def _descendants(pid: int) -> set[int]:
     return out
 
 
-def tpu_device_metrics() -> dict[str, float]:
-    """TPU util/HBM metrics hook. Off-pod (no libtpu metrics service) this
-    returns {} — the nvidia-smi-unavailable analog."""
-    return {}
-
-
 class TaskMetricsMonitor:
     """Sampler thread with max/avg aggregation (ref: setAvgMetrics/
     setMaxMetrics TaskMonitor.java:172-186)."""
 
-    def __init__(self, pid_fn, push_fn, interval_ms: int = 5000):
+    def __init__(self, pid_fn, push_fn, interval_ms: int = 5000,
+                 tpu_info_exec_path: str = ""):
+        from tony_tpu.utils.tpu_info import TpuDiscoverer
+
         self.pid_fn = pid_fn  # () -> pid | None of the user process
         self.push_fn = push_fn  # (metrics: dict) -> None
         self.interval_s = max(interval_ms, 100) / 1000
+        self.discoverer = TpuDiscoverer(info_exec_path=tpu_info_exec_path)
         self._samples = 0
         self.metrics: dict[str, float] = {}
         self._stop = threading.Event()
@@ -94,7 +92,7 @@ class TaskMetricsMonitor:
         rss = float(process_tree_rss_bytes(pid))
         self._samples += 1
         self._fold(MAX_MEMORY_RSS, AVG_MEMORY_RSS, rss)
-        tpu = tpu_device_metrics()
+        tpu = self.discoverer.device_metrics()
         if "util" in tpu:
             self._fold(MAX_TPU_UTIL, AVG_TPU_UTIL, tpu["util"])
         if "hbm" in tpu:

@@ -1,0 +1,15 @@
+from tony_tpu.profiler.profiler import (
+    StepProfiler,
+    maybe_start_server,
+    trace,
+    trigger_path,
+    write_trigger,
+)
+
+__all__ = [
+    "StepProfiler",
+    "maybe_start_server",
+    "trace",
+    "trigger_path",
+    "write_trigger",
+]

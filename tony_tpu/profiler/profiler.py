@@ -1,0 +1,162 @@
+"""Tracing/profiling subsystem.
+
+The reference has none (SURVEY.md §5.1: "Rebuild note: TPU equivalent
+should add jax.profiler/xplane trace capture — greenfield"). Design:
+
+- Every task can host a ``jax.profiler`` server (``TONY_PROFILER_PORT``
+  env, set from ``tony.task.profiler-port``) so TensorBoard's profile
+  plugin can capture remotely.
+- On-demand capture without TensorBoard: the coordinator queues a
+  ``profile`` command for a task (RPC verb ``request_profile``), the
+  agent's heartbeat response delivers it, and the agent drops a trigger
+  file in the task workdir. The user process — any loop that calls
+  ``StepProfiler.poll()`` once per step, which ``tony_tpu.train.Trainer``
+  users get for free — picks the trigger up and writes an xplane trace
+  for the next N steps into the job dir, where the portal/logs page can
+  link it.
+
+Both paths degrade to no-ops off-TPU or when jax is absent; the trigger
+file protocol is plain JSON so non-JAX runtimes can honor it too.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+TRIGGER_FILENAME = ".tony_profile_request"
+PROFILER_PORT_ENV = "TONY_PROFILER_PORT"
+PROFILE_DIR_ENV = "TONY_PROFILE_DIR"
+
+
+def _task_suffix(task_id: str) -> str:
+    return f".{task_id.replace(':', '-')}" if task_id else ""
+
+
+def current_task_id() -> str:
+    """This process's task id from the injected env, or '' standalone."""
+    role = os.environ.get("TONY_JOB_NAME", "")
+    return f"{role}:{os.environ.get('TONY_TASK_INDEX', '0')}" if role else ""
+
+
+def trigger_path(workdir: str, task_id: str = "") -> str:
+    """Per-task trigger file (tasks can share a job dir on one host)."""
+    return os.path.join(workdir, TRIGGER_FILENAME + _task_suffix(task_id))
+
+
+def write_trigger(workdir: str, num_steps: int = 5,
+                  logdir: str | None = None, task_id: str = "") -> str:
+    """Agent side: request a trace from the user process in ``workdir``."""
+    path = trigger_path(workdir, task_id)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"num_steps": int(num_steps), "logdir": logdir}, f)
+    os.replace(tmp, path)  # atomic: the poller never sees a partial file
+    return path
+
+
+def maybe_start_server() -> int:
+    """Start jax's profiler server when TONY_PROFILER_PORT is set (called
+    from tony_tpu.distributed.initialize). Returns the port or 0."""
+    port = int(os.environ.get(PROFILER_PORT_ENV, "0") or "0")
+    if port <= 0:
+        return 0
+    try:
+        import jax
+
+        jax.profiler.start_server(port)
+        log.info("jax profiler server on :%d", port)
+        return port
+    except Exception:
+        log.exception("could not start jax profiler server")
+        return 0
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Programmatic xplane trace of a code region."""
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepProfiler:
+    """Poll-per-step on-demand tracing for training loops.
+
+    ``poll()`` is one ``os.path.exists`` when idle — cheap enough to call
+    every step. When a trigger file appears, the next ``num_steps`` steps
+    are traced to the trigger's logdir (default: ``$TONY_PROFILE_DIR`` or
+    ``<workdir>/profiles``).
+    """
+
+    def __init__(self, workdir: str | None = None,
+                 default_logdir: str | None = None,
+                 task_id: str | None = None):
+        self.workdir = workdir or os.getcwd()
+        self.task_id = current_task_id() if task_id is None else task_id
+        self.default_logdir = (default_logdir
+                               or os.environ.get(PROFILE_DIR_ENV)
+                               or os.path.join(self.workdir, "profiles"))
+        self.active_steps_left = 0
+        self.captures = 0
+        self._logdir = ""
+
+    def poll(self) -> bool:
+        """Call once per training step. Returns True while tracing."""
+        if self.active_steps_left > 0:
+            self.active_steps_left -= 1
+            if self.active_steps_left == 0:
+                self._stop()
+            return self.active_steps_left > 0
+        path = trigger_path(self.workdir, self.task_id)
+        if not os.path.exists(path):
+            return False
+        try:
+            with open(path) as f:
+                req = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            req = {}
+        finally:
+            with contextlib.suppress(OSError):
+                os.remove(path)  # consume: one trigger, one capture
+        self._start(req.get("logdir") or self.default_logdir,
+                    int(req.get("num_steps", 5)))
+        return True
+
+    def _start(self, logdir: str, num_steps: int) -> None:
+        try:
+            import jax
+
+            os.makedirs(logdir, exist_ok=True)
+            jax.profiler.start_trace(logdir)
+        except Exception:
+            log.exception("profile trigger ignored: start_trace failed")
+            return
+        self._logdir = logdir
+        self.active_steps_left = max(num_steps, 1)
+        log.info("profiling next %d steps -> %s", self.active_steps_left, logdir)
+
+    def _stop(self) -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            self.captures += 1
+            log.info("profile capture #%d written to %s", self.captures,
+                     self._logdir)
+        except Exception:
+            log.exception("stop_trace failed")
+
+    def close(self) -> None:
+        if self.active_steps_left > 0:
+            self.active_steps_left = 0
+            self._stop()

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable
@@ -128,6 +129,16 @@ class TaskAdapter:
             C.IS_CHIEF: "true" if ctx.is_chief else "false",
             C.CLUSTER_SPEC: json.dumps(ctx.cluster_spec),
         }
+        for pair in str(ctx.conf.get("tony.application.shell-env", "")).split(","):
+            if "=" in pair:
+                k, _, v = pair.partition("=")
+                env[k.strip()] = v
+        if ctx.workdir:
+            env["TONY_PROFILE_DIR"] = os.path.join(
+                ctx.workdir, "profiles", f"{ctx.role}-{ctx.index}")
+        profiler_base = ctx.conf.get_int("tony.task.profiler-port", 0)
+        if profiler_base > 0:  # unique per task on a shared host
+            env["TONY_PROFILER_PORT"] = str(profiler_base + ctx.flat_index())
         if ctx.tb_port > 0:
             env[C.TB_PORT] = str(ctx.tb_port)
         return env

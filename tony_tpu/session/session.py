@@ -114,6 +114,12 @@ class Session:
             return None
         return slots[index]
 
+    def has_slot(self, task_id: str) -> bool:
+        """Whether ``task_id`` names a configured slot (allocated or not)."""
+        role, _, idx = task_id.rpartition(":")
+        slots = self.tasks.get(role)
+        return slots is not None and idx.isdigit() and int(idx) < len(slots)
+
     def get_task_by_id(self, task_id: str) -> Task | None:
         role, _, idx = task_id.rpartition(":")
         if not role or not idx.isdigit():

@@ -1,0 +1,209 @@
+"""TPU device discovery — the GpuDiscoverer equivalent.
+
+Reference: util/gpu/GpuDiscoverer.java:43 shells out to ``nvidia-smi -x -q``
+(binary found via a configurable path + default search dirs), JAXB-parses
+the XML into POJOs, and gives up after 10 consecutive failures. The TPU
+analog discovers chips and their HBM/duty-cycle metrics from, in order:
+
+1. an external info command (``tpu-info``-style; path configurable via
+   ``tony.tpu.info-exec-path``) emitting the JSON contract below,
+2. the VM's accelerator device files (``/dev/accel*`` / ``/dev/vfio``),
+3. the TPU-VM metadata env (``TPU_ACCELERATOR_TYPE``,
+   ``TPU_CHIPS_PER_HOST_BOUNDS``, ``TPU_WORKER_ID``).
+
+JSON contract for the info command (wrap ``tpu-info`` or libtpu's metrics
+service on :8431 with a few lines of shell to produce it)::
+
+    {"accelerator_type": "v5p-32",
+     "chips": [{"device_id": 0, "hbm_used_bytes": 1024,
+                "hbm_total_bytes": 99857989632, "duty_cycle_pct": 93.1}]}
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import subprocess
+from dataclasses import dataclass, field
+
+log = logging.getLogger(__name__)
+
+DEFAULT_INFO_COMMAND = "tpu-info"
+# ref: GpuDiscoverer's DEFAULT_BINARY_SEARCH_DIRS (/usr/bin,/bin,...)
+DEFAULT_SEARCH_DIRS = ("/usr/bin", "/bin", "/usr/local/bin")
+MAX_REPEATED_ERRORS = 10  # ref: GpuDiscoverer error cap
+ACCEL_DEVICE_GLOBS = ("/dev/accel*", "/dev/vfio/[0-9]*")
+
+
+@dataclass
+class PerTpuChipInformation:
+    """Ref shape: PerGpuDeviceInformation (utilization + fb memory)."""
+
+    device_id: int
+    hbm_used_bytes: int = 0
+    hbm_total_bytes: int = 0
+    duty_cycle_pct: float = -1.0
+
+    def to_dict(self) -> dict:
+        return {
+            "device_id": self.device_id,
+            "hbm_used_bytes": self.hbm_used_bytes,
+            "hbm_total_bytes": self.hbm_total_bytes,
+            "duty_cycle_pct": self.duty_cycle_pct,
+        }
+
+
+@dataclass
+class TpuDeviceInformation:
+    """Ref shape: GpuDeviceInformation (list of per-device POJOs)."""
+
+    accelerator_type: str = ""
+    chips: list[PerTpuChipInformation] = field(default_factory=list)
+    source: str = "none"  # info-command | device-files | env | none
+
+    @property
+    def chip_count(self) -> int:
+        return len(self.chips)
+
+    def to_dict(self) -> dict:
+        return {
+            "accelerator_type": self.accelerator_type,
+            "source": self.source,
+            "chips": [c.to_dict() for c in self.chips],
+        }
+
+
+class TpuInfoException(Exception):
+    """Ref: GpuInfoException."""
+
+
+def parse_tpu_info_json(text: str) -> TpuDeviceInformation:
+    """Parse the info command's JSON (ref: GpuDeviceInformationParser)."""
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise TpuInfoException(f"malformed tpu info JSON: {e}") from e
+    if not isinstance(data, dict) or not isinstance(data.get("chips"), list):
+        raise TpuInfoException("tpu info JSON missing 'chips' list")
+    chips = []
+    for i, chip in enumerate(data["chips"]):
+        if not isinstance(chip, dict):
+            raise TpuInfoException(f"chip entry {i} is not an object")
+        chips.append(PerTpuChipInformation(
+            device_id=int(chip.get("device_id", i)),
+            hbm_used_bytes=int(chip.get("hbm_used_bytes", 0)),
+            hbm_total_bytes=int(chip.get("hbm_total_bytes", 0)),
+            duty_cycle_pct=float(chip.get("duty_cycle_pct", -1.0)),
+        ))
+    return TpuDeviceInformation(
+        accelerator_type=str(data.get("accelerator_type", "")),
+        chips=chips,
+        source="info-command",
+    )
+
+
+def _chips_from_device_files() -> int:
+    seen = set()
+    for pattern in ACCEL_DEVICE_GLOBS:
+        for path in glob.glob(pattern):
+            seen.add(path)
+    return len(seen)
+
+
+def _chips_from_env() -> tuple[int, str]:
+    """TPU-VM metadata env: bounds like '2,2,1' mean 4 chips per host."""
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS", "")
+    count = 0
+    if bounds:
+        try:
+            dims = [int(d) for d in bounds.split(",") if d.strip()]
+            count = 1
+            for d in dims:
+                count *= d
+        except ValueError:
+            count = 0
+    return count, accel
+
+
+class TpuDiscoverer:
+    """Cached, error-capped discovery (ref: GpuDiscoverer.getGpuDeviceInformation
+    :88 + the consecutive-error cap)."""
+
+    def __init__(self, info_exec_path: str = "",
+                 search_dirs: tuple[str, ...] = DEFAULT_SEARCH_DIRS,
+                 timeout_s: float = 10.0):
+        self.info_exec_path = info_exec_path
+        self.search_dirs = search_dirs
+        self.timeout_s = timeout_s
+        self.error_count = 0
+        self._binary: str | None = None
+        self.last: TpuDeviceInformation | None = None
+
+    def _resolve_binary(self) -> str | None:
+        if self._binary is not None:
+            return self._binary or None
+        if self.info_exec_path:
+            self._binary = self.info_exec_path if os.path.exists(
+                self.info_exec_path) else ""
+        else:
+            self._binary = ""
+            for d in self.search_dirs:
+                cand = os.path.join(d, DEFAULT_INFO_COMMAND)
+                if os.path.exists(cand):
+                    self._binary = cand
+                    break
+        return self._binary or None
+
+    def _run_info_command(self) -> TpuDeviceInformation | None:
+        binary = self._resolve_binary()
+        if binary is None or self.error_count >= MAX_REPEATED_ERRORS:
+            return None
+        try:
+            out = subprocess.run(
+                [binary, "--format", "json"], capture_output=True, text=True,
+                timeout=self.timeout_s, check=True).stdout
+            info = parse_tpu_info_json(out)
+            self.error_count = 0
+            return info
+        except (subprocess.SubprocessError, OSError, TpuInfoException) as e:
+            self.error_count += 1
+            if self.error_count == MAX_REPEATED_ERRORS:
+                log.warning("tpu info command failed %d times; giving up "
+                            "(last: %s)", self.error_count, e)
+            return None
+
+    def get_device_information(self) -> TpuDeviceInformation:
+        info = self._run_info_command()
+        if info is None:
+            n_files = _chips_from_device_files()
+            n_env, accel = _chips_from_env()
+            if n_files:
+                info = TpuDeviceInformation(
+                    accelerator_type=accel,
+                    chips=[PerTpuChipInformation(i) for i in range(n_files)],
+                    source="device-files")
+            elif n_env:
+                info = TpuDeviceInformation(
+                    accelerator_type=accel,
+                    chips=[PerTpuChipInformation(i) for i in range(n_env)],
+                    source="env")
+            else:
+                info = TpuDeviceInformation(accelerator_type=accel)
+        self.last = info
+        return info
+
+    def device_metrics(self) -> dict[str, float]:
+        """Aggregate util/hbm for the metrics sampler: mean duty cycle over
+        chips reporting one, summed HBM bytes in use."""
+        info = self.get_device_information()
+        duty = [c.duty_cycle_pct for c in info.chips if c.duty_cycle_pct >= 0]
+        out: dict[str, float] = {}
+        if duty:
+            out["util"] = sum(duty) / len(duty)
+        hbm = sum(c.hbm_used_bytes for c in info.chips)
+        if hbm:
+            out["hbm"] = float(hbm)
+        return out

@@ -1,0 +1,4 @@
+from tony_tpu.workflow.job import FlowContext, WorkflowJob
+from tony_tpu.workflow.airflow import TonyTpuOperator
+
+__all__ = ["FlowContext", "WorkflowJob", "TonyTpuOperator"]

@@ -1,0 +1,107 @@
+"""Airflow operator adapter over WorkflowJob.
+
+The reference integrates with Azkaban (its era's LinkedIn scheduler);
+today's equivalent surface is an Airflow operator. No airflow import is
+required — the class duck-types BaseOperator's ``execute(context)``
+contract, and ``as_airflow_operator()`` grafts the real base class on when
+airflow is installed.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+from tony_tpu.workflow.job import FlowContext, WorkflowJob
+
+
+class TonyTpuOperator:
+    """Submit a tony-tpu job from a workflow DAG.
+
+    Parameters mirror the Azkaban jobtype's prop names (TonyJobArg.java)
+    so reference users' job definitions translate one-to-one::
+
+        TonyTpuOperator(
+            task_id="train",
+            executes="train.py",
+            src_dir="src/",
+            props={"tony.worker.instances": "4", "worker_env.FOO": "1"},
+        )
+    """
+
+    template_fields = ("props", "executes", "task_params")
+
+    def __init__(self, task_id: str, executes: str = "", src_dir: str = "",
+                 task_params: str = "", python_venv: str = "",
+                 shell_env: str = "", conf_file: str = "",
+                 props: dict[str, str] | None = None,
+                 working_dir: str = "", **kwargs):
+        self.task_id = task_id
+        self.props = dict(props or {})
+        # kept as attributes (not folded into props) so template_fields
+        # rendering mutates them before execute() merges
+        self.executes = executes
+        self.task_params = task_params
+        self.src_dir = src_dir
+        self.python_venv = python_venv
+        self.shell_env = shell_env
+        self.conf_file = conf_file
+        self.working_dir = working_dir
+        self.kwargs = kwargs
+
+    def _merged_props(self) -> dict[str, str]:
+        props = dict(self.props)
+        for key, value in [("executes", self.executes),
+                           ("src_dir", self.src_dir),
+                           ("task_params", self.task_params),
+                           ("python_venv", self.python_venv),
+                           ("shell_env", self.shell_env),
+                           ("conf_file", self.conf_file)]:
+            if value:
+                props[key] = value
+        return props
+
+    def _flow_context(self, context: dict) -> FlowContext:
+        """Map Airflow's template context to flow lineage tags."""
+        dag = context.get("dag")
+        run = context.get("dag_run")
+        return FlowContext(
+            execution_id=str(getattr(run, "run_id", "") or ""),
+            flow_id=str(getattr(dag, "dag_id", "") or ""),
+            project_name=str(context.get("project_name", "") or ""),
+            scheduler_host=str(context.get("conf_host", "") or ""),
+        )
+
+    def execute(self, context: dict | None = None) -> bool:
+        workdir = self.working_dir or tempfile.mkdtemp(prefix="tony_wf_")
+        os.makedirs(workdir, exist_ok=True)
+        job = WorkflowJob(
+            job_id=self.task_id,
+            props=self._merged_props(),
+            working_dir=workdir,
+            flow=self._flow_context(context or {}),
+        )
+        ok = job.run()
+        if not ok:
+            raise RuntimeError(f"tony-tpu workflow job {self.task_id} failed")
+        return ok
+
+
+def as_airflow_operator():
+    """Return a real BaseOperator subclass when airflow is importable."""
+    from airflow.models import BaseOperator  # raises if absent
+
+    class _AirflowTonyTpuOperator(BaseOperator, TonyTpuOperator):
+        # MRO would otherwise resolve this to BaseOperator's empty tuple
+        template_fields = TonyTpuOperator.template_fields
+
+        def __init__(self, *, task_id: str, **kwargs):
+            operator_kwargs = {
+                k: kwargs.pop(k) for k in list(kwargs)
+                if k in ("executes", "src_dir", "task_params", "python_venv",
+                         "shell_env", "conf_file", "props", "working_dir")
+            }
+            BaseOperator.__init__(self, task_id=task_id, **kwargs)
+            TonyTpuOperator.__init__(self, task_id=task_id, **operator_kwargs)
+
+    return _AirflowTonyTpuOperator

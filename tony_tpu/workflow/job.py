@@ -1,0 +1,126 @@
+"""Workflow-scheduler jobtype — the tony-azkaban equivalent (layer L7).
+
+Reference: tony-azkaban/TonyJob.java:27-121+ — an Azkaban job that (1)
+collects every ``tony.*`` prop into a generated config file placed on the
+job classpath, (2) injects flow lineage tags (exec id, flow id, project,
+web host) as ``tony.application.tags``, (3) maps standard props
+(TonyJobArg.java: src_dir, executes, task_params, python_venv,
+python_binary_path, shell_env) to client CLI args, and (4) points the
+launcher at TonyClient.
+
+``WorkflowJob`` is that contract with the scheduler abstracted away: any
+engine that can hand over a flat prop map (Azkaban Props, Airflow params,
+Luigi config) and call ``run()`` gets a fully-formed tony-tpu submission.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import uuid
+from dataclasses import dataclass, field
+
+from tony_tpu import constants as C
+from tony_tpu.config import TonyConf, build_conf
+
+log = logging.getLogger(__name__)
+
+TONY_PREFIX = "tony."
+WORKER_ENV_PREFIX = "worker_env."
+# prop name -> conf key (ref: TonyJobArg.java's az-prop -> CLI-arg map)
+STANDARD_ARGS = {
+    "src_dir": "tony.application.src-dir",
+    "executes": "tony.application.executes",
+    "task_params": "tony.application.task-params",
+    "python_venv": "tony.application.python-venv",
+    "python_binary_path": "tony.application.python-command",
+    "shell_env": "tony.application.shell-env",
+}
+
+
+@dataclass
+class FlowContext:
+    """Workflow lineage injected as tags (ref: CommonJobProperties.EXEC_ID /
+    FLOW_ID / PROJECT_NAME + azkaban.webserverhost -> constructHadoopTags)."""
+
+    execution_id: str = ""
+    flow_id: str = ""
+    project_name: str = ""
+    scheduler_host: str = ""
+
+    def tags(self) -> str:
+        parts = [
+            f"execution_id:{self.execution_id}" if self.execution_id else "",
+            f"flow_id:{self.flow_id}" if self.flow_id else "",
+            f"project_name:{self.project_name}" if self.project_name else "",
+            f"scheduler_host:{self.scheduler_host}" if self.scheduler_host else "",
+        ]
+        return ",".join(p for p in parts if p)
+
+
+@dataclass
+class WorkflowJob:
+    """One scheduler job that submits a tony-tpu application.
+
+    ``props`` is the engine's flat prop map for this job; ``working_dir``
+    is the job's scratch dir (the generated conf lands there, mirroring
+    the reference's ``_tony-conf-<jobid>-<uuid>/tony.xml``).
+    """
+
+    job_id: str
+    props: dict[str, str]
+    working_dir: str
+    flow: FlowContext = field(default_factory=FlowContext)
+    conf_path: str = ""
+
+    def build_conf(self) -> TonyConf:
+        """Collect tony.* props + standard args + flow tags into a job conf
+        (ref: TonyJob.getJobConfiguration)."""
+        conf_file = self.props.get("conf_file", "")
+        conf = build_conf(conf_file or None)
+        for key, value in self.props.items():
+            if key.startswith(TONY_PREFIX):
+                conf.set(key, value)
+        for prop, conf_key in STANDARD_ARGS.items():
+            if self.props.get(prop):
+                conf.set(conf_key, self.props[prop])
+        worker_env = [
+            f"{key[len(WORKER_ENV_PREFIX):]}={value}"
+            for key, value in self.props.items()
+            if key.startswith(WORKER_ENV_PREFIX)
+        ]
+        if worker_env:
+            existing = str(conf.get("tony.application.shell-env", ""))
+            joined = ",".join(worker_env)
+            conf.set("tony.application.shell-env",
+                     f"{existing},{joined}" if existing else joined)
+        tags = self.flow.tags()
+        if tags:
+            conf.set("tony.application.tags", tags)
+        if not conf.get("tony.application.name") or \
+                str(conf.get("tony.application.name")) == "tony-tpu":
+            conf.set("tony.application.name", self.flow.flow_id or self.job_id)
+        return conf
+
+    def write_generated_conf(self, conf: TonyConf) -> str:
+        """Persist the merged conf where the launcher (or a human) can see
+        exactly what was submitted (ref: setupJobConfigurationFile)."""
+        gen_dir = os.path.join(self.working_dir,
+                               f"_tony-conf-{self.job_id}-{uuid.uuid4().hex[:8]}")
+        os.makedirs(gen_dir, exist_ok=True)
+        self.conf_path = os.path.join(gen_dir, "tony.json")
+        conf.write_final(self.conf_path)
+        return self.conf_path
+
+    def run(self) -> bool:
+        """Build conf, write it, submit, block until terminal status
+        (ref: TonyJob.run -> main class TonyClient)."""
+        from tony_tpu.client import TonyClient
+
+        conf = self.build_conf()
+        self.write_generated_conf(conf)
+        log.info("workflow job %s submitting (conf: %s, tags: %s)",
+                 self.job_id, self.conf_path,
+                 conf.get("tony.application.tags"))
+        return TonyClient(conf).run()
