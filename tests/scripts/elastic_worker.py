@@ -1,0 +1,45 @@
+"""Payload: an elastic training loop. Counts steps into a per-role-index
+progress file (the 'checkpoint'); on save_and_exit it exits EXIT_RESIZE;
+on relaunch it resumes from the file and finishes at TARGET total steps.
+Also records the TASK_NUM it saw, so the test can assert the gang grew."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ["TONY_REPO_ROOT"])
+
+from tony_tpu import elastic
+
+TARGET = 30
+
+
+def main() -> int:
+    role = os.environ["TONY_JOB_NAME"]
+    index = os.environ["TONY_TASK_INDEX"]
+    task_num = os.environ["TONY_TASK_NUM"]
+    epoch = elastic.session_epoch()
+    ckpt = os.path.join(os.getcwd(), f"progress-{role}-{index}.txt")
+    sizes = os.path.join(os.getcwd(), f"sizes-{role}-{index}.txt")
+    with open(sizes, "a") as f:
+        f.write(f"{epoch}:{task_num}\n")
+
+    step = 0
+    if os.path.exists(ckpt):
+        with open(ckpt) as f:
+            step = int(f.read().strip() or 0)
+        print(f"resumed at step {step} (epoch {epoch})")
+
+    while step < TARGET:
+        step += 1
+        with open(ckpt, "w") as f:
+            f.write(str(step))
+        if elastic.save_and_exit_requested():
+            print(f"save_and_exit at step {step}")
+            return elastic.EXIT_RESIZE
+        time.sleep(0.1)
+    print(f"done at step {step} (epoch {epoch}, task_num {task_num})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

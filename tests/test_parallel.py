@@ -186,3 +186,51 @@ def test_dp_gradient_sync_end_to_end():
     )(w, x, y)
     np.testing.assert_allclose(np.asarray(sharded), np.asarray(grad_single),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_attention_matches_reference():
+    from tony_tpu.parallel import ulysses_attention
+
+    mesh = make_mesh(MeshSpec(data=-1, seq=4))
+    key = jax.random.PRNGKey(3)
+    b, l, h, d = 2, 32, 4, 8  # h divisible by seq=4
+    q, k, v = (jax.random.normal(kk, (b, l, h, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    for causal in (True, False):
+        out_ref = reference_attention(q, k, v, causal=causal)
+        out_uly = ulysses_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(out_uly), np.asarray(out_ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_attention_differentiable():
+    from tony_tpu.parallel import ulysses_attention
+
+    mesh = make_mesh(MeshSpec(data=-1, seq=2))
+    key = jax.random.PRNGKey(4)
+    b, l, h, d = 1, 16, 2, 8
+    q, k, v = (jax.random.normal(kk, (b, l, h, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+
+    def loss_uly(q, k, v):
+        return jnp.sum(ulysses_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_uly = jax.grad(loss_uly, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_uly, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from tony_tpu.parallel import ulysses_attention
+
+    mesh = make_mesh(MeshSpec(data=-1, seq=4))
+    q = jnp.ones((1, 16, 3, 8))  # 3 heads not divisible by 4
+    import pytest
+
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, q, q, mesh)

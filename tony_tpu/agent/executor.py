@@ -57,6 +57,12 @@ class Heartbeater(threading.Thread):
                 write_trigger(self.workdir, int(cmd.get("num_steps", 5)),
                               task_id=self.task_id)
                 log.info("profile trigger dropped for %s", self.task_id)
+            elif cmd.get("type") == "save_and_exit" and self.workdir:
+                from tony_tpu.elastic import write_save_and_exit
+
+                write_save_and_exit(self.workdir, task_id=self.task_id,
+                                    reason=str(cmd.get("reason", "resize")))
+                log.info("save_and_exit requested for %s", self.task_id)
             else:
                 log.warning("unknown coordinator command: %s", cmd)
 
@@ -116,6 +122,21 @@ class TaskAgent:
         self.adapter = get_task_adapter(str(self.conf.get("tony.application.framework")))
         self._user_pid: int | None = None
 
+    def _clean_stale_control_files(self) -> None:
+        """A previous epoch's save_and_exit/profile file for this task id
+        must not fire at step 0 of the new epoch. Runs on the task's own
+        host, so it also covers ssh launch mode where the coordinator's
+        job-dir cleanup can't reach."""
+        import contextlib
+
+        from tony_tpu.elastic import control_path
+        from tony_tpu.profiler import trigger_path
+
+        for path in (control_path(self.job_dir, self.task_id),
+                     trigger_path(self.job_dir, self.task_id)):
+            with contextlib.suppress(OSError):
+                os.remove(path)
+
     # -- fault injection (ref: skewAndHangIfTesting :364-384) ---------------
     def _skew_if_testing(self) -> None:
         spec = os.environ.get(C.TEST_TASK_SKEW, "")
@@ -133,6 +154,7 @@ class TaskAgent:
     def run(self) -> int:
         """Ref: TaskExecutor.main :189-237."""
         self._skew_if_testing()
+        self._clean_stale_control_files()
         reuse = self.conf.get_bool("tony.task.reuse-port", False)
         rdzv = None
         tb = None
@@ -220,7 +242,8 @@ class TaskAgent:
 
         try:
             self.client.call("register_execution_result",
-                             task_id=self.task_id, exit_code=exit_code)
+                             task_id=self.task_id, exit_code=exit_code,
+                             session_id=self.session_id)
         except Exception:
             # coordinator's launcher exit-watch is the backup path
             log.exception("failed to register execution result")

@@ -1,0 +1,48 @@
+"""``tony-tpu resize`` — elastic resize of a running job.
+
+No reference analog (elasticity is stubbed there); see tony_tpu/elastic.py
+for the checkpoint-aware gang-restart protocol this triggers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+from tony_tpu import constants as C
+from tony_tpu.rpc import RpcClient
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(prog="tony-tpu resize")
+    p.add_argument("--job_dir", required=True,
+                   help="the running job's staging dir (holds coordinator.json)")
+    p.add_argument("role", help="role to resize, e.g. worker")
+    p.add_argument("instances", type=int, help="new instance count")
+    p.add_argument("--secret", default=os.environ.get(C.JOB_TOKEN),
+                   help="job token when security is enabled")
+    args = p.parse_args(argv)
+
+    info_path = os.path.join(args.job_dir, "coordinator.json")
+    if not os.path.exists(info_path):
+        print(f"no coordinator.json in {args.job_dir}", file=sys.stderr)
+        return C.EXIT_FAIL
+    with open(info_path) as f:
+        info = json.load(f)
+    client = RpcClient(info["host"], info["port"], secret=args.secret)
+    try:
+        ok = client.call("resize_role", role=args.role,
+                         instances=args.instances)
+    finally:
+        client.close()
+    print(f"resize {args.role} -> {args.instances}: "
+          f"{'accepted' if ok else 'rejected'}")
+    return C.EXIT_SUCCESS if ok else C.EXIT_FAIL
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

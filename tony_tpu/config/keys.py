@@ -104,6 +104,10 @@ KEYS: dict[str, Key] = {
     "tony.task.reuse-port": Key(
         False, bool, "Reserve rendezvous ports with SO_REUSEPORT across exec (ref: TF_GRPC_REUSE_PORT)"
     ),
+    "tony.elastic.grace-ms": Key(
+        15_000, int, "Grace period for tasks to checkpoint-and-exit on an "
+        "elastic resize before the gang restart proceeds"
+    ),
     "tony.task.profiler-port": Key(
         0, int, "Base port for per-task jax profiler servers (0 = off); "
         "task flat-index is added so shared hosts don't collide"

@@ -18,6 +18,8 @@ application report's host:port).
 from __future__ import annotations
 
 import argparse
+import contextlib
+import glob
 import json
 import logging
 import os
@@ -69,8 +71,10 @@ class ClientRpcHandler:
         log.info("TensorBoard registered at %s", url)
         return True
 
-    def register_execution_result(self, task_id: str, exit_code: int):
-        return self._coord.register_execution_result(task_id, int(exit_code))
+    def register_execution_result(self, task_id: str, exit_code: int,
+                                  session_id: int = -1):
+        return self._coord.register_execution_result(
+            task_id, int(exit_code), int(session_id))
 
     def finish_application(self):
         self._coord.client_done.set()
@@ -88,6 +92,12 @@ class ClientRpcHandler:
         reference; SURVEY.md section 5.1)."""
         return self._coord.queue_command(
             task_id, {"type": "profile", "num_steps": int(num_steps)})
+
+    def resize_role(self, role: str, instances: int):
+        """Elastic resize: checkpoint-aware gang restart at the new size
+        (real elasticity where the reference stubs it — see
+        tony_tpu/elastic.py)."""
+        return self._coord.request_resize(role, int(instances))
 
     def register_callback_info(self, task_id: str, info: str):
         self._coord.am_adapter.receive_task_callback_info(task_id, info)
@@ -141,6 +151,8 @@ class Coordinator:
         self._lock = threading.Lock()
         self._worker_termination_done = False
         self._pending_commands: dict[str, list[dict]] = {}
+        self._pending_resize: dict[str, int] = {}
+        self._resizing = False
 
     # -------------------------------------------------- agent command queue
     def queue_command(self, task_id: str, command: dict) -> bool:
@@ -154,6 +166,61 @@ class Coordinator:
     def drain_commands(self, task_id: str) -> list[dict]:
         with self._lock:
             return self._pending_commands.pop(task_id, [])
+
+    # ------------------------------------------------------- elastic resize
+    def request_resize(self, role: str, instances: int) -> bool:
+        """Validate + queue an elastic resize; the monitor loop performs it
+        (see tony_tpu/elastic.py for the protocol)."""
+        if instances < 1:
+            return False
+        with self._lock:
+            if role not in self.session.tasks:
+                return False
+            self._pending_resize[role] = instances
+        return True
+
+    def _take_pending_resize(self) -> dict[str, int]:
+        with self._lock:
+            resize, self._pending_resize = self._pending_resize, {}
+            return resize
+
+    def _perform_resize(self, resize: dict[str, int]) -> None:
+        """Checkpoint-aware gang restart: notify tasks, grace, rebuild the
+        session at the new sizes, relaunch."""
+        from tony_tpu.events import session_resized
+
+        self._resizing = True
+        try:
+            grace_s = self.conf.get_int("tony.elastic.grace-ms", 15_000) / 1000
+            with self._lock:
+                live = [t for t in self.session.all_tasks() if not t.completed]
+                for task in live:
+                    self._pending_commands.setdefault(task.id, []).append(
+                        {"type": "save_and_exit"})
+            log.info("elastic resize to %s: notified %d tasks, grace %.1fs",
+                     resize, len(live), grace_s)
+            deadline = time.monotonic() + grace_s
+            while time.monotonic() < deadline:
+                if all(t.completed for t in self.session.all_tasks()):
+                    break
+                time.sleep(0.1)
+            for role, n in resize.items():
+                self.conf.set(f"tony.{role}.instances", n)
+            self._reset_session()
+            # stale control files must not make the next epoch exit at step
+            # 0 — cleaned after the old agents are dead so none can rewrite
+            # one (agents also self-clean at startup, covering ssh hosts)
+            from tony_tpu.elastic import CONTROL_FILENAME
+
+            for path in glob.glob(os.path.join(
+                    self.job_dir, CONTROL_FILENAME + "*")):
+                with contextlib.suppress(OSError):
+                    os.remove(path)
+            self.events.emit(session_resized(
+                self.app_id, self.session.session_id, resize))
+            self._start_attempt()
+        finally:
+            self._resizing = False
 
     # ------------------------------------------------------------------ rpc
     def cluster_spec_if_ready(self, task_id: str) -> str | None:
@@ -171,7 +238,15 @@ class Coordinator:
                  self.session.num_registered, self.session.total_expected)
         return self.cluster_spec_if_ready(task_id)
 
-    def register_execution_result(self, task_id: str, exit_code: int) -> bool:
+    def register_execution_result(self, task_id: str, exit_code: int,
+                                  session_id: int = -1) -> bool:
+        """A result from a previous session epoch (pre-resize/retry gang)
+        must not complete the current epoch's task of the same id (ref:
+        sessionId guard on TonySession results)."""
+        if session_id >= 0 and session_id != self.session.session_id:
+            log.info("ignoring stale result %s (epoch %d != %d)", task_id,
+                     session_id, self.session.session_id)
+            return False
         log.info("task %s registered exit code %d", task_id, exit_code)
         self._complete_task(task_id, exit_code)
         return True
@@ -181,6 +256,21 @@ class Coordinator:
         delay = os.environ.get(C.TEST_COMPLETION_DELAY)
         if delay:  # fault injection (ref: ApplicationMaster.java:1074-1083)
             time.sleep(int(delay) / 1000)
+        if self._resizing:
+            # the gang is being torn down for an elastic restart; exits in
+            # this window (EXIT_RESIZE or kills) are not failures — record
+            # completion so the grace loop can finish early, skip the
+            # session's exit-status policy
+            from tony_tpu.elastic import EXIT_RESIZE
+
+            self.liveness.unregister(task_id)
+            with self._lock:
+                task = self.session.get_task_by_id(task_id)
+                if task is not None:
+                    # a cooperative EXIT_RESIZE is a clean exit, not a failure
+                    task.set_exit_status(
+                        0 if exit_code == EXIT_RESIZE else exit_code)
+            return
         with self._lock:
             task = self.session.get_task_by_id(task_id)
             if task is None or task.completed:
@@ -369,6 +459,10 @@ class Coordinator:
                 return self.session.status
             if self.session.status != SessionStatus.RUNNING:
                 return self.session.status
+            resize = self._take_pending_resize()
+            if resize:
+                self._perform_resize(resize)
+                continue
             if self.session.training_finished():
                 return self.session.update_session_status()
             self._check_registration_timeouts(reg_timeout_s)
@@ -439,6 +533,9 @@ class Coordinator:
         self.session = Session(self.conf, session_id=old_id + 1)
         self._launch_time.clear()
         self._worker_termination_done = False
+        with self._lock:
+            # undrained commands must not leak into the new epoch's tasks
+            self._pending_commands.clear()
         self.am_adapter = get_am_adapter(self.framework)
         self.am_adapter.validate_and_update_config(self.conf)
 

@@ -55,7 +55,10 @@ class LocalProcessLauncher(Launcher):
         self.workdir = workdir
         self._procs: dict[str, subprocess.Popen] = {}
         self._lock = threading.Lock()
-        self._stopping = False
+        # stop_all bumps the generation: exits from a torn-down generation
+        # never reach on_exit, while relaunches (coordinator retry, elastic
+        # resize) keep working exit detection
+        self._gen = 0
 
     def launch(self, task: Task, env: dict[str, str], log_path: str) -> None:
         full_env = dict(os.environ)
@@ -75,17 +78,19 @@ class LocalProcessLauncher(Launcher):
             out.close()
         with self._lock:
             self._procs[task.id] = proc
+            gen = self._gen
         threading.Thread(
-            target=self._wait, args=(task.id, proc), daemon=True,
+            target=self._wait, args=(task.id, proc, gen), daemon=True,
             name=f"wait-{task.id}",
         ).start()
         log.info("launched %s as pid %d (log: %s)", task.id, proc.pid, log_path)
 
-    def _wait(self, task_id: str, proc: subprocess.Popen) -> None:
+    def _wait(self, task_id: str, proc: subprocess.Popen, gen: int) -> None:
         code = proc.wait()
         with self._lock:
-            self._procs.pop(task_id, None)
-            if self._stopping:
+            if self._procs.get(task_id) is proc:
+                self._procs.pop(task_id)
+            if gen != self._gen:
                 return
         self.on_exit(task_id, code)
 
@@ -99,7 +104,7 @@ class LocalProcessLauncher(Launcher):
 
     def stop_all(self) -> None:
         with self._lock:
-            self._stopping = True
+            self._gen += 1
             procs = list(self._procs.values())
         for proc in procs:
             _kill_tree(proc)
@@ -157,7 +162,8 @@ class SshLauncher(Launcher):
             out.close()
         with self._local._lock:
             self._local._procs[task.id] = proc
-        threading.Thread(target=self._local._wait, args=(task.id, proc),
+            gen = self._local._gen
+        threading.Thread(target=self._local._wait, args=(task.id, proc, gen),
                          daemon=True).start()
         log.info("launched %s on %s via ssh (pid %d)", task.id, host, proc.pid)
 

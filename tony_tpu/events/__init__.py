@@ -4,6 +4,7 @@ from tony_tpu.events.event import (
     JobMetadata,
     application_finished,
     application_inited,
+    session_resized,
     task_finished,
     task_started,
 )
@@ -16,6 +17,7 @@ __all__ = [
     "JobMetadata",
     "application_finished",
     "application_inited",
+    "session_resized",
     "task_finished",
     "task_started",
 ]

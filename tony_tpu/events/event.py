@@ -19,6 +19,8 @@ class EventType(enum.Enum):
     APPLICATION_FINISHED = "APPLICATION_FINISHED"
     TASK_STARTED = "TASK_STARTED"
     TASK_FINISHED = "TASK_FINISHED"
+    # rebuild extra: elastic resize epochs (no reference analog)
+    SESSION_RESIZED = "SESSION_RESIZED"
 
 
 @dataclass
@@ -91,3 +93,11 @@ class JobMetadata:
         return cls(**{k: d[k] for k in
                       ("id", "user", "started", "completed", "status", "conf_path")
                       if k in d})
+
+
+def session_resized(app_id: str, new_session_id: int,
+                    sizes: dict[str, int]) -> Event:
+    """Elastic resize epoch (rebuild extra; reference stubs elasticity)."""
+    return Event(EventType.SESSION_RESIZED,
+                 {"applicationId": app_id, "sessionId": new_session_id,
+                  "sizes": dict(sizes)})

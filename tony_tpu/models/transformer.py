@@ -39,7 +39,7 @@ class TransformerConfig:
     d_ff: int = 2048
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
-    attention_backend: str = "blockwise"  # reference|blockwise|ring|pallas
+    attention_backend: str = "blockwise"  # reference|blockwise|ring|ulysses|pallas
     attention_block_size: int = 512
     remat: bool = False
     mesh: Any = None  # required for the ring backend
@@ -59,6 +59,13 @@ def _attention(cfg: TransformerConfig, q, k, v):
         if cfg.mesh is None:
             raise ValueError("ring attention needs cfg.mesh")
         return ring_attention(q, k, v, cfg.mesh, causal=True)
+    if cfg.attention_backend == "ulysses":
+        if cfg.mesh is None:
+            raise ValueError("ulysses attention needs cfg.mesh")
+        from tony_tpu.parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, cfg.mesh, causal=True,
+                                 block_size=cfg.attention_block_size)
     if cfg.attention_backend == "pallas":
         from tony_tpu.ops.attention import flash_attention
 

@@ -16,6 +16,7 @@ from tony_tpu.parallel.ring_attention import (
     ring_attention,
 )
 from tony_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from tony_tpu.parallel.ulysses import ulysses_attention
 from tony_tpu.parallel.moe import (
     MoEConfig,
     init_moe_params,
@@ -39,5 +40,5 @@ __all__ = [
     "init_moe_params", "make_mesh", "moe_layer", "moe_logical_axes",
     "pipeline_apply", "reference_attention", "replicated", "ring_attention",
     "shard_params_by_size", "spec_for", "stack_stage_params",
-    "top_k_gating", "tree_shardings",
+    "top_k_gating", "tree_shardings", "ulysses_attention",
 ]

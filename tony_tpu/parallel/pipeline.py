@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from tony_tpu.parallel.mesh import PIPE
 
@@ -89,7 +89,7 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh: Mesh,
         mesh=mesh,
         in_specs=(params_specs, P()),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )
     out = fn(stacked_params, x_micro)
     return out.reshape(batch, *x.shape[1:])

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from tony_tpu.parallel.mesh import SEQ
 
@@ -99,7 +99,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = SEQ,
         mesh=mesh,
         in_specs=(qspec, qspec, qspec),
         out_specs=qspec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn(q, k, v)
 

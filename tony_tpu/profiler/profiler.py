@@ -26,6 +26,12 @@ import json
 import logging
 import os
 
+from tony_tpu.utils.controlfile import (
+    control_file_path,
+    current_task_id,
+    write_control_file,
+)
+
 log = logging.getLogger(__name__)
 
 TRIGGER_FILENAME = ".tony_profile_request"
@@ -33,30 +39,17 @@ PROFILER_PORT_ENV = "TONY_PROFILER_PORT"
 PROFILE_DIR_ENV = "TONY_PROFILE_DIR"
 
 
-def _task_suffix(task_id: str) -> str:
-    return f".{task_id.replace(':', '-')}" if task_id else ""
-
-
-def current_task_id() -> str:
-    """This process's task id from the injected env, or '' standalone."""
-    role = os.environ.get("TONY_JOB_NAME", "")
-    return f"{role}:{os.environ.get('TONY_TASK_INDEX', '0')}" if role else ""
-
-
 def trigger_path(workdir: str, task_id: str = "") -> str:
     """Per-task trigger file (tasks can share a job dir on one host)."""
-    return os.path.join(workdir, TRIGGER_FILENAME + _task_suffix(task_id))
+    return control_file_path(workdir, TRIGGER_FILENAME, task_id)
 
 
 def write_trigger(workdir: str, num_steps: int = 5,
                   logdir: str | None = None, task_id: str = "") -> str:
     """Agent side: request a trace from the user process in ``workdir``."""
-    path = trigger_path(workdir, task_id)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump({"num_steps": int(num_steps), "logdir": logdir}, f)
-    os.replace(tmp, path)  # atomic: the poller never sees a partial file
-    return path
+    return write_control_file(
+        trigger_path(workdir, task_id),
+        {"num_steps": int(num_steps), "logdir": logdir})
 
 
 def maybe_start_server() -> int:

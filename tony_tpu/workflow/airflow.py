@@ -91,8 +91,9 @@ def as_airflow_operator():
     """Return a real BaseOperator subclass when airflow is importable."""
     from airflow.models import BaseOperator  # raises if absent
 
-    class _AirflowTonyTpuOperator(BaseOperator, TonyTpuOperator):
-        # MRO would otherwise resolve this to BaseOperator's empty tuple
+    # TonyTpuOperator first so execute() and template_fields resolve to it
+    # (BaseOperator.execute raises NotImplementedError)
+    class _AirflowTonyTpuOperator(TonyTpuOperator, BaseOperator):
         template_fields = TonyTpuOperator.template_fields
 
         def __init__(self, *, task_id: str, **kwargs):
