@@ -1,0 +1,99 @@
+"""KV-cache decode + generation.
+
+Correctness anchor: incremental decode must produce the same logits as the
+full (non-decode) forward pass over the same tokens.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.models import Transformer, TransformerConfig, generate
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                            d_ff=64, max_seq_len=32, dtype=jnp.float32,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    return model, params
+
+
+def test_decode_matches_full_forward(tiny):
+    model, params = tiny
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 64)
+    full = model.apply({"params": params}, tokens)
+
+    from tony_tpu.models import init_cache
+
+    cache = init_cache(model, params, 2)
+    # feed one token at a time through the cache
+    step_logits = []
+    variables = {"params": params, "cache": cache}
+    for i in range(tokens.shape[1]):
+        logits, mut = model.apply(variables, tokens[:, i:i + 1], decode=True,
+                                  mutable=["cache"])
+        variables = {"params": params, "cache": mut["cache"]}
+        step_logits.append(logits[:, 0])
+    incremental = jnp.stack(step_logits, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(incremental),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_prefill_matches_full_forward(tiny):
+    model, params = tiny
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 10), 0, 64)
+    full = model.apply({"params": params}, tokens)
+
+    from tony_tpu.models import init_cache
+
+    cache = init_cache(model, params, 2)
+    prefill, _ = model.apply({"params": params, "cache": cache}, tokens,
+                             decode=True, mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(full), np.asarray(prefill),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_generate_greedy_deterministic(tiny):
+    model, params = tiny
+    prompt = jnp.array([[1, 2, 3]], jnp.int32)
+    out1 = generate(model, params, prompt, max_new_tokens=6)
+    out2 = generate(model, params, prompt, max_new_tokens=6)
+    assert out1.shape == (1, 6)
+    assert out1.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_generate_greedy_matches_stepwise_argmax(tiny):
+    """Greedy generate == repeatedly running the full forward + argmax."""
+    model, params = tiny
+    prompt = jnp.array([[5, 9]], jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=4)
+    tokens = prompt
+    for i in range(4):
+        logits = model.apply({"params": params}, tokens)
+        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        assert int(nxt[0]) == int(out[0, i])
+        tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+
+
+def test_generate_sampled_shapes(tiny):
+    model, params = tiny
+    prompt = jnp.array([[1], [2]], jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=5, temperature=0.8,
+                   top_k=10, rng=jax.random.PRNGKey(3))
+    assert out.shape == (2, 5)
+    assert bool(jnp.all((out >= 0) & (out < 64)))
+
+
+def test_generate_eos_freezes(tiny):
+    model, params = tiny
+    prompt = jnp.array([[1, 2]], jnp.int32)
+    # discover what greedy emits first, then treat that as eos
+    first = int(generate(model, params, prompt, max_new_tokens=1)[0, 0])
+    out = generate(model, params, prompt, max_new_tokens=5, eos_id=first)
+    assert np.asarray(out)[0].tolist() == [first] * 5

@@ -68,7 +68,25 @@ KEYS: dict[str, Key] = {
         False, bool, "0-instance mode: the coordinator itself hosts the user process"
     ),
     "tony.application.launch-mode": Key(
-        "local", str, "Agent placement: local (subprocesses) or ssh (remote TPU-VM hosts)"
+        "local", str, "Agent placement: local (subprocesses), ssh (remote "
+        "TPU-VM hosts), or docker (containers on this host)"
+    ),
+    # docker containers (ref: tony.docker.enabled + DOCKER_* env,
+    # HadoopCompatibleAdapter.getContainerEnvForDocker)
+    "tony.docker.enabled": Key(
+        False, bool, "Run each agent inside a docker container (ref: tony.docker.enabled)"
+    ),
+    "tony.docker.image": Key(
+        "", str, "Container image for docker launch mode (ref: tony.docker.containers.image)"
+    ),
+    "tony.docker.mounts": Key(
+        "", str, "Comma list of host:container[:ro] bind mounts for docker tasks"
+    ),
+    "tony.docker.run-args": Key(
+        "", str, "Extra args spliced into docker run (e.g. --shm-size=4g)"
+    ),
+    "tony.docker.bin": Key(
+        "docker", str, "Container CLI binary (docker/podman; test shims)"
     ),
     "tony.application.hosts": Key(
         "", str, "Comma list of TPU-VM hosts for launch-mode=ssh, round-robin per task"

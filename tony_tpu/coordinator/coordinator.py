@@ -295,6 +295,24 @@ class Coordinator:
         """Pick agent placement from tony.application.launch-mode (local
         subprocesses, or ssh onto the slice's TPU-VM hosts)."""
         mode = str(self.conf.get("tony.application.launch-mode", "local"))
+        if self.conf.get("tony.docker.enabled") and mode not in ("local", "docker"):
+            raise ValueError(
+                f"tony.docker.enabled conflicts with launch-mode={mode}: "
+                "docker launch runs containers on this host only")
+        if mode == "docker" or self.conf.get("tony.docker.enabled"):
+            from tony_tpu.coordinator.launcher import DockerLauncher
+
+            image = str(self.conf.get("tony.docker.image", ""))
+            if not image:
+                raise ValueError("docker launch requires tony.docker.image")
+            mounts = [m.strip() for m in
+                      str(self.conf.get("tony.docker.mounts", "")).split(",")
+                      if m.strip()]
+            extra = str(self.conf.get("tony.docker.run-args", "")).split()
+            return DockerLauncher(
+                image, self._on_task_process_exit, mounts=mounts,
+                extra_args=extra,
+                docker_bin=str(self.conf.get("tony.docker.bin", "docker")))
         if mode == "ssh":
             from tony_tpu.coordinator.launcher import SshLauncher
 
@@ -529,6 +547,9 @@ class Coordinator:
     def _reset_session(self) -> None:
         """Ref: reset() :612-628 — stop containers, rebuild session epoch."""
         self.launcher.stop_all()
+        # a killed task from the old epoch never reports a result, so its
+        # liveness entry would expire against the healthy new session
+        self.liveness.clear()
         old_id = self.session.session_id
         self.session = Session(self.conf, session_id=old_id + 1)
         self._launch_time.clear()

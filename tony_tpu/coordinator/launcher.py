@@ -85,6 +85,15 @@ class LocalProcessLauncher(Launcher):
         ).start()
         log.info("launched %s as pid %d (log: %s)", task.id, proc.pid, log_path)
 
+    def attach(self, task_id: str, proc: subprocess.Popen) -> None:
+        """Register an externally-spawned process (ssh/docker wrapper) for
+        exit detection under this launcher's generation handshake."""
+        with self._lock:
+            self._procs[task_id] = proc
+            gen = self._gen
+        threading.Thread(target=self._wait, args=(task_id, proc, gen),
+                         daemon=True, name=f"wait-{task_id}").start()
+
     def _wait(self, task_id: str, proc: subprocess.Popen, gen: int) -> None:
         code = proc.wait()
         with self._lock:
@@ -118,6 +127,101 @@ def _kill_tree(proc: subprocess.Popen) -> None:
             proc.kill()
         except ProcessLookupError:
             pass
+
+
+def docker_container_name(task: Task) -> str:
+    """Epoch-qualified name: a relaunch after resize/retry must not race
+    the async ``--rm`` cleanup of the previous epoch's same-id container."""
+    return f"tony-s{task.session_id}-{task.id.replace(':', '-')}"
+
+
+def build_docker_command(task: Task, env: dict[str, str], image: str,
+                         mounts: list[str] | None = None,
+                         extra_args: list[str] | None = None,
+                         docker_bin: str = "docker") -> list[str]:
+    """Build the ``docker run`` argv that hosts one agent.
+
+    Reference analog: YARN docker containers via env injection
+    (HadoopCompatibleAdapter.getContainerEnvForDocker — ENV_CONTAINER_TYPE,
+    image, mounts). On TPU-VMs the accelerator needs ``--privileged`` +
+    host networking so the container sees /dev/accel* and the ICI NICs;
+    mounts use docker's ``host:container[:ro]`` syntax directly.
+    """
+    argv = [docker_bin, "run", "--rm", "--name", docker_container_name(task),
+            "--net=host", "--privileged"]
+    for mount in mounts or []:
+        argv += ["-v", mount]
+    for k, v in env.items():
+        argv += ["-e", f"{k}={v}"]
+    argv += extra_args or []
+    argv += [image, "python3", "-m", "tony_tpu.agent"]
+    return argv
+
+
+class DockerLauncher(Launcher):
+    """Run each agent inside a docker container on this host.
+
+    Reference: tony.docker.enabled/tony.docker.containers.image keys +
+    docker env injection (TonyConfigurationKeys DOCKER_*,
+    HadoopCompatibleAdapter.getContainerEnvForDocker). Exit detection rides
+    the local ``docker run`` process (it stays attached); kill goes through
+    ``docker kill`` so the in-container process group dies with it.
+    """
+
+    def __init__(self, image: str, on_exit: OnExit,
+                 mounts: list[str] | None = None,
+                 extra_args: list[str] | None = None,
+                 docker_bin: str = "docker"):
+        if not image:
+            raise ValueError("DockerLauncher needs an image")
+        self.image = image
+        self.mounts = mounts or []
+        self.extra_args = extra_args or []
+        self.docker_bin = docker_bin
+        self._local = LocalProcessLauncher(on_exit)
+        self._names: dict[str, str] = {}
+        self._names_lock = threading.Lock()
+
+    def launch(self, task: Task, env: dict[str, str], log_path: str) -> None:
+        argv = build_docker_command(task, env, self.image, self.mounts,
+                                    self.extra_args, self.docker_bin)
+        os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+        out = open(log_path, "ab", buffering=0)
+        try:
+            proc = subprocess.Popen(argv, stdout=out,
+                                    stderr=subprocess.STDOUT,
+                                    start_new_session=True)
+        finally:
+            out.close()
+        with self._names_lock:
+            self._names[task.id] = docker_container_name(task)
+        self._local.attach(task.id, proc)
+        log.info("launched %s in docker image %s (pid %d)", task.id,
+                 self.image, proc.pid)
+
+    def _docker_kill(self, name: str) -> None:
+        subprocess.run([self.docker_bin, "kill", name],
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                       check=False)
+
+    def kill_task(self, task_id: str) -> bool:
+        with self._names_lock:
+            name = self._names.get(task_id)
+        if name:
+            self._docker_kill(name)
+        return self._local.kill_task(task_id)
+
+    def stop_all(self) -> None:
+        # bump the generation FIRST so teardown exits never reach on_exit
+        # (the docker kills below complete each attached `docker run`)
+        with self._local._lock:
+            self._local._gen += 1
+        with self._names_lock:
+            names = list(self._names.values())
+            self._names.clear()
+        for name in names:
+            self._docker_kill(name)
+        self._local.stop_all()
 
 
 class SshLauncher(Launcher):
@@ -160,11 +264,7 @@ class SshLauncher(Launcher):
             )
         finally:
             out.close()
-        with self._local._lock:
-            self._local._procs[task.id] = proc
-            gen = self._local._gen
-        threading.Thread(target=self._local._wait, args=(task.id, proc, gen),
-                         daemon=True).start()
+        self._local.attach(task.id, proc)
         log.info("launched %s on %s via ssh (pid %d)", task.id, host, proc.pid)
 
     def kill_task(self, task_id: str) -> bool:

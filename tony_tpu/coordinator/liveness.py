@@ -31,6 +31,12 @@ class LivenessMonitor:
         with self._lock:
             self._last[task_id] = time.monotonic()
 
+    def clear(self) -> None:
+        """Drop every watched task — session reset/resize must not let a
+        previous epoch's entries expire against the new session."""
+        with self._lock:
+            self._last.clear()
+
     def unregister(self, task_id: str) -> None:
         """Stop watching a task — called when its result is registered, to
         close the completion-vs-heartbeat race (ref: ApplicationMaster.java

@@ -6,9 +6,13 @@ from tony_tpu.models.resnet import (
     ResNet101,
     ResNet152,
 )
+from tony_tpu.models.generate import generate, init_cache, sample_logits
 from tony_tpu.models.transformer import Transformer, TransformerConfig
 
 __all__ = [
+    "generate",
+    "init_cache",
+    "sample_logits",
     "ResNet",
     "ResNet18",
     "ResNet34",

@@ -1,0 +1,90 @@
+"""Autoregressive generation: prefill + KV-cache decode under one jit.
+
+No reference analog (TonY orchestrates training jobs; inference is out of
+scope there) — this is framework surface the TPU rebuild adds so the
+flagship transformer is usable end-to-end. TPU-first design:
+
+- the KV cache is a static [b, max_seq_len, h, dh] buffer per layer
+  (Attention._decode_attention), so prefill and every decode step compile
+  once each — no dynamic shapes, no recompiles
+- the decode loop is a single lax.scan over max_new_tokens: one XLA
+  program, device-resident carry (cache + last token + rng), zero
+  host<->device traffic until the final token block comes back
+- sampling (greedy / temperature / top-k) is branchless inside the scan
+- under a Mesh the cache shards like activations (batch on "data", heads
+  on "tensor"), so tensor-parallel decode works unchanged via jit+sharding
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def init_cache(model, params, batch_size: int, dtype=None) -> Any:
+    """Allocate the per-layer KV cache sized by cfg.max_seq_len."""
+    cfg = model.cfg
+    tokens = jnp.zeros((batch_size, cfg.max_seq_len), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens, decode=True)
+    return variables["cache"]
+
+
+def sample_logits(logits, rng, temperature, top_k: int):
+    """Greedy when temperature==0, else softmax sampling with an optional
+    top-k cut. ``temperature`` is a traced operand — changing it per call
+    (a serving loop sweeping 0.7, 0.8, ...) never recompiles; the greedy
+    case rides the same program via a where. ``top_k`` is static (it sets
+    the sort slice); changing it recompiles once per distinct value."""
+    scaled = logits / jnp.maximum(jnp.asarray(temperature, logits.dtype), 1e-6)
+    if top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
+        scaled = jnp.where(scaled < kth, -1e30, scaled)
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(jnp.asarray(temperature) == 0.0, greedy, sampled)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
+                                             "top_k"))
+def generate(model, params, prompt, *, max_new_tokens: int,
+             temperature: float = 0.0, top_k: int = 0,
+             rng: jax.Array | None = None, eos_id: int = -1):
+    """Generate max_new_tokens continuations of ``prompt`` [b, Lp].
+
+    Returns [b, max_new_tokens] int32. Tokens after an eos_id are frozen
+    to eos_id (computed but masked — fixed trip count keeps the scan
+    static; early-exit would force a while_loop with dynamic shapes
+    downstream).
+    """
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    b = prompt.shape[0]
+    cache = init_cache(model, params, b)
+
+    # prefill: one pass over the whole prompt fills every layer's cache
+    logits, vars_ = model.apply({"params": params, "cache": cache}, prompt,
+                                decode=True, mutable=["cache"])
+    rng, sub = jax.random.split(rng)
+    next_tok = sample_logits(logits[:, -1], sub, temperature, top_k)
+    done = next_tok == eos_id
+
+    def step(carry, _):
+        cache, tok, rng, done = carry
+        logits, vars_ = model.apply({"params": params, "cache": cache},
+                                    tok[:, None], decode=True,
+                                    mutable=["cache"])
+        rng, sub = jax.random.split(rng)
+        nxt = sample_logits(logits[:, -1], sub, temperature, top_k)
+        nxt = jnp.where(done, eos_id, nxt)
+        done = done | (nxt == eos_id)
+        return (vars_["cache"], nxt, rng, done), nxt
+
+    carry = (vars_["cache"], next_tok, rng, done)
+    if max_new_tokens > 1:
+        _, rest = jax.lax.scan(step, carry, None, length=max_new_tokens - 1)
+        rest = jnp.moveaxis(rest, 0, 1)  # [steps, b] -> [b, steps]
+        return jnp.concatenate([next_tok[:, None], rest], axis=1)
+    return next_tok[:, None]

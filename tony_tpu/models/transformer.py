@@ -103,7 +103,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False):
         cfg = self.cfg
         b, l, _ = x.shape
         dense = lambda name, feats, axes: nn.DenseGeneral(  # noqa: E731
@@ -113,15 +113,57 @@ class Attention(nn.Module):
         q = dense("q", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
         k = dense("k", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
         v = dense("v", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
-        positions = jnp.arange(l)
-        q = rotary_embedding(q, positions)
-        k = rotary_embedding(k, positions)
-        out = _attention(cfg, q, k, v)
+        if decode:
+            out = self._decode_attention(q, k, v)
+        else:
+            positions = jnp.arange(l)
+            q = rotary_embedding(q, positions)
+            k = rotary_embedding(k, positions)
+            out = _attention(cfg, q, k, v)
         out = nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
             param_dtype=jnp.float32, name="o",
             kernel_init=nn.initializers.normal(0.02))(out)
         return out
+
+    def _decode_attention(self, q, k, v):
+        """Incremental attention over a fixed-size KV cache.
+
+        Flax "cache" collection, the standard jittable decode shape: the
+        cache is a static [b, max_seq_len, h, dh] buffer updated with
+        lax.dynamic_update_slice at the current index, so every decode
+        step compiles to the same static-shape program (no growing
+        tensors, no recompiles — the XLA-friendly way to autoregress).
+        """
+        cfg = self.cfg
+        b, l, h, dh = q.shape
+        max_len = cfg.max_seq_len
+        is_init = self.has_variable("cache", "cached_key")
+        cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                 (b, max_len, h, dh), k.dtype)
+        cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                 (b, max_len, h, dh), v.dtype)
+        cache_index = self.variable("cache", "cache_index",
+                                    lambda: jnp.array(0, jnp.int32))
+        if not is_init:  # shape-only init pass
+            return jnp.zeros((b, l, h, dh), q.dtype)
+        cur = cache_index.value
+        positions = cur + jnp.arange(l)
+        q = rotary_embedding(q, positions)
+        k = rotary_embedding(k, positions)
+        keys = jax.lax.dynamic_update_slice(cached_k.value, k, (0, cur, 0, 0))
+        values = jax.lax.dynamic_update_slice(cached_v.value, v, (0, cur, 0, 0))
+        cached_k.value = keys
+        cached_v.value = values
+        cache_index.value = cur + l
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       keys.astype(jnp.float32)) / jnp.sqrt(dh)
+        kv_pos = jnp.arange(max_len)
+        visible = kv_pos[None, :] <= (cur + jnp.arange(l))[:, None]  # [l, max]
+        s = jnp.where(visible[None, None, :, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, values.astype(jnp.float32))
+        return out.astype(q.dtype)
 
 
 class MLP(nn.Module):
@@ -143,9 +185,9 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
-        x = x + Attention(self.cfg, name="attn")(RMSNorm(self.cfg.dtype,
-                                                         name="ln1")(x))
+    def __call__(self, x, decode: bool = False):
+        x = x + Attention(self.cfg, name="attn")(
+            RMSNorm(self.cfg.dtype, name="ln1")(x), decode=decode)
         x = x + MLP(self.cfg, name="mlp")(RMSNorm(self.cfg.dtype,
                                                   name="ln2")(x))
         return x
@@ -155,16 +197,16 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, decode: bool = False):
         cfg = self.cfg
         embed = self.param("embedding", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.d_model), jnp.float32)
         x = embed[tokens].astype(cfg.dtype)
         block = Block
-        if cfg.remat:
-            block = nn.remat(Block)
+        if cfg.remat and not decode:
+            block = nn.remat(Block, static_argnums=(2,))
         for i in range(cfg.n_layers):
-            x = block(cfg, name=f"block_{i}")(x)
+            x = block(cfg, name=f"block_{i}")(x, decode)
         x = RMSNorm(cfg.dtype, name="ln_f")(x)
         logits = jnp.einsum("bld,vd->blv", x.astype(jnp.float32), embed)
         return logits
