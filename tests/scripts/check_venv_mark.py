@@ -1,0 +1,6 @@
+"""Exit non-zero unless launched through the shipped venv's interpreter
+(the shim exports TONY_VENV_MARK; ref: check_env_and_venv.py)."""
+import os
+import sys
+
+sys.exit(0 if os.environ.get("TONY_VENV_MARK") == "1" else 1)

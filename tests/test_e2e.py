@@ -208,6 +208,111 @@ def test_coordinator_exception_no_retry_fails(cluster, monkeypatch):
     assert not ok
 
 
+def test_ps_worker_training_pass(cluster):
+    """Ref: testPSWorkerTrainingShouldPass (:128): untracked ps + 2 tracked
+    workers; job succeeds when the tracked gang completes."""
+    conf = cluster.base_conf()
+    conf.set("tony.ps.instances", 1)
+    conf.set("tony.worker.instances", 2)
+    conf.set("tony.ps.command", f"python {script('sleep_5.py')}")
+    conf.set("tony.worker.command", f"python {script('check_env.py')}")
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+
+
+def test_delayed_completion_notification(cluster, monkeypatch):
+    """Ref: testTaskCompletionNotificationDelayed (:412): a late launcher
+    exit callback must not override the RPC-registered result."""
+    monkeypatch.setenv(C.TEST_COMPLETION_DELAY, "500")
+    ok, client = run_job(cluster, script_conf(cluster, script("exit_0.py"),
+                                              {"worker": 1}))
+    assert ok, client.final_status
+
+
+def test_resources_localization(cluster):
+    """Ref: testResourcesLocalization (:339) + archive payload: per-role
+    resources (plain file, renamed file, archive) appear in the task cwd."""
+    res_dir = os.path.join(cluster.root, "res")
+    os.makedirs(res_dir)
+    plain = os.path.join(res_dir, "data.txt")
+    with open(plain, "w") as f:
+        f.write("x")
+    import zipfile
+
+    archive = os.path.join(res_dir, "bundle.zip")
+    with zipfile.ZipFile(archive, "w") as z:
+        z.writestr("inner.txt", "y")
+    conf = cluster.base_conf()
+    conf.set("tony.worker.instances", 1)
+    conf.set("tony.worker.resources",
+             f"{plain},{plain}::renamed.txt,{archive}::bundle#archive")
+    conf.set("tony.worker.command",
+             "test -f data.txt -a -f renamed.txt -a -f bundle/inner.txt")
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+
+
+def test_venv_interpreter_used(cluster):
+    """Ref: check_env_and_venv payload: tasks run under the shipped venv's
+    interpreter, not the system python."""
+    import stat
+    import sys
+
+    venv_bin = os.path.join(cluster.root, "venv", "bin")
+    os.makedirs(venv_bin)
+    shim = os.path.join(venv_bin, "python")
+    with open(shim, "w") as f:
+        f.write(f"#!/bin/bash\nexport TONY_VENV_MARK=1\n"
+                f"exec {sys.executable} \"$@\"\n")
+    os.chmod(shim, os.stat(shim).st_mode | stat.S_IEXEC)
+    conf = cluster.base_conf()
+    conf.set("tony.application.python-venv", os.path.dirname(venv_bin))
+    conf.set("tony.worker.instances", 1)
+    conf.set("tony.application.executes", script("check_venv_mark.py"))
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+
+
+def test_application_timeout_fails_job(cluster):
+    """Ref: tony.application.timeout semantics — whole-job deadline."""
+    conf = script_conf(cluster, script("sleep_5.py"), {"worker": 1})
+    conf.set("tony.application.timeout-ms", 800)
+    ok, client = run_job(cluster, conf)
+    assert not ok
+    assert "timed out" in (client.final_status.get("reason") or "").lower()
+
+
+def test_client_task_update_listener(cluster):
+    """Ref: testTaskUpdateListener (:430): the client fans task-info
+    updates out to registered listeners (NotebookSubmitter's discovery
+    mechanism)."""
+    conf = script_conf(cluster, script("exit_0.py"), {"worker": 1})
+    client = cluster.make_client(conf)
+    seen: list[list] = []
+    client.add_listener(lambda infos: seen.append(infos))
+    ok = client.run()
+    assert ok
+    assert seen, "listener never called"
+    final = {f"{t.name}:{t.index}": t.status for t in seen[-1]}
+    assert final.get("worker:0") in ("FINISHED", "SUCCEEDED")
+
+
+def test_final_conf_written(cluster):
+    """Ref: testTonyFinalConf (:621-654): the merged conf is serialized
+    into the job dir and reloadable."""
+    import json as _json
+
+    conf = script_conf(cluster, script("exit_0.py"), {"worker": 1})
+    ok, client = run_job(cluster, conf)
+    assert ok
+    final_path = os.path.join(client.job_dir, "tony-final.json")
+    assert os.path.exists(final_path)
+    with open(final_path) as f:
+        merged = _json.load(f)
+    assert merged.get("tony.worker.instances") in (1, "1")
+    assert merged.get("tony.application.framework") == "jax"
+
+
 # -- history -----------------------------------------------------------------
 
 

@@ -90,6 +90,13 @@ def test_generate_sampled_shapes(tiny):
     assert bool(jnp.all((out >= 0) & (out < 64)))
 
 
+def test_generate_rejects_cache_overflow(tiny):
+    model, params = tiny  # max_seq_len=32
+    prompt = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        generate(model, params, prompt, max_new_tokens=30)
+
+
 def test_generate_eos_freezes(tiny):
     model, params = tiny
     prompt = jnp.array([[1, 2]], jnp.int32)

@@ -26,11 +26,14 @@ def test_build_docker_command():
     argv = build_docker_command(
         task, {"JOB_NAME": "worker", "TASK_INDEX": "0"},
         image="gcr.io/proj/train:1", mounts=["/data:/data:ro"],
-        extra_args=["--shm-size=4g"])
+        extra_args=["--shm-size=4g"], workdir="/jobs/app1")
     assert argv[:2] == ["docker", "run"]
     assert "--net=host" in argv and "--privileged" in argv
     assert "tony-s0-worker-0" in argv  # epoch-qualified container name
-    assert argv[argv.index("-v") + 1] == "/data:/data:ro"
+    assert "/data:/data:ro" in argv
+    # job dir is mounted at the same path and set as the workdir
+    assert "/jobs/app1:/jobs/app1" in argv
+    assert argv[argv.index("-w") + 1] == "/jobs/app1"
     assert "JOB_NAME=worker" in argv and "TASK_INDEX=0" in argv
     assert "--shm-size=4g" in argv
     assert argv[-4:] == ["gcr.io/proj/train:1", "python3", "-m",
@@ -55,7 +58,7 @@ FAKE_DOCKER = textwrap.dedent("""\
     while [ $# -gt 0 ]; do
       case "$1" in
         --rm|--net=host|--privileged) shift;;
-        --name|-v) shift 2;;
+        --name|-v|-w) shift 2;;
         -e) envs+=("$2"); shift 2;;
         *) break;;
       esac

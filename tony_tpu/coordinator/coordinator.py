@@ -23,6 +23,7 @@ import glob
 import json
 import logging
 import os
+import shlex
 import threading
 import time
 
@@ -308,11 +309,12 @@ class Coordinator:
             mounts = [m.strip() for m in
                       str(self.conf.get("tony.docker.mounts", "")).split(",")
                       if m.strip()]
-            extra = str(self.conf.get("tony.docker.run-args", "")).split()
+            extra = shlex.split(str(self.conf.get("tony.docker.run-args", "")))
             return DockerLauncher(
                 image, self._on_task_process_exit, mounts=mounts,
                 extra_args=extra,
-                docker_bin=str(self.conf.get("tony.docker.bin", "docker")))
+                docker_bin=str(self.conf.get("tony.docker.bin", "docker")),
+                workdir=self.job_dir)
         if mode == "ssh":
             from tony_tpu.coordinator.launcher import SshLauncher
 

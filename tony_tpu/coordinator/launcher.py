@@ -85,6 +85,13 @@ class LocalProcessLauncher(Launcher):
         ).start()
         log.info("launched %s as pid %d (log: %s)", task.id, proc.pid, log_path)
 
+    def pause_exits(self) -> None:
+        """Bump the generation so in-flight process exits never reach
+        on_exit — wrapper launchers (docker) call this before their own
+        teardown kills complete the attached processes."""
+        with self._lock:
+            self._gen += 1
+
     def attach(self, task_id: str, proc: subprocess.Popen) -> None:
         """Register an externally-spawned process (ssh/docker wrapper) for
         exit detection under this launcher's generation handshake."""
@@ -138,7 +145,8 @@ def docker_container_name(task: Task) -> str:
 def build_docker_command(task: Task, env: dict[str, str], image: str,
                          mounts: list[str] | None = None,
                          extra_args: list[str] | None = None,
-                         docker_bin: str = "docker") -> list[str]:
+                         docker_bin: str = "docker",
+                         workdir: str = "") -> list[str]:
     """Build the ``docker run`` argv that hosts one agent.
 
     Reference analog: YARN docker containers via env injection
@@ -149,6 +157,11 @@ def build_docker_command(task: Task, env: dict[str, str], image: str,
     """
     argv = [docker_bin, "run", "--rm", "--name", docker_container_name(task),
             "--net=host", "--privileged"]
+    if workdir:
+        # the job dir carries the payload script, localized resources, and
+        # venv — mount it at the same path and start there, mirroring
+        # LocalProcessLauncher's workdir=job_dir
+        argv += ["-v", f"{workdir}:{workdir}", "-w", workdir]
     for mount in mounts or []:
         argv += ["-v", mount]
     for k, v in env.items():
@@ -171,20 +184,22 @@ class DockerLauncher(Launcher):
     def __init__(self, image: str, on_exit: OnExit,
                  mounts: list[str] | None = None,
                  extra_args: list[str] | None = None,
-                 docker_bin: str = "docker"):
+                 docker_bin: str = "docker", workdir: str = ""):
         if not image:
             raise ValueError("DockerLauncher needs an image")
         self.image = image
         self.mounts = mounts or []
         self.extra_args = extra_args or []
         self.docker_bin = docker_bin
+        self.workdir = workdir
         self._local = LocalProcessLauncher(on_exit)
         self._names: dict[str, str] = {}
         self._names_lock = threading.Lock()
 
     def launch(self, task: Task, env: dict[str, str], log_path: str) -> None:
         argv = build_docker_command(task, env, self.image, self.mounts,
-                                    self.extra_args, self.docker_bin)
+                                    self.extra_args, self.docker_bin,
+                                    workdir=self.workdir)
         os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
         out = open(log_path, "ab", buffering=0)
         try:
@@ -214,8 +229,7 @@ class DockerLauncher(Launcher):
     def stop_all(self) -> None:
         # bump the generation FIRST so teardown exits never reach on_exit
         # (the docker kills below complete each attached `docker run`)
-        with self._local._lock:
-            self._local._gen += 1
+        self._local.pause_exits()
         with self._names_lock:
             names = list(self._names.values())
             self._names.clear()

@@ -61,7 +61,12 @@ def generate(model, params, prompt, *, max_new_tokens: int,
     """
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    b = prompt.shape[0]
+    b, prompt_len = prompt.shape
+    if prompt_len + max_new_tokens > model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds cfg.max_seq_len ({model.cfg.max_seq_len}): the KV "
+            "cache would overflow")
     cache = init_cache(model, params, b)
 
     # prefill: one pass over the whole prompt fills every layer's cache
