@@ -35,19 +35,24 @@ def make_model(on_tpu: bool):
     return ResNet18(num_classes=100, num_filters=16), 8, 32
 
 
-def bench_fn(fn, args, steps: int, warmup: int = 2) -> float:
+def bench_fn(fn, args, steps: int, warmup: int = 2, repeats: int = 3) -> float:
+    """Median-of-repeats wall time for `steps` dispatches of fn."""
     for _ in range(warmup):
         out = fn(*args)
     jax.block_until_ready(out)
-    start = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return time.perf_counter() - start
+    times = []
+    for _ in range(repeats):
+        start = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - start)
+    times.sort()
+    return times[len(times) // 2]
 
 
 def main() -> None:
-    on_tpu = _platform() == "tpu"
+    on_tpu = _platform() in ("tpu", "axon")
     steps = 20 if on_tpu else 3
     model, batch, size = make_model(on_tpu)
     rng = jax.random.PRNGKey(0)
@@ -76,9 +81,11 @@ def main() -> None:
         p = optax.apply_updates(p, updates)
         return p, new_bs, o, loss
 
-    t_native = bench_fn(
-        lambda: native_step(params, batch_stats, opt_state, images, labels),
-        (), steps)
+    def native_once():
+        # return + block on the loss only, symmetric with fw_once below
+        return native_step(params, batch_stats, opt_state, images, labels)[3]
+
+    t_native = bench_fn(native_once, (), steps)
     native_ips = batch * steps / t_native
 
     # ---- framework step: tony_tpu Trainer over a mesh ---------------------

@@ -134,6 +134,50 @@ def test_untracked_failure_fails_fast(cluster):
     assert not ok
 
 
+def test_sidecar_tb_builtin_launcher(cluster):
+    """A tensorboard role with no command gets the built-in sidecar
+    launcher shipped into the job dir, and its URL reaches the client
+    (ref: setSidecarTBResources TonyClient.java:571-600)."""
+    conf = cluster.base_conf()
+    conf.set("tony.worker.instances", 1)
+    conf.set("tony.tensorboard.instances", 1)
+    conf.set("tony.worker.command", f"python {script('sleep_5.py')}")
+    conf.set("tony.application.tensorboard-log-dir",
+             os.path.join(cluster.root, "tblogs"))
+    conf.set("tony.application.shell-env", "TONY_TEST_TB_SLEEP=30")
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+    cmd = str(client.conf.role_get("tensorboard", "command"))
+    assert "sidecar_tensorboard.py" in cmd and client.job_dir in cmd
+    assert client.tensorboard_url.startswith("http://")
+
+
+def test_sidecar_tb_executes_fallback_preserved(cluster):
+    """A command-less tensorboard role with tony.application.executes set
+    keeps the entrypoint-switches-on-JOB_NAME fallback — the built-in
+    launcher must not hijack it."""
+    conf = cluster.base_conf()
+    conf.set("tony.worker.instances", 1)
+    conf.set("tony.tensorboard.instances", 1)
+    conf.set("tony.application.executes", script("exit_0.py"))
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+    assert str(client.conf.role_get("tensorboard", "command")) == ""
+
+
+def test_sidecar_tb_requires_log_dir(cluster):
+    """Command-less tensorboard role without a log dir fails at submit
+    time instead of as a silently tolerated sidecar crash."""
+    from tony_tpu.config import ConfError
+
+    conf = cluster.base_conf()
+    conf.set("tony.worker.instances", 1)
+    conf.set("tony.tensorboard.instances", 1)
+    conf.set("tony.worker.command", f"python {script('exit_0.py')}")
+    with pytest.raises(ConfError):
+        cluster.make_client(conf).run()
+
+
 def test_sidecar_failure_tolerated(cluster):
     """Ref: testSidecarCrashTolerated (:499)."""
     conf = cluster.base_conf()

@@ -40,6 +40,19 @@ def test_build_docker_command():
                          "tony_tpu.agent"]
 
 
+def test_build_docker_command_user_mount_covers_workdir():
+    """A user mount of the workdir target must suppress the implicit one —
+    docker rejects duplicate mount points."""
+    from tony_tpu.coordinator.launcher import build_docker_command
+
+    task = Task(role="worker", index=0)
+    argv = build_docker_command(
+        task, {}, image="img", mounts=["/jobs/app1:/jobs/app1"],
+        workdir="/jobs/app1")
+    assert argv.count("/jobs/app1:/jobs/app1") == 1
+    assert argv[argv.index("-w") + 1] == "/jobs/app1"
+
+
 def test_docker_launcher_rejects_missing_image():
     from tony_tpu.coordinator.launcher import DockerLauncher
 

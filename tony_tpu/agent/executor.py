@@ -200,6 +200,14 @@ class TaskAgent:
             rdzv.release()
         if tb and not reuse:
             tb.release()
+        if tb:
+            # ref: TaskExecutor.registerTensorBoardUrl :303-311 -> AM
+            # registerTensorBoardUrlToRM; here it lands in the app status
+            try:
+                self.client.call("register_tensorboard_url",
+                                 url=f"http://{host}:{tb.port}")
+            except Exception:
+                log.warning("failed to register tensorboard url", exc_info=True)
 
         ctx = TaskContext(
             conf=self.conf,

@@ -61,8 +61,55 @@ class TonyClient:
         """Validate conf + runtime preflight (ref: TonyClient.init :442 /
         validateTonyConf :788)."""
         self.conf.validate()
+        self._validate_sidecar_tb()
         framework = str(self.conf.get("tony.application.framework"))
         get_am_adapter(framework).validate_and_update_config(self.conf)
+
+    def _sidecar_tb_mode(self) -> str:
+        """How a configured ``tensorboard`` role gets its command:
+        ``user`` (explicit tony.tensorboard.command), ``builtin`` (log dir
+        set -> ship the built-in launcher; ref: the reference gates sidecar
+        TB on its log-dir flag, TonyClient.java:560-600), ``fallback``
+        (tony.application.executes serves the role, the pre-existing
+        entrypoint-switches-on-JOB_NAME pattern), or ``none``."""
+        role = C.TENSORBOARD_JOB_NAME
+        if role not in self.conf.roles():
+            return "none"
+        if str(self.conf.role_get(role, "command")):
+            return "user"
+        if str(self.conf.get("tony.application.tensorboard-log-dir", "")):
+            return "builtin"
+        if str(self.conf.get("tony.application.executes", "")):
+            return "fallback"
+        return "error"
+
+    def _validate_sidecar_tb(self) -> None:
+        """A ``tensorboard`` role with nothing to run fails at submit time,
+        not as a silently tolerated sidecar crash."""
+        if self._sidecar_tb_mode() == "error":
+            from tony_tpu.config import ConfError
+            raise ConfError(
+                "tony.tensorboard.instances is set with no "
+                "tony.tensorboard.command; the built-in sidecar launcher "
+                "needs tony.application.tensorboard-log-dir")
+
+    def _set_sidecar_tb_command(self) -> None:
+        """Ship the built-in sidecar launcher into the job dir and point the
+        command-less ``tensorboard`` role at it (ref: setSidecarTBResources
+        TonyClient.java:571-600 localizing resources/sidecar_tensorboard.py).
+        The script is stdlib-only, so it runs under the shipped venv's
+        python when present, else the task host's python3 — never the
+        client's interpreter, which may not exist under ssh/docker launch
+        modes."""
+        if self._sidecar_tb_mode() != "builtin":
+            return
+        from tony_tpu.runtime import sidecar_tensorboard
+        script = os.path.join(self.job_dir, "sidecar_tensorboard.py")
+        shutil.copyfile(sidecar_tensorboard.__file__, script)
+        venv_python = os.path.join(self.job_dir, "venv", "bin", "python")
+        interp = venv_python if os.path.exists(venv_python) else "python3"
+        self.conf.set(f"tony.{C.TENSORBOARD_JOB_NAME}.command",
+                      f"{interp} {script}")
 
     def stage(self) -> str:
         """Create the job dir and localize src/venv/resources into it
@@ -86,6 +133,7 @@ class TonyClient:
             spec = str(self.conf.role_get(role, "resources"))
             for res in parse_resources(spec):
                 res.localize(self.job_dir)
+        self._set_sidecar_tb_command()
         if self.conf.get_bool("tony.application.security.enabled"):
             self.secret = pysecrets.token_hex(32)
         self.conf.write_final(os.path.join(self.job_dir, C.TONY_FINAL_CONF))

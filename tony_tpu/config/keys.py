@@ -55,6 +55,11 @@ KEYS: dict[str, Key] = {
     "tony.application.sidecar.jobtypes": Key(
         "tensorboard", str, "Untracked helper roles whose failure is tolerated"
     ),
+    "tony.application.tensorboard-log-dir": Key(
+        "", str,
+        "Log dir served by the built-in sidecar TensorBoard launcher "
+        "(ref: setSidecarTBResources TonyClient.java:571-600)"
+    ),
     "tony.application.stop-on-failure.jobtypes": Key(
         "", str, "Roles whose single-task failure fails the whole job immediately"
     ),

@@ -157,11 +157,16 @@ def build_docker_command(task: Task, env: dict[str, str], image: str,
     """
     argv = [docker_bin, "run", "--rm", "--name", docker_container_name(task),
             "--net=host", "--privileged"]
-    if workdir:
+    # container paths already covered by user mounts — docker rejects
+    # duplicate mount points, so the implicit workdir mount must yield
+    user_targets = {m.split(":")[1] for m in mounts or [] if ":" in m}
+    if workdir and workdir not in user_targets:
         # the job dir carries the payload script, localized resources, and
         # venv — mount it at the same path and start there, mirroring
         # LocalProcessLauncher's workdir=job_dir
-        argv += ["-v", f"{workdir}:{workdir}", "-w", workdir]
+        argv += ["-v", f"{workdir}:{workdir}"]
+    if workdir:
+        argv += ["-w", workdir]
     for mount in mounts or []:
         argv += ["-v", mount]
     for k, v in env.items():

@@ -141,6 +141,9 @@ class TaskAdapter:
             env["TONY_PROFILER_PORT"] = str(profiler_base + ctx.flat_index())
         if ctx.tb_port > 0:
             env[C.TB_PORT] = str(ctx.tb_port)
+        tb_log_dir = str(ctx.conf.get("tony.application.tensorboard-log-dir", ""))
+        if tb_log_dir:
+            env[C.TB_LOG_DIR] = tb_log_dir
         return env
 
     def run(self, ctx: TaskContext) -> int:
