@@ -7,9 +7,16 @@ from tony_tpu.models.resnet import (
     ResNet152,
 )
 from tony_tpu.models.generate import generate, init_cache, sample_logits
-from tony_tpu.models.transformer import Transformer, TransformerConfig
+from tony_tpu.models.transformer import (
+    MoEMLP,
+    Transformer,
+    TransformerConfig,
+    moe_aux_loss,
+)
 
 __all__ = [
+    "MoEMLP",
+    "moe_aux_loss",
     "generate",
     "init_cache",
     "sample_logits",

@@ -4,9 +4,9 @@ No reference analog (TonY orchestrates training jobs; inference is out of
 scope there) — this is framework surface the TPU rebuild adds so the
 flagship transformer is usable end-to-end. TPU-first design:
 
-- the KV cache is a static [b, max_seq_len, h, dh] buffer per layer
-  (Attention._decode_attention), so prefill and every decode step compile
-  once each — no dynamic shapes, no recompiles
+- the KV cache is a static [b, max_seq_len, kv_heads, dh] buffer per layer
+  (Attention._decode_attention; GQA caches only n_kv_heads), so prefill and
+  every decode step compile once each — no dynamic shapes, no recompiles
 - the decode loop is a single lax.scan over max_new_tokens: one XLA
   program, device-resident carry (cache + last token + rng), zero
   host<->device traffic until the final token block comes back

@@ -35,6 +35,7 @@ class TransformerConfig:
     vocab_size: int = 32_000
     d_model: int = 512
     n_heads: int = 8
+    n_kv_heads: int | None = None  # GQA: fewer K/V heads; None = MHA
     n_layers: int = 6
     d_ff: int = 2048
     max_seq_len: int = 2048
@@ -43,10 +44,25 @@ class TransformerConfig:
     attention_block_size: int = 512
     remat: bool = False
     mesh: Any = None  # required for the ring backend
+    # MoE (expert-parallel FFN): 0 = dense MLP everywhere; k > 0 replaces the
+    # MLP of every k-th block with a mixture-of-experts layer
+    moe_every: int = 0
+    moe_num_experts: int = 8
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_heads if self.n_kv_heads is None else self.n_kv_heads
+        if kv <= 0 or self.n_heads % kv:
+            raise ValueError(
+                f"n_kv_heads={kv} must be positive and divide "
+                f"n_heads={self.n_heads}")
+        return kv
 
 
 def _attention(cfg: TransformerConfig, q, k, v):
@@ -111,14 +127,24 @@ class Attention(nn.Module):
             param_dtype=jnp.float32, name=name,
             kernel_init=nn.initializers.normal(0.02))
         q = dense("q", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
-        k = dense("k", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
-        v = dense("v", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
+        kv_ax = ("embed", "heads" if cfg.kv_heads == cfg.n_heads else "kv_heads",
+                 "kv")
+        k = dense("k", (cfg.kv_heads, cfg.head_dim), kv_ax)(x)
+        v = dense("v", (cfg.kv_heads, cfg.head_dim), kv_ax)(x)
         if decode:
             out = self._decode_attention(q, k, v)
         else:
             positions = jnp.arange(l)
             q = rotary_embedding(q, positions)
             k = rotary_embedding(k, positions)
+            if cfg.kv_heads != cfg.n_heads:
+                # GQA: broadcast K/V head groups up to n_heads for the
+                # backend. XLA fuses the repeat into the score einsum, so
+                # nothing is materialized; the HBM win (small KV) is kept
+                # where it matters — the decode cache below.
+                group = cfg.n_heads // cfg.kv_heads
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
             out = _attention(cfg, q, k, v)
         out = nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
@@ -130,19 +156,24 @@ class Attention(nn.Module):
         """Incremental attention over a fixed-size KV cache.
 
         Flax "cache" collection, the standard jittable decode shape: the
-        cache is a static [b, max_seq_len, h, dh] buffer updated with
+        cache is a static [b, max_seq_len, kv_heads, dh] buffer (GQA: only
+        n_kv_heads are cached — the decode-path HBM bound) updated with
         lax.dynamic_update_slice at the current index, so every decode
         step compiles to the same static-shape program (no growing
         tensors, no recompiles — the XLA-friendly way to autoregress).
         """
         cfg = self.cfg
         b, l, h, dh = q.shape
+        kvh = cfg.kv_heads
+        group = h // kvh
         max_len = cfg.max_seq_len
         is_init = self.has_variable("cache", "cached_key")
+        # cache holds only kv_heads — the GQA HBM saving that makes long
+        # batched decode fit (cache is the decode-path memory bound)
         cached_k = self.variable("cache", "cached_key", jnp.zeros,
-                                 (b, max_len, h, dh), k.dtype)
+                                 (b, max_len, kvh, dh), k.dtype)
         cached_v = self.variable("cache", "cached_value", jnp.zeros,
-                                 (b, max_len, h, dh), v.dtype)
+                                 (b, max_len, kvh, dh), v.dtype)
         cache_index = self.variable("cache", "cache_index",
                                     lambda: jnp.array(0, jnp.int32))
         if not is_init:  # shape-only init pass
@@ -156,14 +187,16 @@ class Attention(nn.Module):
         cached_k.value = keys
         cached_v.value = values
         cache_index.value = cur + l
-        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+        # grouped attention: q [b, l, kvh, group, dh] against kv [b, m, kvh, dh]
+        qg = q.astype(jnp.float32).reshape(b, l, kvh, group, dh)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
                        keys.astype(jnp.float32)) / jnp.sqrt(dh)
         kv_pos = jnp.arange(max_len)
         visible = kv_pos[None, :] <= (cur + jnp.arange(l))[:, None]  # [l, max]
-        s = jnp.where(visible[None, None, :, :], s, -1e30)
+        s = jnp.where(visible[None, None, None, :, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", p, values.astype(jnp.float32))
-        return out.astype(q.dtype)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", p, values.astype(jnp.float32))
+        return out.reshape(b, l, h, dh).astype(q.dtype)
 
 
 class MLP(nn.Module):
@@ -181,15 +214,67 @@ class MLP(nn.Module):
                         kernel_init=nn.initializers.normal(0.02))(h)
 
 
+class MoEMLP(nn.Module):
+    """Expert-parallel FFN: router + per-expert wi/wo with a leading expert
+    dim (sharded on the ``expert`` mesh axis under pjit — the dispatch and
+    combine einsums lower to all-to-all over ICI, see parallel/moe.py).
+
+    The load-balancing auxiliary loss is sown into the ``losses`` collection.
+    It is NOT applied automatically: your ``apply_fn`` must run
+    ``logits, mut = model.apply(params, tokens, mutable=["losses"])`` and add
+    ``moe_aux_loss(mut["losses"])`` to the objective, or the router trains
+    unregularized and can collapse onto a few experts. Plain
+    ``model.apply(params, tokens)`` still works for inference (sow no-ops).
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from tony_tpu.parallel.moe import MoEConfig, moe_layer
+
+        cfg = self.cfg
+        moe_cfg = MoEConfig(
+            num_experts=cfg.moe_num_experts,
+            capacity_factor=cfg.moe_capacity_factor,
+            top_k=cfg.moe_top_k,
+            d_model=cfg.d_model,
+            d_ff=cfg.d_ff,
+        )
+        init = nn.initializers.normal(0.02)
+        params = {
+            "router": self.param("router", init,
+                                 (cfg.d_model, cfg.moe_num_experts),
+                                 jnp.float32),
+            "wi": self.param("wi", init,
+                             (cfg.moe_num_experts, cfg.d_model, cfg.d_ff),
+                             jnp.float32),
+            "wo": self.param("wo", init,
+                             (cfg.moe_num_experts, cfg.d_ff, cfg.d_model),
+                             jnp.float32),
+        }
+        # experts compute in cfg.dtype (bf16 on TPU); the router stays fp32 —
+        # bf16 routing logits quantize near-tied gate probabilities and flip
+        # top-k choices step to step, destabilizing load balancing
+        cast = {"router": params["router"],
+                "wi": params["wi"].astype(cfg.dtype),
+                "wo": params["wo"].astype(cfg.dtype)}
+        out, aux = moe_layer(cast, x, moe_cfg)
+        self.sow("losses", "moe_aux", aux.astype(jnp.float32))
+        return out.astype(cfg.dtype)
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, decode: bool = False):
         x = x + Attention(self.cfg, name="attn")(
             RMSNorm(self.cfg.dtype, name="ln1")(x), decode=decode)
-        x = x + MLP(self.cfg, name="mlp")(RMSNorm(self.cfg.dtype,
-                                                  name="ln2")(x))
+        ffn = (MoEMLP(self.cfg, name="moe") if self.use_moe
+               else MLP(self.cfg, name="mlp"))
+        x = x + ffn(RMSNorm(self.cfg.dtype, name="ln2")(x))
         return x
 
 
@@ -206,7 +291,8 @@ class Transformer(nn.Module):
         if cfg.remat and not decode:
             block = nn.remat(Block, static_argnums=(2,))
         for i in range(cfg.n_layers):
-            x = block(cfg, name=f"block_{i}")(x, decode)
+            use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
+            x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x, decode)
         x = RMSNorm(cfg.dtype, name="ln_f")(x)
         logits = jnp.einsum("bld,vd->blv", x.astype(jnp.float32), embed)
         return logits
@@ -215,22 +301,51 @@ class Transformer(nn.Module):
 def logical_axis_rules_tree(params: Any) -> Any:
     """Best-effort logical axes for the transformer param tree, consumed by
     parallel.sharding.tree_shardings. Derived from param path names."""
+    # Pre-scan head counts: a GQA K/V kernel has fewer heads (dim 1) than
+    # its sibling q kernel and must get the always-replicated "kv_heads"
+    # axis (splitting n_kv_heads over a larger tensor axis would fail);
+    # full-MHA K/V keeps "heads" and stays tensor-shardable.
+    head_counts: dict[str, int] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        joined = "/" + "/".join(getattr(p, "key", str(p)) for p in path)
+        if "/q/" in joined and getattr(leaf, "ndim", 0) == 3:
+            head_counts[joined.rsplit("/q/", 1)[0]] = leaf.shape[1]
 
     def axes_for(path: tuple, x) -> tuple:
-        names = [getattr(p, "key", str(p)) for p in path]
         leaf_dims = x.ndim
-        joined = "/".join(names)
+        joined = "/" + "/".join(getattr(p, "key", str(p)) for p in path)
         if "embedding" in joined:
             return ("vocab", "embed")
-        if any(s in joined for s in ("/q/", "/k/", "/v/")) or \
-                joined.endswith(("q/kernel", "k/kernel", "v/kernel")):
+        if "/q/" in joined:
             return ("embed", "heads", "kv")[:leaf_dims]
+        for s in ("/k/", "/v/"):
+            if s in joined:
+                parent = joined.rsplit(s, 1)[0]
+                grouped = (leaf_dims == 3
+                           and x.shape[1] != head_counts.get(parent, x.shape[1]))
+                return ("embed", "kv_heads" if grouped else "heads",
+                        "kv")[:leaf_dims]
         if "/o/" in joined or joined.endswith("o/kernel"):
             return ("heads", "kv", "embed")[:leaf_dims]
+        if "router" in joined:
+            return (None, None)
+        # MoE expert weights: must match parallel.moe.moe_logical_axes()
+        # (single source of truth for 3-dim expert params)
         if "wi" in joined:
-            return ("embed", "mlp")
+            return moe_logical_axes()["wi"] if leaf_dims == 3 \
+                else ("embed", "mlp")
         if "wo" in joined:
-            return ("mlp", "embed")
+            return moe_logical_axes()["wo"] if leaf_dims == 3 \
+                else ("mlp", "embed")
         return tuple([None] * leaf_dims)
 
     return jax.tree_util.tree_map_with_path(axes_for, params)
+
+
+def moe_aux_loss(losses: Any, weight: float = 0.01):
+    """Sum the sown MoE load-balancing losses from a ``losses`` collection
+    (as returned by ``model.apply(..., mutable=["losses"])``)."""
+    leaves = jax.tree_util.tree_leaves(losses)
+    if not leaves:
+        return jnp.float32(0.0)
+    return weight * sum(jnp.sum(leaf) for leaf in leaves)

@@ -1,7 +1,9 @@
 """Sharding presets: logical-axis rules -> PartitionSpecs for model states.
 
 The framework's models annotate arrays with *logical* axis names
-("batch", "seq", "embed", "heads", "mlp", "vocab", "expert", "layers");
+("batch", "seq", "embed", "heads", "kv_heads", "mlp", "vocab", "expert",
+"layers"); "kv_heads" is the GQA-shrunk K/V head dim — always replicated,
+since its size (n_kv_heads) is typically smaller than the tensor axis;
 a preset maps logical names to mesh axes. This is the pjit idiom: the same
 model runs DP, FSDP, TP, or combinations by swapping the rule set, and XLA
 inserts the collectives (no NCCL-style explicit comms as in the reference's
@@ -22,47 +24,47 @@ RULES: dict[str, dict[str, Any]] = {
     # pure data parallelism: params replicated, batch sharded
     "dp": {
         "batch": (DATA, FSDP),
-        "seq": None, "embed": None, "heads": None, "kv": None,
+        "seq": None, "embed": None, "heads": None, "kv": None, "kv_heads": None,
         "mlp": None, "vocab": None, "expert": None, "layers": None,
     },
     # fsdp: params sharded on the fsdp axis along their largest dim
     "fsdp": {
         "batch": (DATA, FSDP),
         "embed": FSDP,
-        "seq": None, "heads": None, "kv": None, "mlp": None,
+        "seq": None, "heads": None, "kv": None, "kv_heads": None, "mlp": None,
         "vocab": None, "expert": None, "layers": None,
     },
     # tensor parallelism (megatron-style): heads + mlp sharded
     "tp": {
         "batch": (DATA, FSDP),
         "heads": TENSOR, "mlp": TENSOR, "vocab": TENSOR,
-        "seq": None, "embed": None, "kv": None, "expert": None, "layers": None,
+        "seq": None, "embed": None, "kv": None, "kv_heads": None, "expert": None, "layers": None,
     },
     # fsdp + tp combined (the common large-model preset)
     "fsdp_tp": {
         "batch": (DATA, FSDP),
         "embed": FSDP, "heads": TENSOR, "mlp": TENSOR, "vocab": TENSOR,
-        "seq": None, "kv": None, "expert": None, "layers": None,
+        "seq": None, "kv": None, "kv_heads": None, "expert": None, "layers": None,
     },
     # sequence/context parallelism: activations sharded along seq
     "sp": {
         "batch": (DATA, FSDP),
         "act_seq": SEQ,
-        "seq": None, "embed": None, "heads": None, "kv": None,
+        "seq": None, "embed": None, "heads": None, "kv": None, "kv_heads": None,
         "mlp": None, "vocab": None, "expert": None, "layers": None,
     },
     # expert parallelism for MoE blocks
     "ep": {
         "batch": (DATA, FSDP),
         "expert": EXPERT,
-        "seq": None, "embed": None, "heads": None, "kv": None,
+        "seq": None, "embed": None, "heads": None, "kv": None, "kv_heads": None,
         "mlp": None, "vocab": None, "layers": None,
     },
     # pipeline: layers sharded across stages (used with parallel.pipeline)
     "pp": {
         "batch": (DATA, FSDP),
         "layers": PIPE,
-        "seq": None, "embed": None, "heads": None, "kv": None,
+        "seq": None, "embed": None, "heads": None, "kv": None, "kv_heads": None,
         "mlp": None, "vocab": None, "expert": None,
     },
 }
