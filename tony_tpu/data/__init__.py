@@ -1,0 +1,16 @@
+from tony_tpu.data.loader import DataLoader, device_prefetch
+from tony_tpu.data.sources import (
+    ArraySource,
+    JsonlSource,
+    SyntheticImageSource,
+    SyntheticTokenSource,
+)
+
+__all__ = [
+    "ArraySource",
+    "DataLoader",
+    "device_prefetch",
+    "JsonlSource",
+    "SyntheticImageSource",
+    "SyntheticTokenSource",
+]

@@ -1,0 +1,163 @@
+"""Sharded, prefetched data loading: host numpy -> device-resident global
+batches.
+
+No reference analog (TonY delegates input to the user script; SURVEY.md
+section 2.2 tony-examples read MNIST themselves). TPU-first design:
+
+- **per-process sharding**: every process sees the same seeded per-epoch
+  permutation and takes a disjoint stride of it, so a multi-host job reads
+  each example exactly once per epoch with zero coordination traffic —
+  the data analog of the env-var rendezvous the launcher already does.
+- **global batch assembly**: with a ``NamedSharding``, local host batches
+  are stitched into one global ``jax.Array`` via
+  ``jax.make_array_from_process_local_data`` — the multi-host pjit input
+  idiom (each host contributes only the shard its devices own).
+- **background prefetch**: a daemon thread stages the next batches while
+  the current step runs, hiding host->HBM transfer behind MXU time.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, Mapping
+
+import jax
+import numpy as np
+
+from tony_tpu.data.sources import Source
+
+_STOP = object()
+
+
+class DataLoader:
+    """Iterates device-ready batches from a ``Source``.
+
+    Args:
+      source: random-access examples.
+      global_batch_size: batch size summed over all processes.
+      shuffle: reshuffle each epoch with a (seed, epoch)-derived permutation.
+      seed: base shuffle seed (must match across processes).
+      drop_remainder: drop the trailing partial batch (required for jit's
+        static shapes; keep True for training).
+      num_epochs: None = loop forever.
+      process_index/process_count: which stride of the permutation this
+        process owns; default = jax.process_index()/process_count().
+      sharding: optional ``NamedSharding`` for the batch. When set, the
+        iterator yields global ``jax.Array``s (multi-host safe); when None
+        it yields host numpy dicts.
+      prefetch: how many batches to stage ahead (0 = synchronous).
+    """
+
+    def __init__(self, source: Source, global_batch_size: int, *,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_remainder: bool = True, num_epochs: int | None = None,
+                 process_index: int | None = None,
+                 process_count: int | None = None,
+                 sharding: Any | None = None, prefetch: int = 2):
+        self.source = source
+        self.global_batch_size = global_batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self.num_epochs = num_epochs
+        self.process_index = (jax.process_index() if process_index is None
+                              else process_index)
+        self.process_count = (jax.process_count() if process_count is None
+                              else process_count)
+        if global_batch_size % self.process_count:
+            raise ValueError(
+                f"global_batch_size={global_batch_size} not divisible by "
+                f"process_count={self.process_count}")
+        self.local_batch_size = global_batch_size // self.process_count
+        self.sharding = sharding
+        self.prefetch = prefetch
+
+    # -- host-side iteration -------------------------------------------------
+
+    def _epoch_indices(self, epoch: int) -> np.ndarray:
+        n = len(self.source)
+        if self.shuffle:
+            order = np.random.default_rng((self.seed, epoch)).permutation(n)
+        else:
+            order = np.arange(n)
+        return order[self.process_index::self.process_count]
+
+    def _host_batches(self) -> Iterator[Mapping[str, np.ndarray]]:
+        epoch = 0
+        while self.num_epochs is None or epoch < self.num_epochs:
+            mine = self._epoch_indices(epoch)
+            lb = self.local_batch_size
+            if self.drop_remainder:
+                # every process must yield the SAME batch count: the global
+                # batch is assembled collectively (and the following pjit
+                # step is a cross-host collective), so one process ending an
+                # epoch a step early would hang the others. Cap by the
+                # minimum per-process example count, not this stride's.
+                stop = (len(self.source) // self.process_count) // lb * lb
+            else:
+                stop = len(mine)
+            for start in range(0, stop, lb):
+                rows = [self.source[int(i)] for i in mine[start:start + lb]]
+                yield {k: np.stack([r[k] for r in rows]) for k in rows[0]}
+            epoch += 1
+
+    # -- public iterator -----------------------------------------------------
+
+    def __iter__(self):
+        it = self._host_batches()
+        if self.sharding is not None:
+            it = (self._to_global(b) for b in it)
+        if self.prefetch > 0:
+            it = _prefetch_iter(it, self.prefetch)
+        return it
+
+    def _to_global(self, batch: Mapping[str, np.ndarray]):
+        return {
+            k: jax.make_array_from_process_local_data(self.sharding, v)
+            for k, v in batch.items()
+        }
+
+    def steps_per_epoch(self) -> int:
+        if self.drop_remainder:
+            # same formula as _host_batches: identical on every process
+            return (len(self.source) // self.process_count) \
+                // self.local_batch_size
+        per_proc = (len(self.source) + self.process_count - 1
+                    - self.process_index) // self.process_count
+        return (per_proc + self.local_batch_size - 1) // self.local_batch_size
+
+
+def _prefetch_iter(it: Iterator, size: int) -> Iterator:
+    """Stage up to `size` items from a daemon thread."""
+    q: queue.Queue = queue.Queue(maxsize=size)
+    err: list[BaseException] = []
+
+    def worker():
+        try:
+            for item in it:
+                q.put(item)
+        except BaseException as e:  # surfaced on the consumer side
+            err.append(e)
+        finally:
+            q.put(_STOP)
+
+    threading.Thread(target=worker, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is _STOP:
+            if err:
+                raise err[0]
+            return
+        yield item
+
+
+def device_prefetch(iterator: Iterator, sharding: Any, size: int = 2):
+    """Wrap any host-batch iterator: device_put with `size` lookahead so the
+    next batch's host->HBM DMA overlaps the current step's compute."""
+
+    def put(batch):
+        return jax.tree.map(
+            lambda x: jax.device_put(x, sharding), batch)
+
+    return _prefetch_iter((put(b) for b in iterator), size)

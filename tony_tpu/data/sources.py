@@ -1,0 +1,130 @@
+"""Data sources for the input pipeline.
+
+No reference analog: TonY leaves data loading entirely to the user script
+(its examples read MNIST from local disk/HDFS themselves). A TPU framework
+cannot — keeping the MXU fed is half the throughput battle — so tony-tpu
+ships a small source/loader layer: a ``Source`` is random-access over
+*examples* (host-side numpy), and the ``DataLoader`` (loader.py) turns it
+into sharded, prefetched, device-resident global batches.
+
+Sources are deliberately host-side and framework-free (pure numpy): the
+device boundary is crossed exactly once, in the loader.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+
+class Source:
+    """Random-access examples: len() + [i] -> dict of numpy arrays."""
+
+    def __len__(self) -> int:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def __getitem__(self, idx: int) -> Mapping[str, np.ndarray]:
+        raise NotImplementedError  # pragma: no cover - interface
+
+
+class ArraySource(Source):
+    """Wraps a dict of equal-leading-dim numpy arrays (in-memory dataset)."""
+
+    def __init__(self, arrays: Mapping[str, np.ndarray]):
+        if not arrays:
+            raise ValueError("ArraySource needs at least one array")
+        sizes = {k: len(v) for k, v in arrays.items()}
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"leading dims differ: {sizes}")
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        self._n = next(iter(sizes.values()))
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, idx: int) -> Mapping[str, np.ndarray]:
+        return {k: v[idx] for k, v in self.arrays.items()}
+
+
+class SyntheticTokenSource(Source):
+    """Deterministic random token sequences (LM training/benchmarks).
+
+    Example i is reproducible from (seed, i) alone, so every process
+    materializes identical data without coordination — the multi-host-safe
+    way to synthesize.
+    """
+
+    def __init__(self, num_examples: int, seq_len: int, vocab_size: int,
+                 seed: int = 0):
+        self.num_examples = num_examples
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.num_examples
+
+    def __getitem__(self, idx: int) -> Mapping[str, np.ndarray]:
+        rng = np.random.default_rng((self.seed, idx))
+        return {"tokens": rng.integers(
+            0, self.vocab_size, (self.seq_len,), dtype=np.int32)}
+
+
+class SyntheticImageSource(Source):
+    """Deterministic random image/label pairs (vision benchmarks)."""
+
+    def __init__(self, num_examples: int, height: int, width: int,
+                 channels: int = 3, num_classes: int = 1000, seed: int = 0):
+        self.num_examples = num_examples
+        self.shape = (height, width, channels)
+        self.num_classes = num_classes
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.num_examples
+
+    def __getitem__(self, idx: int) -> Mapping[str, np.ndarray]:
+        rng = np.random.default_rng((self.seed, idx))
+        return {
+            "image": rng.standard_normal(self.shape, dtype=np.float32),
+            "label": np.int32(rng.integers(0, self.num_classes)),
+        }
+
+
+class JsonlSource(Source):
+    """Pre-tokenized examples from .jsonl file(s): one JSON object per line,
+    values are lists/scalars converted to numpy. Line offsets are indexed
+    once at open, so access is random without loading the file into memory.
+    """
+
+    def __init__(self, paths: str | Sequence[str],
+                 dtypes: Mapping[str, Any] | None = None):
+        if isinstance(paths, (str, os.PathLike)):
+            paths = [paths]
+        self.paths = [str(p) for p in paths]
+        self.dtypes = dict(dtypes or {})
+        self._index: list[tuple[int, int]] = []  # (file idx, byte offset)
+        for fi, path in enumerate(self.paths):
+            offset = 0
+            with open(path, "rb") as f:
+                for line in f:
+                    if line.strip():
+                        self._index.append((fi, offset))
+                    offset += len(line)
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def __getitem__(self, idx: int) -> Mapping[str, np.ndarray]:
+        fi, offset = self._index[idx]
+        with open(self.paths[fi], "rb") as f:
+            f.seek(offset)
+            obj = json.loads(f.readline())
+        out = {}
+        for k, v in obj.items():
+            dtype = self.dtypes.get(k)
+            out[k] = np.asarray(v, dtype=dtype) if dtype else np.asarray(v)
+        return out
