@@ -1,0 +1,44 @@
+"""Payload: restart-with-resume contract (ref analog: none — TonY's AM
+retry restarts user scripts cold; tony-tpu injects TONY_CHECKPOINT_DIR /
+TONY_RESUME_STEP so attempt 1 resumes attempt 0's checkpoint).
+
+Attempt 0: save a checkpoint at step 5, then fail -> coordinator retries.
+Attempt 1: must resume step 5 (and see TONY_RESUME_STEP=5), then succeed.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+from tony_tpu.train import CheckpointManager, auto_resume
+
+attempt = int(os.environ["TONY_ATTEMPT_NUMBER"])
+ckpt_dir = os.environ.get("TONY_CHECKPOINT_DIR")
+if not ckpt_dir:
+    sys.exit("TONY_CHECKPOINT_DIR not injected")
+
+
+def init_fn():
+    return {"step": np.array(0, np.int32), "w": np.zeros(4, np.float32)}
+
+
+state, manager, resumed = auto_resume(init_fn)
+
+if attempt == 0:
+    if resumed:
+        sys.exit("attempt 0 must start fresh")
+    state = {"step": np.array(5, np.int32), "w": np.full(4, 2.5, np.float32)}
+    mgr = manager or CheckpointManager(ckpt_dir)
+    mgr.save(5, state, force=True)
+    mgr.wait()
+    print("attempt 0: checkpointed step 5, failing to trigger retry")
+    sys.exit(1)
+
+if not resumed:
+    sys.exit("attempt 1 did not resume")
+if int(state["step"]) != 5 or not np.allclose(state["w"], 2.5):
+    sys.exit(f"bad restored state: {state}")
+if os.environ.get("TONY_RESUME_STEP") != "5":
+    sys.exit(f"TONY_RESUME_STEP={os.environ.get('TONY_RESUME_STEP')!r}")
+print("attempt 1: resumed from step 5 OK")

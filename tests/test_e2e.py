@@ -245,6 +245,18 @@ def test_coordinator_exception_retry(cluster, monkeypatch):
     assert ok, client.final_status
 
 
+def test_resume_from_checkpoint_on_retry(cluster):
+    """Restart-with-resume (no reference analog, SURVEY 5.4): attempt 0
+    checkpoints then fails; the retry attempt must see TONY_RESUME_STEP and
+    restore the saved state before succeeding."""
+    conf = script_conf(cluster, script("resume_from_checkpoint.py"),
+                       {"worker": 1})
+    conf.set("tony.coordinator.retry-count", 1)
+    conf.set("tony.application.checkpoint-dir", "ckpts")  # job-dir relative
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+
+
 def test_coordinator_exception_no_retry_fails(cluster, monkeypatch):
     monkeypatch.setenv(C.TEST_COORD_THROW, "1")
     conf = script_conf(cluster, script("exit_0.py"), {"worker": 1})

@@ -60,6 +60,14 @@ KEYS: dict[str, Key] = {
         "Log dir served by the built-in sidecar TensorBoard launcher "
         "(ref: setSidecarTBResources TonyClient.java:571-600)"
     ),
+    "tony.application.checkpoint-dir": Key(
+        "", str,
+        "Checkpoint directory for restart-with-resume (no reference analog: "
+        "TonY has no in-tree checkpointing, SURVEY.md 5.4). When set, tasks "
+        "get TONY_CHECKPOINT_DIR (relative paths resolve under the job dir) "
+        "and, on coordinator retry, TONY_RESUME_STEP with the newest step "
+        "found there so training resumes instead of restarting from scratch"
+    ),
     "tony.application.stop-on-failure.jobtypes": Key(
         "", str, "Roles whose single-task failure fails the whole job immediately"
     ),

@@ -20,6 +20,8 @@ JOB_ID = "TONY_JOB_ID"  # application id (ref: JOB_ID)
 SESSION_ID = "TONY_SESSION_ID"  # session epoch, bumped on retry (ref: SESSION_ID)
 DISTRIBUTED_MODE = "TONY_DISTRIBUTED_MODE"  # GANG | FCFS
 ATTEMPT_NUMBER = "TONY_ATTEMPT_NUMBER"  # coordinator retry attempt (ref: ATTEMPT_NUMBER)
+CHECKPOINT_DIR = "TONY_CHECKPOINT_DIR"  # resume: checkpoint root (no ref analog, SURVEY 5.4)
+RESUME_STEP = "TONY_RESUME_STEP"  # resume: newest step found at (re)launch
 NUM_AM_RETRIES = "TONY_NUM_COORD_RETRIES"  # retries left (ref: NUM_AM_RETRIES)
 
 # Coordinator (AM) control-plane address, for agents to register back

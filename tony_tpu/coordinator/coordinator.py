@@ -419,7 +419,28 @@ class Coordinator:
         }
         if self.secret:
             env[C.JOB_TOKEN] = self.secret
+        ckpt = self._checkpoint_dir()
+        if ckpt:
+            # restart-with-resume (no ref analog — TonY's AM retry restarts
+            # user scripts cold, SURVEY 5.4): every attempt gets the same
+            # checkpoint root; on retry we also advertise the newest step
+            # found so the task can log/assert what it resumes from
+            env[C.CHECKPOINT_DIR] = ckpt
+            from tony_tpu.train.checkpoint import scan_latest_step
+
+            step = scan_latest_step(ckpt)
+            if step is not None:
+                env[C.RESUME_STEP] = str(step)
         return env
+
+    def _checkpoint_dir(self) -> str | None:
+        path = str(self.conf.get("tony.application.checkpoint-dir", ""))
+        if not path:
+            return None
+        if not os.path.isabs(path):
+            path = os.path.join(self.job_dir, path)
+        os.makedirs(path, exist_ok=True)
+        return path
 
     def _task_command(self, req) -> str:
         """Ref: TonyClient.buildTaskCommand :618-635 — role command override,

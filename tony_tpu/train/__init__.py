@@ -1,4 +1,10 @@
-from tony_tpu.train.checkpoint import CheckpointManager, restore_or_init
+from tony_tpu.train.checkpoint import (
+    CheckpointManager,
+    auto_resume,
+    job_checkpoint_dir,
+    restore_or_init,
+    scan_latest_step,
+)
 from tony_tpu.train.trainer import (
     Trainer,
     TrainState,
@@ -8,6 +14,9 @@ from tony_tpu.train.trainer import (
 
 __all__ = [
     "CheckpointManager",
+    "auto_resume",
+    "job_checkpoint_dir",
+    "scan_latest_step",
     "Trainer",
     "TrainState",
     "build_train_step",

@@ -69,3 +69,44 @@ def restore_or_init(directory: str, init_fn, state_template=None):
         if restored is not None:
             return restored, manager, True
     return template, manager, False
+
+
+def scan_latest_step(directory: str) -> int | None:
+    """Newest checkpoint step under `directory` without importing orbax —
+    numbered subdirs are orbax's on-disk layout. Used by the coordinator
+    (which must stay lightweight) to advertise TONY_RESUME_STEP."""
+    def complete(name: str) -> bool:
+        # per-entry guard: a step dir GC'd mid-scan (orbax max_to_keep)
+        # must not abort the scan of the surviving steps
+        try:
+            path = os.path.join(directory, name)
+            # an in-flight orbax save holds a *.orbax-checkpoint-tmp*
+            # marker inside; only complete steps count
+            return os.path.isdir(path) and \
+                not any("tmp" in f for f in os.listdir(path))
+        except OSError:
+            return False
+
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    steps = [int(n) for n in names if n.isdigit() and complete(n)]
+    return max(steps) if steps else None
+
+
+def job_checkpoint_dir() -> str | None:
+    """The coordinator-injected checkpoint dir for this task, if any."""
+    return os.environ.get("TONY_CHECKPOINT_DIR") or None
+
+
+def auto_resume(init_fn, state_template=None):
+    """User-script one-liner: resume from the job's TONY_CHECKPOINT_DIR if
+    the coordinator injected one (set tony.application.checkpoint-dir),
+    else init fresh with no manager. Returns (state, manager|None, resumed).
+    """
+    directory = job_checkpoint_dir()
+    if directory is None:
+        template = state_template if state_template is not None else init_fn()
+        return template, None, False
+    return restore_or_init(directory, init_fn, state_template)
