@@ -125,6 +125,25 @@ def test_transformer_moe_blocks():
     np.testing.assert_allclose(np.asarray(logits), np.asarray(logits2))
 
 
+def test_moe_param_tree_logical_axes_and_ep_sharding():
+    """logical_axis_rules_tree must handle MoE trees (regression: it used
+    moe_logical_axes without importing it) and place them on an ep mesh."""
+    from tony_tpu.models.transformer import logical_axis_rules_tree
+    from tony_tpu.parallel.sharding import tree_shardings
+
+    mesh = make_mesh(MeshSpec(data=-1, expert=2))
+    cfg = tiny_cfg(moe_every=1, moe_num_experts=2, moe_top_k=1)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((2, 8), jnp.int32))["params"]
+    axes = logical_axis_rules_tree(params)
+    assert axes["block_0"]["moe"]["wi"] == ("expert", None, "mlp")
+    assert axes["block_0"]["moe"]["router"] == (None, None)
+    sh = tree_shardings(mesh, axes, "ep")
+    assert sh["block_0"]["moe"]["wi"].spec[0] == "expert"
+    jax.device_put(params, sh)  # placement must succeed
+
+
 def test_transformer_moe_trains_on_expert_mesh():
     from tony_tpu.models import moe_aux_loss
 

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import functools
 import pytest
 
 from tony_tpu.ops import add_rmsnorm, flash_attention, rmsnorm
@@ -61,3 +62,68 @@ def test_add_rmsnorm():
     ref = s * jax.lax.rsqrt(jnp.mean(s * s, -1, keepdims=True) + 1e-6)
     np.testing.assert_allclose(np.asarray(normed), np.asarray(ref), atol=1e-5,
                                rtol=1e-5)
+
+
+def test_chunked_xent_matches_full():
+    from tony_tpu.ops import chunked_cross_entropy, full_cross_entropy
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    hidden = jax.random.normal(k1, (2, 8, 16))
+    emb = jax.random.normal(k2, (100, 16))  # vocab not a chunk multiple
+    labels = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 100)
+    ref = full_cross_entropy(hidden, emb, labels)
+    for chunk in (16, 32, 100, 4096):
+        got = chunked_cross_entropy(hidden, emb, labels, chunk_size=chunk)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_chunked_xent_grads_match():
+    from tony_tpu.ops import chunked_cross_entropy, full_cross_entropy
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(2))
+    hidden = jax.random.normal(k1, (12, 8))
+    emb = jax.random.normal(k2, (40, 8))
+    labels = jax.random.randint(jax.random.PRNGKey(3), (12,), 0, 40)
+    g_ref = jax.grad(full_cross_entropy, argnums=(0, 1))(hidden, emb, labels)
+    g_chk = jax.grad(
+        lambda h, e: chunked_cross_entropy(h, e, labels, chunk_size=16),
+        argnums=(0, 1))(hidden, emb)
+    for a, b in zip(g_chk, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_chunked_xent_z_loss_and_jit():
+    from tony_tpu.ops import chunked_cross_entropy
+
+    hidden = jax.random.normal(jax.random.PRNGKey(4), (2, 4, 8))
+    emb = jax.random.normal(jax.random.PRNGKey(5), (30, 8))
+    labels = jnp.zeros((2, 4), jnp.int32)
+    base = chunked_cross_entropy(hidden, emb, labels, chunk_size=8)
+    with_z = jax.jit(functools.partial(
+        chunked_cross_entropy, chunk_size=8, z_loss=1e-3))(hidden, emb, labels)
+    assert float(with_z) > float(base)  # lse^2 regularizer is additive
+
+
+def test_transformer_hidden_plus_chunked_xent():
+    """Training path: return_hidden + chunked loss == logits + standard CE."""
+    from tony_tpu.models import Transformer, TransformerConfig
+    from tony_tpu.ops import chunked_cross_entropy
+    from tony_tpu.train import cross_entropy_loss
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                            d_ff=64, max_seq_len=32, dtype=jnp.float32,
+                            attention_backend="blockwise",
+                            attention_block_size=16)
+    model = Transformer(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 16), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    logits = model.apply(params, tokens)
+    ref = cross_entropy_loss(logits[:, :-1], tokens[:, 1:])
+    hidden = model.apply(params, tokens, return_hidden=True)
+    got = chunked_cross_entropy(hidden[:, :-1],
+                                params["params"]["embedding"],
+                                tokens[:, 1:], chunk_size=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from flax.linen import partitioning as nn_partitioning
 
+from tony_tpu.parallel.moe import moe_logical_axes
 from tony_tpu.parallel.ring_attention import (
     blockwise_attention,
     reference_attention,
@@ -122,15 +123,15 @@ class Attention(nn.Module):
     def __call__(self, x, decode: bool = False):
         cfg = self.cfg
         b, l, _ = x.shape
-        dense = lambda name, feats, axes: nn.DenseGeneral(  # noqa: E731
+        # logical sharding axes for these kernels come from path-name
+        # matching in logical_axis_rules_tree, not from annotations here
+        dense = lambda name, feats: nn.DenseGeneral(  # noqa: E731
             feats, axis=-1, use_bias=False, dtype=cfg.dtype,
             param_dtype=jnp.float32, name=name,
             kernel_init=nn.initializers.normal(0.02))
-        q = dense("q", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
-        kv_ax = ("embed", "heads" if cfg.kv_heads == cfg.n_heads else "kv_heads",
-                 "kv")
-        k = dense("k", (cfg.kv_heads, cfg.head_dim), kv_ax)(x)
-        v = dense("v", (cfg.kv_heads, cfg.head_dim), kv_ax)(x)
+        q = dense("q", (cfg.n_heads, cfg.head_dim))(x)
+        k = dense("k", (cfg.kv_heads, cfg.head_dim))(x)
+        v = dense("v", (cfg.kv_heads, cfg.head_dim))(x)
         if decode:
             out = self._decode_attention(q, k, v)
         else:
@@ -282,7 +283,12 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, decode: bool = False):
+    def __call__(self, tokens, decode: bool = False,
+                 return_hidden: bool = False):
+        """return_hidden=True yields the final [B, L, D] activations
+        (post ln_f) instead of logits, for the chunked large-vocab loss
+        (ops.xent.chunked_cross_entropy with params["embedding"]) — the
+        [B, L, V] logits tensor is never materialized."""
         cfg = self.cfg
         embed = self.param("embedding", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.d_model), jnp.float32)
@@ -294,6 +300,8 @@ class Transformer(nn.Module):
             use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
             x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x, decode)
         x = RMSNorm(cfg.dtype, name="ln_f")(x)
+        if return_hidden:
+            return x.astype(jnp.float32)
         logits = jnp.einsum("bld,vd->blv", x.astype(jnp.float32), embed)
         return logits
 

@@ -1,4 +1,6 @@
 from tony_tpu.ops.attention import flash_attention
 from tony_tpu.ops.fused import add_rmsnorm, rmsnorm
+from tony_tpu.ops.xent import chunked_cross_entropy, full_cross_entropy
 
-__all__ = ["flash_attention", "rmsnorm", "add_rmsnorm"]
+__all__ = ["flash_attention", "rmsnorm", "add_rmsnorm",
+           "chunked_cross_entropy", "full_cross_entropy"]

@@ -1,0 +1,93 @@
+"""Chunked-over-vocab softmax cross-entropy.
+
+No reference analog (TonY has no numerics). Motivation: with logits
+[B, L, V] in fp32, a 256k-vocab model at L=8k burns gigabytes of HBM on a
+tensor that exists only to be reduced — on TPU the loss becomes the memory
+peak of the whole step. This op never materializes more than one
+[T, chunk] tile: it streams vocab chunks of the embedding through an
+online logsumexp (the flash-attention trick applied to the classifier),
+with the scan body rematerialized (jax.checkpoint) so the backward pass
+recomputes tiles instead of storing them.
+
+The matmuls are [T, D] x [D, chunk] — large, static-shaped, MXU-friendly;
+chunk defaults to a multiple of 128 lanes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def chunked_cross_entropy(hidden, embedding, labels, *,
+                          chunk_size: int = 8192, z_loss: float = 0.0):
+    """Mean token cross-entropy of ``logits = hidden @ embedding.T`` without
+    materializing the logits.
+
+    Args:
+      hidden: [B, L, D] (or [T, D]) final-layer activations.
+      embedding: [V, D] tied output embedding.
+      labels: [B, L] (or [T]) int targets.
+      chunk_size: vocab tile width (rounded use: keep a multiple of 128).
+      z_loss: optional logsumexp^2 regularizer weight (PaLM-style), keeps
+        logits from drifting — free here since lse is already computed.
+
+    Returns mean loss (fp32 scalar).
+    """
+    if hidden.ndim == 3:
+        t = hidden.shape[0] * hidden.shape[1]
+        hidden = hidden.reshape(t, hidden.shape[2])
+        labels = labels.reshape(t)
+    v, d = embedding.shape
+    chunk = min(chunk_size, v)
+    n_chunks = (v + chunk - 1) // chunk
+    pad = n_chunks * chunk - v
+    emb = jnp.pad(embedding, ((0, pad), (0, 0))) if pad else embedding
+    h32 = hidden.astype(jnp.float32)
+    labels = labels.astype(jnp.int32)
+
+    def body(carry, i):
+        m, s, lab = carry
+        e_chunk = lax.dynamic_slice(emb, (i * chunk, 0), (chunk, d))
+        logits = h32 @ e_chunk.astype(jnp.float32).T  # [T, chunk]
+        pos = i * chunk + jnp.arange(chunk)
+        logits = jnp.where(pos[None, :] < v, logits, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        idx = labels - i * chunk
+        in_chunk = (idx >= 0) & (idx < chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, chunk - 1)[:, None], axis=-1)[:, 0]
+        lab = jnp.where(in_chunk, picked, lab)
+        return (m_new, s, lab), None
+
+    t = h32.shape[0]
+    init = (jnp.full((t,), NEG_INF, jnp.float32),
+            jnp.zeros((t,), jnp.float32),
+            jnp.full((t,), NEG_INF, jnp.float32))
+    # remat: the backward pass recomputes each [T, chunk] tile instead of
+    # keeping n_chunks of them alive — peak memory stays O(T * chunk)
+    (m, s, lab), _ = lax.scan(jax.checkpoint(body), init,
+                              jnp.arange(n_chunks))
+    lse = m + jnp.log(s)
+    loss = jnp.mean(lse - lab)
+    if z_loss:
+        loss = loss + z_loss * jnp.mean(lse * lse)
+    return loss
+
+
+def full_cross_entropy(hidden, embedding, labels):
+    """Reference O(T*V)-memory computation (tests / small vocab)."""
+    if hidden.ndim == 3:
+        t = hidden.shape[0] * hidden.shape[1]
+        hidden = hidden.reshape(t, hidden.shape[2])
+        labels = labels.reshape(t)
+    logits = hidden.astype(jnp.float32) @ embedding.astype(jnp.float32).T
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    lab = jnp.take_along_axis(logits, labels[:, None].astype(jnp.int32),
+                              axis=-1)[:, 0]
+    return jnp.mean(lse - lab)
