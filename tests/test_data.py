@@ -36,6 +36,13 @@ def test_array_source_validates_dims():
         ArraySource({"a": np.zeros(3), "b": np.zeros(4)})
 
 
+def test_loader_rejects_dataset_smaller_than_batch():
+    """steps_per_epoch == 0 must raise, not hang the gang's collective."""
+    src = ArraySource({"x": np.arange(3, dtype=np.float32)})
+    with pytest.raises(ValueError, match="dataset too small"):
+        DataLoader(src, global_batch_size=8, process_index=0, process_count=2)
+
+
 def test_per_process_sharding_disjoint_and_complete():
     """Across processes: same permutation, disjoint strides, full coverage."""
     src = ArraySource({"x": np.arange(16, dtype=np.int64)})

@@ -53,6 +53,19 @@ def test_mnist_pytorch_example(cluster):
     assert client.final_status["status"] == "SUCCEEDED", client.final_status
 
 
+def test_lm_pretrain_example(cluster):
+    """Full-stack flagship: loader + GQA/chunked-CE + fit with checkpoints,
+    2-worker gang."""
+    conf = example_conf(
+        cluster, "lm-pretrain",
+        # batch divisible by the gang's global device count (2 procs x 8
+        # forced host devices in the test env = 16)
+        **{"tony.application.task-params":
+           "--steps 6 --global-batch 16 --seq-len 32 --vocab 64"})
+    client = cluster.submit(conf)
+    assert client.final_status["status"] == "SUCCEEDED", client.final_status
+
+
 def test_ray_example(cluster):
     client = cluster.submit(example_conf(cluster, "ray-on-tony"))
     assert client.final_status["status"] == "SUCCEEDED", client.final_status
@@ -77,6 +90,9 @@ def test_examples_run_standalone():
         ("ray-on-tony/example.py", []),
         ("mnist-pytorch/mnist_ddp.py", ["--steps", "8", "--batch", "64"]),
         ("mnist-jax/mnist_spmd.py", ["--steps", "8", "--global-batch", "64"]),
+        ("lm-pretrain/pretrain.py", ["--steps", "6", "--global-batch", "8",
+                                     "--seq-len", "32", "--vocab", "64",
+                                     "--moe"]),
     ]:
         proc = subprocess.run(
             [sys.executable, os.path.join(EXAMPLES, rel), *args],

@@ -1,0 +1,117 @@
+"""High-level fit() loop tests: loader integration, checkpoint resume,
+eval, metric sinks."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tony_tpu.data import ArraySource, DataLoader
+from tony_tpu.parallel import data_parallel_mesh
+from tony_tpu.parallel.sharding import batch_sharding
+from tony_tpu.train import JsonlMetricsLogger, Trainer, cross_entropy_loss, fit
+
+
+def _setup(seed=0):
+    mesh = data_parallel_mesh()
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = (x @ w_true)[:, 0] + 0.01 * rng.standard_normal(64).astype(np.float32)
+    src = ArraySource({"x": x, "y": y})
+
+    def apply_fn(p, batch):
+        pred = batch["x"] @ p["w"]
+        return jnp.mean((pred[:, 0] - batch["y"]) ** 2)
+
+    trainer = Trainer(mesh=mesh, apply_fn=apply_fn,
+                      optimizer=optax.adam(0.05), donate=False)
+    params = {"w": jnp.zeros((4, 1), jnp.float32)}
+    loader = lambda epochs: DataLoader(  # noqa: E731
+        src, global_batch_size=16, seed=1, num_epochs=epochs,
+        sharding=batch_sharding(mesh), process_index=0, process_count=1)
+    return trainer, params, loader
+
+
+def test_fit_trains_and_logs(tmp_path):
+    trainer, params, loader = _setup()
+    sink_path = tmp_path / "metrics.jsonl"
+    result = fit(trainer, params, loader(10), log_every=5,
+                 metric_sinks=[JsonlMetricsLogger(str(sink_path))])
+    assert result.steps_run == 40  # 4 batches x 10 epochs
+    assert result.resumed_from is None
+    assert result.history, "log_every should have recorded metrics"
+    assert result.history[-1]["loss"] < result.history[0]["loss"]
+    lines = [json.loads(l) for l in sink_path.read_text().splitlines()]
+    assert lines[0]["step"] == 5 and "loss" in lines[0]
+    assert "steps_per_sec" in lines[0]
+
+
+def test_fit_num_steps_cap():
+    trainer, params, loader = _setup()
+    result = fit(trainer, params, loader(None), num_steps=7, log_every=0)
+    assert result.steps_run == 7
+    assert int(result.state.step) == 7
+
+
+def test_fit_checkpoint_resume(tmp_path):
+    ckpt = str(tmp_path / "ckpts")
+    trainer, params, loader = _setup()
+    first = fit(trainer, params, loader(None), num_steps=6,
+                checkpoint_dir=ckpt, checkpoint_every=4, log_every=0)
+    assert first.steps_run == 6
+
+    # second run resumes at 6 and trains 4 more
+    second = fit(trainer, params, loader(None), num_steps=4,
+                 checkpoint_dir=ckpt, log_every=0)
+    assert second.resumed_from == 6
+    assert second.steps_run == 4
+    assert int(second.state.step) == 10
+    # restored params actually carried over (loss keeps improving, not reset)
+    w2 = np.asarray(second.state.params["w"])
+    assert not np.allclose(w2, 0.0)
+
+
+def test_fit_total_steps_resume_completes_budget(tmp_path):
+    """total_steps is absolute: a resumed attempt trains only the remainder
+    (the retry-resume contract), and the data order fast-forwards via
+    DataLoader.from_step instead of replaying consumed batches."""
+    ckpt = str(tmp_path / "ckpts")
+    trainer, params, loader = _setup()
+    first = fit(trainer, params, loader(None), total_steps=6,
+                checkpoint_dir=ckpt, log_every=0)
+    assert first.steps_run == 6
+    second = fit(trainer, params, loader(None), total_steps=10,
+                 checkpoint_dir=ckpt, log_every=0)
+    assert second.resumed_from == 6
+    assert second.steps_run == 4  # completes the budget, not 10 more
+    third = fit(trainer, params, loader(None), total_steps=10,
+                checkpoint_dir=ckpt, log_every=0)
+    assert third.steps_run == 0  # budget already met
+
+
+def test_loader_from_step_matches_continuous_run():
+    src = ArraySource({"x": np.arange(32, dtype=np.float32),
+                       "y": np.arange(32, dtype=np.float32)})
+    mk = lambda: DataLoader(  # noqa: E731
+        src, global_batch_size=8, seed=9, num_epochs=2,
+        process_index=0, process_count=1, prefetch=0)
+    full = [b["x"].tolist() for b in mk()]
+    tail = [b["x"].tolist() for b in mk().from_step(5)]
+    assert tail == full[5:]  # epoch boundary (4/epoch) crossed correctly
+
+
+def test_fit_eval_loop():
+    trainer, params, loader = _setup()
+
+    def eval_fn(p, batch):
+        pred = batch["x"] @ p["w"]
+        return jnp.mean((pred[:, 0] - batch["y"]) ** 2)
+
+    result = fit(trainer, params, loader(4), log_every=0,
+                 eval_data=list(loader(1)), eval_fn=eval_fn, eval_every=8)
+    evals = [h for h in result.history if "eval/loss" in h]
+    assert len(evals) == 2  # 16 steps / eval_every=8
+    assert evals[-1]["eval/loss"] < evals[0]["eval/loss"]

@@ -115,9 +115,14 @@ def test_transformer_moe_blocks():
     assert "mlp" in params["params"]["block_0"]  # 1st stays dense
     wi = params["params"]["block_1"]["moe"]["wi"]
     assert wi.shape == (4, cfg.d_model, cfg.d_ff)
+    # init must NOT leak a "losses" collection (it would be trained as a
+    # free parameter and double-counted when apply seeds the collection)
+    assert set(params) == {"params"}
     logits, mut = model.apply(params, tokens, mutable=["losses"])
     assert logits.shape == (2, 16, 64)
     assert jnp.all(jnp.isfinite(logits))
+    aux_leaves = jax.tree_util.tree_leaves(mut["losses"])
+    assert len(aux_leaves) == 1  # exactly one sown value for the one MoE block
     aux = moe_aux_loss(mut["losses"])
     assert float(aux) > 0.0
     # plain apply (no mutable) still works — sow no-ops

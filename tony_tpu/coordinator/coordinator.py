@@ -611,6 +611,22 @@ class Coordinator:
 
     def application_status(self) -> dict:
         status = self.session.status
+        # Ref semantics: the client polls the *application* report, which
+        # stays RUNNING across AM retries (YARN only finalizes at app end).
+        # Without this, the client's poll can observe the transient FAILED
+        # between a crashed attempt and _reset_session() and signal finish,
+        # suppressing the retry (race window is up to one monitor interval).
+        retries = self.conf.get_int("tony.coordinator.retry-count", 0)
+        if status == SessionStatus.FAILED and self.attempt < retries \
+                and not self.killed.is_set():
+            return {
+                "status": SessionStatus.RUNNING.value,
+                "reason": f"attempt {self.attempt} failed "
+                          f"({self.session.failure_reason}); retrying",
+                "session_id": self.session.session_id,
+                "attempt": self.attempt,
+                "tensorboard_url": self.tensorboard_url,
+            }
         return {
             "status": status.value,
             "reason": self.session.failure_reason,

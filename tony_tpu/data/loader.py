@@ -70,6 +70,14 @@ class DataLoader:
                 f"global_batch_size={global_batch_size} not divisible by "
                 f"process_count={self.process_count}")
         self.local_batch_size = global_batch_size // self.process_count
+        if drop_remainder and \
+                len(source) // self.process_count < self.local_batch_size:
+            # would loop forever yielding nothing (steps_per_epoch == 0) —
+            # fail loudly instead of hanging the gang's first collective
+            raise ValueError(
+                f"dataset too small: {len(source)} examples over "
+                f"{self.process_count} processes yields less than one "
+                f"local batch of {self.local_batch_size}")
         self.sharding = sharding
         self.prefetch = prefetch
 
@@ -83,11 +91,13 @@ class DataLoader:
             order = np.arange(n)
         return order[self.process_index::self.process_count]
 
-    def _host_batches(self) -> Iterator[Mapping[str, np.ndarray]]:
-        epoch = 0
+    def _host_batches(self, start_batch: int = 0) \
+            -> Iterator[Mapping[str, np.ndarray]]:
+        lb = self.local_batch_size
+        spe = self.steps_per_epoch()
+        epoch, skip = (divmod(start_batch, spe) if spe else (0, 0))
         while self.num_epochs is None or epoch < self.num_epochs:
             mine = self._epoch_indices(epoch)
-            lb = self.local_batch_size
             if self.drop_remainder:
                 # every process must yield the SAME batch count: the global
                 # batch is assembled collectively (and the following pjit
@@ -97,15 +107,26 @@ class DataLoader:
                 stop = (len(self.source) // self.process_count) // lb * lb
             else:
                 stop = len(mine)
-            for start in range(0, stop, lb):
+            for start in range(skip * lb, stop, lb):
                 rows = [self.source[int(i)] for i in mine[start:start + lb]]
                 yield {k: np.stack([r[k] for r in rows]) for k in rows[0]}
+            skip = 0
             epoch += 1
 
     # -- public iterator -----------------------------------------------------
 
     def __iter__(self):
-        it = self._host_batches()
+        return self.from_step(0)
+
+    def from_step(self, step: int):
+        """Iterator starting at global batch index `step` — the data-order
+        half of checkpoint resume: skipping is index arithmetic (the seeded
+        per-epoch permutation is recomputed), no examples are read. Every
+        process must pass the same step. Requires drop_remainder."""
+        if step and not self.drop_remainder:
+            raise ValueError("from_step needs drop_remainder=True "
+                             "(stable steps_per_epoch)")
+        it = self._host_batches(start_batch=step)
         if self.sharding is not None:
             it = (self._to_global(b) for b in it)
         if self.prefetch > 0:

@@ -19,7 +19,6 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from flax.linen import partitioning as nn_partitioning
 
 from tony_tpu.parallel.moe import moe_logical_axes
 from tony_tpu.parallel.ring_attention import (
@@ -27,8 +26,6 @@ from tony_tpu.parallel.ring_attention import (
     reference_attention,
     ring_attention,
 )
-
-param_with_axes = nn_partitioning.param_with_axes
 
 
 @dataclass(frozen=True)
@@ -261,7 +258,12 @@ class MoEMLP(nn.Module):
                 "wi": params["wi"].astype(cfg.dtype),
                 "wo": params["wo"].astype(cfg.dtype)}
         out, aux = moe_layer(cast, x, moe_cfg)
-        self.sow("losses", "moe_aux", aux.astype(jnp.float32))
+        if not self.is_initializing():
+            # sowing during init would put a "losses" collection into the
+            # init() output, which callers then pass around as if it were
+            # params (and would double-count: apply(mutable=["losses"])
+            # seeds the collection from the input before sow appends)
+            self.sow("losses", "moe_aux", aux.astype(jnp.float32))
         return out.astype(cfg.dtype)
 
 

@@ -5,6 +5,7 @@ from tony_tpu.train.checkpoint import (
     restore_or_init,
     scan_latest_step,
 )
+from tony_tpu.train.loop import FitResult, JsonlMetricsLogger, fit
 from tony_tpu.train.trainer import (
     Trainer,
     TrainState,
@@ -15,6 +16,9 @@ from tony_tpu.train.trainer import (
 __all__ = [
     "CheckpointManager",
     "auto_resume",
+    "fit",
+    "FitResult",
+    "JsonlMetricsLogger",
     "job_checkpoint_dir",
     "scan_latest_step",
     "Trainer",

@@ -78,10 +78,9 @@ class Trainer:
             opt_state=o_sh,
         )
 
-    def build_step(self, state: TrainState):
-        """Returns (step_fn, placed_state). step_fn(state, batch) ->
-        (state, metrics)."""
-        shardings = self.state_shardings(state)
+    def compile_step(self, shardings):
+        """The jitted step for a given TrainState sharding tree (shardings
+        may come from a real or an abstract — jax.eval_shape — state)."""
         b_sh = batch_sharding(self.mesh)
 
         def step_fn(state: TrainState, batch):
@@ -94,17 +93,21 @@ class Trainer:
                                    opt_state=opt_state)
             return new_state, {"loss": loss, "grad_norm": gnorm}
 
-        placed = jax.device_put(state, shardings)
         metric_sh = {"loss": NamedSharding(self.mesh, P()),
                      "grad_norm": NamedSharding(self.mesh, P())}
         # b_sh is a pytree prefix: one sharding broadcast over the batch tree
-        jit_step = jax.jit(
+        return jax.jit(
             step_fn,
             in_shardings=(shardings, b_sh),
             out_shardings=(shardings, metric_sh),
             donate_argnums=(0,) if self.donate else (),
         )
-        return jit_step, placed
+
+    def build_step(self, state: TrainState):
+        """Returns (step_fn, placed_state). step_fn(state, batch) ->
+        (state, metrics)."""
+        shardings = self.state_shardings(state)
+        return self.compile_step(shardings), jax.device_put(state, shardings)
 
 
 def build_train_step(mesh: Mesh, apply_fn, optimizer, params, fsdp=False):
