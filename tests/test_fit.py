@@ -92,6 +92,22 @@ def test_fit_total_steps_resume_completes_budget(tmp_path):
     assert third.steps_run == 0  # budget already met
 
 
+def test_fit_closes_prefetch_thread_on_early_exit():
+    """Exiting at the step target on an infinite prefetching loader must
+    stop the prefetch worker (no leaked thread / pinned staged batches)."""
+    import threading
+    import time
+
+    trainer, params, loader = _setup()
+    before = threading.active_count()
+    result = fit(trainer, params, loader(None), num_steps=3, log_every=0)
+    assert result.steps_run == 3
+    deadline = time.monotonic() + 5
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+
 def test_loader_from_step_matches_continuous_run():
     src = ArraySource({"x": np.arange(32, dtype=np.float32),
                        "y": np.arange(32, dtype=np.float32)})

@@ -64,6 +64,54 @@ def test_add_rmsnorm():
                                rtol=1e-5)
 
 
+def test_flash_attention_gqa_matches_repeated_reference():
+    """Grouped K/V (2 kv heads, 4 q heads) must equal reference attention
+    over explicitly repeated K/V — forward and grads."""
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(8), 3)
+    q = jax.random.normal(k1, (2, 32, 4, 16))
+    k = jax.random.normal(k2, (2, 32, 2, 16))
+    v = jax.random.normal(k3, (2, 32, 2, 16))
+    kf = jnp.repeat(k, 2, axis=2)
+    vf = jnp.repeat(v, 2, axis=2)
+    out = flash_attention(q, k, v, True, 16, 16)
+    ref = reference_attention(q, kf, vf, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+    def loss_gqa(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, 16, 16) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(
+            q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2),
+            causal=True) ** 2)
+
+    g_gqa = jax.grad(loss_gqa, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_gqa, g_ref):
+        assert a.shape == b.shape  # dk/dv stay at kv-head width
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-3)
+
+
+def test_transformer_pallas_gqa_backend():
+    """The pallas backend consumes grouped K/V directly (no repeat) and
+    agrees with the reference backend."""
+    from tony_tpu.models import Transformer, TransformerConfig
+
+    mk = lambda backend: TransformerConfig(  # noqa: E731
+        vocab_size=64, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32,
+        attention_backend=backend, attention_block_size=16)
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (2, 32), 0, 64)
+    model_ref = Transformer(mk("reference"))
+    params = model_ref.init(jax.random.PRNGKey(0), tokens)
+    out_ref = model_ref.apply(params, tokens)
+    out_pl = Transformer(mk("pallas")).apply(params, tokens)
+    np.testing.assert_allclose(np.asarray(out_pl), np.asarray(out_ref),
+                               atol=1e-3, rtol=1e-3)
+
+
 def test_chunked_xent_matches_full():
     from tony_tpu.ops import chunked_cross_entropy, full_cross_entropy
 

@@ -150,27 +150,52 @@ class DataLoader:
 
 
 def _prefetch_iter(it: Iterator, size: int) -> Iterator:
-    """Stage up to `size` items from a daemon thread."""
+    """Stage up to `size` items from a daemon thread.
+
+    Closeable: generator .close() (or abandonment + GC) signals the worker
+    to stop, so a consumer that exits early (e.g. fit() hitting its step
+    target on an infinite loader) does not leak a blocked thread pinning
+    `size` staged device batches for the life of the process.
+    """
     q: queue.Queue = queue.Queue(maxsize=size)
+    stop = threading.Event()
     err: list[BaseException] = []
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for item in it:
-                q.put(item)
+                if not put(item):
+                    return
         except BaseException as e:  # surfaced on the consumer side
             err.append(e)
         finally:
-            q.put(_STOP)
+            put(_STOP)
 
     threading.Thread(target=worker, daemon=True).start()
-    while True:
-        item = q.get()
-        if item is _STOP:
-            if err:
-                raise err[0]
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _STOP:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()
+        while True:  # unblock a producer stuck on a full queue
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
 
 
 def device_prefetch(iterator: Iterator, sharding: Any, size: int = 2):

@@ -135,11 +135,14 @@ class Attention(nn.Module):
             positions = jnp.arange(l)
             q = rotary_embedding(q, positions)
             k = rotary_embedding(k, positions)
-            if cfg.kv_heads != cfg.n_heads:
+            if cfg.kv_heads != cfg.n_heads and \
+                    cfg.attention_backend != "pallas":
                 # GQA: broadcast K/V head groups up to n_heads for the
                 # backend. XLA fuses the repeat into the score einsum, so
                 # nothing is materialized; the HBM win (small KV) is kept
-                # where it matters — the decode cache below.
+                # where it matters — the decode cache below. The pallas
+                # kernel takes grouped K/V natively (its kv BlockSpec
+                # indexes the group row per q head), so it skips this.
                 group = cfg.n_heads // cfg.kv_heads
                 k = jnp.repeat(k, group, axis=2)
                 v = jnp.repeat(v, group, axis=2)

@@ -80,15 +80,25 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
                    interpret: bool):
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    kvh = k.shape[2]
     if lq % block_q or lk % block_k:
         raise ValueError(
             f"seq lens ({lq},{lk}) must divide block sizes ({block_q},{block_k})")
+    if h % kvh:
+        raise ValueError(f"q heads {h} not divisible by kv heads {kvh}")
+    group = h // kvh
     scale = d ** -0.5
     # [B, L, H, D] -> [B*H, L, D]
     qr = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
-    kr = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
-    vr = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * kvh, lk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * kvh, lk, d)
     grid = (b * h, lq // block_q, lk // block_k)
+
+    def kv_index(bh, qi, ki):
+        # GQA: q head -> its kv group's row; the same kv block is DMA'd for
+        # each of the `group` q heads instead of materializing a repeat
+        return (bh // h) * kvh + (bh % h) // group, ki, 0
+
     kernel = functools.partial(_flash_kernel, causal=causal, block_q=block_q,
                                block_k=block_k, scale=scale)
     out = pl.pallas_call(
@@ -97,8 +107,8 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
         scratch_shapes=[
@@ -139,7 +149,9 @@ def _on_tpu() -> bool:
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
                     block_k: int = 128, interpret: bool | None = None):
-    """Fused attention. q/k/v: [B, L, H, D] -> [B, L, H, D].
+    """Fused attention. q: [B, L, H, D]; k/v: [B, L, KVH, D] with
+    H % KVH == 0 (GQA: the kernel indexes each q head's kv group directly —
+    no repeated K/V is ever materialized). Returns [B, L, H, D].
 
     interpret=None auto-selects: compiled on TPU, interpreter elsewhere.
     """
@@ -158,12 +170,22 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 
 def _bwd(causal, block_q, block_k, interpret, res, g):
     """Remat backward through the blockwise implementation — O(L) memory,
-    numerically identical attention math."""
+    numerically identical attention math. For GQA the recompute broadcasts
+    K/V to H heads and group-sums the grads back to KVH."""
     q, k, v = res
+    b, lk, kvh, d = k.shape
+    h = q.shape[2]
+    group = h // kvh
+    kf = jnp.repeat(k, group, axis=2) if group > 1 else k
+    vf = jnp.repeat(v, group, axis=2) if group > 1 else v
     _, vjp = jax.vjp(
         lambda q, k, v: blockwise_attention(q, k, v, block_size=block_k,
-                                            causal=causal), q, k, v)
-    return vjp(g)
+                                            causal=causal), q, kf, vf)
+    dq, dkf, dvf = vjp(g)
+    if group > 1:
+        dkf = dkf.reshape(b, lk, kvh, group, d).sum(axis=3)
+        dvf = dvf.reshape(b, lk, kvh, group, d).sum(axis=3)
+    return dq, dkf, dvf
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -9,6 +9,8 @@ from tony_tpu.parallel.mesh import (
     MeshSpec,
     data_parallel_mesh,
     make_mesh,
+    multislice_mesh,
+    num_slices,
 )
 from tony_tpu.parallel.ring_attention import (
     blockwise_attention,
@@ -38,6 +40,7 @@ __all__ = [
     "MeshSpec", "MoEConfig", "RULES",
     "batch_sharding", "blockwise_attention", "data_parallel_mesh",
     "init_moe_params", "make_mesh", "moe_layer", "moe_logical_axes",
+    "multislice_mesh", "num_slices",
     "pipeline_apply", "reference_attention", "replicated", "ring_attention",
     "shard_params_by_size", "spec_for", "stack_stage_params",
     "top_k_gating", "tree_shardings", "ulysses_attention",

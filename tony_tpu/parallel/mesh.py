@@ -91,3 +91,49 @@ def data_parallel_mesh(devices=None) -> Mesh:
 
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape.get(axis, 1)
+
+
+# ---------------------------------------------------------------------------
+# Multi-slice (DCN x ICI) meshes. A multi-slice TPU job has fast ICI only
+# *within* each slice; slices talk over DCN. The standard recipe (scaling
+# book; reference analog is TonY's multi-cluster spec construction,
+# SURVEY.md section 7.9c) is: put pure data parallelism on the DCN axis,
+# keep model axes (fsdp/tensor/seq/expert) inside a slice on ICI.
+# ---------------------------------------------------------------------------
+
+
+def num_slices(devices=None) -> int:
+    """Number of TPU slices in this job (1 on single-slice / CPU)."""
+    devices = list(devices if devices is not None else jax.devices())
+    ids = {getattr(d, "slice_index", 0) for d in devices}
+    return max(len(ids), 1)
+
+
+def multislice_mesh(spec: MeshSpec | None = None, *, devices=None,
+                    dcn_axis: str = DATA) -> Mesh:
+    """Mesh whose ``dcn_axis`` additionally spans slices while every other
+    axis stays within-slice (ICI). ``spec`` is resolved against the
+    per-slice device count (a wildcard absorbs the per-slice remainder),
+    then the ``dcn_axis`` is multiplied by the slice count — e.g. 2 slices
+    of 16 chips with MeshSpec(data=-1, tensor=4) gives data=8 (4 per slice
+    x 2 slices over DCN) x tensor=4 (ICI).
+
+    Single-slice (or CPU test) degenerates to ``make_mesh`` — the same
+    code runs everywhere.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n_slices = num_slices(devices)
+    spec = spec or MeshSpec()
+    if n_slices == 1:
+        return make_mesh(spec, devices=devices)
+    from jax.experimental import mesh_utils
+
+    per_slice = len(devices) // n_slices
+    ici_sizes = spec.resolve(per_slice)
+    dcn_sizes = {a: (n_slices if a == dcn_axis else 1) for a in ALL_AXES}
+    arr = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=[ici_sizes[a] for a in ALL_AXES],
+        dcn_mesh_shape=[dcn_sizes[a] for a in ALL_AXES],
+        devices=devices,
+    )
+    return Mesh(arr, ALL_AXES)

@@ -131,38 +131,47 @@ def fit(trainer: Trainer, params: Any, train_data: Iterable, *,
         for sink in sinks:
             sink(step, metrics)
 
-    if resumed_from and hasattr(train_data, "from_step"):
-        # resume the data order too: skip the batches already consumed
-        data_iter = train_data.from_step(start_step)
-    else:
-        if resumed_from:
-            log.warning(
-                "fit: resumed model state at step %d but train_data has no "
-                "from_step — the iterator restarts from its beginning, "
-                "replaying already-seen batches", resumed_from)
-        data_iter = iter(train_data)
+    data_iter = None
+    if target is None or start_step < target:  # budget not already met
+        if resumed_from and hasattr(train_data, "from_step"):
+            # resume the data order too: skip the batches already consumed
+            data_iter = train_data.from_step(start_step)
+        else:
+            if resumed_from:
+                log.warning(
+                    "fit: resumed model state at step %d but train_data has "
+                    "no from_step — the iterator restarts from its "
+                    "beginning, replaying already-seen batches", resumed_from)
+            data_iter = iter(train_data)
 
-    while target is None or start_step + steps_run < target:
-        try:
-            batch = next(data_iter)
-        except StopIteration:
-            break
-        placed, last_metrics = step_fn(placed, batch)
-        steps_run += 1
-        step = start_step + steps_run
-        if log_every and steps_run % log_every == 0:
-            fetched = {k: float(v) for k, v in last_metrics.items()}
-            rate = steps_run / (time.monotonic() - t0)
-            log.info("step %d: %s (%.2f steps/s)", step,
-                     {k: round(v, 4) for k, v in fetched.items()}, rate)
-            emit(step, {**fetched, "steps_per_sec": rate})
-        if manager and checkpoint_every and steps_run % checkpoint_every == 0:
-            manager.save(step, placed)
-        if eval_step and eval_data is not None and eval_every and \
-                steps_run % eval_every == 0:
-            ev = _run_eval(eval_step, placed.params, eval_data)
-            if ev:
-                emit(step, ev)
+    try:
+        while data_iter is not None and \
+                (target is None or start_step + steps_run < target):
+            try:
+                batch = next(data_iter)
+            except StopIteration:
+                break
+            placed, last_metrics = step_fn(placed, batch)
+            steps_run += 1
+            step = start_step + steps_run
+            if log_every and steps_run % log_every == 0:
+                fetched = {k: float(v) for k, v in last_metrics.items()}
+                rate = steps_run / (time.monotonic() - t0)
+                log.info("step %d: %s (%.2f steps/s)", step,
+                         {k: round(v, 4) for k, v in fetched.items()}, rate)
+                emit(step, {**fetched, "steps_per_sec": rate})
+            if manager and checkpoint_every and \
+                    steps_run % checkpoint_every == 0:
+                manager.save(step, placed)
+            if eval_step and eval_data is not None and eval_every and \
+                    steps_run % eval_every == 0:
+                ev = _run_eval(eval_step, placed.params, eval_data)
+                if ev:
+                    emit(step, ev)
+    finally:
+        # release the loader's prefetch thread + staged device batches
+        if data_iter is not None and hasattr(data_iter, "close"):
+            data_iter.close()
 
     if manager:
         final = start_step + steps_run
