@@ -7,6 +7,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from tony_tpu.data import ArraySource, DataLoader
 from tony_tpu.parallel import data_parallel_mesh
@@ -117,6 +118,43 @@ def test_loader_from_step_matches_continuous_run():
     full = [b["x"].tolist() for b in mk()]
     tail = [b["x"].tolist() for b in mk().from_step(5)]
     assert tail == full[5:]  # epoch boundary (4/epoch) crossed correctly
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=4 must produce the same update as one full-batch step
+    (mean-of-microbatch grads == full-batch grad for mean losses)."""
+    mesh = data_parallel_mesh()
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = rng.standard_normal(16).astype(np.float32)
+    batch = {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+
+    def apply_fn(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"][:, None]) ** 2)
+
+    params = {"w": jnp.ones((4, 1), jnp.float32)}
+    outs = {}
+    for accum in (1, 4):
+        trainer = Trainer(mesh=mesh, apply_fn=apply_fn,
+                          optimizer=optax.sgd(0.1), donate=False,
+                          accum_steps=accum)
+        step_fn, placed = trainer.build_step(trainer.init_state(params))
+        placed, metrics = step_fn(placed, batch)
+        outs[accum] = (np.asarray(placed.params["w"]),
+                       float(metrics["loss"]))
+    np.testing.assert_allclose(outs[1][0], outs[4][0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs[1][1], outs[4][1], rtol=1e-5)
+
+
+def test_grad_accumulation_rejects_indivisible():
+    mesh = data_parallel_mesh()
+    trainer = Trainer(mesh=mesh,
+                      apply_fn=lambda p, b: jnp.sum(p["w"] * b["x"]),
+                      optimizer=optax.sgd(0.1), donate=False, accum_steps=3)
+    step_fn, placed = trainer.build_step(
+        trainer.init_state({"w": jnp.ones((2,))}))
+    with pytest.raises(ValueError, match="not divisible"):
+        step_fn(placed, {"x": jnp.ones((8, 2))})
 
 
 def test_fit_eval_loop():

@@ -48,6 +48,12 @@ class TransformerConfig:
     moe_num_experts: int = 8
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # scan the layer stack with nn.scan: one traced/compiled block instead
+    # of n_layers copies — XLA compile time and HBM for code stay O(1) in
+    # depth (the standard TPU deep-stack idiom). Params gain a leading
+    # stacked "layers" dim (shardable over the pipe axis). Uniform layers
+    # only (incompatible with moe_every, which alternates block types).
+    scan_layers: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -284,8 +290,35 @@ class Block(nn.Module):
         return x
 
 
+class _ScanBody(nn.Module):
+    """Block adapted to nn.scan's (carry, out) body signature."""
+
+    cfg: TransformerConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x, _):
+        return Block(self.cfg, name="block")(x, self.decode), None
+
+
 class Transformer(nn.Module):
     cfg: TransformerConfig
+
+    def _scan_blocks(self, x, decode: bool):
+        cfg = self.cfg
+        body = _ScanBody
+        if cfg.remat and not decode:
+            body = nn.remat(
+                _ScanBody, policy=jax.checkpoint_policies.nothing_saveable)
+        scanned = nn.scan(
+            body,
+            variable_axes={"params": 0, "cache": 0},
+            split_rngs={"params": True},
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        x, _ = scanned(cfg, decode, name="layers")(x, None)
+        return x
 
     @nn.compact
     def __call__(self, tokens, decode: bool = False,
@@ -298,12 +331,18 @@ class Transformer(nn.Module):
         embed = self.param("embedding", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.d_model), jnp.float32)
         x = embed[tokens].astype(cfg.dtype)
-        block = Block
-        if cfg.remat and not decode:
-            block = nn.remat(Block, static_argnums=(2,))
-        for i in range(cfg.n_layers):
-            use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
-            x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x, decode)
+        if cfg.scan_layers:
+            if cfg.moe_every:
+                raise ValueError("scan_layers needs uniform layers "
+                                 "(moe_every alternates block types)")
+            x = self._scan_blocks(x, decode)
+        else:
+            block = Block
+            if cfg.remat and not decode:
+                block = nn.remat(Block, static_argnums=(2,))
+            for i in range(cfg.n_layers):
+                use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
+                x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x, decode)
         x = RMSNorm(cfg.dtype, name="ln_f")(x)
         if return_hidden:
             return x.astype(jnp.float32)
@@ -318,39 +357,49 @@ def logical_axis_rules_tree(params: Any) -> Any:
     # its sibling q kernel and must get the always-replicated "kv_heads"
     # axis (splitting n_kv_heads over a larger tensor axis would fail);
     # full-MHA K/V keeps "heads" and stays tensor-shardable.
+    def is_stacked(joined: str) -> bool:
+        # scan_layers params live under ".../layers/block/..." with a
+        # leading stacked dim (one slice per layer)
+        return "/layers/" in joined
+
     head_counts: dict[str, int] = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
         joined = "/" + "/".join(getattr(p, "key", str(p)) for p in path)
-        if "/q/" in joined and getattr(leaf, "ndim", 0) == 3:
-            head_counts[joined.rsplit("/q/", 1)[0]] = leaf.shape[1]
+        off = 1 if is_stacked(joined) else 0
+        if "/q/" in joined and getattr(leaf, "ndim", 0) == 3 + off:
+            head_counts[joined.rsplit("/q/", 1)[0]] = leaf.shape[1 + off]
 
     def axes_for(path: tuple, x) -> tuple:
-        leaf_dims = x.ndim
         joined = "/" + "/".join(getattr(p, "key", str(p)) for p in path)
+        off = 1 if is_stacked(joined) else 0
+        leaf_dims = x.ndim - off
+        base: tuple
         if "embedding" in joined:
-            return ("vocab", "embed")
-        if "/q/" in joined:
-            return ("embed", "heads", "kv")[:leaf_dims]
-        for s in ("/k/", "/v/"):
-            if s in joined:
-                parent = joined.rsplit(s, 1)[0]
-                grouped = (leaf_dims == 3
-                           and x.shape[1] != head_counts.get(parent, x.shape[1]))
-                return ("embed", "kv_heads" if grouped else "heads",
-                        "kv")[:leaf_dims]
-        if "/o/" in joined or joined.endswith("o/kernel"):
-            return ("heads", "kv", "embed")[:leaf_dims]
-        if "router" in joined:
-            return (None, None)
+            base = ("vocab", "embed")
+        elif "/q/" in joined:
+            base = ("embed", "heads", "kv")[:leaf_dims]
+        elif any(s in joined for s in ("/k/", "/v/")):
+            s = "/k/" if "/k/" in joined else "/v/"
+            parent = joined.rsplit(s, 1)[0]
+            grouped = (leaf_dims == 3 and x.shape[1 + off] !=
+                       head_counts.get(parent, x.shape[1 + off]))
+            base = ("embed", "kv_heads" if grouped else "heads",
+                    "kv")[:leaf_dims]
+        elif "/o/" in joined or joined.endswith("o/kernel"):
+            base = ("heads", "kv", "embed")[:leaf_dims]
+        elif "router" in joined:
+            base = (None, None)
         # MoE expert weights: must match parallel.moe.moe_logical_axes()
         # (single source of truth for 3-dim expert params)
-        if "wi" in joined:
-            return moe_logical_axes()["wi"] if leaf_dims == 3 \
+        elif "wi" in joined:
+            base = moe_logical_axes()["wi"] if leaf_dims == 3 \
                 else ("embed", "mlp")
-        if "wo" in joined:
-            return moe_logical_axes()["wo"] if leaf_dims == 3 \
+        elif "wo" in joined:
+            base = moe_logical_axes()["wo"] if leaf_dims == 3 \
                 else ("mlp", "embed")
-        return tuple([None] * leaf_dims)
+        else:
+            base = tuple([None] * leaf_dims)
+        return ("layers",) + tuple(base) if off else tuple(base)
 
     return jax.tree_util.tree_map_with_path(axes_for, params)
 

@@ -56,6 +56,11 @@ class Trainer:
     optimizer: optax.GradientTransformation
     fsdp: bool = False
     donate: bool = True
+    # gradient accumulation: the incoming batch's leading dim is split into
+    # `accum_steps` microbatches scanned inside the jitted step (grads
+    # averaged, ONE optimizer update) — the way to train at a global batch
+    # whose activations don't fit HBM without changing the data pipeline
+    accum_steps: int = 1
 
     def init_state(self, params) -> TrainState:
         return TrainState(
@@ -82,9 +87,45 @@ class Trainer:
         """The jitted step for a given TrainState sharding tree (shardings
         may come from a real or an abstract — jax.eval_shape — state)."""
         b_sh = batch_sharding(self.mesh)
+        accum = max(self.accum_steps, 1)
+
+        def grads_of(params, batch):
+            if accum == 1:
+                return jax.value_and_grad(self.apply_fn)(params, batch)
+
+            def micro(x):
+                b = x.shape[0]
+                if b % accum:
+                    raise ValueError(
+                        f"batch dim {b} not divisible by accum_steps {accum}")
+                # strided split: row i -> microbatch i % accum, so each
+                # device contributes an equal local slice to EVERY
+                # microbatch and the sharding constraint is a local
+                # relayout, not a cross-device reshard (a contiguous split
+                # would move ~(accum-1)/accum of the batch over the
+                # interconnect each step; row assignment is arbitrary
+                # since grads are averaged over all microbatches)
+                x = x.reshape(b // accum, accum, *x.shape[1:]).swapaxes(0, 1)
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(self.mesh, P(None, *b_sh.spec)))
+
+            micros = jax.tree.map(micro, batch)
+
+            def body(carry, mb):
+                loss_sum, grad_sum = carry
+                loss, grads = jax.value_and_grad(self.apply_fn)(params, mb)
+                return (loss_sum + loss,
+                        jax.tree.map(jnp.add, grad_sum, grads)), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zeros), micros)
+            scale = 1.0 / accum
+            return loss_sum * scale, jax.tree.map(
+                lambda g: g * scale, grad_sum)
 
         def step_fn(state: TrainState, batch):
-            loss, grads = jax.value_and_grad(self.apply_fn)(state.params, batch)
+            loss, grads = grads_of(state.params, batch)
             updates, opt_state = self.optimizer.update(
                 grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
