@@ -113,3 +113,60 @@ def test_purger(tmp_path):
     purged = purge_old_history(root, retention_sec=-10)
     assert len(purged) == 1
     assert history.list_jobs(root) == []
+
+
+def test_portal_pages_and_api(tmp_path):
+    """Boot the portal on a seeded history dir and fetch every page + its
+    JSON twin (ref: tony-portal Play functional tests over example data),
+    including the beyond-reference training-metrics page."""
+    import json as _json
+    import os
+    import urllib.error
+    import urllib.request
+
+    from tony_tpu.portal.app import Portal
+
+    root = str(tmp_path)
+    h = EventHandler(root, "application_p1")
+    h.start()
+    h.emit(task_started("worker", 0, "host1"))
+    # seed a config + archived training metrics like the coordinator does
+    with open(os.path.join(h.job_dir, "tony-final.json"), "w") as f:
+        _json.dump({"tony.application.name": "ptest"}, f)
+    os.makedirs(os.path.join(h.job_dir, "metrics"), exist_ok=True)
+    with open(os.path.join(h.job_dir, "metrics", "train.jsonl"), "w") as f:
+        f.write('{"step": 5, "loss": 1.5}\n{"step": 10, "loss": 0.7}\n')
+    h.stop("SUCCEEDED")
+
+    portal = Portal(root, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{portal.port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return r.status, r.read().decode()
+
+        status, body = get("/")
+        assert status == 200 and "application_p1" in body
+        assert "/job/application_p1/metrics" in body  # index links metrics
+        status, body = get("/api/")
+        assert _json.loads(body)[0]["app_id"] == "application_p1"
+        status, body = get("/job/application_p1/config")
+        assert status == 200 and "ptest" in body
+        status, body = get("/api/job/application_p1/events")
+        events = _json.loads(body)
+        assert any(e["type"] == "TASK_STARTED" for e in events)
+        status, body = get("/job/application_p1/logs")
+        assert status == 200
+        status, body = get("/job/application_p1/metrics")
+        assert status == 200 and "loss" in body
+        status, body = get("/api/job/application_p1/metrics")
+        series = _json.loads(body)
+        assert series["train"][1] == {"step": 10, "loss": 0.7}
+        try:
+            get("/job/nosuchjob/config")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        portal.stop()

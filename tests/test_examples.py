@@ -64,6 +64,14 @@ def test_lm_pretrain_example(cluster):
            "--steps 6 --global-batch 16 --seq-len 32 --vocab 64"})
     client = cluster.submit(conf)
     assert client.final_status["status"] == "SUCCEEDED", client.final_status
+    # the coordinator archives fit()'s metric sink into history for the
+    # portal's /metrics page
+    import glob
+
+    hist = str(conf.get("tony.history.location"))
+    archived = glob.glob(os.path.join(
+        hist, "**", client.app_id, "metrics", "train.jsonl"), recursive=True)
+    assert archived, f"metrics not archived under {hist}"
 
 
 def test_ray_example(cluster):

@@ -23,6 +23,7 @@ import glob
 import json
 import logging
 import os
+import shutil
 import shlex
 import threading
 import time
@@ -590,6 +591,7 @@ class Coordinator:
         final = "SUCCEEDED" if status == SessionStatus.SUCCEEDED else "FAILED"
         failed = sum(1 for t in self.session.all_tasks() if t.status.name == "FAILED")
         self.events.emit(application_finished(self.app_id, final, failed))
+        self._archive_metrics()
         self._write_status_file(final)
         self.am_adapter.destroy()
         self.client_done.wait(timeout=30)
@@ -597,6 +599,31 @@ class Coordinator:
         log.info("application %s finished: %s (%s)", self.app_id, final,
                  self.session.failure_reason or "ok")
         return status == SessionStatus.SUCCEEDED
+
+    def _archive_metrics(self) -> None:
+        """Copy training-metric jsonl files (written by train.fit sinks into
+        <job_dir>/metrics/) into the history dir so the portal can serve
+        them after the job dir is gone (no reference analog: TonY's history
+        holds only events + config, SURVEY.md 5.5)."""
+        src = os.path.join(self.job_dir, "metrics")
+        if not os.path.isdir(src):
+            return
+        # wholly best-effort: a full/read-only history mount must not abort
+        # _stop() (status file, adapter destroy, jhist finalize come after)
+        try:
+            dst = os.path.join(self.events.job_dir, "metrics")
+            os.makedirs(dst, exist_ok=True)
+            names = os.listdir(src)
+        except OSError:
+            log.exception("failed to create metrics archive dir")
+            return
+        for name in names:
+            if name.endswith(".jsonl"):
+                try:
+                    shutil.copy2(os.path.join(src, name),
+                                 os.path.join(dst, name))
+                except OSError:
+                    log.exception("failed to archive metrics file %s", name)
 
     def _write_status_file(self, final: str) -> None:
         path = os.path.join(self.job_dir, "status.json")

@@ -96,6 +96,8 @@ class PortalHandler(BaseHTTPRequestHandler):
                 return self._job_events(job, api)
             if page == "logs":
                 return self._job_logs(job, api)
+            if page == "metrics":
+                return self._job_metrics(job, api)
         return self._send(404, "not found", "text/plain")
 
     # -- pages --------------------------------------------------------------
@@ -109,7 +111,8 @@ class PortalHandler(BaseHTTPRequestHandler):
             f"<td>{j['user'] or '-'}</td>"
             f"<td>{_ts(j['started'])}</td><td>{_ts(j['completed'])}</td>"
             f"<td><a href='/job/{j['app_id']}/events'>events</a> "
-            f"<a href='/job/{j['app_id']}/logs'>logs</a></td></tr>"
+            f"<a href='/job/{j['app_id']}/logs'>logs</a> "
+            f"<a href='/job/{j['app_id']}/metrics'>metrics</a></td></tr>"
             for j in jobs
         )
         body = (f"<table><tr><th>application</th><th>status</th><th>user</th>"
@@ -151,6 +154,52 @@ class PortalHandler(BaseHTTPRequestHandler):
         items = "".join(f"<li>{html.escape(p)}</li>" for p in found) or "<li>none</li>"
         body = f"<p><a href='/'>&larr; jobs</a></p><ul>{items}</ul>"
         self._send(200, _PAGE.format(title=f"{job['app_id']} logs", body=body))
+
+    def _job_metrics(self, job: dict, api: bool):
+        """Training metrics archived by the coordinator from train.fit's
+        jsonl sinks (<history job dir>/metrics/*.jsonl). Beyond-reference:
+        tony-portal serves only events/config/logs."""
+        import collections
+
+        mdir = os.path.join(job["dir"], "metrics")
+        # stream with a bounded tail: metric files grow with run length and
+        # are re-read per request (no reason to hold 10^5 rows for a page
+        # that shows 200); non-dict JSON lines are skipped, any task can
+        # write into metrics/ so the content is untrusted
+        keep = 2000 if api else 200
+        series: dict[str, list[dict]] = {}
+        if os.path.isdir(mdir):
+            for name in sorted(os.listdir(mdir)):
+                if not name.endswith(".jsonl"):
+                    continue
+                rows: collections.deque = collections.deque(maxlen=keep)
+                with open(os.path.join(mdir, name)) as f:
+                    for line in f:
+                        if line.strip():
+                            try:
+                                row = json.loads(line)
+                            except json.JSONDecodeError:
+                                continue
+                            if isinstance(row, dict):
+                                rows.append(row)
+                series[name[:-len(".jsonl")]] = list(rows)
+        if api:
+            return self._send(200, json.dumps(series), "application/json")
+        sections = []
+        for name, rows in series.items():
+            cols = sorted({k for r in rows for k in r})
+            head = "".join(f"<th>{html.escape(c)}</th>" for c in cols)
+            body_rows = "".join(
+                "<tr>" + "".join(
+                    f"<td>{html.escape(str(r.get(c, '')))}</td>"
+                    for c in cols) + "</tr>"
+                for r in rows)
+            sections.append(f"<h3>{html.escape(name)}</h3>"
+                            f"<table><tr>{head}</tr>{body_rows}</table>")
+        body = ("<p><a href='/'>&larr; jobs</a></p>"
+                + ("".join(sections) or "<p>no metrics recorded</p>"))
+        self._send(200, _PAGE.format(title=f"{job['app_id']} metrics",
+                                     body=body))
 
     def _send(self, code: int, body: str, ctype: str = "text/html"):
         data = body.encode()
