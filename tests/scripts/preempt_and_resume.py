@@ -1,0 +1,49 @@
+"""Payload: TPU-preemption contract. Attempt 0 SIGTERMs its own agent
+(standing in for the platform's spot-reclaim notice); the agent forwards
+SIGTERM to this process, whose handler checkpoints and exits non-zero; the
+agent reports the exit as preempted; the coordinator retry resumes.
+"""
+
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+from tony_tpu.train import CheckpointManager, auto_resume
+
+attempt = int(os.environ["TONY_ATTEMPT_NUMBER"])
+ckpt_dir = os.environ["TONY_CHECKPOINT_DIR"]
+
+
+def init_fn():
+    return {"step": np.array(0, np.int32)}
+
+
+state, manager, resumed = auto_resume(init_fn)
+
+if attempt == 0:
+    if resumed:
+        sys.exit("attempt 0 must start fresh")
+
+    def on_sigterm(signum, frame):
+        # the checkpoint-in-grace-window path every real trainer follows
+        mgr = CheckpointManager(ckpt_dir)
+        mgr.save(7, {"step": np.array(7, np.int32)}, force=True)
+        mgr.wait()
+        print("checkpointed step 7 inside the preemption grace window")
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+
+    # stand-in for the cloud preemption notice: SIGTERM the agent process
+    os.kill(int(os.environ["TONY_AGENT_PID"]), signal.SIGTERM)
+    time.sleep(30)  # the forwarded SIGTERM interrupts this
+    sys.exit("never got the forwarded SIGTERM")
+
+if not resumed or int(state["step"]) != 7:
+    sys.exit(f"attempt 1 did not resume from step 7: {state}")
+if os.environ.get("TONY_RESUME_STEP") != "7":
+    sys.exit(f"TONY_RESUME_STEP={os.environ.get('TONY_RESUME_STEP')!r}")
+print("resumed from preemption checkpoint OK")

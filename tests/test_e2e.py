@@ -257,6 +257,19 @@ def test_resume_from_checkpoint_on_retry(cluster):
     assert ok, client.final_status
 
 
+def test_preemption_grace_checkpoint_and_resume(cluster):
+    """TPU-preemption path (SURVEY 7.9b: the heartbeat-expiry analog):
+    SIGTERM to the agent forwards to the user process with a grace window;
+    the exit is reported preempted; the retry resumes from the checkpoint
+    saved inside the window."""
+    conf = script_conf(cluster, script("preempt_and_resume.py"),
+                       {"worker": 1})
+    conf.set("tony.coordinator.retry-count", 1)
+    conf.set("tony.application.checkpoint-dir", "ckpts")
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+
+
 def test_coordinator_exception_no_retry_fails(cluster, monkeypatch):
     monkeypatch.setenv(C.TEST_COORD_THROW, "1")
     conf = script_conf(cluster, script("exit_0.py"), {"worker": 1})

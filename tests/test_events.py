@@ -134,8 +134,11 @@ def test_portal_pages_and_api(tmp_path):
     with open(os.path.join(h.job_dir, "tony-final.json"), "w") as f:
         _json.dump({"tony.application.name": "ptest"}, f)
     os.makedirs(os.path.join(h.job_dir, "metrics"), exist_ok=True)
-    with open(os.path.join(h.job_dir, "metrics", "train.jsonl"), "w") as f:
-        f.write('{"step": 5, "loss": 1.5}\n{"step": 10, "loss": 0.7}\n')
+    with open(os.path.join(h.job_dir, "metrics", "train.jsonl"), "wb") as f:
+        # includes untrusted content: non-dict JSON, NaN, and a bad byte —
+        # the page must skip/null them, not 500
+        f.write(b'{"step": 5, "loss": 1.5}\n42\n{"step": 7, "loss": NaN}\n'
+                b'\xff garbage\n{"step": 10, "loss": 0.7}\n')
     h.stop("SUCCEEDED")
 
     portal = Portal(root, port=0).start()
@@ -161,8 +164,10 @@ def test_portal_pages_and_api(tmp_path):
         status, body = get("/job/application_p1/metrics")
         assert status == 200 and "loss" in body
         status, body = get("/api/job/application_p1/metrics")
-        series = _json.loads(body)
-        assert series["train"][1] == {"step": 10, "loss": 0.7}
+        series = _json.loads(body)  # strict: would fail on a bare NaN token
+        assert series["train"][-1] == {"step": 10, "loss": 0.7}
+        assert series["train"][1] == {"step": 7, "loss": None}  # NaN nulled
+        assert len(series["train"]) == 3  # non-dict + garbage lines dropped
         try:
             get("/job/nosuchjob/config")
             raise AssertionError("expected 404")

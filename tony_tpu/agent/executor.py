@@ -121,6 +121,44 @@ class TaskAgent:
                                         secret=self.secret) if self.metrics_port else None
         self.adapter = get_task_adapter(str(self.conf.get("tony.application.framework")))
         self._user_pid: int | None = None
+        self.preempted = False
+
+    def _install_preemption_handler(self) -> None:
+        """SIGTERM = TPU spot preemption / maintenance notice (the
+        heartbeat-expiry analog of SURVEY 7.9b): forward it to the user
+        process group with a checkpoint grace window, and report the exit
+        as preempted so the coordinator retry can resume from checkpoint.
+        Main-thread only (signal module restriction); launch modes that run
+        the agent off the main thread just skip it."""
+        import signal as _signal
+
+        from tony_tpu.utils.shell import request_graceful_shutdown
+
+        grace = self.conf.get_int("tony.task.preemption-grace-ms", 15_000)
+
+        def forward():
+            # runs on a worker thread: request_graceful_shutdown (and
+            # logging) take locks, which a handler on the interrupted main
+            # thread could self-deadlock on
+            log.warning("SIGTERM: preemption/maintenance — forwarding to "
+                        "user process with %d ms checkpoint grace", grace)
+            if request_graceful_shutdown(grace) == 0:
+                # nothing registered to forward to (e.g. an adapter that
+                # spawns children outside the exec registry, or between
+                # exec points): don't swallow the signal and hang — die
+                # like the default disposition would have (128+SIGTERM;
+                # signal.signal can't be called off the main thread)
+                log.warning("no active user process; exiting on SIGTERM")
+                os._exit(143)
+
+        def on_sigterm(signum, frame):
+            self.preempted = True
+            threading.Thread(target=forward, daemon=True).start()
+
+        try:
+            _signal.signal(_signal.SIGTERM, on_sigterm)
+        except ValueError:  # not on the main thread
+            log.debug("not main thread; preemption handler not installed")
 
     def _clean_stale_control_files(self) -> None:
         """A previous epoch's save_and_exit/profile file for this task id
@@ -232,8 +270,10 @@ class TaskAgent:
                 C.SESSION_ID: str(self.session_id),
                 C.DISTRIBUTED_MODE: self.mode,
                 C.ATTEMPT_NUMBER: os.environ.get(C.ATTEMPT_NUMBER, "0"),
+                C.AGENT_PID: str(os.getpid()),
             },
         )
+        self._install_preemption_handler()
         try:
             exit_code = self.adapter.run(ctx)
         except Exception:
@@ -251,7 +291,8 @@ class TaskAgent:
         try:
             self.client.call("register_execution_result",
                              task_id=self.task_id, exit_code=exit_code,
-                             session_id=self.session_id)
+                             session_id=self.session_id,
+                             preempted=self.preempted)
         except Exception:
             # coordinator's launcher exit-watch is the backup path
             log.exception("failed to register execution result")

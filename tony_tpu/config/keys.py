@@ -139,6 +139,13 @@ KEYS: dict[str, Key] = {
         15_000, int, "Grace period for tasks to checkpoint-and-exit on an "
         "elastic resize before the gang restart proceeds"
     ),
+    "tony.task.preemption-grace-ms": Key(
+        15_000, int, "On SIGTERM (TPU spot preemption / maintenance event — "
+        "the heartbeat-expiry analog, SURVEY.md 7.9b), the agent forwards "
+        "SIGTERM to the user process and waits this long for a "
+        "checkpoint-and-exit before SIGKILL; the coordinator records the "
+        "task as preempted so a retry (with checkpoint-dir set) resumes"
+    ),
     "tony.task.profiler-port": Key(
         0, int, "Base port for per-task jax profiler servers (0 = off); "
         "task flat-index is added so shared hosts don't collide"

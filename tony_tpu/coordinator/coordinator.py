@@ -74,9 +74,10 @@ class ClientRpcHandler:
         return True
 
     def register_execution_result(self, task_id: str, exit_code: int,
-                                  session_id: int = -1):
+                                  session_id: int = -1,
+                                  preempted: bool = False):
         return self._coord.register_execution_result(
-            task_id, int(exit_code), int(session_id))
+            task_id, int(exit_code), int(session_id), bool(preempted))
 
     def finish_application(self):
         self._coord.client_done.set()
@@ -241,7 +242,8 @@ class Coordinator:
         return self.cluster_spec_if_ready(task_id)
 
     def register_execution_result(self, task_id: str, exit_code: int,
-                                  session_id: int = -1) -> bool:
+                                  session_id: int = -1,
+                                  preempted: bool = False) -> bool:
         """A result from a previous session epoch (pre-resize/retry gang)
         must not complete the current epoch's task of the same id (ref:
         sessionId guard on TonySession results)."""
@@ -249,12 +251,14 @@ class Coordinator:
             log.info("ignoring stale result %s (epoch %d != %d)", task_id,
                      session_id, self.session.session_id)
             return False
-        log.info("task %s registered exit code %d", task_id, exit_code)
-        self._complete_task(task_id, exit_code)
+        log.info("task %s registered exit code %d%s", task_id, exit_code,
+                 " (preempted)" if preempted else "")
+        self._complete_task(task_id, exit_code, preempted=preempted)
         return True
 
     # ---------------------------------------------------------- completions
-    def _complete_task(self, task_id: str, exit_code: int) -> None:
+    def _complete_task(self, task_id: str, exit_code: int,
+                       preempted: bool = False) -> None:
         delay = os.environ.get(C.TEST_COMPLETION_DELAY)
         if delay:  # fault injection (ref: ApplicationMaster.java:1074-1083)
             time.sleep(int(delay) / 1000)
@@ -282,6 +286,15 @@ class Coordinator:
             self.liveness.unregister(task_id)
             was_registered = task.registered
             self.session.on_task_completed(task.role, task.index, exit_code)
+            if preempted and exit_code != 0 and \
+                    self.session.status == SessionStatus.FAILED:
+                # annotate so operators (and the history) see this was the
+                # platform reclaiming capacity, not the training failing;
+                # a retry attempt with checkpoint-dir set resumes from the
+                # grace-window checkpoint
+                self.session.failure_reason = (
+                    f"task {task_id} preempted (spot reclaim / maintenance); "
+                    f"exit {exit_code}")
             self.events.emit(task_finished(
                 task.role, task.index, task.status.name,
                 self.metrics.get_metrics(task_id)))

@@ -173,7 +173,9 @@ class PortalHandler(BaseHTTPRequestHandler):
                 if not name.endswith(".jsonl"):
                     continue
                 rows: collections.deque = collections.deque(maxlen=keep)
-                with open(os.path.join(mdir, name)) as f:
+                # errors="replace": one bad byte must not 500 the page
+                # (the mangled line is then dropped by the JSON guard)
+                with open(os.path.join(mdir, name), errors="replace") as f:
                     for line in f:
                         if line.strip():
                             try:
@@ -181,9 +183,11 @@ class PortalHandler(BaseHTTPRequestHandler):
                             except json.JSONDecodeError:
                                 continue
                             if isinstance(row, dict):
-                                rows.append(row)
+                                rows.append(_finite(row))
                 series[name[:-len(".jsonl")]] = list(rows)
         if api:
+            # NaN/Infinity already nulled by _finite: bare NaN tokens are
+            # not JSON and break strict parsers (browsers, jq)
             return self._send(200, json.dumps(series), "application/json")
         sections = []
         for name, rows in series.items():
@@ -208,6 +212,15 @@ class PortalHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+
+def _finite(row: dict) -> dict:
+    """Replace non-finite floats (a diverged run logs NaN loss) with None —
+    json.dumps would otherwise emit bare NaN, which is not valid JSON."""
+    import math
+
+    return {k: (None if isinstance(v, float) and not math.isfinite(v) else v)
+            for k, v in row.items()}
 
 
 def _ts(ms: int) -> str:

@@ -96,7 +96,13 @@ class HorovodDriver:
         import tony_tpu
         pkg_parent = os.path.dirname(os.path.dirname(tony_tpu.__file__))
         env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
-        proc = subprocess.Popen(cmd, cwd=workdir, env=env)
+        proc = subprocess.Popen(cmd, cwd=workdir, env=env,
+                                start_new_session=True)
+        # preemption forwarding (agent SIGTERM handler) must reach the
+        # rendezvous driver too, not only execute_shell children
+        from tony_tpu.utils.shell import register_external_process
+
+        register_external_process(proc)
         deadline = time.time() + cls.START_TIMEOUT_S
         while time.time() < deadline:
             files = glob.glob(os.path.join(workdir, f"*{PORT_FILE_SUFFIX}"))
@@ -128,11 +134,19 @@ class HorovodDriver:
             {"host": host, "port": self.port, "slots": self.slots})
 
     def wait(self) -> int:
-        return self.proc.wait()
+        try:
+            return self.proc.wait()
+        finally:
+            from tony_tpu.utils.shell import unregister_external_process
+
+            unregister_external_process(self.proc)
 
     def kill(self) -> None:
+        from tony_tpu.utils.shell import unregister_external_process
+
         if self.proc.poll() is None:
             self.proc.kill()
+        unregister_external_process(self.proc)
 
 
 class HorovodAMAdapter(AMAdapter):

@@ -15,6 +15,54 @@ import threading
 
 log = logging.getLogger(__name__)
 
+# live children of this process (pgid leaders), for preemption forwarding
+_ACTIVE: set = set()
+_ACTIVE_LOCK = threading.Lock()
+
+
+def register_external_process(proc) -> None:
+    """Track a Popen started outside execute_shell (e.g. the horovod
+    rendezvous driver) so preemption forwarding reaches it too."""
+    with _ACTIVE_LOCK:
+        _ACTIVE.add(proc)
+
+
+def unregister_external_process(proc) -> None:
+    with _ACTIVE_LOCK:
+        _ACTIVE.discard(proc)
+
+
+def request_graceful_shutdown(grace_ms: int = 15_000) -> int:
+    """TPU preemption/maintenance path: forward SIGTERM to every active
+    user-process group so training can checkpoint-and-exit, then SIGKILL
+    whatever is still alive after the grace period. Returns the number of
+    process groups signalled, immediately (the killer runs on a daemon
+    thread); callers keep waiting on the child, which exits with 143
+    (SIGTERM) or 137 (SIGKILL). NOT async-signal-safe (takes locks): call
+    from a worker thread, never directly inside a signal handler."""
+    with _ACTIVE_LOCK:
+        procs = list(_ACTIVE)
+    for proc in procs:
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+    def kill_after_grace():
+        for proc in procs:
+            try:
+                proc.wait(timeout=grace_ms / 1000)
+            except subprocess.TimeoutExpired:
+                log.warning("grace period (%d ms) expired; SIGKILL pgid %d",
+                            grace_ms, proc.pid)
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
+    threading.Thread(target=kill_after_grace, daemon=True).start()
+    return len(procs)
+
 
 def execute_shell(
     command: str,
@@ -39,6 +87,8 @@ def execute_shell(
             stderr=subprocess.STDOUT if out else None,
             start_new_session=True,
         )
+        with _ACTIVE_LOCK:
+            _ACTIVE.add(proc)
         try:
             return proc.wait(timeout=timeout_ms / 1000 if timeout_ms > 0 else None)
         except subprocess.TimeoutExpired:
@@ -49,6 +99,9 @@ def execute_shell(
                 pass
             proc.wait()
             return 124
+        finally:
+            with _ACTIVE_LOCK:
+                _ACTIVE.discard(proc)
     finally:
         if out:
             out.close()
