@@ -287,14 +287,16 @@ class Coordinator:
             was_registered = task.registered
             self.session.on_task_completed(task.role, task.index, exit_code)
             if preempted and exit_code != 0 and \
-                    self.session.status == SessionStatus.FAILED:
+                    self.session.status == SessionStatus.FAILED and \
+                    self.session.failure_reason and \
+                    task_id in self.session.failure_reason:
                 # annotate so operators (and the history) see this was the
-                # platform reclaiming capacity, not the training failing;
-                # a retry attempt with checkpoint-dir set resumes from the
-                # grace-window checkpoint
-                self.session.failure_reason = (
-                    f"task {task_id} preempted (spot reclaim / maintenance); "
-                    f"exit {exit_code}")
+                # platform reclaiming capacity, not the training failing —
+                # but only when THIS task's failure is the recorded reason
+                # (a preempted worker arriving after a genuine chief crash
+                # must not clobber the real first-failure reason)
+                self.session.failure_reason += \
+                    " [preempted: spot reclaim / maintenance]"
             self.events.emit(task_finished(
                 task.role, task.index, task.status.name,
                 self.metrics.get_metrics(task_id)))

@@ -7,6 +7,7 @@ from tony_tpu.models.resnet import (
     ResNet152,
 )
 from tony_tpu.models.generate import generate, init_cache, sample_logits
+from tony_tpu.models.hf import convert_gpt2_state_dict, from_hf_gpt2, gpt2_config
 from tony_tpu.models.transformer import (
     MoEMLP,
     Transformer,
@@ -16,6 +17,9 @@ from tony_tpu.models.transformer import (
 
 __all__ = [
     "MoEMLP",
+    "convert_gpt2_state_dict",
+    "from_hf_gpt2",
+    "gpt2_config",
     "moe_aux_loss",
     "generate",
     "init_cache",

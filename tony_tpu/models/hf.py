@@ -1,0 +1,108 @@
+"""Hugging Face checkpoint import for the flagship transformer.
+
+No reference analog (TonY has no models). GPT-2-family weights map onto
+``TransformerConfig(norm="layer", positional="learned", use_bias=True,
+activation="gelu_tanh")``; the converter is pure tensor reshuffling
+(torch state_dict -> jax pytree), so it works on any GPT-2-sized
+checkpoint already on disk — no network needed.
+
+HF GPT-2 layout notes: ``Conv1D`` stores weights as [in, out] (already
+the jax kernel orientation); ``c_attn`` packs Q,K,V as one [d, 3d]
+matrix split here into per-head kernels; ``wte`` is tied to the LM head
+(our model ties through the same ``embedding`` param).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tony_tpu.models.transformer import Transformer, TransformerConfig
+
+
+_HF_ACTIVATIONS = {"gelu_new": "gelu_tanh", "gelu_pytorch_tanh": "gelu_tanh",
+                   "gelu": "gelu", "silu": "silu", "swish": "silu"}
+
+
+def gpt2_config(hf_config, **overrides) -> TransformerConfig:
+    """TransformerConfig matching a transformers GPT2Config."""
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act not in _HF_ACTIVATIONS:
+        raise ValueError(f"unsupported GPT-2 activation_function {act!r}; "
+                         f"supported: {sorted(_HF_ACTIVATIONS)}")
+    n_inner = getattr(hf_config, "n_inner", None)
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.n_embd,
+        n_heads=hf_config.n_head,
+        n_layers=hf_config.n_layer,
+        d_ff=n_inner if n_inner else 4 * hf_config.n_embd,
+        max_seq_len=hf_config.n_positions,
+        dtype=jnp.float32,
+        attention_backend="reference",
+        norm="layer",
+        positional="learned",
+        use_bias=True,
+        activation=_HF_ACTIVATIONS[act],
+        norm_eps=hf_config.layer_norm_epsilon,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+def convert_gpt2_state_dict(state_dict: dict, cfg: TransformerConfig) -> Any:
+    """torch GPT-2 state_dict -> tony-tpu Transformer params pytree."""
+    d, h, dh = cfg.d_model, cfg.n_heads, cfg.head_dim
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    params: dict[str, Any] = {
+        "embedding": _np(sd["wte.weight"]),
+        "pos_embedding": _np(sd["wpe.weight"]),
+        "ln_f": {"scale": _np(sd["ln_f.weight"]),
+                 "bias": _np(sd["ln_f.bias"])},
+    }
+    for i in range(cfg.n_layers):
+        pre = f"h.{i}."
+        qkv_w = _np(sd[pre + "attn.c_attn.weight"])  # [d, 3d] (Conv1D)
+        qkv_b = _np(sd[pre + "attn.c_attn.bias"])  # [3d]
+        qw, kw, vw = np.split(qkv_w, 3, axis=1)
+        qb, kb, vb = np.split(qkv_b, 3, axis=0)
+        block = {
+            "ln1": {"scale": _np(sd[pre + "ln_1.weight"]),
+                    "bias": _np(sd[pre + "ln_1.bias"])},
+            "ln2": {"scale": _np(sd[pre + "ln_2.weight"]),
+                    "bias": _np(sd[pre + "ln_2.bias"])},
+            "attn": {
+                "q": {"kernel": qw.reshape(d, h, dh),
+                      "bias": qb.reshape(h, dh)},
+                "k": {"kernel": kw.reshape(d, h, dh),
+                      "bias": kb.reshape(h, dh)},
+                "v": {"kernel": vw.reshape(d, h, dh),
+                      "bias": vb.reshape(h, dh)},
+                "o": {"kernel": _np(
+                          sd[pre + "attn.c_proj.weight"]).reshape(h, dh, d),
+                      "bias": _np(sd[pre + "attn.c_proj.bias"])},
+            },
+            "mlp": {
+                "wi": {"kernel": _np(sd[pre + "mlp.c_fc.weight"]),
+                       "bias": _np(sd[pre + "mlp.c_fc.bias"])},
+                "wo": {"kernel": _np(sd[pre + "mlp.c_proj.weight"]),
+                       "bias": _np(sd[pre + "mlp.c_proj.bias"])},
+            },
+        }
+        params[f"block_{i}"] = block
+    return {"params": jax.tree.map(jnp.asarray, params)}
+
+
+def from_hf_gpt2(model) -> tuple[Transformer, Any]:
+    """(Transformer, params) from a transformers GPT2LMHeadModel (or its
+    GPT2Model trunk) instance — local weights, no network."""
+    cfg = gpt2_config(model.config)
+    params = convert_gpt2_state_dict(model.state_dict(), cfg)
+    return Transformer(cfg), params

@@ -42,6 +42,15 @@ class TransformerConfig:
     attention_block_size: int = 512
     remat: bool = False
     mesh: Any = None  # required for the ring backend
+    # architecture family knobs: the defaults are the Llama-style TPU
+    # flagship (RMSNorm + RoPE + no biases + gelu); flipping them to
+    # ("layer", "learned", True, "gelu_tanh") gives GPT-2 exactly —
+    # models/hf.py imports HF GPT-2 checkpoints into that configuration
+    norm: str = "rms"  # rms | layer
+    positional: str = "rope"  # rope | learned
+    use_bias: bool = False
+    activation: str = "gelu"  # gelu (erf) | gelu_tanh | silu
+    norm_eps: float = 1e-6
     # MoE (expert-parallel FFN): 0 = dense MLP everywhere; k > 0 replaces the
     # MLP of every k-th block with a mixture-of-experts layer
     moe_every: int = 0
@@ -95,6 +104,7 @@ def _attention(cfg: TransformerConfig, q, k, v):
 
 class RMSNorm(nn.Module):
     dtype: Any = jnp.bfloat16
+    eps: float = 1e-6
 
     @nn.compact
     def __call__(self, x):
@@ -102,8 +112,46 @@ class RMSNorm(nn.Module):
                            jnp.float32)
         x32 = x.astype(jnp.float32)
         norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True)
-                                   + 1e-6)
+                                   + self.eps)
         return (norm * scale).astype(self.dtype)
+
+
+class LayerNorm(nn.Module):
+    """Mean-subtracting norm with bias (GPT-2 family); fp32 math."""
+
+    dtype: Any = jnp.bfloat16
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones_init(), (d,),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(), (d,),
+                          jnp.float32)
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
+        return (((x32 - mu) * jax.lax.rsqrt(var + self.eps)) * scale
+                + bias).astype(self.dtype)
+
+
+def make_norm(cfg: TransformerConfig, name: str):
+    if cfg.norm == "layer":
+        return LayerNorm(cfg.dtype, cfg.norm_eps, name=name)
+    if cfg.norm == "rms":
+        return RMSNorm(cfg.dtype, cfg.norm_eps, name=name)
+    raise ValueError(f"unknown norm {cfg.norm}")
+
+
+def _activation(cfg: TransformerConfig):
+    if cfg.activation == "gelu":
+        return lambda x: nn.gelu(x, approximate=False)
+    if cfg.activation == "gelu_tanh":
+        return lambda x: nn.gelu(x, approximate=True)
+    if cfg.activation == "silu":
+        return nn.silu
+    raise ValueError(f"unknown activation {cfg.activation}")
 
 
 def rotary_embedding(x, positions):
@@ -129,7 +177,7 @@ class Attention(nn.Module):
         # logical sharding axes for these kernels come from path-name
         # matching in logical_axis_rules_tree, not from annotations here
         dense = lambda name, feats: nn.DenseGeneral(  # noqa: E731
-            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            feats, axis=-1, use_bias=cfg.use_bias, dtype=cfg.dtype,
             param_dtype=jnp.float32, name=name,
             kernel_init=nn.initializers.normal(0.02))
         q = dense("q", (cfg.n_heads, cfg.head_dim))(x)
@@ -138,9 +186,10 @@ class Attention(nn.Module):
         if decode:
             out = self._decode_attention(q, k, v)
         else:
-            positions = jnp.arange(l)
-            q = rotary_embedding(q, positions)
-            k = rotary_embedding(k, positions)
+            if cfg.positional == "rope":
+                positions = jnp.arange(l)
+                q = rotary_embedding(q, positions)
+                k = rotary_embedding(k, positions)
             if cfg.kv_heads != cfg.n_heads and \
                     cfg.attention_backend != "pallas":
                 # GQA: broadcast K/V head groups up to n_heads for the
@@ -154,7 +203,7 @@ class Attention(nn.Module):
                 v = jnp.repeat(v, group, axis=2)
             out = _attention(cfg, q, k, v)
         out = nn.DenseGeneral(
-            cfg.d_model, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
+            cfg.d_model, axis=(-2, -1), use_bias=cfg.use_bias, dtype=cfg.dtype,
             param_dtype=jnp.float32, name="o",
             kernel_init=nn.initializers.normal(0.02))(out)
         return out
@@ -186,9 +235,10 @@ class Attention(nn.Module):
         if not is_init:  # shape-only init pass
             return jnp.zeros((b, l, h, dh), q.dtype)
         cur = cache_index.value
-        positions = cur + jnp.arange(l)
-        q = rotary_embedding(q, positions)
-        k = rotary_embedding(k, positions)
+        if cfg.positional == "rope":
+            positions = cur + jnp.arange(l)
+            q = rotary_embedding(q, positions)
+            k = rotary_embedding(k, positions)
         keys = jax.lax.dynamic_update_slice(cached_k.value, k, (0, cur, 0, 0))
         values = jax.lax.dynamic_update_slice(cached_v.value, v, (0, cur, 0, 0))
         cached_k.value = keys
@@ -212,11 +262,11 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        h = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+        h = nn.Dense(cfg.d_ff, use_bias=cfg.use_bias, dtype=cfg.dtype,
                      param_dtype=jnp.float32, name="wi",
                      kernel_init=nn.initializers.normal(0.02))(x)
-        h = nn.gelu(h)
-        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+        h = _activation(cfg)(h)
+        return nn.Dense(cfg.d_model, use_bias=cfg.use_bias, dtype=cfg.dtype,
                         param_dtype=jnp.float32, name="wo",
                         kernel_init=nn.initializers.normal(0.02))(h)
 
@@ -283,10 +333,10 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, decode: bool = False):
         x = x + Attention(self.cfg, name="attn")(
-            RMSNorm(self.cfg.dtype, name="ln1")(x), decode=decode)
+            make_norm(self.cfg, "ln1")(x), decode=decode)
         ffn = (MoEMLP(self.cfg, name="moe") if self.use_moe
                else MLP(self.cfg, name="mlp"))
-        x = x + ffn(RMSNorm(self.cfg.dtype, name="ln2")(x))
+        x = x + ffn(make_norm(self.cfg, "ln2")(x))
         return x
 
 
@@ -303,6 +353,26 @@ class _ScanBody(nn.Module):
 
 class Transformer(nn.Module):
     cfg: TransformerConfig
+
+    def _learned_positions(self, l: int, decode: bool):
+        """GPT-2-style absolute position embeddings. In decode mode a
+        top-level cache counter tracks the current offset (the per-layer
+        attention cache keeps its own; they advance in lockstep)."""
+        cfg = self.cfg
+        pos_emb = self.param("pos_embedding", nn.initializers.normal(0.02),
+                             (cfg.max_seq_len, cfg.d_model), jnp.float32)
+        if decode:
+            is_init = self.has_variable("cache", "pos_index")
+            pos_index = self.variable("cache", "pos_index",
+                                      lambda: jnp.array(0, jnp.int32))
+            if is_init:
+                positions = pos_index.value + jnp.arange(l)
+                pos_index.value = pos_index.value + l
+            else:
+                positions = jnp.arange(l)
+        else:
+            positions = jnp.arange(l)
+        return pos_emb[positions][None].astype(cfg.dtype)
 
     def _scan_blocks(self, x, decode: bool):
         cfg = self.cfg
@@ -331,6 +401,8 @@ class Transformer(nn.Module):
         embed = self.param("embedding", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.d_model), jnp.float32)
         x = embed[tokens].astype(cfg.dtype)
+        if cfg.positional == "learned":
+            x = x + self._learned_positions(tokens.shape[1], decode)
         if cfg.scan_layers:
             if cfg.moe_every:
                 raise ValueError("scan_layers needs uniform layers "
@@ -343,7 +415,7 @@ class Transformer(nn.Module):
             for i in range(cfg.n_layers):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
                 x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x, decode)
-        x = RMSNorm(cfg.dtype, name="ln_f")(x)
+        x = make_norm(cfg, "ln_f")(x)
         if return_hidden:
             return x.astype(jnp.float32)
         logits = jnp.einsum("bld,vd->blv", x.astype(jnp.float32), embed)
@@ -369,12 +441,34 @@ def logical_axis_rules_tree(params: Any) -> Any:
         if "/q/" in joined and getattr(leaf, "ndim", 0) == 3 + off:
             head_counts[joined.rsplit("/q/", 1)[0]] = leaf.shape[1 + off]
 
+    def bias_axes(joined: str, x, off: int, leaf_dims: int) -> tuple:
+        # use_bias=True (GPT-2 family): biases shard like their kernel's
+        # OUTPUT dims — q/k/v [h, dh], o/wo [d_model], wi [d_ff]
+        if "/q/" in joined:
+            return ("heads", "kv")[:leaf_dims]
+        for s in ("/k/", "/v/"):
+            if s in joined:
+                parent = joined.rsplit(s, 1)[0]
+                grouped = (leaf_dims == 2 and x.shape[off] !=
+                           head_counts.get(parent, x.shape[off]))
+                return ("kv_heads" if grouped else "heads",
+                        "kv")[:leaf_dims]
+        if "/o/" in joined or "/wo/" in joined:
+            return ("embed",)
+        if "/wi/" in joined:
+            return ("mlp",)
+        return tuple([None] * leaf_dims)  # norm biases etc: replicated
+
     def axes_for(path: tuple, x) -> tuple:
         joined = "/" + "/".join(getattr(p, "key", str(p)) for p in path)
         off = 1 if is_stacked(joined) else 0
         leaf_dims = x.ndim - off
         base: tuple
-        if "embedding" in joined:
+        if joined.endswith("/bias"):
+            base = bias_axes(joined, x, off, leaf_dims)
+        elif "pos_embedding" in joined:
+            base = (None, "embed")
+        elif "embedding" in joined:
             base = ("vocab", "embed")
         elif "/q/" in joined:
             base = ("embed", "heads", "kv")[:leaf_dims]
@@ -385,16 +479,20 @@ def logical_axis_rules_tree(params: Any) -> Any:
                        head_counts.get(parent, x.shape[1 + off]))
             base = ("embed", "kv_heads" if grouped else "heads",
                     "kv")[:leaf_dims]
-        elif "/o/" in joined or joined.endswith("o/kernel"):
+        elif "/o/" in joined:
+            # note: NOT endswith("o/kernel") — that would also capture
+            # the MLP's "wo/kernel"
             base = ("heads", "kv", "embed")[:leaf_dims]
         elif "router" in joined:
             base = (None, None)
         # MoE expert weights: must match parallel.moe.moe_logical_axes()
-        # (single source of truth for 3-dim expert params)
-        elif "wi" in joined:
+        # (single source of truth for 3-dim expert params). Dense MLP
+        # kernels live at .../wi/kernel; MoE expert arrays are the leaf
+        # .../moe/wi itself
+        elif "/wi/" in joined or joined.endswith("/wi"):
             base = moe_logical_axes()["wi"] if leaf_dims == 3 \
                 else ("embed", "mlp")
-        elif "wo" in joined:
+        elif "/wo/" in joined or joined.endswith("/wo"):
             base = moe_logical_axes()["wo"] if leaf_dims == 3 \
                 else ("mlp", "embed")
         else:

@@ -100,7 +100,10 @@ class HorovodDriver:
                                 start_new_session=True)
         # preemption forwarding (agent SIGTERM handler) must reach the
         # rendezvous driver too, not only execute_shell children
-        from tony_tpu.utils.shell import register_external_process
+        from tony_tpu.utils.shell import (
+            register_external_process,
+            unregister_external_process,
+        )
 
         register_external_process(proc)
         deadline = time.time() + cls.START_TIMEOUT_S
@@ -119,11 +122,13 @@ class HorovodDriver:
                 except (ValueError, KeyError, OSError):
                     pass
             if proc.poll() is not None:
+                unregister_external_process(proc)  # never leak a dead entry
                 raise RuntimeError(
                     f"rendezvous driver exited {proc.returncode} before "
                     "announcing its port")
             time.sleep(cls.POLL_INTERVAL_S)
         proc.kill()
+        unregister_external_process(proc)
         raise TimeoutError("rendezvous driver did not announce a port in "
                            f"{cls.START_TIMEOUT_S}s")
 

@@ -12,6 +12,7 @@ import signal
 import subprocess
 import sys
 import threading
+import time
 
 log = logging.getLogger(__name__)
 
@@ -49,9 +50,12 @@ def request_graceful_shutdown(grace_ms: int = 15_000) -> int:
             pass
 
     def kill_after_grace():
+        # one shared deadline: per-proc fresh timeouts would compound to
+        # N x grace and outlive the platform's actual reclaim window
+        deadline = time.monotonic() + grace_ms / 1000
         for proc in procs:
             try:
-                proc.wait(timeout=grace_ms / 1000)
+                proc.wait(timeout=max(0.0, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 log.warning("grace period (%d ms) expired; SIGKILL pgid %d",
                             grace_ms, proc.pid)
