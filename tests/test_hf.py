@@ -107,3 +107,128 @@ def test_gpt2_generate_under_framework(gpt2_pair):
         ref = hf.generate(torch.tensor(np.asarray(prompt)), max_new_tokens=6,
                           do_sample=False, pad_token_id=0)
     np.testing.assert_array_equal(np.asarray(out), ref.numpy()[:, 5:])
+
+
+@pytest.fixture(scope="module")
+def llama_pair():
+    from tony_tpu.models.hf import from_hf_llama
+
+    config = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=80,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=50_000.0,
+        tie_word_embeddings=False, attention_dropout=0.0)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(config).eval()
+    model, params = from_hf_llama(hf)
+    return hf, model, params
+
+
+def test_llama_config_mapping(llama_pair):
+    _, model, _ = llama_pair
+    cfg = model.cfg
+    assert cfg.norm == "rms" and cfg.positional == "rope"
+    assert cfg.gated_mlp and not cfg.use_bias and not cfg.tied_embeddings
+    assert cfg.n_kv_heads == 2 and cfg.rope_theta == 50_000.0
+
+
+def test_llama_logits_parity(llama_pair):
+    """GQA + RMSNorm + RoPE(theta) + SwiGLU + untied head, all exact vs
+    torch LlamaForCausalLM."""
+    hf, model, params = llama_pair
+    tokens = np.random.default_rng(1).integers(0, 96, (2, 17))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_llama_decode_parity(llama_pair):
+    """Incremental GQA KV-cache decode (RoPE applied at cached positions)
+    matches the full forward."""
+    hf, model, params = llama_pair
+    tokens = np.random.default_rng(2).integers(0, 96, (1, 9))
+    full = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    cache = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens),
+                       decode=True)["cache"]
+    steps = []
+    for i in range(tokens.shape[1]):
+        logits, mut = model.apply(
+            {"params": params["params"], "cache": cache},
+            jnp.asarray(tokens[:, i:i + 1]), decode=True, mutable=["cache"])
+        cache = mut["cache"]
+        steps.append(np.asarray(logits[:, 0]))
+    np.testing.assert_allclose(np.stack(steps, axis=1), full,
+                               atol=1e-3, rtol=1e-3)
+
+
+def test_llama_tied_variant():
+    """tie_word_embeddings=True maps onto tied_embeddings (no lm_head
+    param) and still matches torch logits."""
+    from tony_tpu.models.hf import from_hf_llama
+
+    config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32, tie_word_embeddings=True)
+    torch.manual_seed(1)
+    hf = transformers.LlamaForCausalLM(config).eval()
+    model, params = from_hf_llama(hf)
+    assert "lm_head" not in params["params"]
+    tokens = np.random.default_rng(3).integers(0, 64, (1, 7))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_llama_params_place_under_fsdp_tp(llama_pair):
+    """Untied lm_head and the SwiGLU gate kernel must get sharding axes
+    under the presets."""
+    from tony_tpu.models.transformer import logical_axis_rules_tree
+    from tony_tpu.parallel import MeshSpec, make_mesh
+    from tony_tpu.parallel.sharding import tree_shardings
+
+    _, model, params = llama_pair
+    axes = logical_axis_rules_tree(params["params"])
+    assert axes["lm_head"] == ("vocab", "embed")
+    blk = axes["block_0"]
+    assert blk["mlp"]["wg"]["kernel"] == ("embed", "mlp")
+    assert blk["mlp"]["wi"]["kernel"] == ("embed", "mlp")
+    assert blk["attn"]["k"]["kernel"] == ("embed", "kv_heads", "kv")
+    mesh = make_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    sh = tree_shardings(mesh, axes, "fsdp_tp")
+    jax.device_put(params["params"], sh)
+
+
+def test_llama_importer_rejects_unsupported():
+    from tony_tpu.models.hf import llama_config
+
+    config = transformers.LlamaConfig(
+        vocab_size=32, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2,
+        rope_scaling={"rope_type": "linear", "factor": 2.0})
+    with pytest.raises(ValueError, match="rope_scaling"):
+        llama_config(config)
+    config = transformers.LlamaConfig(
+        vocab_size=32, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2, attention_bias=True)
+    with pytest.raises(ValueError, match="biased"):
+        llama_config(config)
+
+
+def test_llama_importer_rejects_unmapped_tensors():
+    """Qwen2-style checkpoints (hardcoded q/k/v biases the config can't
+    flag) must be rejected, not silently mis-imported."""
+    from tony_tpu.models.hf import convert_llama_state_dict, llama_config
+
+    config = transformers.LlamaConfig(
+        vocab_size=32, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(config).eval()
+    sd = dict(hf.state_dict())
+    sd["model.layers.0.self_attn.q_proj.bias"] = torch.zeros(16)
+    with pytest.raises(ValueError, match="does not map"):
+        convert_llama_state_dict(sd, llama_config(config))

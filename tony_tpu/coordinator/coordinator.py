@@ -289,7 +289,7 @@ class Coordinator:
             if preempted and exit_code != 0 and \
                     self.session.status == SessionStatus.FAILED and \
                     self.session.failure_reason and \
-                    task_id in self.session.failure_reason:
+                    f"task {task_id} failed" in self.session.failure_reason:
                 # annotate so operators (and the history) see this was the
                 # platform reclaiming capacity, not the training failing —
                 # but only when THIS task's failure is the recorded reason

@@ -7,7 +7,14 @@ from tony_tpu.models.resnet import (
     ResNet152,
 )
 from tony_tpu.models.generate import generate, init_cache, sample_logits
-from tony_tpu.models.hf import convert_gpt2_state_dict, from_hf_gpt2, gpt2_config
+from tony_tpu.models.hf import (
+    convert_gpt2_state_dict,
+    convert_llama_state_dict,
+    from_hf_gpt2,
+    from_hf_llama,
+    gpt2_config,
+    llama_config,
+)
 from tony_tpu.models.transformer import (
     MoEMLP,
     Transformer,
@@ -18,8 +25,11 @@ from tony_tpu.models.transformer import (
 __all__ = [
     "MoEMLP",
     "convert_gpt2_state_dict",
+    "convert_llama_state_dict",
     "from_hf_gpt2",
+    "from_hf_llama",
     "gpt2_config",
+    "llama_config",
     "moe_aux_loss",
     "generate",
     "init_cache",

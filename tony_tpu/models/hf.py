@@ -106,3 +106,107 @@ def from_hf_gpt2(model) -> tuple[Transformer, Any]:
     cfg = gpt2_config(model.config)
     params = convert_gpt2_state_dict(model.state_dict(), cfg)
     return Transformer(cfg), params
+
+
+def llama_config(hf_config, **overrides) -> TransformerConfig:
+    """TransformerConfig matching a transformers LlamaConfig (any
+    RMSNorm + plain-RoPE + GQA + SwiGLU architecture; variants with
+    rope scaling, projection biases — e.g. Qwen2 — or sliding-window
+    attention are rejected rather than silently mis-imported)."""
+    if getattr(hf_config, "rope_scaling", None):
+        raise ValueError("rope_scaling is not supported by the importer")
+    if getattr(hf_config, "attention_bias", False) or \
+            getattr(hf_config, "mlp_bias", False):
+        raise ValueError("biased Llama variants are not supported "
+                         "(use_bias is all-or-nothing here)")
+    if getattr(hf_config, "sliding_window", None):
+        raise ValueError("sliding_window attention is not supported; "
+                         "this model would silently diverge past the window")
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act not in _HF_ACTIVATIONS:
+        raise ValueError(f"unsupported hidden_act {act!r}")
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+        n_layers=hf_config.num_hidden_layers,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        dtype=jnp.float32,
+        attention_backend="reference",
+        norm="rms",
+        positional="rope",
+        use_bias=False,
+        activation=_HF_ACTIVATIONS[act],
+        norm_eps=hf_config.rms_norm_eps,
+        rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
+        gated_mlp=True,
+        tied_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def convert_llama_state_dict(state_dict: dict, cfg: TransformerConfig) -> Any:
+    """torch Llama state_dict -> tony-tpu Transformer params pytree.
+
+    torch ``nn.Linear`` stores [out, in]; jax kernels are [in, out], so
+    every projection transposes. q/k/v rows are head-major, so the
+    transposed [d, heads*dh] reshapes straight into [d, heads, dh];
+    RoPE conventions already agree (half-split rotate, see
+    ``rotary_embedding``).
+    """
+    d, h, dh, kvh = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.kv_heads
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    # lm_head.weight is consumed untied and a duplicate view when tied
+    consumed = {"embed_tokens.weight", "norm.weight", "lm_head.weight"}
+    for i in range(cfg.n_layers):
+        consumed |= {f"layers.{i}.{s}.weight" for s in (
+            "input_layernorm", "post_attention_layernorm",
+            "self_attn.q_proj", "self_attn.k_proj", "self_attn.v_proj",
+            "self_attn.o_proj", "mlp.gate_proj", "mlp.up_proj",
+            "mlp.down_proj")}
+    # strictness: an unmapped tensor means this checkpoint is NOT plain
+    # Llama (e.g. Qwen2's hardcoded q/k/v biases) and the import would be
+    # silently wrong. inv_freq buffers (old transformers) carry no weights.
+    leftover = {k for k in sd
+                if k not in consumed and not k.endswith("inv_freq")}
+    if leftover:
+        raise ValueError(
+            f"state_dict has tensors the Llama importer does not map "
+            f"(not a plain-Llama architecture?): {sorted(leftover)[:8]}")
+    params: dict[str, Any] = {
+        "embedding": _np(sd["embed_tokens.weight"]),
+        "ln_f": {"scale": _np(sd["norm.weight"])},
+    }
+    if not cfg.tied_embeddings:
+        params["lm_head"] = _np(sd["lm_head.weight"])
+    for i in range(cfg.n_layers):
+        pre = f"layers.{i}."
+        proj = lambda name: _np(sd[pre + name + ".weight"]).T  # noqa: E731
+        params[f"block_{i}"] = {
+            "ln1": {"scale": _np(sd[pre + "input_layernorm.weight"])},
+            "ln2": {"scale": _np(
+                sd[pre + "post_attention_layernorm.weight"])},
+            "attn": {
+                "q": {"kernel": proj("self_attn.q_proj").reshape(d, h, dh)},
+                "k": {"kernel": proj("self_attn.k_proj").reshape(d, kvh, dh)},
+                "v": {"kernel": proj("self_attn.v_proj").reshape(d, kvh, dh)},
+                "o": {"kernel": proj("self_attn.o_proj").reshape(h, dh, d)},
+            },
+            "mlp": {
+                "wg": {"kernel": proj("mlp.gate_proj")},
+                "wi": {"kernel": proj("mlp.up_proj")},
+                "wo": {"kernel": proj("mlp.down_proj")},
+            },
+        }
+    return {"params": jax.tree.map(jnp.asarray, params)}
+
+
+def from_hf_llama(model) -> tuple[Transformer, Any]:
+    """(Transformer, params) from a transformers LlamaForCausalLM (or
+    Mistral/Qwen2-compatible) instance — local weights, no network."""
+    cfg = llama_config(model.config)
+    params = convert_llama_state_dict(model.state_dict(), cfg)
+    return Transformer(cfg), params

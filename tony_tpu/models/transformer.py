@@ -51,6 +51,13 @@ class TransformerConfig:
     use_bias: bool = False
     activation: str = "gelu"  # gelu (erf) | gelu_tanh | silu
     norm_eps: float = 1e-6
+    rope_theta: float = 10_000.0
+    # SwiGLU-style gated FFN (Llama family): wo(act(wg(x)) * wi(x));
+    # False = classic 2-matmul MLP (GPT-2 family)
+    gated_mlp: bool = False
+    # False adds a separate lm_head param instead of reusing the input
+    # embedding for output logits (Llama unties; GPT-2 ties)
+    tied_embeddings: bool = True
     # MoE (expert-parallel FFN): 0 = dense MLP everywhere; k > 0 replaces the
     # MLP of every k-th block with a mixture-of-experts layer
     moe_every: int = 0
@@ -154,11 +161,12 @@ def _activation(cfg: TransformerConfig):
     raise ValueError(f"unknown activation {cfg.activation}")
 
 
-def rotary_embedding(x, positions):
-    """RoPE over head_dim (TPU-friendly: pure elementwise, fuses away)."""
+def rotary_embedding(x, positions, theta: float = 10_000.0):
+    """RoPE over head_dim (TPU-friendly: pure elementwise, fuses away).
+    Half-split rotation convention (matches HF Llama's rotate_half)."""
     d = x.shape[-1]
     half = d // 2
-    freq = 1.0 / (10_000 ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     angles = positions[:, None].astype(jnp.float32) * freq[None, :]  # [L, half]
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
@@ -188,8 +196,8 @@ class Attention(nn.Module):
         else:
             if cfg.positional == "rope":
                 positions = jnp.arange(l)
-                q = rotary_embedding(q, positions)
-                k = rotary_embedding(k, positions)
+                q = rotary_embedding(q, positions, cfg.rope_theta)
+                k = rotary_embedding(k, positions, cfg.rope_theta)
             if cfg.kv_heads != cfg.n_heads and \
                     cfg.attention_backend != "pallas":
                 # GQA: broadcast K/V head groups up to n_heads for the
@@ -237,8 +245,8 @@ class Attention(nn.Module):
         cur = cache_index.value
         if cfg.positional == "rope":
             positions = cur + jnp.arange(l)
-            q = rotary_embedding(q, positions)
-            k = rotary_embedding(k, positions)
+            q = rotary_embedding(q, positions, cfg.rope_theta)
+            k = rotary_embedding(k, positions, cfg.rope_theta)
         keys = jax.lax.dynamic_update_slice(cached_k.value, k, (0, cur, 0, 0))
         values = jax.lax.dynamic_update_slice(cached_v.value, v, (0, cur, 0, 0))
         cached_k.value = keys
@@ -262,13 +270,17 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        h = nn.Dense(cfg.d_ff, use_bias=cfg.use_bias, dtype=cfg.dtype,
-                     param_dtype=jnp.float32, name="wi",
-                     kernel_init=nn.initializers.normal(0.02))(x)
-        h = _activation(cfg)(h)
-        return nn.Dense(cfg.d_model, use_bias=cfg.use_bias, dtype=cfg.dtype,
-                        param_dtype=jnp.float32, name="wo",
-                        kernel_init=nn.initializers.normal(0.02))(h)
+        dense = lambda name, feats: nn.Dense(  # noqa: E731
+            feats, use_bias=cfg.use_bias, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name,
+            kernel_init=nn.initializers.normal(0.02))
+        h = _activation(cfg)(dense("wi" if not cfg.gated_mlp else "wg",
+                                   cfg.d_ff)(x))
+        if cfg.gated_mlp:
+            # SwiGLU: the gate rides the same [B,L,ff] tile as wi's output,
+            # so XLA fuses the elementwise product into the matmul epilogue
+            h = h * dense("wi", cfg.d_ff)(x)
+        return dense("wo", cfg.d_model)(h)
 
 
 class MoEMLP(nn.Module):
@@ -416,9 +428,15 @@ class Transformer(nn.Module):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
                 x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x, decode)
         x = make_norm(cfg, "ln_f")(x)
+        if not cfg.tied_embeddings:
+            head = self.param("lm_head", nn.initializers.normal(0.02),
+                              (cfg.vocab_size, cfg.d_model), jnp.float32)
         if return_hidden:
+            # chunked large-vocab loss: pair with params["lm_head"] when
+            # untied, params["embedding"] when tied (ops.xent)
             return x.astype(jnp.float32)
-        logits = jnp.einsum("bld,vd->blv", x.astype(jnp.float32), embed)
+        head = embed if cfg.tied_embeddings else head
+        logits = jnp.einsum("bld,vd->blv", x.astype(jnp.float32), head)
         return logits
 
 
@@ -455,7 +473,7 @@ def logical_axis_rules_tree(params: Any) -> Any:
                         "kv")[:leaf_dims]
         if "/o/" in joined or "/wo/" in joined:
             return ("embed",)
-        if "/wi/" in joined:
+        if "/wi/" in joined or "/wg/" in joined:
             return ("mlp",)
         return tuple([None] * leaf_dims)  # norm biases etc: replicated
 
@@ -468,7 +486,7 @@ def logical_axis_rules_tree(params: Any) -> Any:
             base = bias_axes(joined, x, off, leaf_dims)
         elif "pos_embedding" in joined:
             base = (None, "embed")
-        elif "embedding" in joined:
+        elif "embedding" in joined or "lm_head" in joined:
             base = ("vocab", "embed")
         elif "/q/" in joined:
             base = ("embed", "heads", "kv")[:leaf_dims]
@@ -489,7 +507,7 @@ def logical_axis_rules_tree(params: Any) -> Any:
         # (single source of truth for 3-dim expert params). Dense MLP
         # kernels live at .../wi/kernel; MoE expert arrays are the leaf
         # .../moe/wi itself
-        elif "/wi/" in joined or joined.endswith("/wi"):
+        elif "/wi/" in joined or "/wg/" in joined or joined.endswith("/wi"):
             base = moe_logical_axes()["wi"] if leaf_dims == 3 \
                 else ("embed", "mlp")
         elif "/wo/" in joined or joined.endswith("/wo"):
