@@ -118,3 +118,30 @@ def test_role_key_helper():
     assert role_key("worker", "instances") == "tony.worker.instances"
     with pytest.raises(KeyError):
         role_key("worker", "nope")
+
+
+def test_config_reference_drift_lock():
+    """CONFIG.md must be the exact rendering of the key schema — the
+    rebuild's analog of TestTonyConfigurationFields locking
+    TonyConfigurationKeys <-> tony-default.xml (SURVEY.md section 4.3).
+    Regenerate with: python -m tony_tpu.config.docs > CONFIG.md"""
+    import pathlib
+
+    from tony_tpu.config.docs import render_config_reference
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    checked_in = (root / "CONFIG.md").read_text()
+    assert checked_in == render_config_reference(), (
+        "CONFIG.md is stale; regenerate with "
+        "`python -m tony_tpu.config.docs > CONFIG.md`")
+
+
+def test_config_reference_covers_every_key():
+    from tony_tpu.config import keys as K
+    from tony_tpu.config.docs import render_config_reference
+
+    text = render_config_reference()
+    for name in K.KEYS:
+        assert f"`{name}`" in text, name
+    for suffix in K.ROLE_SUFFIXES:
+        assert f"`{suffix}`" in text, suffix

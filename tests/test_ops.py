@@ -175,3 +175,34 @@ def test_transformer_hidden_plus_chunked_xent():
                                 tokens[:, 1:], chunk_size=16)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_chunked_xent_with_untied_lm_head():
+    """return_hidden + params["lm_head"] must reproduce the full-logits
+    loss for an untied (Llama-style) model — the documented pairing."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from tony_tpu.models import Transformer, TransformerConfig
+    from tony_tpu.ops import chunked_cross_entropy
+
+    cfg = TransformerConfig(
+        vocab_size=300, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32, attention_backend="reference",
+        gated_mlp=True, tied_embeddings=False)
+    model = Transformer(cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 300, (2, 16)))
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    labels = jnp.asarray(
+        np.random.default_rng(1).integers(0, 300, (2, 16)))
+
+    logits = model.apply(params, tokens)
+    onehot = jax.nn.one_hot(labels, 300)
+    full = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+    hidden = model.apply(params, tokens, return_hidden=True)
+    chunked = chunked_cross_entropy(
+        hidden, params["params"]["lm_head"], labels, chunk_size=128)
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(full),
+                               rtol=1e-5, atol=1e-5)

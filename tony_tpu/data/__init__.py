@@ -2,6 +2,7 @@ from tony_tpu.data.loader import DataLoader, device_prefetch
 from tony_tpu.data.sources import (
     ArraySource,
     JsonlSource,
+    PackedTokenSource,
     SyntheticImageSource,
     SyntheticTokenSource,
 )
@@ -11,6 +12,7 @@ __all__ = [
     "DataLoader",
     "device_prefetch",
     "JsonlSource",
+    "PackedTokenSource",
     "SyntheticImageSource",
     "SyntheticTokenSource",
 ]

@@ -128,3 +128,45 @@ class JsonlSource(Source):
             dtype = self.dtypes.get(k)
             out[k] = np.asarray(v, dtype=dtype) if dtype else np.asarray(v)
         return out
+
+
+class PackedTokenSource(Source):
+    """Flat binary token stream (np.memmap) sliced into fixed-length
+    windows — the standard packed-pretraining format (one giant .bin of
+    uint16/uint32 token ids, documents separated by an EOS id upstream).
+
+    Example i is tokens[i*stride : i*stride + seq_len + 1] split into
+    ``tokens`` (inputs) and ``labels`` (inputs shifted by one), so the
+    loader feeds next-token prediction directly. ``stride`` defaults to
+    ``seq_len`` (disjoint windows); smaller strides overlap.
+
+    memmap keeps the host working set at pages actually touched, so a
+    multi-hundred-GB corpus serves random access from every host without
+    loading; combined with the DataLoader's per-process strides each host
+    only ever pages in its own shard of the permutation.
+    """
+
+    def __init__(self, path: str, seq_len: int, dtype=np.uint16,
+                 stride: int | None = None):
+        self.path = str(path)
+        self.seq_len = seq_len
+        self.stride = seq_len if stride is None else stride
+        if self.stride <= 0:
+            raise ValueError(f"stride must be positive, got {self.stride}")
+        self._tokens = np.memmap(self.path, dtype=dtype, mode="r")
+        # +1: each window needs a trailing target for the shifted labels
+        n = (len(self._tokens) - self.seq_len - 1) // self.stride + 1
+        if len(self._tokens) < self.seq_len + 1:
+            raise ValueError(
+                f"{path}: {len(self._tokens)} tokens < seq_len+1 "
+                f"({self.seq_len + 1})")
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, idx: int) -> Mapping[str, np.ndarray]:
+        start = idx * self.stride
+        window = np.asarray(self._tokens[start:start + self.seq_len + 1],
+                            dtype=np.int32)
+        return {"tokens": window[:-1], "labels": window[1:]}

@@ -415,6 +415,9 @@ class Transformer(nn.Module):
         x = embed[tokens].astype(cfg.dtype)
         if cfg.positional == "learned":
             x = x + self._learned_positions(tokens.shape[1], decode)
+        if cfg.gated_mlp and cfg.moe_every:
+            raise ValueError("gated_mlp is not implemented for MoE expert "
+                             "FFNs; use moe_every with gated_mlp=False")
         if cfg.scan_layers:
             if cfg.moe_every:
                 raise ValueError("scan_layers needs uniform layers "
