@@ -36,10 +36,33 @@ def test_flash_attention_grad():
                                atol=5e-5, rtol=5e-5)
 
 
-def test_flash_attention_bad_block():
-    q = jnp.zeros((1, 100, 2, 16))
-    with pytest.raises(ValueError, match="divide"):
-        flash_attention(q, q, q, True, 64, 64)
+def test_flash_attention_block_fallback():
+    """Non-divisible seq lens fall back to the largest multiple-of-8
+    divisor block and still match the reference; lengths with no usable
+    divisor are a clear error (not a silent degenerate kernel)."""
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((1, 160, 2, 16)), jnp.float32)
+    out = flash_attention(q, q, q, True, 64, 64)  # falls back to block 40
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, q) / 4.0
+    s = jnp.where(jnp.tril(jnp.ones((160, 160), bool))[None, None], s, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+    # 100 has no mult-of-8 divisor <= 64: causal pads to 128 and slices
+    rng2 = np.random.default_rng(8)
+    q = jnp.asarray(rng2.standard_normal((1, 100, 2, 16)), jnp.float32)
+    out = flash_attention(q, q, q, True, 64, 64)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, q) / 4.0
+    s = jnp.where(jnp.tril(jnp.ones((100, 100), bool))[None, None], s, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+    # ... and its gradient flows through the pad/slice
+    grad = jax.grad(lambda q: jnp.sum(flash_attention(q, q, q, True, 64, 64)))(q)
+    assert grad.shape == q.shape and bool(jnp.all(jnp.isfinite(grad)))
+    # non-causal cannot pad safely: clear error
+    with pytest.raises(ValueError, match="non-causal"):
+        flash_attention(q, q, q, False, 64, 64)
 
 
 def test_rmsnorm_matches():

@@ -71,6 +71,15 @@ class TransformerConfig:
     # only (incompatible with moe_every, which alternates block types).
     scan_layers: bool = False
 
+    def __post_init__(self):
+        # invalid knob combinations fail at construction, not first apply
+        if self.gated_mlp and self.moe_every:
+            raise ValueError("gated_mlp is not implemented for MoE expert "
+                             "FFNs; use moe_every with gated_mlp=False")
+        if self.scan_layers and self.moe_every:
+            raise ValueError("scan_layers needs uniform layers "
+                             "(moe_every alternates block types)")
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
@@ -105,7 +114,9 @@ def _attention(cfg: TransformerConfig, q, k, v):
     if cfg.attention_backend == "pallas":
         from tony_tpu.ops.attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True,
+                               block_q=cfg.attention_block_size,
+                               block_k=cfg.attention_block_size)
     raise ValueError(f"unknown attention backend {cfg.attention_backend}")
 
 
@@ -415,13 +426,7 @@ class Transformer(nn.Module):
         x = embed[tokens].astype(cfg.dtype)
         if cfg.positional == "learned":
             x = x + self._learned_positions(tokens.shape[1], decode)
-        if cfg.gated_mlp and cfg.moe_every:
-            raise ValueError("gated_mlp is not implemented for MoE expert "
-                             "FFNs; use moe_every with gated_mlp=False")
         if cfg.scan_layers:
-            if cfg.moe_every:
-                raise ValueError("scan_layers needs uniform layers "
-                                 "(moe_every alternates block types)")
             x = self._scan_blocks(x, decode)
         else:
             block = Block
