@@ -169,3 +169,52 @@ def test_fit_eval_loop():
     evals = [h for h in result.history if "eval/loss" in h]
     assert len(evals) == 2  # 16 steps / eval_every=8
     assert evals[-1]["eval/loss"] < evals[0]["eval/loss"]
+
+
+def test_mixed_precision_bf16_compute_keeps_fp32_master():
+    mesh = data_parallel_mesh()
+
+    def apply_fn(p, batch):
+        pred = jnp.tanh(batch["x"] @ p["w1"]) @ p["w2"]
+        return jnp.mean((pred[:, 0] - batch["y"]) ** 2)
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 4)).astype(np.float32)
+    y = (x @ np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32))[:, 0]
+    batch = {
+        "x": jax.device_put(jnp.asarray(x), batch_sharding(mesh)),
+        "y": jax.device_put(jnp.asarray(y), batch_sharding(mesh)),
+    }
+    params = {"w1": jnp.ones((4, 8), jnp.float32) * 0.1,
+              "w2": jnp.ones((8, 1), jnp.float32) * 0.1}
+    trainer = Trainer(mesh=mesh, apply_fn=apply_fn,
+                      optimizer=optax.adam(0.05), donate=False,
+                      compute_dtype=jnp.bfloat16)
+    step_fn, state = trainer.build_step(trainer.init_state(params))
+    losses = []
+    for _ in range(20):
+        state, metrics = step_fn(state, batch)
+        losses.append(float(metrics["loss"]))
+    # master params and adam moments stay fp32
+    assert state.params["w1"].dtype == jnp.float32
+    for leaf in jax.tree.leaves(state.opt_state):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_mixed_precision_with_grad_accum():
+    mesh = data_parallel_mesh()
+
+    def apply_fn(p, batch):
+        return jnp.mean((batch["x"] @ p["w"])[:, 0] ** 2)
+
+    batch = {"x": jax.device_put(jnp.ones((16, 4)), batch_sharding(mesh))}
+    params = {"w": jnp.ones((4, 1), jnp.float32)}
+    trainer = Trainer(mesh=mesh, apply_fn=apply_fn,
+                      optimizer=optax.sgd(0.1), donate=False,
+                      accum_steps=4, compute_dtype=jnp.bfloat16)
+    step_fn, state = trainer.build_step(trainer.init_state(params))
+    state, metrics = step_fn(state, batch)
+    assert state.params["w"].dtype == jnp.float32
+    assert np.isfinite(float(metrics["loss"]))

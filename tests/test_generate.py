@@ -104,3 +104,53 @@ def test_generate_eos_freezes(tiny):
     first = int(generate(model, params, prompt, max_new_tokens=1)[0, 0])
     out = generate(model, params, prompt, max_new_tokens=5, eos_id=first)
     assert np.asarray(out)[0].tolist() == [first] * 5
+
+
+def test_generate_top_p_shapes_and_validity(tiny):
+    model, params = tiny
+    prompt = jnp.array([[1, 2, 3]], jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=5, temperature=0.8,
+                   top_p=0.9, rng=jax.random.PRNGKey(3))
+    assert out.shape == (1, 5)
+    assert ((out >= 0) & (out < 64)).all()
+
+
+def test_top_p_one_matches_plain_sampling():
+    # top_p=1.0 must be a no-op: identical draws to raw categorical sampling
+    from tony_tpu.models.generate import sample_logits
+
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 64))
+    rng = jax.random.PRNGKey(1)
+    a = sample_logits(logits, rng, 1.0, 0, 1.0)
+    b = jax.random.categorical(rng, logits, axis=-1)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_top_p_zero_degrades_to_top1():
+    # top_p<=0 must keep the argmax token, never sample uniform noise
+    from tony_tpu.models.generate import sample_logits
+
+    logits = jax.random.normal(jax.random.PRNGKey(2), (4, 64))
+    for seed in range(4):
+        tok = sample_logits(logits, jax.random.PRNGKey(seed), 1.0, 0, 0.0)
+        np.testing.assert_array_equal(
+            np.asarray(tok), np.asarray(jnp.argmax(logits, axis=-1)))
+
+
+def test_top_p_restricts_to_nucleus():
+    from tony_tpu.models.generate import sample_logits
+
+    # one dominant token (p ~ 0.97): nucleus at p=0.5 is just that token
+    logits = jnp.zeros((1, 16)).at[0, 7].set(5.0)
+    for seed in range(8):
+        tok = sample_logits(logits, jax.random.PRNGKey(seed), 1.0, 0, 0.5)
+        assert int(tok[0]) == 7
+
+
+def test_top_p_greedy_unaffected(tiny):
+    model, params = tiny
+    prompt = jnp.array([[1, 2, 3]], jnp.int32)
+    a = generate(model, params, prompt, max_new_tokens=4, temperature=0.0,
+                 top_p=0.3)
+    b = generate(model, params, prompt, max_new_tokens=4, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -32,25 +32,43 @@ def init_cache(model, params, batch_size: int, dtype=None) -> Any:
     return variables["cache"]
 
 
-def sample_logits(logits, rng, temperature, top_k: int):
-    """Greedy when temperature==0, else softmax sampling with an optional
-    top-k cut. ``temperature`` is a traced operand — changing it per call
-    (a serving loop sweeping 0.7, 0.8, ...) never recompiles; the greedy
-    case rides the same program via a where. ``top_k`` is static (it sets
-    the sort slice); changing it recompiles once per distinct value."""
+def sample_logits(logits, rng, temperature, top_k: int, top_p: float = 1.0):
+    """Greedy when temperature==0, else softmax sampling with optional
+    top-k and top-p (nucleus) cuts. ``temperature`` is a traced operand —
+    changing it per call (a serving loop sweeping 0.7, 0.8, ...) never
+    recompiles; the greedy case rides the same program via a where.
+    ``top_k`` and ``top_p`` are static: they change the compiled program
+    (top_k sets the sort slice; top_p=1.0 skips the nucleus sorts entirely
+    so the default decode hot path pays zero extra work), recompiling once
+    per distinct value."""
     scaled = logits / jnp.maximum(jnp.asarray(temperature, logits.dtype), 1e-6)
     if top_k > 0:
         kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
         scaled = jnp.where(scaled < kth, -1e30, scaled)
+    if top_p < 1.0:
+        # nucleus cut: drop tokens outside the smallest probability mass
+        # >= p. One descending sort + cumsum; a token survives if the mass
+        # strictly before it is < p; the top token always survives (so
+        # top_p<=0 degrades to top-1 sampling, not uniform noise).
+        order = jnp.argsort(-scaled, axis=-1)
+        sorted_probs = jax.nn.softmax(
+            jnp.take_along_axis(scaled, order, axis=-1).astype(jnp.float32),
+            axis=-1)
+        mass_before = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs
+        keep_sorted = (mass_before < top_p).at[..., 0].set(True)
+        # scatter the mask back to vocab order via the inverse permutation
+        keep = jnp.take_along_axis(
+            keep_sorted, jnp.argsort(order, axis=-1), axis=-1)
+        scaled = jnp.where(keep, scaled, -1e30)
     sampled = jax.random.categorical(rng, scaled, axis=-1)
     greedy = jnp.argmax(logits, axis=-1)
     return jnp.where(jnp.asarray(temperature) == 0.0, greedy, sampled)
 
 
 @functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
-                                             "top_k"))
+                                             "top_k", "top_p"))
 def generate(model, params, prompt, *, max_new_tokens: int,
-             temperature: float = 0.0, top_k: int = 0,
+             temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
              rng: jax.Array | None = None, eos_id: int = -1):
     """Generate max_new_tokens continuations of ``prompt`` [b, Lp].
 
@@ -73,7 +91,7 @@ def generate(model, params, prompt, *, max_new_tokens: int,
     logits, vars_ = model.apply({"params": params, "cache": cache}, prompt,
                                 decode=True, mutable=["cache"])
     rng, sub = jax.random.split(rng)
-    next_tok = sample_logits(logits[:, -1], sub, temperature, top_k)
+    next_tok = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
     done = next_tok == eos_id
 
     def step(carry, _):
@@ -82,7 +100,7 @@ def generate(model, params, prompt, *, max_new_tokens: int,
                                     tok[:, None], decode=True,
                                     mutable=["cache"])
         rng, sub = jax.random.split(rng)
-        nxt = sample_logits(logits[:, -1], sub, temperature, top_k)
+        nxt = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
         nxt = jnp.where(done, eos_id, nxt)
         done = done | (nxt == eos_id)
         return (vars_["cache"], nxt, rng, done), nxt

@@ -67,7 +67,8 @@ def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name):
 
 
 def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh: Mesh,
-                   n_microbatches: int, axis_name: str = PIPE):
+                   n_microbatches: int, axis_name: str = PIPE,
+                   remat: bool = False):
     """Run ``x`` through ``n_stages`` pipeline stages.
 
     stage_fn(params, x_mb) -> y_mb with y_mb.shape == x_mb.shape (uniform
@@ -75,6 +76,10 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh: Mesh,
     stacked_params: pytree whose leaves have leading dim n_stages (sharded
       along ``axis_name``).
     x: [batch, ...]; batch must divide by n_microbatches.
+    remat: rematerialize each stage call in the backward pass — activation
+      memory per device drops from O(schedule_len x stage_activations) to
+      O(schedule_len x microbatch) at the cost of one extra forward, the
+      standard trade for deep pipelines on HBM-bound TPUs.
     """
     n_stages = mesh.shape[axis_name]
     batch = x.shape[0]
@@ -83,6 +88,8 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh: Mesh,
     x_micro = x.reshape(n_microbatches, batch // n_microbatches, *x.shape[1:])
 
     params_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     fn = shard_map(
         functools.partial(_pipeline_local, stage_fn=stage_fn,
                           axis_name=axis_name),

@@ -56,6 +56,13 @@ class Trainer:
     optimizer: optax.GradientTransformation
     fsdp: bool = False
     donate: bool = True
+    # mixed precision: keep fp32 master params + optimizer state, run the
+    # forward/backward in `compute_dtype` (bf16 on TPU: MXU-native, halves
+    # activation HBM). The cast happens inside the differentiated function,
+    # so XLA fuses it into the first consumer of each param and autodiff
+    # casts gradients back to fp32 before the optimizer — no loss scaling
+    # needed on TPU since bf16 keeps fp32's exponent range.
+    compute_dtype: Any = None
     # gradient accumulation: the incoming batch's leading dim is split into
     # `accum_steps` microbatches scanned inside the jitted step (grads
     # averaged, ONE optimizer update) — the way to train at a global batch
@@ -89,9 +96,29 @@ class Trainer:
         b_sh = batch_sharding(self.mesh)
         accum = max(self.accum_steps, 1)
 
+        if self.compute_dtype is not None:
+            cdtype = self.compute_dtype
+
+            def to_compute(tree):
+                # batch floats must be cast too: one fp32 operand would
+                # promote every downstream op back to fp32 and silently
+                # undo the bf16 compute/activation savings
+                return jax.tree.map(
+                    lambda x: x.astype(cdtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                    tree)
+
+            def loss_fn(params, batch):
+                # fp32 loss: keeps the logged metric at full precision and
+                # matches the accum>1 path's f32 scan carry
+                return self.apply_fn(
+                    to_compute(params), to_compute(batch)).astype(jnp.float32)
+        else:
+            loss_fn = self.apply_fn
+
         def grads_of(params, batch):
             if accum == 1:
-                return jax.value_and_grad(self.apply_fn)(params, batch)
+                return jax.value_and_grad(loss_fn)(params, batch)
 
             def micro(x):
                 b = x.shape[0]
@@ -113,7 +140,7 @@ class Trainer:
 
             def body(carry, mb):
                 loss_sum, grad_sum = carry
-                loss, grads = jax.value_and_grad(self.apply_fn)(params, mb)
+                loss, grads = jax.value_and_grad(loss_fn)(params, mb)
                 return (loss_sum + loss,
                         jax.tree.map(jnp.add, grad_sum, grads)), None
 
