@@ -108,20 +108,46 @@ def from_hf_gpt2(model) -> tuple[Transformer, Any]:
     return Transformer(cfg), params
 
 
+def _effective_sliding_window(hf_config) -> int:
+    """Sliding-window size actually in force for this checkpoint.
+
+    Mistral: ``sliding_window`` (None = full attention). Qwen2 ships
+    ``sliding_window`` set but gated behind ``use_sliding_window`` (False
+    on the released checkpoints), so honor the gate when present.
+    """
+    win = getattr(hf_config, "sliding_window", None)
+    if not win:
+        return 0
+    if not getattr(hf_config, "use_sliding_window", True):
+        return 0
+    # Qwen2-style layer gating: HF windows only layers with
+    # layer_idx >= max_window_layers. A single global cfg.sliding_window
+    # can represent "all layers" (gate at 0) or "no layers" (gate past the
+    # stack); anything in between would silently diverge — reject.
+    gate = getattr(hf_config, "max_window_layers", 0) or 0
+    if gate >= hf_config.num_hidden_layers:
+        return 0
+    if gate > 0:
+        raise ValueError(
+            f"per-layer sliding-window gating (max_window_layers={gate} of "
+            f"{hf_config.num_hidden_layers}) is not supported; only "
+            "all-layers or no-layers windows import exactly")
+    return int(win)
+
+
 def llama_config(hf_config, **overrides) -> TransformerConfig:
-    """TransformerConfig matching a transformers LlamaConfig (any
-    RMSNorm + plain-RoPE + GQA + SwiGLU architecture; variants with
-    rope scaling, projection biases — e.g. Qwen2 — or sliding-window
-    attention are rejected rather than silently mis-imported)."""
+    """TransformerConfig matching a transformers LlamaConfig or close kin:
+    any RMSNorm + plain-RoPE + GQA + SwiGLU architecture, including
+    Mistral (sliding-window attention -> cfg.sliding_window) and Qwen2
+    (q/k/v projection biases -> cfg.qkv_bias). Variants with rope scaling
+    or full attention_bias/mlp_bias are rejected rather than silently
+    mis-imported."""
     if getattr(hf_config, "rope_scaling", None):
         raise ValueError("rope_scaling is not supported by the importer")
     if getattr(hf_config, "attention_bias", False) or \
             getattr(hf_config, "mlp_bias", False):
-        raise ValueError("biased Llama variants are not supported "
-                         "(use_bias is all-or-nothing here)")
-    if getattr(hf_config, "sliding_window", None):
-        raise ValueError("sliding_window attention is not supported; "
-                         "this model would silently diverge past the window")
+        raise ValueError("attention_bias/mlp_bias Llama variants are not "
+                         "supported (only Qwen2-style qkv biases are)")
     act = getattr(hf_config, "hidden_act", "silu")
     if act not in _HF_ACTIVATIONS:
         raise ValueError(f"unsupported hidden_act {act!r}")
@@ -138,6 +164,8 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
         norm="rms",
         positional="rope",
         use_bias=False,
+        qkv_bias=getattr(hf_config, "model_type", "") == "qwen2",
+        sliding_window=_effective_sliding_window(hf_config),
         activation=_HF_ACTIVATIONS[act],
         norm_eps=hf_config.rms_norm_eps,
         rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
@@ -167,9 +195,13 @@ def convert_llama_state_dict(state_dict: dict, cfg: TransformerConfig) -> Any:
             "self_attn.q_proj", "self_attn.k_proj", "self_attn.v_proj",
             "self_attn.o_proj", "mlp.gate_proj", "mlp.up_proj",
             "mlp.down_proj")}
-    # strictness: an unmapped tensor means this checkpoint is NOT plain
-    # Llama (e.g. Qwen2's hardcoded q/k/v biases) and the import would be
-    # silently wrong. inv_freq buffers (old transformers) carry no weights.
+        if cfg.qkv_bias:
+            consumed |= {f"layers.{i}.self_attn.{p}_proj.bias"
+                         for p in "qkv"}
+    # strictness: an unmapped tensor means this checkpoint is NOT the
+    # architecture the config claimed (e.g. stray projection biases when
+    # qkv_bias is off) and the import would be silently wrong. inv_freq
+    # buffers (old transformers) carry no weights.
     leftover = {k for k in sd
                 if k not in consumed and not k.endswith("inv_freq")}
     if leftover:
@@ -185,14 +217,22 @@ def convert_llama_state_dict(state_dict: dict, cfg: TransformerConfig) -> Any:
     for i in range(cfg.n_layers):
         pre = f"layers.{i}."
         proj = lambda name: _np(sd[pre + name + ".weight"]).T  # noqa: E731
+
+        def head_proj(name, heads):
+            leaf = {"kernel": proj(name).reshape(d, heads, dh)}
+            if cfg.qkv_bias:
+                leaf["bias"] = _np(
+                    sd[pre + name + ".bias"]).reshape(heads, dh)
+            return leaf
+
         params[f"block_{i}"] = {
             "ln1": {"scale": _np(sd[pre + "input_layernorm.weight"])},
             "ln2": {"scale": _np(
                 sd[pre + "post_attention_layernorm.weight"])},
             "attn": {
-                "q": {"kernel": proj("self_attn.q_proj").reshape(d, h, dh)},
-                "k": {"kernel": proj("self_attn.k_proj").reshape(d, kvh, dh)},
-                "v": {"kernel": proj("self_attn.v_proj").reshape(d, kvh, dh)},
+                "q": head_proj("self_attn.q_proj", h),
+                "k": head_proj("self_attn.k_proj", kvh),
+                "v": head_proj("self_attn.v_proj", kvh),
                 "o": {"kernel": proj("self_attn.o_proj").reshape(h, dh, d)},
             },
             "mlp": {

@@ -49,6 +49,13 @@ class TransformerConfig:
     norm: str = "rms"  # rms | layer
     positional: str = "rope"  # rope | learned
     use_bias: bool = False
+    # biases on the q/k/v projections ONLY (Qwen2 family: biased qkv, bias-
+    # free o/mlp). Independent of use_bias, which biases every dense.
+    qkv_bias: bool = False
+    # sliding-window attention (Mistral family): each query sees only the
+    # last `sliding_window` keys. 0 = full causal. Supported by the
+    # reference and blockwise backends and the KV-cache decode path.
+    sliding_window: int = 0
     activation: str = "gelu"  # gelu (erf) | gelu_tanh | silu
     norm_eps: float = 1e-6
     rope_theta: float = 10_000.0
@@ -95,11 +102,17 @@ class TransformerConfig:
 
 
 def _attention(cfg: TransformerConfig, q, k, v):
+    if cfg.sliding_window > 0 and cfg.attention_backend not in (
+            "reference", "blockwise"):
+        raise ValueError(
+            f"sliding_window is only implemented for the reference and "
+            f"blockwise backends, not {cfg.attention_backend!r}")
     if cfg.attention_backend == "reference":
-        return reference_attention(q, k, v, causal=True)
+        return reference_attention(q, k, v, causal=True,
+                                   window=cfg.sliding_window)
     if cfg.attention_backend == "blockwise":
         return blockwise_attention(q, k, v, block_size=cfg.attention_block_size,
-                                   causal=True)
+                                   causal=True, window=cfg.sliding_window)
     if cfg.attention_backend == "ring":
         if cfg.mesh is None:
             raise ValueError("ring attention needs cfg.mesh")
@@ -195,13 +208,14 @@ class Attention(nn.Module):
         b, l, _ = x.shape
         # logical sharding axes for these kernels come from path-name
         # matching in logical_axis_rules_tree, not from annotations here
-        dense = lambda name, feats: nn.DenseGeneral(  # noqa: E731
-            feats, axis=-1, use_bias=cfg.use_bias, dtype=cfg.dtype,
+        dense = lambda name, feats, bias: nn.DenseGeneral(  # noqa: E731
+            feats, axis=-1, use_bias=bias, dtype=cfg.dtype,
             param_dtype=jnp.float32, name=name,
             kernel_init=nn.initializers.normal(0.02))
-        q = dense("q", (cfg.n_heads, cfg.head_dim))(x)
-        k = dense("k", (cfg.kv_heads, cfg.head_dim))(x)
-        v = dense("v", (cfg.kv_heads, cfg.head_dim))(x)
+        qkv_bias = cfg.use_bias or cfg.qkv_bias
+        q = dense("q", (cfg.n_heads, cfg.head_dim), qkv_bias)(x)
+        k = dense("k", (cfg.kv_heads, cfg.head_dim), qkv_bias)(x)
+        v = dense("v", (cfg.kv_heads, cfg.head_dim), qkv_bias)(x)
         if decode:
             out = self._decode_attention(q, k, v)
         else:
@@ -268,7 +282,10 @@ class Attention(nn.Module):
         s = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
                        keys.astype(jnp.float32)) / jnp.sqrt(dh)
         kv_pos = jnp.arange(max_len)
-        visible = kv_pos[None, :] <= (cur + jnp.arange(l))[:, None]  # [l, max]
+        q_pos = (cur + jnp.arange(l))[:, None]
+        visible = kv_pos[None, :] <= q_pos  # [l, max]
+        if cfg.sliding_window > 0:
+            visible = visible & (q_pos - kv_pos[None, :] < cfg.sliding_window)
         s = jnp.where(visible[None, None, None, :, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum("bhgqk,bkhd->bqhgd", p, values.astype(jnp.float32))

@@ -104,10 +104,16 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = SEQ,
     return fn(q, k, v)
 
 
-def blockwise_attention(q, k, v, *, block_size: int = 512, causal: bool = True):
+def blockwise_attention(q, k, v, *, block_size: int = 512, causal: bool = True,
+                        window: int = 0):
     """Single-device memory-efficient attention: the same online-softmax
     accumulation over K/V chunks without the ring — the long-context path
-    when seq fits one device but the full [L, L] score matrix does not."""
+    when seq fits one device but the full [L, L] score matrix does not.
+
+    window > 0 restricts each query to the last ``window`` keys (sliding
+    window, HF Mistral semantics: key visible iff 0 <= q_pos - k_pos <
+    window); 0 means full causal/bidirectional.
+    """
     b, lq, h, d = q.shape
     lk = k.shape[1]
     block = min(block_size, lk)
@@ -132,6 +138,11 @@ def blockwise_attention(q, k, v, *, block_size: int = 512, causal: bool = True):
             mask = mask & (pos_q[:, None] >= pos_k[None, :])
         else:
             mask = jnp.broadcast_to(mask, (lq, block))
+        if window > 0:
+            # documented bound 0 <= q_pos - k_pos < window: the lower half
+            # must hold even under causal=False
+            delta = pos_q[:, None] - pos_k[None, :]
+            mask = mask & (delta >= 0) & (delta < window)
         m, l, o = _block_attn(q32, k_blk.astype(jnp.float32),
                               v_blk.astype(jnp.float32), m, l, o, mask)
         return (m, l, o), None
@@ -141,14 +152,18 @@ def blockwise_attention(q, k, v, *, block_size: int = 512, causal: bool = True):
     return out.astype(q.dtype)
 
 
-def reference_attention(q, k, v, *, causal: bool = True):
-    """O(L^2)-memory reference for tests."""
+def reference_attention(q, k, v, *, causal: bool = True, window: int = 0):
+    """O(L^2)-memory reference for tests. ``window`` as in
+    blockwise_attention (sliding window over the last ``window`` keys)."""
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    lq, lk = q.shape[1], k.shape[1]
+    pos_q, pos_k = jnp.arange(lq)[:, None], jnp.arange(lk)[None, :]
     if causal:
-        lq, lk = q.shape[1], k.shape[1]
-        mask = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        s = jnp.where((pos_q >= pos_k)[None, None], s, NEG_INF)
+    if window > 0:
+        visible = (pos_q >= pos_k) & (pos_q - pos_k < window)
+        s = jnp.where(visible[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
