@@ -54,7 +54,8 @@ class TransformerConfig:
     qkv_bias: bool = False
     # sliding-window attention (Mistral family): each query sees only the
     # last `sliding_window` keys. 0 = full causal. Supported by the
-    # reference and blockwise backends and the KV-cache decode path.
+    # reference and blockwise backends, the KV-cache decode path, and the
+    # pallas backend (banded kernel: O(L*window) compute and HBM traffic).
     sliding_window: int = 0
     activation: str = "gelu"  # gelu (erf) | gelu_tanh | silu
     norm_eps: float = 1e-6
@@ -103,10 +104,10 @@ class TransformerConfig:
 
 def _attention(cfg: TransformerConfig, q, k, v):
     if cfg.sliding_window > 0 and cfg.attention_backend not in (
-            "reference", "blockwise"):
+            "reference", "blockwise", "pallas"):
         raise ValueError(
-            f"sliding_window is only implemented for the reference and "
-            f"blockwise backends, not {cfg.attention_backend!r}")
+            f"sliding_window is only implemented for the reference, "
+            f"blockwise, and pallas backends, not {cfg.attention_backend!r}")
     if cfg.attention_backend == "reference":
         return reference_attention(q, k, v, causal=True,
                                    window=cfg.sliding_window)
@@ -129,7 +130,8 @@ def _attention(cfg: TransformerConfig, q, k, v):
 
         return flash_attention(q, k, v, causal=True,
                                block_q=cfg.attention_block_size,
-                               block_k=cfg.attention_block_size)
+                               block_k=cfg.attention_block_size,
+                               window=cfg.sliding_window)
     raise ValueError(f"unknown attention backend {cfg.attention_backend}")
 
 

@@ -33,21 +33,84 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _causal_mask(qi, ki, block_q, block_k):
+def _causal_mask(qi, ki, block_q, block_k, window: int = 0):
+    """Causal visibility for one (q block, kv block) tile; window > 0 also
+    hides keys further than ``window`` behind the query (sliding window,
+    key visible iff 0 <= q_pos - k_pos < window)."""
     pos_q = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     pos_k = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return pos_q >= pos_k
+    mask = pos_q >= pos_k
+    if window > 0:
+        mask = mask & (pos_q - pos_k < window)
+    return mask
+
+
+def _block_visible(qi, ki, block_q, block_k, window: int):
+    """Grid predicate: does this (q block, kv block) tile contain ANY
+    visible entry? Upper side: the tile's newest query must not precede
+    the tile's oldest key (causal). Lower side (window only): the tile's
+    oldest query must be nearer than ``window`` to the tile's newest key —
+    tiles wholly behind the window are skipped, making windowed compute
+    O(L*window) instead of O(L^2/2)."""
+    pred = ki * block_k <= qi * block_q + block_q - 1
+    if window > 0:
+        pred = pred & (qi * block_q - (ki * block_k + block_k - 1) < window)
+    return pred
+
+
+def _kv_band(window: int, block_q: int, block_k: int, nk: int) -> int:
+    """Grid width (in kv blocks) of the visible band for one q block under
+    a sliding window. The band [q_first - window + 1, q_last] spans at most
+    window + block_q - 1 keys, i.e. this many kv tiles (+1 for alignment
+    slack). Shrinking the GRID — not just @pl.when-skipping the body —
+    means invisible kv tiles are never DMA'd, so windowed attention is
+    O(L*window) in HBM traffic too, which is what actually pays on a
+    bandwidth-bound chip."""
+    if window <= 0:
+        return nk
+    return min(nk, (window + block_q - 2) // block_k + 2)
+
+
+def _banded_ki(qi, ki_local, nkb, block_q: int, block_k: int):
+    """Real kv block index for banded grids: the band ends at this q
+    block's diagonal tile; local index 0 is ``nkb - 1`` tiles before it
+    (clamped at 0 — early q blocks just re-scan the first tiles and rely
+    on the visibility predicate). With a full band (nkb == nk) this is the
+    identity, so the same formula serves the unwindowed causal path."""
+    diag = (qi * block_q + block_q - 1) // block_k
+    return jnp.maximum(diag - (nkb - 1), 0) + ki_local
+
+
+def _q_band(window: int, block_q: int, block_k: int, nq: int) -> int:
+    """Grid width (in q blocks) of the band of queries that can see one kv
+    block under a sliding window (the dK/dV mirror of _kv_band)."""
+    if window <= 0:
+        return nq
+    return min(nq, (window + block_k - 2) // block_q + 2)
+
+
+def _banded_qi(ki, qi_local, nqb, nq, block_q: int, block_k: int):
+    """Real q block index for the dK/dV banded grid: the band starts at
+    the first q tile that can see this kv block (its diagonal), clamped so
+    the band stays inside [0, nq)."""
+    first = (ki * block_k) // block_q
+    return jnp.minimum(first, nq - nqb) + qi_local
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                  *, causal: bool, block_q: int, block_k: int, scale: float):
+                  *, causal: bool, block_q: int, block_k: int, scale: float,
+                  window: int = 0):
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
+    ki_local = pl.program_id(2)
+    nk = pl.num_programs(2)  # band width (= all kv blocks when unwindowed)
+    if causal:
+        ki = _banded_ki(qi, ki_local, nk, block_q, block_k)
+    else:
+        ki = ki_local
 
-    @pl.when(ki == 0)
+    @pl.when(ki_local == 0)
     def _init():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
@@ -63,7 +126,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(qi, ki, block_q, block_k), s, NEG_INF)
+            s = jnp.where(_causal_mask(qi, ki, block_q, block_k, window),
+                          s, NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -75,14 +139,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             preferred_element_type=jnp.float32)
 
     if causal:
-        # skip kv blocks strictly above the diagonal
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        # skip kv blocks strictly above the diagonal or behind the window
+        @pl.when(_block_visible(qi, ki, block_q, block_k, window))
         def _run():
             _body()
     else:
         _body()
 
-    @pl.when(ki == nk - 1)
+    @pl.when(ki_local == nk - 1)
     def _finalize():
         l_safe = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
@@ -93,7 +157,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_scr, *, causal: bool, block_q: int,
-                         block_k: int, scale: float):
+                         block_k: int, scale: float, window: int = 0):
     """dQ: grid (bh, nq, nk); for each q block, scan kv blocks.
 
     FlashAttention-2 backward math with the normalized P recomputed from
@@ -101,10 +165,14 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dS = P * (dP - delta) * scale; dQ = sum_k dS K.
     """
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
+    ki_local = pl.program_id(2)
+    nk = pl.num_programs(2)  # band width
+    if causal:
+        ki = _banded_ki(qi, ki_local, nk, block_q, block_k)
+    else:
+        ki = ki_local
 
-    @pl.when(ki == 0)
+    @pl.when(ki_local == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
@@ -116,7 +184,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(qi, ki, block_q, block_k), s, NEG_INF)
+            s = jnp.where(_causal_mask(qi, ki, block_q, block_k, window),
+                          s, NEG_INF)
         p = jnp.exp(s - lse_ref[0])  # lse block: [block_q, 1], broadcasts
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -126,13 +195,13 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        @pl.when(_block_visible(qi, ki, block_q, block_k, window))
         def _run():
             _body()
     else:
         _body()
 
-    @pl.when(ki == nk - 1)
+    @pl.when(ki_local == nk - 1)
     def _finalize():
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
@@ -140,18 +209,24 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
                           block_q: int, block_k: int, scale: float,
-                          nq: int):
+                          nq: int, nqb: int, window: int = 0):
     """dK/dV: grid (b*kvh, nk, group*nq); for each KV-HEAD block, the
     innermost scan walks every q block of every q head in this kv group
     (step s: head g = s // nq, q block qi = s % nq), accumulating into one
     [block_k, d] scratch pair — so dK/dV are written at their true
     [b*kvh, lk, d] size with no group-factor HBM amplification.
 
-    dV = sum_{g,q} P^T dO; dK = sum_{g,q} dS^T Q (dS as in the dQ kernel)."""
+    dV = sum_{g,q} P^T dO; dK = sum_{g,q} dS^T Q (dS as in the dQ kernel).
+
+    ``nq`` is the TOTAL q-block count; ``nqb`` the banded width actually
+    walked per head (== nq when unwindowed)."""
     ki = pl.program_id(1)
     s_idx = pl.program_id(2)
     ns = pl.num_programs(2)
-    qi = s_idx % nq
+    if causal:
+        qi = _banded_qi(ki, s_idx % nqb, nqb, nq, block_q, block_k)
+    else:
+        qi = s_idx % nqb
 
     @pl.when(s_idx == 0)
     def _init():
@@ -166,7 +241,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(qi, ki, block_q, block_k), s, NEG_INF)
+            s = jnp.where(_causal_mask(qi, ki, block_q, block_k, window),
+                          s, NEG_INF)
         p = jnp.exp(s - lse_ref[0])  # [block_q, block_k]
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -179,8 +255,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        # q blocks whose last row is above this kv block see none of it
-        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        # q blocks whose last row is above this kv block, or whose first
+        # row is already past the window, see none of it
+        @pl.when(_block_visible(qi, ki, block_q, block_k, window))
         def _run():
             _body()
     else:
@@ -193,7 +270,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
+                   interpret: bool, window: int = 0):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     kvh = k.shape[2]
@@ -208,15 +285,22 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
     qr = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
     kr = k.transpose(0, 2, 1, 3).reshape(b * kvh, lk, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * kvh, lk, d)
-    grid = (b * h, lq // block_q, lk // block_k)
+    nk = lk // block_k
+    # windowed: the kv grid axis covers only the visible band per q block,
+    # so out-of-window kv tiles are never DMA'd (O(L*window) HBM traffic)
+    nkb = _kv_band(window, block_q, block_k, nk) if causal else nk
+    grid = (b * h, lq // block_q, nkb)
 
     def kv_index(bh, qi, ki):
         # GQA: q head -> its kv group's row; the same kv block is DMA'd for
         # each of the `group` q heads instead of materializing a repeat
-        return (bh // h) * kvh + (bh % h) // group, ki, 0
+        row = (bh // h) * kvh + (bh % h) // group
+        if causal:
+            return row, _banded_ki(qi, ki, nkb, block_q, block_k), 0
+        return row, ki, 0
 
     kernel = functools.partial(_flash_kernel, causal=causal, block_q=block_q,
-                               block_k=block_k, scale=scale)
+                               block_k=block_k, scale=scale, window=window)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
@@ -247,7 +331,7 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
 
 
 def _flash_backward(q, k, v, o, lse, g, *, causal: bool, block_q: int,
-                    block_k: int, interpret: bool):
+                    block_k: int, interpret: bool, window: int = 0):
     """Pallas dQ/dK/dV (FlashAttention-2 scheme).
 
     GQA: the kv BlockSpec indexes each q head's group row (as in the
@@ -268,17 +352,24 @@ def _flash_backward(q, k, v, o, lse, g, *, causal: bool, block_q: int,
                     * o.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
                     .astype(jnp.float32), axis=-1, keepdims=True)
 
+    nk = lk // block_k
+    nkb = _kv_band(window, block_q, block_k, nk) if causal else nk
+
     def kv_index_dq(bh, qi, ki):
-        return (bh // h) * kvh + (bh % h) // group, ki, 0
+        row = (bh // h) * kvh + (bh % h) // group
+        if causal:
+            return row, _banded_ki(qi, ki, nkb, block_q, block_k), 0
+        return row, ki, 0
 
     q_spec_dq = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
     row_spec_dq = pl.BlockSpec((1, block_q, 1),
                                lambda bh, qi, ki: (bh, qi, 0))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal,
-                          block_q=block_q, block_k=block_k, scale=scale),
+                          block_q=block_q, block_k=block_k, scale=scale,
+                          window=window),
         out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
-        grid=(b * h, lq // block_q, lk // block_k),
+        grid=(b * h, lq // block_q, nkb),
         in_specs=[
             q_spec_dq,
             pl.BlockSpec((1, block_k, d), kv_index_dq),
@@ -293,13 +384,18 @@ def _flash_backward(q, k, v, o, lse, g, *, causal: bool, block_q: int,
         interpret=interpret,
     )(qr, kr, vr, dor, lse, delta)
 
-    # dK/dV grid is per KV head: the innermost axis walks group*nq steps
-    # (all q blocks of all q heads in this group), so outputs are written
-    # at [b*kvh, lk, d] directly — no group-factor HBM amplification
+    # dK/dV grid is per KV head: the innermost axis walks group*nqb steps
+    # (the banded q blocks of all q heads in this group), so outputs are
+    # written at [b*kvh, lk, d] directly — no group-factor HBM
+    # amplification, and out-of-window q tiles are never DMA'd
     nq = lq // block_q
+    nqb = _q_band(window, block_q, block_k, nq) if causal else nq
 
     def q_row_dkv(bkv, ki, s):
-        return (bkv // kvh) * h + (bkv % kvh) * group + s // nq, s % nq, 0
+        row = (bkv // kvh) * h + (bkv % kvh) * group + s // nqb
+        if causal:
+            return row, _banded_qi(ki, s % nqb, nqb, nq, block_q, block_k), 0
+        return row, s % nqb, 0
 
     q_spec_dkv = pl.BlockSpec((1, block_q, d), q_row_dkv)
     row_spec_dkv = pl.BlockSpec((1, block_q, 1), q_row_dkv)
@@ -307,12 +403,12 @@ def _flash_backward(q, k, v, o, lse, g, *, causal: bool, block_q: int,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, causal=causal,
                           block_q=block_q, block_k=block_k, scale=scale,
-                          nq=nq),
+                          nq=nq, nqb=nqb, window=window),
         out_shape=[
             jax.ShapeDtypeStruct((b * kvh, lk, d), k.dtype),
             jax.ShapeDtypeStruct((b * kvh, lk, d), v.dtype),
         ],
-        grid=(b * kvh, lk // block_k, group * nq),
+        grid=(b * kvh, lk // block_k, group * nqb),
         in_specs=[
             q_spec_dkv,
             kv_spec_dkv,
@@ -379,28 +475,28 @@ def _blocks(block_q, block_k, q, k):
     return _pick_block(block_q, q.shape[1]), _pick_block(block_k, k.shape[1])
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_attention_core(q, k, v, causal: bool, block_q: int, block_k: int,
-                          interpret: bool | None):
+                          interpret: bool | None, window: int = 0):
     """custom_vjp core; sequence lengths must have a usable block."""
     if interpret is None:
         interpret = not _on_tpu()
     bq, bk = _blocks(block_q, block_k, q, k)
     out, _ = _flash_forward(q, k, v, causal=causal, block_q=bq, block_k=bk,
-                            interpret=interpret)
+                            interpret=interpret, window=window)
     return out
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, window=0):
     if interpret is None:
         interpret = not _on_tpu()
     bq, bk = _blocks(block_q, block_k, q, k)
     out, lse = _flash_forward(q, k, v, causal=causal, block_q=bq, block_k=bk,
-                              interpret=interpret)
+                              interpret=interpret, window=window)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, block_q, block_k, interpret, res, g):
+def _bwd(causal, block_q, block_k, interpret, window, res, g):
     """Pallas FlashAttention-2 backward: recomputes P blockwise from the
     saved logsumexp — O(L) memory, no [L, L] tensor, no K/V repeat."""
     q, k, v, o, lse = res
@@ -408,7 +504,7 @@ def _bwd(causal, block_q, block_k, interpret, res, g):
         interpret = not _on_tpu()
     bq, bk = _blocks(block_q, block_k, q, k)
     return _flash_backward(q, k, v, o, lse, g, causal=causal, block_q=bq,
-                           block_k=bk, interpret=interpret)
+                           block_k=bk, interpret=interpret, window=window)
 
 
 _flash_attention_core.defvjp(_fwd, _bwd)
@@ -426,10 +522,15 @@ def _padded_len(length: int, limit: int) -> int:
 
 
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
-                    block_k: int = 512, interpret: bool | None = None):
+                    block_k: int = 512, interpret: bool | None = None,
+                    window: int = 0):
     """Fused attention. q: [B, L, H, D]; k/v: [B, L, KVH, D] with
     H % KVH == 0 (GQA: the kernel indexes each q head's kv group directly —
     no repeated K/V is ever materialized). Returns [B, L, H, D].
+
+    window > 0 adds sliding-window masking (key visible iff
+    0 <= q_pos - k_pos < window, HF Mistral semantics; requires causal)
+    with block-level pruning, so compute scales O(L*window) not O(L^2).
 
     Awkward sequence lengths (e.g. the L-1 of a shifted LM batch) are
     zero-padded up to a blockable length and sliced back — safe for causal
@@ -440,11 +541,14 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
 
     interpret=None auto-selects: compiled on TPU, interpreter elsewhere.
     """
+    if window > 0 and not causal:
+        raise ValueError("window > 0 requires causal=True (the sliding "
+                         "window is defined over past keys)")
     lq, lk = q.shape[1], k.shape[1]
     plq, plk = _padded_len(lq, block_q), _padded_len(lk, block_k)
     if plq == lq and plk == lk:
         return _flash_attention_core(q, k, v, causal, block_q, block_k,
-                                     interpret)
+                                     interpret, window)
     if not causal:
         raise ValueError(
             f"non-causal flash attention needs blockable seq lens, got "
@@ -453,5 +557,5 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
     pad_k = [(0, 0), (0, plk - lk), (0, 0), (0, 0)]
     out = _flash_attention_core(
         jnp.pad(q, pad_q), jnp.pad(k, pad_k), jnp.pad(v, pad_k),
-        causal, block_q, block_k, interpret)
+        causal, block_q, block_k, interpret, window)
     return out[:, :lq]
