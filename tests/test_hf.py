@@ -206,12 +206,6 @@ def test_llama_importer_rejects_unsupported():
 
     config = transformers.LlamaConfig(
         vocab_size=32, hidden_size=16, intermediate_size=32,
-        num_hidden_layers=1, num_attention_heads=2,
-        rope_scaling={"rope_type": "linear", "factor": 2.0})
-    with pytest.raises(ValueError, match="rope_scaling"):
-        llama_config(config)
-    config = transformers.LlamaConfig(
-        vocab_size=32, hidden_size=16, intermediate_size=32,
         num_hidden_layers=1, num_attention_heads=2, attention_bias=True)
     with pytest.raises(ValueError, match="attention_bias"):
         llama_config(config)
@@ -391,3 +385,89 @@ def test_window_noncausal_enforces_lower_bound():
                                atol=1e-6, rtol=1e-6)
     np.testing.assert_allclose(np.asarray(blk_nc), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_llama3_rope_scaling_logits_parity():
+    """rope_scaling type=llama3 (the Llama-3.1 long-context recipe) must be
+    applied to the rotary frequencies exactly as HF does."""
+    from tony_tpu.models.hf import from_hf_llama
+
+    config = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=80,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        rope_theta=10_000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 16})
+    torch.manual_seed(2)
+    hf = transformers.LlamaForCausalLM(config).eval()
+    model, params = from_hf_llama(hf)
+    assert model.cfg.rope_scaling is not None
+    assert model.cfg.rope_scaling.kind == "llama3"
+    # long enough that positions land well past original_max/LF thresholds
+    tokens = np.random.default_rng(4).integers(0, 96, (2, 100))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_linear_rope_scaling_logits_parity():
+    from tony_tpu.models.hf import from_hf_llama
+
+    config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=True,
+        rope_scaling={"rope_type": "linear", "factor": 4.0})
+    torch.manual_seed(3)
+    hf = transformers.LlamaForCausalLM(config).eval()
+    model, params = from_hf_llama(hf)
+    assert model.cfg.rope_scaling.kind == "linear"
+    tokens = np.random.default_rng(5).integers(0, 64, (1, 50))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_rope_scaling_decode_parity():
+    """Scaled-RoPE decode must apply the same scaled frequencies at cached
+    positions."""
+    from tony_tpu.models.hf import from_hf_llama
+
+    config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=True,
+        rope_scaling={"rope_type": "llama3", "factor": 4.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 2.0,
+                      "original_max_position_embeddings": 8})
+    torch.manual_seed(4)
+    hf = transformers.LlamaForCausalLM(config).eval()
+    model, params = from_hf_llama(hf)
+    tokens = np.random.default_rng(6).integers(0, 64, (1, 20))
+    full = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    cache = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens),
+                       decode=True)["cache"]
+    steps = []
+    for i in range(tokens.shape[1]):
+        logits, mut = model.apply(
+            {"params": params["params"], "cache": cache},
+            jnp.asarray(tokens[:, i:i + 1]), decode=True, mutable=["cache"])
+        cache = mut["cache"]
+        steps.append(np.asarray(logits[:, 0]))
+    np.testing.assert_allclose(np.stack(steps, axis=1), full,
+                               atol=1e-3, rtol=1e-3)
+
+
+def test_exotic_rope_scaling_rejected():
+    from tony_tpu.models.hf import llama_config
+
+    config = transformers.LlamaConfig(
+        vocab_size=32, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2,
+        rope_scaling={"rope_type": "yarn", "factor": 2.0})
+    with pytest.raises(ValueError, match="rope_scaling"):
+        llama_config(config)

@@ -17,6 +17,7 @@ from tony_tpu.models.hf import (
 )
 from tony_tpu.models.transformer import (
     MoEMLP,
+    RopeScaling,
     Transformer,
     TransformerConfig,
     moe_aux_loss,
@@ -40,6 +41,7 @@ __all__ = [
     "ResNet50",
     "ResNet101",
     "ResNet152",
+    "RopeScaling",
     "Transformer",
     "TransformerConfig",
 ]

@@ -20,7 +20,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tony_tpu.models.transformer import Transformer, TransformerConfig
+from tony_tpu.models.transformer import (
+    RopeScaling,
+    Transformer,
+    TransformerConfig,
+)
 
 
 _HF_ACTIVATIONS = {"gelu_new": "gelu_tanh", "gelu_pytorch_tanh": "gelu_tanh",
@@ -135,15 +139,38 @@ def _effective_sliding_window(hf_config) -> int:
     return int(win)
 
 
+def _rope_scaling(hf_config) -> RopeScaling | None:
+    """HF rope_scaling dict -> RopeScaling (llama3 / linear), None when
+    absent or "default". Unknown kinds (yarn, dynamic, longrope) are
+    rejected — importing them as plain RoPE would silently corrupt
+    long-position attention."""
+    rs = getattr(hf_config, "rope_scaling", None)
+    if not rs:
+        return None
+    kind = rs.get("rope_type", rs.get("type", ""))
+    if kind == "default":
+        return None
+    if kind == "linear":
+        return RopeScaling(kind="linear", factor=float(rs["factor"]))
+    if kind == "llama3":
+        return RopeScaling(
+            kind="llama3",
+            factor=float(rs["factor"]),
+            low_freq_factor=float(rs["low_freq_factor"]),
+            high_freq_factor=float(rs["high_freq_factor"]),
+            original_max_len=int(rs["original_max_position_embeddings"]))
+    raise ValueError(f"unsupported rope_scaling type {kind!r} "
+                     "(supported: default, linear, llama3)")
+
+
 def llama_config(hf_config, **overrides) -> TransformerConfig:
     """TransformerConfig matching a transformers LlamaConfig or close kin:
-    any RMSNorm + plain-RoPE + GQA + SwiGLU architecture, including
-    Mistral (sliding-window attention -> cfg.sliding_window) and Qwen2
-    (q/k/v projection biases -> cfg.qkv_bias). Variants with rope scaling
-    or full attention_bias/mlp_bias are rejected rather than silently
-    mis-imported."""
-    if getattr(hf_config, "rope_scaling", None):
-        raise ValueError("rope_scaling is not supported by the importer")
+    any RMSNorm + RoPE + GQA + SwiGLU architecture, including Mistral
+    (sliding-window attention -> cfg.sliding_window), Qwen2 (q/k/v
+    projection biases -> cfg.qkv_bias), and Llama-3 long-context
+    checkpoints (rope_scaling llama3/linear -> cfg.rope_scaling).
+    Variants with full attention_bias/mlp_bias or exotic rope scaling are
+    rejected rather than silently mis-imported."""
     if getattr(hf_config, "attention_bias", False) or \
             getattr(hf_config, "mlp_bias", False):
         raise ValueError("attention_bias/mlp_bias Llama variants are not "
@@ -169,6 +196,7 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
         activation=_HF_ACTIVATIONS[act],
         norm_eps=hf_config.rms_norm_eps,
         rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
+        rope_scaling=_rope_scaling(hf_config),
         gated_mlp=True,
         tied_embeddings=getattr(hf_config, "tie_word_embeddings", False),
     )

@@ -60,6 +60,8 @@ class TransformerConfig:
     activation: str = "gelu"  # gelu (erf) | gelu_tanh | silu
     norm_eps: float = 1e-6
     rope_theta: float = 10_000.0
+    # long-context RoPE rescaling (Llama-3 family); None = plain RoPE
+    rope_scaling: Any = None  # RopeScaling | None
     # SwiGLU-style gated FFN (Llama family): wo(act(wg(x)) * wi(x));
     # False = classic 2-matmul MLP (GPT-2 family)
     gated_mlp: bool = False
@@ -187,12 +189,50 @@ def _activation(cfg: TransformerConfig):
     raise ValueError(f"unknown activation {cfg.activation}")
 
 
-def rotary_embedding(x, positions, theta: float = 10_000.0):
+@dataclass(frozen=True)
+class RopeScaling:
+    """Long-context RoPE frequency rescaling (hashable so configs stay
+    valid jit static args).
+
+    kind="linear": every frequency divided by ``factor`` (position
+    interpolation). kind="llama3": HF's Llama-3 rule — low-frequency
+    (long-wavelength) components are divided by ``factor``, high-frequency
+    ones kept, with a smooth ramp between the two wavelength thresholds
+    derived from ``low_freq_factor``/``high_freq_factor`` and the
+    pre-extension ``original_max_len``.
+    """
+
+    kind: str = "llama3"  # llama3 | linear
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_len: int = 8192
+
+    def apply(self, freq):
+        if self.kind == "linear":
+            return freq / self.factor
+        if self.kind != "llama3":
+            raise ValueError(f"unknown rope scaling kind {self.kind!r}")
+        two_pi = 2.0 * jnp.pi
+        wavelen = two_pi / freq
+        low_wl = self.original_max_len / self.low_freq_factor
+        high_wl = self.original_max_len / self.high_freq_factor
+        smooth = (self.original_max_len / wavelen - self.low_freq_factor) / (
+            self.high_freq_factor - self.low_freq_factor)
+        mid = (1.0 - smooth) * freq / self.factor + smooth * freq
+        scaled = jnp.where(wavelen > low_wl, freq / self.factor, mid)
+        return jnp.where(wavelen < high_wl, freq, scaled)
+
+
+def rotary_embedding(x, positions, theta: float = 10_000.0,
+                     scaling: RopeScaling | None = None):
     """RoPE over head_dim (TPU-friendly: pure elementwise, fuses away).
     Half-split rotation convention (matches HF Llama's rotate_half)."""
     d = x.shape[-1]
     half = d // 2
     freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    if scaling is not None:
+        freq = scaling.apply(freq)
     angles = positions[:, None].astype(jnp.float32) * freq[None, :]  # [L, half]
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
@@ -223,8 +263,10 @@ class Attention(nn.Module):
         else:
             if cfg.positional == "rope":
                 positions = jnp.arange(l)
-                q = rotary_embedding(q, positions, cfg.rope_theta)
-                k = rotary_embedding(k, positions, cfg.rope_theta)
+                q = rotary_embedding(q, positions, cfg.rope_theta,
+                                     cfg.rope_scaling)
+                k = rotary_embedding(k, positions, cfg.rope_theta,
+                                     cfg.rope_scaling)
             if cfg.kv_heads != cfg.n_heads and \
                     cfg.attention_backend != "pallas":
                 # GQA: broadcast K/V head groups up to n_heads for the
@@ -272,8 +314,10 @@ class Attention(nn.Module):
         cur = cache_index.value
         if cfg.positional == "rope":
             positions = cur + jnp.arange(l)
-            q = rotary_embedding(q, positions, cfg.rope_theta)
-            k = rotary_embedding(k, positions, cfg.rope_theta)
+            q = rotary_embedding(q, positions, cfg.rope_theta,
+                                 cfg.rope_scaling)
+            k = rotary_embedding(k, positions, cfg.rope_theta,
+                                 cfg.rope_scaling)
         keys = jax.lax.dynamic_update_slice(cached_k.value, k, (0, cur, 0, 0))
         values = jax.lax.dynamic_update_slice(cached_v.value, v, (0, cur, 0, 0))
         cached_k.value = keys
