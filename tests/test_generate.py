@@ -154,3 +154,28 @@ def test_top_p_greedy_unaffected(tiny):
                  top_p=0.3)
     b = generate(model, params, prompt, max_new_tokens=4, temperature=0.0)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sliding_window_decode_full_cache():
+    """Windowed decode's static slice must stay correct up to the last
+    cache slot (the clip at max_len - span engages)."""
+    from tony_tpu.models import Transformer, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=16,
+                            dtype=jnp.float32, attention_backend="reference",
+                            sliding_window=4)
+    model = Transformer(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 64)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    full = np.asarray(model.apply({"params": params}, tokens))
+    cache = model.init(jax.random.PRNGKey(0), tokens, decode=True)["cache"]
+    steps = []
+    variables = {"params": params, "cache": cache}
+    for i in range(16):
+        logits, mut = model.apply(variables, tokens[:, i:i + 1], decode=True,
+                                  mutable=["cache"])
+        variables = {"params": params, "cache": mut["cache"]}
+        steps.append(np.asarray(logits[:, 0]))
+    np.testing.assert_allclose(np.stack(steps, axis=1), full,
+                               atol=1e-4, rtol=1e-4)

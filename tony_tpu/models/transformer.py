@@ -61,7 +61,7 @@ class TransformerConfig:
     norm_eps: float = 1e-6
     rope_theta: float = 10_000.0
     # long-context RoPE rescaling (Llama-3 family); None = plain RoPE
-    rope_scaling: Any = None  # RopeScaling | None
+    rope_scaling: RopeScaling | None = None
     # SwiGLU-style gated FFN (Llama family): wo(act(wg(x)) * wi(x));
     # False = classic 2-matmul MLP (GPT-2 family)
     gated_mlp: bool = False
@@ -323,18 +323,35 @@ class Attention(nn.Module):
         cached_k.value = keys
         cached_v.value = values
         cache_index.value = cur + l
+        q_pos = (cur + jnp.arange(l))[:, None]
+        win = cfg.sliding_window
+        if win > 0 and win + l <= max_len:
+            # windowed decode: attend over a STATIC (window+l)-sized slice
+            # ending at the newest token instead of the whole max_len
+            # buffer — per-step attention work drops from O(max_len) to
+            # O(window), the same static-shape/no-recompile properties
+            # (dynamic_slice start is traced, its size is not)
+            span = win + l
+            start = jnp.clip(cur + l - span, 0, max_len - span)
+            keys_att = jax.lax.dynamic_slice(keys, (0, start, 0, 0),
+                                             (b, span, kvh, dh))
+            values_att = jax.lax.dynamic_slice(values, (0, start, 0, 0),
+                                               (b, span, kvh, dh))
+            kv_pos = start + jnp.arange(span)
+        else:
+            keys_att, values_att = keys, values
+            kv_pos = jnp.arange(max_len)
         # grouped attention: q [b, l, kvh, group, dh] against kv [b, m, kvh, dh]
         qg = q.astype(jnp.float32).reshape(b, l, kvh, group, dh)
         s = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
-                       keys.astype(jnp.float32)) / jnp.sqrt(dh)
-        kv_pos = jnp.arange(max_len)
-        q_pos = (cur + jnp.arange(l))[:, None]
-        visible = kv_pos[None, :] <= q_pos  # [l, max]
-        if cfg.sliding_window > 0:
-            visible = visible & (q_pos - kv_pos[None, :] < cfg.sliding_window)
+                       keys_att.astype(jnp.float32)) / jnp.sqrt(dh)
+        visible = kv_pos[None, :] <= q_pos  # [l, span]
+        if win > 0:
+            visible = visible & (q_pos - kv_pos[None, :] < win)
         s = jnp.where(visible[None, None, None, :, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhgqk,bkhd->bqhgd", p, values.astype(jnp.float32))
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", p,
+                         values_att.astype(jnp.float32))
         return out.reshape(b, l, h, dh).astype(q.dtype)
 
 
