@@ -12,6 +12,7 @@ them, so steps dispatch back-to-back and XLA pipelines them.
 
 from __future__ import annotations
 
+import functools
 import json
 import logging
 import os
@@ -20,6 +21,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable
 
 import jax
+import jax.numpy as jnp
+import optax
 
 from tony_tpu.train.checkpoint import CheckpointManager, job_checkpoint_dir
 from tony_tpu.train.trainer import Trainer, TrainState
@@ -33,6 +36,8 @@ class FitResult:
     steps_run: int
     resumed_from: int | None
     history: list[dict] = field(default_factory=list)
+    # exponential moving average of params (None unless fit(ema_decay=...))
+    ema_params: Any = None
 
 
 class JsonlMetricsLogger:
@@ -59,6 +64,7 @@ def fit(trainer: Trainer, params: Any, train_data: Iterable, *,
         eval_every: int = 0,
         log_every: int = 50,
         metric_sinks: list[Callable[[int, dict], None]] | None = None,
+        ema_decay: float = 0.0,
         ) -> FitResult:
     """Train until ``train_data`` is exhausted or ``num_steps`` is reached.
 
@@ -85,6 +91,14 @@ def fit(trainer: Trainer, params: Any, train_data: Iterable, *,
         fetch; between logs, steps dispatch without synchronizing).
       metric_sinks: callables (step, metrics-dict) — e.g.
         JsonlMetricsLogger — invoked at the log cadence and after eval.
+      ema_decay: > 0 maintains a device-resident exponential moving
+        average of params (ema = decay*ema + (1-decay)*params after every
+        step; typical 0.999), returned as FitResult.ema_params — the
+        standard eval/serving weights for vision and diffusion training.
+        The EMA lives alongside params with the same shardings and one
+        cheap fused elementwise update per step; it is NOT checkpointed —
+        a retry-resumed attempt restarts the average from the restored
+        params.
 
     Returns FitResult (final state, steps run, resume step, logged history).
     """
@@ -110,11 +124,27 @@ def fit(trainer: Trainer, params: Any, train_data: Iterable, *,
                 log.info("fit: resumed from checkpoint step %d", resumed_from)
     if placed is None:
         placed = jax.device_put(trainer.init_state(params), shardings)
+        if trainer.donate:
+            # device_put can alias buffers of the CALLER's params (no-op
+            # placement, or zero-copy on host platforms), and the first
+            # donated step would delete them out from under the caller —
+            # one transient copy at init keeps donation self-contained
+            placed = jax.tree.map(jnp.copy, placed)
     step_fn = trainer.compile_step(shardings)
 
     # compile the eval step once: shapes are static (drop_remainder
     # contract), and an uncompiled per-batch apply would run eager
     eval_step = jax.jit(eval_fn) if eval_fn else None
+
+    ema_params = None
+    ema_step = None
+    if ema_decay:
+        # deep copy, NOT a reference: step_fn donates its input state
+        # (Trainer.donate default), which would delete aliased buffers out
+        # from under the first EMA update
+        ema_params = jax.tree.map(jnp.copy, placed.params)
+        ema_step = jax.jit(functools.partial(
+            optax.incremental_update, step_size=1.0 - ema_decay))
 
     sinks = list(metric_sinks or [])
     history: list[dict] = []
@@ -152,6 +182,8 @@ def fit(trainer: Trainer, params: Any, train_data: Iterable, *,
             except StopIteration:
                 break
             placed, last_metrics = step_fn(placed, batch)
+            if ema_step is not None:
+                ema_params = ema_step(placed.params, ema_params)
             steps_run += 1
             step = start_step + steps_run
             if log_every and steps_run % log_every == 0:
@@ -182,7 +214,8 @@ def fit(trainer: Trainer, params: Any, train_data: Iterable, *,
         manager.wait()
         manager.close()
     return FitResult(state=placed, steps_run=steps_run,
-                     resumed_from=resumed_from, history=history)
+                     resumed_from=resumed_from, history=history,
+                     ema_params=ema_params)
 
 
 def _run_eval(eval_fn, params, eval_data) -> dict:
