@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))  # repo root, for standalone runs
@@ -33,10 +34,14 @@ def main() -> int:
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--vocab", type=int, default=512)
     p.add_argument("--moe", action="store_true", help="MoE FFN every 2nd block")
+    p.add_argument("--text", nargs="+", default=None, metavar="FILE",
+                   help="pretrain on these text files (byte-tokenized into "
+                        "a packed .bin) instead of synthetic tokens")
     args = p.parse_args()
 
     from tony_tpu import distributed
-    from tony_tpu.data import DataLoader, SyntheticTokenSource
+    from tony_tpu.data import (ByteTokenizer, DataLoader, PackedTokenSource,
+                               SyntheticTokenSource, encode_files_to_bin)
     from tony_tpu.models import Transformer, TransformerConfig, moe_aux_loss
     from tony_tpu.ops import chunked_cross_entropy
     from tony_tpu.parallel import data_parallel_mesh
@@ -45,6 +50,20 @@ def main() -> int:
 
     distributed.initialize()  # no-op outside a gang
     mesh = data_parallel_mesh()
+
+    tok = None
+    if args.text:
+        # raw text -> packed corpus: byte tokenizer keeps this offline
+        tok = ByteTokenizer()
+        args.vocab = tok.vocab_size
+        # job dir is per-job; standalone runs get a run-unique tempdir so
+        # concurrent runs on one host never clobber a live memmap
+        work = os.environ.get("TONY_JOB_DIR") or tempfile.mkdtemp(
+            prefix="lm-pretrain-")
+        corpus = os.path.join(work, f"corpus-{jax.process_index()}.bin")
+        n_tok = encode_files_to_bin(args.text, corpus, tok.encode,
+                                    eos_id=tok.eos_id)
+        print(f"tokenized {len(args.text)} file(s) -> {n_tok} tokens")
 
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=64, n_heads=4, n_kv_heads=2,
@@ -69,9 +88,12 @@ def main() -> int:
                                    batch["tokens"][:, 1:], chunk_size=256)
         return ce + aux
 
-    source = SyntheticTokenSource(
-        num_examples=args.global_batch * max(args.steps, 1),
-        seq_len=args.seq_len, vocab_size=args.vocab, seed=0)
+    if tok is not None:
+        source = PackedTokenSource(corpus, seq_len=args.seq_len)
+    else:
+        source = SyntheticTokenSource(
+            num_examples=args.global_batch * max(args.steps, 1),
+            seq_len=args.seq_len, vocab_size=args.vocab, seed=0)
     loader = DataLoader(source, global_batch_size=args.global_batch,
                         num_epochs=None, sharding=batch_sharding(mesh))
 

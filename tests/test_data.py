@@ -251,3 +251,74 @@ def test_packed_token_source_rejects_zero_stride(tmp_path):
     np.arange(100, dtype=np.uint16).tofile(tmp_path / "c.bin")
     with pytest.raises(ValueError, match="stride must be positive"):
         PackedTokenSource(str(tmp_path / "c.bin"), seq_len=16, stride=0)
+
+
+def test_byte_tokenizer_roundtrip():
+    from tony_tpu.data import ByteTokenizer
+
+    tok = ByteTokenizer()
+    s = "hello, TPU — héllo\n"
+    ids = tok.encode(s)
+    assert all(0 <= i < 256 for i in ids)
+    assert tok.decode(ids) == s
+    assert tok.decode(ids + [tok.eos_id]) == s  # eos stripped
+
+
+def test_encode_corpus_to_bin_feeds_packed_source(tmp_path):
+    from tony_tpu.data import (ByteTokenizer, PackedTokenSource,
+                               encode_corpus_to_bin)
+
+    tok = ByteTokenizer()
+    docs = ["first document", "second, longer document body",
+            "third " * 20]
+    out = str(tmp_path / "corpus.bin")
+    total = encode_corpus_to_bin(docs, out, tok.encode, eos_id=tok.eos_id)
+    expected = sum(len(tok.encode(d)) + 1 for d in docs)
+    assert total == expected
+    src = PackedTokenSource(out, seq_len=16)
+    ex = src[0]
+    assert ex["tokens"].shape == (16,) and ex["labels"].shape == (16,)
+    # windows are the shifted stream: labels[i] == tokens[i+1] within window
+    np.testing.assert_array_equal(ex["tokens"][1:], ex["labels"][:-1])
+    # eos separators present in the stream
+    flat = np.memmap(out, dtype=np.uint16, mode="r")
+    assert (np.asarray(flat) == tok.eos_id).sum() == len(docs)
+
+
+def test_encode_corpus_rejects_overflowing_dtype(tmp_path):
+    from tony_tpu.data import encode_corpus_to_bin
+
+    with pytest.raises(ValueError, match="out of range"):
+        encode_corpus_to_bin(["x"], str(tmp_path / "o.bin"),
+                             lambda s: [70_000], dtype=np.uint16)
+
+
+def test_encode_files_to_bin(tmp_path):
+    from tony_tpu.data import ByteTokenizer, encode_files_to_bin
+
+    tok = ByteTokenizer()
+    p1, p2 = tmp_path / "a.txt", tmp_path / "b.txt"
+    p1.write_text("aaa")
+    p2.write_text("bbbb")
+    out = str(tmp_path / "c.bin")
+    total = encode_files_to_bin([str(p1), str(p2)], out, tok.encode,
+                                eos_id=tok.eos_id)
+    assert total == 3 + 1 + 4 + 1
+    flat = np.fromfile(out, dtype=np.uint16)
+    assert flat.tolist() == tok.encode("aaa") + [256] + tok.encode("bbbb") + [256]
+
+
+def test_encode_files_streams_in_blocks(tmp_path):
+    """Block splitting at line boundaries must not change the token stream."""
+    from tony_tpu.data import ByteTokenizer, encode_files_to_bin
+
+    tok = ByteTokenizer()
+    text = "".join(f"line number {i}\n" for i in range(200))
+    p = tmp_path / "t.txt"
+    p.write_text(text)
+    out1, out2 = str(tmp_path / "big.bin"), str(tmp_path / "small.bin")
+    encode_files_to_bin([str(p)], out1, tok.encode, eos_id=tok.eos_id)
+    encode_files_to_bin([str(p)], out2, tok.encode, eos_id=tok.eos_id,
+                        block_bytes=64)  # forces many blocks
+    np.testing.assert_array_equal(np.fromfile(out1, np.uint16),
+                                  np.fromfile(out2, np.uint16))

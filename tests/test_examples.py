@@ -128,3 +128,21 @@ def test_tpu_pod_conf_selects_ssh_launcher():
         finally:
             coord.rpc.stop()
             coord.metrics_rpc.stop()
+
+
+def test_lm_pretrain_on_raw_text(tmp_path):
+    """--text: raw files -> byte-tokenized packed corpus -> fit, standalone
+    (no cluster; the data-prep path is what's under test)."""
+    import subprocess
+    import sys
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text("the quick brown fox jumps over the lazy dog. " * 100)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "lm-pretrain", "pretrain.py"),
+         "--steps", "4", "--global-batch", "8", "--seq-len", "32",
+         "--text", str(corpus)],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "tokenized 1 file(s)" in proc.stdout

@@ -6,11 +6,19 @@ from tony_tpu.data.sources import (
     SyntheticImageSource,
     SyntheticTokenSource,
 )
+from tony_tpu.data.tokenize import (
+    ByteTokenizer,
+    encode_corpus_to_bin,
+    encode_files_to_bin,
+)
 
 __all__ = [
     "ArraySource",
+    "ByteTokenizer",
     "DataLoader",
     "device_prefetch",
+    "encode_corpus_to_bin",
+    "encode_files_to_bin",
     "JsonlSource",
     "PackedTokenSource",
     "SyntheticImageSource",

@@ -127,9 +127,14 @@ def fit(trainer: Trainer, params: Any, train_data: Iterable, *,
         if trainer.donate:
             # device_put can alias buffers of the CALLER's params (no-op
             # placement, or zero-copy on host platforms), and the first
-            # donated step would delete them out from under the caller —
-            # one transient copy at init keeps donation self-contained
-            placed = jax.tree.map(jnp.copy, placed)
+            # donated step would delete them out from under the caller.
+            # Copy ONLY the params subtree: step/opt_state are freshly
+            # created inside init_state, and copying the whole state would
+            # transiently double opt-state memory (~2x params for Adam)
+            # exactly in the near-HBM-capacity regime donation targets.
+            placed = TrainState(step=placed.step,
+                                params=jax.tree.map(jnp.copy, placed.params),
+                                opt_state=placed.opt_state)
     step_fn = trainer.compile_step(shardings)
 
     # compile the eval step once: shapes are static (drop_remainder
