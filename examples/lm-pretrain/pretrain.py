@@ -57,9 +57,15 @@ def main() -> int:
         tok = ByteTokenizer()
         args.vocab = tok.vocab_size
         # job dir is per-job; standalone runs get a run-unique tempdir so
-        # concurrent runs on one host never clobber a live memmap
-        work = os.environ.get("TONY_JOB_DIR") or tempfile.mkdtemp(
-            prefix="lm-pretrain-")
+        # concurrent runs on one host never clobber a live memmap —
+        # removed at exit so repeated runs don't fill /tmp
+        work = os.environ.get("TONY_JOB_DIR")
+        if not work:
+            import atexit
+            import shutil
+
+            work = tempfile.mkdtemp(prefix="lm-pretrain-")
+            atexit.register(shutil.rmtree, work, ignore_errors=True)
         corpus = os.path.join(work, f"corpus-{jax.process_index()}.bin")
         n_tok = encode_files_to_bin(args.text, corpus, tok.encode,
                                     eos_id=tok.eos_id)

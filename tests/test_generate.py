@@ -179,3 +179,37 @@ def test_sliding_window_decode_full_cache():
         steps.append(np.asarray(logits[:, 0]))
     np.testing.assert_allclose(np.stack(steps, axis=1), full,
                                atol=1e-4, rtol=1e-4)
+
+
+def test_generate_cli_on_local_checkpoint(tmp_path):
+    """tony-tpu generate: local HF dir -> framework decode loop, offline."""
+    import subprocess
+    import sys
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(config).eval()
+    mdir = tmp_path / "ckpt"
+    hf.save_pretrained(str(mdir))
+    import os
+    proc = subprocess.run(
+        [sys.executable, "-m", "tony_tpu.cli.generate", "--model", str(mdir),
+         "--token-ids", "1,2,3", "--max-new-tokens", "4",
+         "--eos-id", "63"],  # out-of-path id: no early stop either side
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__)))})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    ids = [int(x) for x in proc.stdout.strip().split(",")]
+    assert ids[:3] == [1, 2, 3] and len(ids) == 7
+    # greedy must match HF generate on the same checkpoint
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor([[1, 2, 3]]), max_new_tokens=4,
+                          do_sample=False, pad_token_id=0, eos_token_id=63)
+    assert ids == ref[0].tolist()

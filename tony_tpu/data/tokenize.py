@@ -101,29 +101,37 @@ def encode_corpus_to_bin(
 def encode_files_to_bin(paths: Sequence[str], out_path: str,
                         encode: Callable[[str], Sequence[int]], *,
                         eos_id: int | None = None, dtype=np.uint16,
-                        block_bytes: int = 8 << 20) -> int:
+                        block_bytes: int | None = None) -> int:
     """Stream files into one packed .bin, EOS separator once per FILE.
 
-    Files are read in ~``block_bytes`` blocks split at LINE boundaries (a
-    subword tokenizer never sees a word cut mid-block; a single line
-    longer than block_bytes still passes through intact), so no whole file
-    is ever held in memory and multi-GB inputs stream.
+    By default each file is encoded in ONE ``encode`` call — lossless for
+    every tokenizer (BPE merges and per-call special tokens see the whole
+    document), at the cost of holding one file's text + ids in memory.
+
+    ``block_bytes`` opts into streaming for files too large for that:
+    ~block_bytes chunks split at LINE boundaries. Only use it with a
+    split-invariant ``encode`` (bytes/chars, or a subword tokenizer called
+    with special tokens off AND whose merges never span a newline) —
+    otherwise every block boundary perturbs the token stream.
     """
     os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
     with open(out_path, "wb") as f:
         w = _BinWriter(f, dtype, 1 << 20)
         for path in paths:
             with open(path, encoding="utf-8") as src:
-                block: list[str] = []
-                size = 0
-                for line in src:
-                    block.append(line)
-                    size += len(line)
-                    if size >= block_bytes:
+                if block_bytes is None:
+                    w.append(encode(src.read()))
+                else:
+                    block: list[str] = []
+                    size = 0
+                    for line in src:
+                        block.append(line)
+                        size += len(line)
+                        if size >= block_bytes:
+                            w.append(encode("".join(block)))
+                            block, size = [], 0
+                    if block:
                         w.append(encode("".join(block)))
-                        block, size = [], 0
-                if block:
-                    w.append(encode("".join(block)))
             if eos_id is not None:
                 w.append([eos_id])
         w.flush()

@@ -128,13 +128,33 @@ def fit(trainer: Trainer, params: Any, train_data: Iterable, *,
             # device_put can alias buffers of the CALLER's params (no-op
             # placement, or zero-copy on host platforms), and the first
             # donated step would delete them out from under the caller.
-            # Copy ONLY the params subtree: step/opt_state are freshly
-            # created inside init_state, and copying the whole state would
-            # transiently double opt-state memory (~2x params for Adam)
-            # exactly in the near-HBM-capacity regime donation targets.
-            placed = TrainState(step=placed.step,
-                                params=jax.tree.map(jnp.copy, placed.params),
-                                opt_state=placed.opt_state)
+            # That reaches opt_state too when an optimizer's init stores
+            # params references (lookahead-style slow weights), so detect
+            # aliasing by underlying buffer pointer and copy exactly the
+            # aliased leaves — fresh zeros_like opt leaves are never
+            # copied, keeping init peak memory flat in the near-HBM
+            # regime donation targets.
+            def ptrs(x):
+                try:
+                    return {s.data.unsafe_buffer_pointer()
+                            for s in x.addressable_shards}
+                except Exception:
+                    return None
+
+            caller_bufs: set = set()
+            for x in jax.tree.leaves(params):
+                p = ptrs(x)
+                if p:
+                    caller_bufs |= p
+
+            def fresh(x):
+                p = ptrs(x)
+                # unknown pointers -> copy to be safe
+                if p is None or p & caller_bufs:
+                    return jnp.copy(x)
+                return x
+
+            placed = jax.tree.map(fresh, placed)
     step_fn = trainer.compile_step(shardings)
 
     # compile the eval step once: shapes are static (drop_remainder
