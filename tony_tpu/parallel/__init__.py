@@ -17,7 +17,11 @@ from tony_tpu.parallel.ring_attention import (
     reference_attention,
     ring_attention,
 )
-from tony_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from tony_tpu.parallel.pipeline import (
+    interleave_stage_params,
+    pipeline_apply,
+    stack_stage_params,
+)
 from tony_tpu.parallel.ulysses import ulysses_attention
 from tony_tpu.parallel.moe import (
     MoEConfig,
@@ -41,6 +45,7 @@ __all__ = [
     "batch_sharding", "blockwise_attention", "data_parallel_mesh",
     "init_moe_params", "make_mesh", "moe_layer", "moe_logical_axes",
     "multislice_mesh", "num_slices",
+    "interleave_stage_params",
     "pipeline_apply", "reference_attention", "replicated", "ring_attention",
     "shard_params_by_size", "spec_for", "stack_stage_params",
     "top_k_gating", "tree_shardings", "ulysses_attention",

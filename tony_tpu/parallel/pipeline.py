@@ -1,12 +1,20 @@
-"""Pipeline parallelism: GPipe-style microbatch schedule over the ``pipe``
-mesh axis.
+"""Pipeline parallelism over the ``pipe`` mesh axis.
 
 Absent from the reference (its TaskScheduler DAG sequences *jobs*, not
-micro-batches — SURVEY.md section 2.4). Here each pipe-axis device holds
-one stage's parameters (stacked along a leading "layers" dim sharded on
-``pipe``); activations flow stage-to-stage via ``lax.ppermute`` inside a
-``lax.scan`` bubble schedule. Differentiable; jit-compatible (static
-schedule length n_micro + n_stages - 1).
+micro-batches — SURVEY.md section 2.4). Two schedules:
+
+- GPipe (default): each pipe-axis device holds one stage's parameters
+  (stacked along a leading "layers" dim sharded on ``pipe``); activations
+  flow stage-to-stage via ``lax.ppermute`` inside a ``lax.scan`` bubble
+  schedule. Bubble: (n_stages - 1) ticks of one stage's work per tick.
+- Interleaved/circular (``circular_repeats=R > 1``, the Megatron-style
+  schedule): n_stages * R virtual stages round-robin over the same ring
+  (device d holds virtual stages {r*n + d}), microbatches injected in
+  groups of n. Same per-device parameter count as stacking R layers into
+  one GPipe stage, but the bubble stays (n - 1) ticks of ONE virtual
+  stage's work — R times smaller.
+
+Both are differentiable and jit-compatible (static schedule lengths).
 """
 
 from __future__ import annotations
@@ -66,9 +74,92 @@ def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name):
     return lax.psum(out_buf * mask, axis_name)
 
 
+def _circular_local(stage_params, x_micro, *, stage_fn, axis_name,
+                    n_stages: int, repeats: int, n_micro: int):
+    """Interleaved schedule body under shard_map.
+
+    stage_params: this device's [R, ...] virtual-stage params (device-major
+      interleaving done by the caller: local rep r = virtual stage r*n + d).
+    x_micro: [n_micro, mb, ...] microbatched input (replicated).
+
+    Schedule: microbatch m enters virtual stage v at tick
+      t(m, v) = (m // n) * n * R + (m % n) + v
+    (conflict-free: each device runs at most one stage_fn per tick), so a
+    microbatch advances one virtual stage — one ring hop — every tick, and
+    injections pause between groups while earlier microbatches loop around
+    the ring. Total ticks: t(n_micro-1, V-1) + 1.
+    """
+    d = lax.axis_index(axis_name)
+    V = n_stages * repeats
+    total = ((n_micro - 1) // n_stages) * n_stages * repeats \
+        + ((n_micro - 1) % n_stages) + V
+    out_buf = jnp.zeros_like(x_micro)
+    # carry slot per device: activation + its virtual stage v + microbatch m
+    act0 = jnp.zeros_like(x_micro[0])
+    state0 = (act0, jnp.int32(-1), jnp.int32(0), out_buf)
+
+    def step(state, t):
+        act, v, m, out_buf = state
+        # device 0 injection: tick t carries microbatch m_cand iff the
+        # in-group offset (t mod n*R) is < n
+        tmod = t % (n_stages * repeats)
+        m_cand = (t // (n_stages * repeats)) * n_stages + tmod
+        inject = (d == 0) & (tmod < n_stages) & (m_cand < n_micro)
+        act = jnp.where(inject, x_micro[jnp.clip(m_cand, 0, n_micro - 1)],
+                        act)
+        v = jnp.where(inject, 0, v)
+        m = jnp.where(inject, m_cand, m)
+
+        active = (v >= 0) & (v < V)
+        rep = jnp.clip(v // n_stages, 0, repeats - 1)
+        params_r = jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(p, rep, 0, keepdims=False),
+            stage_params)
+
+        def run(operand):
+            p, a = operand
+            return stage_fn(p, a)
+
+        y = lax.cond(active, run, lambda operand: operand[1],
+                     (params_r, act))
+        # last virtual stage (necessarily device n-1) emits the microbatch
+        done = active & (v == V - 1)
+        out_buf = lax.cond(
+            done,
+            lambda b: lax.dynamic_update_index_in_dim(
+                b, y, jnp.clip(m, 0, n_micro - 1), 0),
+            lambda b: b,
+            out_buf,
+        )
+        v_next = jnp.where(active & ~done, v + 1, -1)
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+        act = lax.ppermute(y, axis_name, perm)
+        v_next = lax.ppermute(v_next, axis_name, perm)
+        m = lax.ppermute(m, axis_name, perm)
+        return (act, v_next, m, out_buf), None
+
+    (_, _, _, out_buf), _ = lax.scan(step, state0, jnp.arange(total))
+    # each finished microbatch was written on device n-1 only
+    mask = (d == n_stages - 1).astype(out_buf.dtype)
+    return lax.psum(out_buf * mask, axis_name)
+
+
+def interleave_stage_params(stacked_params, n_stages: int, repeats: int):
+    """Pipeline-order [V, ...] stack -> device-major order for the
+    interleaved schedule (device d's contiguous rows become its virtual
+    stages [r*n + d]). Do this ONCE at setup and pass
+    ``interleaved=True``: the permutation is a cross-device reshuffle of
+    every parameter when the stack is pipe-sharded, not something to pay
+    per training step."""
+    perm = jnp.asarray([r * n_stages + d for d in range(n_stages)
+                        for r in range(repeats)])
+    return jax.tree.map(lambda p: p[perm], stacked_params)
+
+
 def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh: Mesh,
                    n_microbatches: int, axis_name: str = PIPE,
-                   remat: bool = False):
+                   remat: bool = False, circular_repeats: int = 1,
+                   interleaved: bool = False):
     """Run ``x`` through ``n_stages`` pipeline stages.
 
     stage_fn(params, x_mb) -> y_mb with y_mb.shape == x_mb.shape (uniform
@@ -80,16 +171,53 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh: Mesh,
       memory per device drops from O(schedule_len x stage_activations) to
       O(schedule_len x microbatch) at the cost of one extra forward, the
       standard trade for deep pipelines on HBM-bound TPUs.
+    circular_repeats: R > 1 selects the interleaved (Megatron-style)
+      schedule: stacked_params' leading dim must be n_stages * R virtual
+      stages in PIPELINE ORDER (stage v runs on device v % n_stages);
+      bubble shrinks from (n-1) R-deep ticks to (n-1) 1-deep ticks.
+    interleaved: the circular stacked_params are ALREADY device-major
+      (pre-permuted once at setup by ``interleave_stage_params``). Without
+      it, pipeline_apply permutes per call — a full cross-device reshuffle
+      of the parameters every step when the stack lives pipe-sharded, so
+      training loops should pre-interleave.
     """
     n_stages = mesh.shape[axis_name]
+    if circular_repeats < 1:
+        raise ValueError(f"circular_repeats must be >= 1, "
+                         f"got {circular_repeats}")
+    lead = jax.tree.leaves(stacked_params)[0].shape[0]
+    if lead != n_stages * circular_repeats:
+        raise ValueError(
+            f"{n_stages} pipe devices x circular_repeats={circular_repeats} "
+            f"needs {n_stages * circular_repeats} stacked virtual stages, "
+            f"got leading dim {lead}")
     batch = x.shape[0]
     if batch % n_microbatches:
         raise ValueError(f"batch {batch} % n_microbatches {n_microbatches} != 0")
     x_micro = x.reshape(n_microbatches, batch // n_microbatches, *x.shape[1:])
 
-    params_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
+
+    if circular_repeats > 1:
+        R = circular_repeats
+        if not interleaved:
+            stacked_params = interleave_stage_params(
+                stacked_params, n_stages, R)
+        params_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+        fn = shard_map(
+            functools.partial(_circular_local, stage_fn=stage_fn,
+                              axis_name=axis_name, n_stages=n_stages,
+                              repeats=R, n_micro=n_microbatches),
+            mesh=mesh,
+            in_specs=(params_specs, P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        out = fn(stacked_params, x_micro)
+        return out.reshape(batch, *x.shape[1:])
+
+    params_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
     fn = shard_map(
         functools.partial(_pipeline_local, stage_fn=stage_fn,
                           axis_name=axis_name),
