@@ -7,6 +7,7 @@ from tony_tpu.models.resnet import (
     ResNet152,
 )
 from tony_tpu.models.generate import generate, init_cache, sample_logits
+from tony_tpu.models.pipeline import pipelined_forward
 from tony_tpu.models.hf import (
     convert_gpt2_state_dict,
     convert_llama_state_dict,
@@ -33,6 +34,7 @@ __all__ = [
     "llama_config",
     "moe_aux_loss",
     "generate",
+    "pipelined_forward",
     "init_cache",
     "sample_logits",
     "ResNet",

@@ -1,0 +1,73 @@
+"""Pipeline-parallel forward for the flagship transformer.
+
+Connects the model's ``scan_layers`` stacked-block parameters (leading
+"layers" dim, one slice per block — transformer.py:_scan_blocks) to the
+``parallel.pipeline`` schedules: shard that dim over the ``pipe`` mesh
+axis and each pipe device runs its blocks, with activations flowing
+device-to-device per microbatch. GPipe or interleaved/circular
+(``circular_repeats``) — see parallel/pipeline.py for the schedules.
+
+Embedding + final norm + head stay outside the pipeline (they are the
+first/last stages' work in practice; here they run replicated, which is
+exact and keeps this helper schedule-agnostic).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from tony_tpu.models.transformer import (
+    Block,
+    Transformer,
+    make_norm,
+)
+from tony_tpu.parallel.mesh import PIPE
+from tony_tpu.parallel.pipeline import pipeline_apply
+
+
+def pipelined_forward(model: Transformer, params, tokens, *, mesh: Mesh,
+                      n_microbatches: int, axis_name: str = PIPE,
+                      circular_repeats: int = 1, interleaved: bool = False,
+                      remat: bool = False, return_hidden: bool = False):
+    """Forward pass with the block stack pipelined over ``axis_name``.
+
+    model.cfg must have ``scan_layers=True`` (stacked block params) and
+    ``n_layers == mesh.shape[axis_name] * circular_repeats`` (one virtual
+    stage per block). ``params`` is the model's variables dict or its
+    "params" subtree. Matches ``model.apply`` exactly (same params, same
+    math; the pipeline only reorders WHERE each block runs).
+    """
+    cfg = model.cfg
+    if not cfg.scan_layers:
+        raise ValueError("pipelined_forward needs cfg.scan_layers=True "
+                         "(stacked per-layer params)")
+    p = params.get("params", params)
+    n_stages = mesh.shape[axis_name]
+    if cfg.n_layers != n_stages * circular_repeats:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} must equal pipe axis {n_stages} x "
+            f"circular_repeats {circular_repeats}")
+
+    embed = p["embedding"]
+    x = jnp.asarray(embed)[tokens].astype(cfg.dtype)
+    if cfg.positional == "learned":
+        x = x + jnp.asarray(p["pos_embedding"])[
+            jnp.arange(tokens.shape[1])][None].astype(cfg.dtype)
+
+    block = Block(cfg)
+
+    def stage_fn(block_params, h):
+        return block.apply({"params": block_params}, h)
+
+    x = pipeline_apply(stage_fn, p["layers"]["block"], x, mesh=mesh,
+                       n_microbatches=n_microbatches, axis_name=axis_name,
+                       remat=remat, circular_repeats=circular_repeats,
+                       interleaved=interleaved)
+
+    x = make_norm(cfg, "ln_f").apply({"params": p["ln_f"]}, x)
+    if return_hidden:
+        return x.astype(jnp.float32)
+    head = embed if cfg.tied_embeddings else p["lm_head"]
+    return jnp.einsum("bld,vd->blv", x.astype(jnp.float32),
+                      jnp.asarray(head))

@@ -200,27 +200,20 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh: Mesh,
         stage_fn = jax.checkpoint(stage_fn)
 
     if circular_repeats > 1:
-        R = circular_repeats
         if not interleaved:
             stacked_params = interleave_stage_params(
-                stacked_params, n_stages, R)
-        params_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
-        fn = shard_map(
-            functools.partial(_circular_local, stage_fn=stage_fn,
-                              axis_name=axis_name, n_stages=n_stages,
-                              repeats=R, n_micro=n_microbatches),
-            mesh=mesh,
-            in_specs=(params_specs, P()),
-            out_specs=P(),
-            check_vma=False,
-        )
-        out = fn(stacked_params, x_micro)
-        return out.reshape(batch, *x.shape[1:])
+                stacked_params, n_stages, circular_repeats)
+        local = functools.partial(
+            _circular_local, stage_fn=stage_fn, axis_name=axis_name,
+            n_stages=n_stages, repeats=circular_repeats,
+            n_micro=n_microbatches)
+    else:
+        local = functools.partial(_pipeline_local, stage_fn=stage_fn,
+                                  axis_name=axis_name)
 
     params_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
     fn = shard_map(
-        functools.partial(_pipeline_local, stage_fn=stage_fn,
-                          axis_name=axis_name),
+        local,
         mesh=mesh,
         in_specs=(params_specs, P()),
         out_specs=P(),
