@@ -400,3 +400,38 @@ def test_history_written(cluster):
     assert "TASK_STARTED" in types
     assert "TASK_FINISHED" in types
     assert types[-1] == "APPLICATION_FINISHED"
+
+
+def test_coordinator_hard_crash_respawned(cluster, monkeypatch):
+    """Ref: TEST_AM_CRASH + YARN AM restart (testAMCrash :241): the
+    coordinator process hard-exits; the client respawns it (the AM-attempt
+    analog) and the job completes."""
+    monkeypatch.setenv(C.TEST_COORD_CRASH, "1")
+    conf = script_conf(cluster, script("exit_0.py"), {"worker": 1})
+    conf.set("tony.client.coordinator-max-attempts", 2)
+    # shrink the respawn fence (liveness horizon + grace) for test speed
+    conf.set("tony.task.heartbeat-interval-ms", 100)
+    conf.set("tony.task.max-missed-heartbeats", 3)
+    conf.set("tony.task.preemption-grace-ms", 300)
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+
+
+def test_coordinator_hard_crash_without_respawn_fails(cluster, monkeypatch):
+    monkeypatch.setenv(C.TEST_COORD_CRASH, "1")
+    conf = script_conf(cluster, script("exit_0.py"), {"worker": 1})
+    ok, client = run_job(cluster, conf)
+    assert not ok
+    assert "coordinator" in str(client.final_status.get("reason", ""))
+
+
+def test_registration_timeout_fails_job(cluster, monkeypatch):
+    """Ref: registrationTimeout (:1309-1329): a launched task that never
+    registers within tony.coordinator.registration-timeout-ms fails the
+    app with a clear reason."""
+    monkeypatch.setenv(C.TEST_TASK_SKEW, "worker#0#15000")  # stalls pre-reg
+    conf = script_conf(cluster, script("exit_0.py"), {"worker": 1})
+    conf.set("tony.coordinator.registration-timeout-ms", 1500)
+    ok, client = run_job(cluster, conf)
+    assert not ok
+    assert "register" in str(client.final_status.get("reason", ""))

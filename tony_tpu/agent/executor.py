@@ -37,13 +37,18 @@ class Heartbeater(threading.Thread):
     MAX_SEND_FAILURES = 5
 
     def __init__(self, client: RpcClient, task_id: str, interval_ms: int,
-                 workdir: str | None = None):
+                 workdir: str | None = None, on_lost=None,
+                 lost_after_s: float | None = None):
         super().__init__(name="heartbeater", daemon=True)
         self.client = client
         self.task_id = task_id
         self.interval_s = max(interval_ms, 50) / 1000
         self.misses_to_skip = int(os.environ.get(C.TEST_TASK_NUM_HB_MISS, "0"))
         self.workdir = workdir
+        self.on_lost = on_lost
+        # keep pinging through failures until this much time has passed
+        # (the coordinator-side expiry horizon); only then declare it lost
+        self.lost_after_s = lost_after_s
         self._stop = threading.Event()
 
     def _handle_commands(self, response) -> None:
@@ -88,7 +93,15 @@ class Heartbeater(threading.Thread):
                 failures += 1
                 log.warning("heartbeat send failure %d/%d", failures,
                             self.MAX_SEND_FAILURES)
-                if failures >= self.MAX_SEND_FAILURES:
+                if self.on_lost is not None and self.lost_after_s:
+                    # keep pinging through the outage; only past the
+                    # coordinator's own expiry horizon is it truly gone
+                    if failures * self.interval_s >= self.lost_after_s:
+                        log.error("coordinator lost (unreachable for "
+                                  "%.0fs)", failures * self.interval_s)
+                        self.on_lost()
+                        return
+                elif failures >= self.MAX_SEND_FAILURES:
                     log.error("too many heartbeat failures; giving up")
                     return
 
@@ -201,10 +214,34 @@ class TaskAgent:
         if self.adapter.need_reserve_tb_port(self.role, self.is_chief, self.conf):
             tb = reserve_port(reuse=reuse)
 
+        def coordinator_lost():
+            # the gang's brain is gone: a replacement coordinator will
+            # relaunch this task, so finish the orphan instead of leaving
+            # two generations of user processes running side by side
+            from tony_tpu.utils.shell import request_graceful_shutdown
+
+            grace = self.conf.get_int("tony.task.preemption-grace-ms", 15_000)
+            log.error("coordinator unreachable; shutting down task (grace "
+                      "%d ms)", grace)
+            request_graceful_shutdown(grace)
+            # the SIGKILL backstop runs on a daemon thread — exiting now
+            # would kill it and orphan a SIGTERM-ignoring user process on
+            # the chip; outlive the grace window before dying
+            time.sleep(grace / 1000 + 2)
+            os._exit(1)
+
+        hb_interval_ms = self.conf.get_int("tony.task.heartbeat-interval-ms",
+                                           1000)
+        # only kill the task once the coordinator's OWN liveness horizon
+        # has passed (interval x max(3, max-missed)): a shorter fuse would
+        # hard-fail healthy jobs on a transient ~5 s RPC blip the
+        # coordinator itself tolerates
+        horizon_s = hb_interval_ms * max(
+            3, self.conf.get_int("tony.task.max-missed-heartbeats", 25)) / 1000
         hb = Heartbeater(
-            self.client, self.task_id,
-            self.conf.get_int("tony.task.heartbeat-interval-ms", 1000),
-            workdir=self.job_dir)
+            self.client, self.task_id, hb_interval_ms,
+            workdir=self.job_dir, on_lost=coordinator_lost,
+            lost_after_s=horizon_s)
         hb.start()
         monitor = None
         if self.metrics_client is not None:

@@ -10,6 +10,7 @@ finish (ref: monitorApplication :1031-1099, signalAMToFinish :1101-1111).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -139,10 +140,17 @@ class TonyClient:
         self.conf.write_final(os.path.join(self.job_dir, C.TONY_FINAL_CONF))
         return self.job_dir
 
-    def start_coordinator(self) -> None:
+    def start_coordinator(self, attempt: int = 0) -> None:
         """Launch the coordinator process (ref: submitApplication :314-349 +
-        buildCommand :900-919 — the AM container spec becomes a subprocess)."""
+        buildCommand :900-919 — the AM container spec becomes a subprocess).
+        ``attempt`` is the client-side respawn index (YARN AM-attempt
+        analog), exported so fault injections can target one attempt."""
+        # a respawn must not connect to the dead generation's endpoint
+        for stale in ("coordinator.json", "status.json"):
+            with contextlib.suppress(OSError):
+                os.remove(os.path.join(self.job_dir, stale))
         env = dict(os.environ)
+        env[C.COORD_CLIENT_ATTEMPT] = str(attempt)
         if self.secret:
             env[C.JOB_TOKEN] = self.secret
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -187,9 +195,17 @@ class TonyClient:
 
     def monitor(self) -> bool:
         """Poll status until terminal (ref: monitorApplication :1031-1099).
-        Returns True on SUCCEEDED."""
+        Returns True on SUCCEEDED.
+
+        A coordinator process that dies WITHOUT a terminal status is
+        respawned up to tony.client.coordinator-max-attempts times (the
+        YARN AM-restart analog, ref: tony.am.retry handled by RM attempts)
+        — checkpoint-dir jobs then resume from the last checkpoint."""
         self.rpc = self._connect_rpc()
         interval = self.conf.get_int("tony.client.poll-interval-ms", 1000) / 1000
+        max_attempts = max(
+            self.conf.get_int("tony.client.coordinator-max-attempts", 1), 1)
+        attempt = 0
         last_rendered = ""
         status: dict = {"status": "RUNNING"}
         while True:
@@ -198,7 +214,39 @@ class TonyClient:
                 infos = [TaskInfo.from_dict(d) for d in self.rpc.call("get_task_infos")]
             except (ConnectionError, TimeoutError):
                 if self.coordinator_proc and self.coordinator_proc.poll() is not None:
-                    status = self._status_from_file() or {
+                    terminal = self._status_from_file()
+                    if terminal is None and attempt + 1 < max_attempts:
+                        attempt += 1
+                        # fence the respawn past the old gang's kill
+                        # horizon (agents self-terminate once the liveness
+                        # horizon + checkpoint grace elapse) so two
+                        # generations of user processes never hold the
+                        # chips at once
+                        hb = self.conf.get_int(
+                            "tony.task.heartbeat-interval-ms", 1000)
+                        horizon = hb * max(3, self.conf.get_int(
+                            "tony.task.max-missed-heartbeats", 25))
+                        grace = self.conf.get_int(
+                            "tony.task.preemption-grace-ms", 15_000)
+                        fence_s = (horizon + grace) / 1000 + 3
+                        log.warning(
+                            "coordinator died (exit %s) with no terminal "
+                            "status; fencing %.0fs then respawning "
+                            "(attempt %d/%d)",
+                            self.coordinator_proc.returncode, fence_s,
+                            attempt + 1, max_attempts)
+                        time.sleep(fence_s)
+                        self.start_coordinator(attempt=attempt)
+                        try:
+                            self.rpc = self._connect_rpc()
+                        except (RuntimeError, TimeoutError, ConnectionError):
+                            # died again before serving RPC: loop back —
+                            # the death branch consumes the next attempt
+                            # or reports FAILED when they run out
+                            log.warning("respawned coordinator not "
+                                        "reachable; retrying")
+                        continue
+                    status = terminal or {
                         "status": "FAILED",
                         "reason": "coordinator process died",
                     }

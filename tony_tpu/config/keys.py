@@ -191,6 +191,11 @@ KEYS: dict[str, Key] = {
     "tony.client.poll-interval-ms": Key(
         1000, int, "Client job-status poll cadence (ref: TonyClient 1s)"
     ),
+    "tony.client.coordinator-max-attempts": Key(
+        1, int, "Times the client will (re)spawn the coordinator process; "
+        ">1 restarts a crashed coordinator, the YARN AM-attempt analog "
+        "(checkpoint-dir jobs resume from the last checkpoint)"
+    ),
     # limits (reference: tony.application.max-total-instances etc.)
     "tony.application.max-total-instances": Key(
         -1, int, "Cap on total task instances; -1 = unlimited"

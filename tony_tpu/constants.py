@@ -108,6 +108,9 @@ EXIT_INVALID_CONF = 2
 # (reference: Constants.java:124-129, SURVEY.md section 4.2).
 # ---------------------------------------------------------------------------
 TEST_COORD_CRASH = "TEST_TONY_COORD_CRASH"  # ref: TEST_AM_CRASH
+# which client-side (re)spawn of the coordinator this process is —
+# the YARN attempt-number analog, used by crash injection to die once
+COORD_CLIENT_ATTEMPT = "TONY_COORD_CLIENT_ATTEMPT"
 TEST_COORD_THROW = "TEST_TONY_COORD_THROW"  # ref: TEST_AM_THROW_EXCEPTION_CRASH
 TEST_TASK_NUM_HB_MISS = "TEST_TONY_NUM_HB_MISS"  # ref: TEST_TASK_EXECUTOR_NUM_HB_MISS
 TEST_TASK_SKEW = "TEST_TONY_TASK_SKEW"  # "role#idx#ms" (ref: TEST_TASK_EXECUTOR_SKEW)

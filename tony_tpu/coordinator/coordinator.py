@@ -561,7 +561,12 @@ class Coordinator:
             for self.attempt in range(retries + 1):
                 try:
                     self._start_attempt()
-                    if os.environ.get(C.TEST_COORD_CRASH) and self.attempt == 0:
+                    if os.environ.get(C.TEST_COORD_CRASH) \
+                            and self.attempt == 0 \
+                            and os.environ.get(C.COORD_CLIENT_ATTEMPT,
+                                               "0") == "0":
+                        # crash exactly once: a client-respawned coordinator
+                        # (attempt env > 0) proceeds, so respawn is testable
                         log.error("TEST_COORD_CRASH: hard-exiting coordinator")
                         os._exit(1)
                     status = self._monitor()
