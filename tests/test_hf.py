@@ -300,7 +300,7 @@ def test_sliding_window_rejected_on_unsupported_backend():
 
     cfg = TransformerConfig(vocab_size=32, d_model=16, n_heads=2, n_layers=1,
                             d_ff=32, max_seq_len=16, dtype=jnp.float32,
-                            attention_backend="ulysses", sliding_window=4)
+                            attention_backend="ring", sliding_window=4)
     model = Transformer(cfg)
     with pytest.raises(ValueError, match="sliding_window"):
         model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))

@@ -429,3 +429,19 @@ def test_gpipe_rejects_wrong_stage_count():
     with pytest.raises(ValueError, match="virtual stages"):
         pipeline_apply(lambda p, x: x, stacked, jnp.zeros((8, 2)), mesh=mesh,
                        n_microbatches=4)
+
+
+def test_ulysses_sliding_window_matches_reference():
+    from tony_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_mesh(MeshSpec(data=2, seq=4))
+    rng = jax.random.PRNGKey(21)
+    q, k, v = (jax.random.normal(key, (2, 32, 4, 8))
+               for key in jax.random.split(rng, 3))
+    from tony_tpu.parallel.ring_attention import reference_attention
+
+    ref = reference_attention(q, k, v, causal=True, window=7)
+    out = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh, causal=True, block_size=8, window=7))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)

@@ -73,6 +73,7 @@ class Heartbeater(threading.Thread):
 
     def run(self) -> None:
         failures = 0
+        outage_start: float | None = None
         while not self._stop.wait(self.interval_s):
             if self.misses_to_skip > 0:
                 self.misses_to_skip -= 1
@@ -83,6 +84,7 @@ class Heartbeater(threading.Thread):
                 response = self.client.call("task_executor_heartbeat",
                                             retries=0, task_id=self.task_id)
                 failures = 0
+                outage_start = None
                 try:
                     self._handle_commands(response)
                 except Exception:
@@ -91,14 +93,22 @@ class Heartbeater(threading.Thread):
                     log.exception("coordinator command failed")
             except Exception:
                 failures += 1
+                if outage_start is None:
+                    outage_start = time.monotonic()
                 log.warning("heartbeat send failure %d/%d", failures,
                             self.MAX_SEND_FAILURES)
                 if self.on_lost is not None and self.lost_after_s:
                     # keep pinging through the outage; only past the
-                    # coordinator's own expiry horizon is it truly gone
-                    if failures * self.interval_s >= self.lost_after_s:
+                    # coordinator's own expiry horizon is it truly gone.
+                    # WALL-CLOCK since the first consecutive failure, not
+                    # failures x interval: a blackholed host makes each
+                    # failed RPC block for its own connect timeout, which
+                    # would stretch a count-based horizon far past the
+                    # client's respawn fence
+                    outage_s = time.monotonic() - outage_start
+                    if outage_s >= self.lost_after_s:
                         log.error("coordinator lost (unreachable for "
-                                  "%.0fs)", failures * self.interval_s)
+                                  "%.0fs)", outage_s)
                         self.on_lost()
                         return
                 elif failures >= self.MAX_SEND_FAILURES:
@@ -233,15 +243,21 @@ class TaskAgent:
         hb_interval_ms = self.conf.get_int("tony.task.heartbeat-interval-ms",
                                            1000)
         # only kill the task once the coordinator's OWN liveness horizon
-        # has passed (interval x max(3, max-missed)): a shorter fuse would
-        # hard-fail healthy jobs on a transient ~5 s RPC blip the
+        # has passed (shared formula in coordinator/liveness.py): a shorter
+        # fuse would hard-fail healthy jobs on a transient RPC blip the
         # coordinator itself tolerates
-        horizon_s = hb_interval_ms * max(
-            3, self.conf.get_int("tony.task.max-missed-heartbeats", 25)) / 1000
+        from tony_tpu.coordinator.liveness import liveness_expiry_s
+
+        # dedicated short-timeout channel: a blackholed coordinator must
+        # not block each ping for the default 30 s RPC timeout, which
+        # would push loss detection far past the client's respawn fence
+        hb_client = RpcClient(
+            self.coord_host, self.coord_port, secret=self.secret,
+            timeout=max(2 * hb_interval_ms / 1000, 2.0))
         hb = Heartbeater(
-            self.client, self.task_id, hb_interval_ms,
+            hb_client, self.task_id, hb_interval_ms,
             workdir=self.job_dir, on_lost=coordinator_lost,
-            lost_after_s=horizon_s)
+            lost_after_s=liveness_expiry_s(self.conf))
         hb.start()
         monitor = None
         if self.metrics_client is not None:

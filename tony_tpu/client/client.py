@@ -217,18 +217,7 @@ class TonyClient:
                     terminal = self._status_from_file()
                     if terminal is None and attempt + 1 < max_attempts:
                         attempt += 1
-                        # fence the respawn past the old gang's kill
-                        # horizon (agents self-terminate once the liveness
-                        # horizon + checkpoint grace elapse) so two
-                        # generations of user processes never hold the
-                        # chips at once
-                        hb = self.conf.get_int(
-                            "tony.task.heartbeat-interval-ms", 1000)
-                        horizon = hb * max(3, self.conf.get_int(
-                            "tony.task.max-missed-heartbeats", 25))
-                        grace = self.conf.get_int(
-                            "tony.task.preemption-grace-ms", 15_000)
-                        fence_s = (horizon + grace) / 1000 + 3
+                        fence_s = self._respawn_fence_s()
                         log.warning(
                             "coordinator died (exit %s) with no terminal "
                             "status; fencing %.0fs then respawning "
@@ -240,11 +229,21 @@ class TonyClient:
                         try:
                             self.rpc = self._connect_rpc()
                         except (RuntimeError, TimeoutError, ConnectionError):
-                            # died again before serving RPC: loop back —
-                            # the death branch consumes the next attempt
-                            # or reports FAILED when they run out
+                            # either it died again (the death branch above
+                            # consumes the next attempt) or it is alive but
+                            # slow to serve — keep re-trying the connect
+                            # while the process lives so a late endpoint
+                            # is still picked up
                             log.warning("respawned coordinator not "
-                                        "reachable; retrying")
+                                        "reachable yet; will keep trying")
+                            while self.coordinator_proc.poll() is None:
+                                try:
+                                    self.rpc = self._connect_rpc(
+                                        timeout_s=10)
+                                    break
+                                except (RuntimeError, TimeoutError,
+                                        ConnectionError):
+                                    continue
                         continue
                     status = terminal or {
                         "status": "FAILED",
@@ -273,6 +272,22 @@ class TonyClient:
         log.info("application %s: %s (%s)", self.app_id, status["status"],
                  status.get("reason") or "ok")
         return ok
+
+    def _respawn_fence_s(self) -> float:
+        """How long to wait before respawning a dead coordinator so the old
+        gang is certainly off the chips: the agents' loss-detection horizon
+        (shared liveness formula + their short heartbeat-RPC timeout + one
+        interval of lag), their checkpoint grace window, the +2 s they
+        sleep so the SIGKILL backstop can run, and a margin."""
+        from tony_tpu.coordinator.liveness import liveness_expiry_s
+
+        hb_s = self.conf.get_int("tony.task.heartbeat-interval-ms",
+                                 1000) / 1000
+        hb_rpc_timeout_s = max(2 * hb_s, 2.0)
+        grace_s = self.conf.get_int("tony.task.preemption-grace-ms",
+                                    15_000) / 1000
+        return (liveness_expiry_s(self.conf) + hb_rpc_timeout_s + hb_s
+                + grace_s + 2 + 3)
 
     def _status_from_file(self) -> dict | None:
         path = os.path.join(self.job_dir, "status.json")

@@ -16,10 +16,24 @@ from typing import Callable
 log = logging.getLogger(__name__)
 
 
+def _expiry_s(interval_ms: int, max_missed: int) -> float:
+    return (interval_ms / 1000) * max(3, max_missed)
+
+
+def liveness_expiry_s(conf) -> float:
+    """The ONE expiry-horizon formula. The coordinator expires a silent
+    task after this long; the agent self-terminates after being unable to
+    reach the coordinator for this long; the client fences a coordinator
+    respawn past this + the checkpoint grace. All three must agree or
+    task generations can overlap on the chips — change _expiry_s only."""
+    return _expiry_s(conf.get_int("tony.task.heartbeat-interval-ms", 1000),
+                     conf.get_int("tony.task.max-missed-heartbeats", 25))
+
+
 class LivenessMonitor:
     def __init__(self, interval_ms: int, max_missed: int,
                  on_expired: Callable[[str], None]):
-        self.expiry_s = (interval_ms / 1000) * max(3, max_missed)
+        self.expiry_s = _expiry_s(interval_ms, max_missed)
         self.check_s = max(interval_ms / 1000, 0.05)
         self.on_expired = on_expired
         self._last: dict[str, float] = {}

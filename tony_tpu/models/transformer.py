@@ -106,10 +106,11 @@ class TransformerConfig:
 
 def _attention(cfg: TransformerConfig, q, k, v):
     if cfg.sliding_window > 0 and cfg.attention_backend not in (
-            "reference", "blockwise", "pallas"):
+            "reference", "blockwise", "pallas", "ulysses"):
         raise ValueError(
             f"sliding_window is only implemented for the reference, "
-            f"blockwise, and pallas backends, not {cfg.attention_backend!r}")
+            f"blockwise, pallas, and ulysses backends, not "
+            f"{cfg.attention_backend!r}")
     if cfg.attention_backend == "reference":
         return reference_attention(q, k, v, causal=True,
                                    window=cfg.sliding_window)
@@ -126,7 +127,8 @@ def _attention(cfg: TransformerConfig, q, k, v):
         from tony_tpu.parallel.ulysses import ulysses_attention
 
         return ulysses_attention(q, k, v, cfg.mesh, causal=True,
-                                 block_size=cfg.attention_block_size)
+                                 block_size=cfg.attention_block_size,
+                                 window=cfg.sliding_window)
     if cfg.attention_backend == "pallas":
         from tony_tpu.ops.attention import flash_attention
 

@@ -28,14 +28,15 @@ from tony_tpu.parallel.ring_attention import blockwise_attention
 
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
-                   block_size: int):
+                   block_size: int, window: int):
     """Per-shard body. Local shapes in: [B, L/n, H, D]."""
     # seq-shard -> head-shard: split heads (axis 2) n ways, gather seq (1)
     q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
     # full-sequence attention over this device's head group
-    out = blockwise_attention(q, k, v, block_size=block_size, causal=causal)
+    out = blockwise_attention(q, k, v, block_size=block_size,
+                              causal=causal, window=window)
     # head-shard -> seq-shard
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
@@ -43,11 +44,13 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
 
 def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = SEQ,
                       causal: bool = True, block_size: int = 512,
-                      batch_spec: P | None = None):
+                      batch_spec: P | None = None, window: int = 0):
     """Sequence-parallel attention via all-to-all head redistribution.
 
     q/k/v: [B, L, H, D] globally, sharded along L over ``axis_name``.
     Requires H % mesh.shape[axis_name] == 0. Returns the same sharding.
+    ``window`` adds sliding-window masking (each device already holds the
+    full sequence post-all-to-all, so the cut is local and free).
     """
     n = mesh.shape.get(axis_name, 1)
     heads = q.shape[2]
@@ -59,7 +62,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = SEQ,
         P(None, axis_name, None, None)
     fn = shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
-                          block_size=block_size),
+                          block_size=block_size, window=window),
         mesh=mesh,
         in_specs=(qspec, qspec, qspec),
         out_specs=qspec,
