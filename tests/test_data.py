@@ -322,3 +322,54 @@ def test_encode_files_streams_in_blocks(tmp_path):
                         block_bytes=64)  # forces many blocks
     np.testing.assert_array_equal(np.fromfile(out1, np.uint16),
                                   np.fromfile(out2, np.uint16))
+
+
+def test_mixture_source_ratios_and_determinism():
+    from tony_tpu.data import ArraySource, MixtureSource
+
+    a = ArraySource({"x": np.zeros((10, 2), np.float32)})
+    b = ArraySource({"x": np.ones((3, 2), np.float32)})
+    mix = MixtureSource([(a, 0.75), (b, 0.25)], num_examples=4000, seed=7)
+    counts = mix.component_counts()
+    assert abs(counts[0] / 4000 - 0.75) < 0.03
+    # deterministic across constructions (multi-host contract)
+    mix2 = MixtureSource([(a, 0.75), (b, 0.25)], num_examples=4000, seed=7)
+    for i in (0, 17, 3999):
+        np.testing.assert_array_equal(mix[i]["x"], mix2[i]["x"])
+    # small component cycles rather than truncating
+    ones = sum(int(mix[i]["x"][0]) for i in range(200))
+    assert ones > 3  # component b (len 3) sampled far more than its size
+
+
+def test_mixture_source_cycles_small_component_through_all_examples():
+    from tony_tpu.data import ArraySource, MixtureSource
+
+    vals = np.arange(3, dtype=np.float32).reshape(3, 1)
+    b = ArraySource({"x": vals})
+    mix = MixtureSource([(b, 1.0)], num_examples=9, seed=0)
+    got = [float(mix[i]["x"][0]) for i in range(9)]
+    assert got == [0, 1, 2, 0, 1, 2, 0, 1, 2]
+
+
+def test_mixture_source_validates():
+    from tony_tpu.data import ArraySource, MixtureSource
+
+    a = ArraySource({"x": np.zeros((2, 1), np.float32)})
+    with pytest.raises(ValueError, match="positive"):
+        MixtureSource([(a, 0.0)], num_examples=10)
+    with pytest.raises(ValueError, match="at least one"):
+        MixtureSource([], num_examples=10)
+
+
+def test_mixture_source_through_loader():
+    from tony_tpu.data import ArraySource, DataLoader, MixtureSource
+
+    a = ArraySource({"x": np.zeros((8, 2), np.float32)})
+    b = ArraySource({"x": np.ones((8, 2), np.float32)})
+    mix = MixtureSource([(a, 0.5), (b, 0.5)], num_examples=64, seed=1)
+    loader = DataLoader(mix, global_batch_size=16, seed=2, num_epochs=1,
+                        process_index=0, process_count=1)
+    batches = list(loader)
+    assert len(batches) == 4
+    vals = np.concatenate([np.asarray(bt["x"])[:, 0] for bt in batches])
+    assert 10 < vals.sum() < 54  # both components present

@@ -246,14 +246,17 @@ class TaskAgent:
         # has passed (shared formula in coordinator/liveness.py): a shorter
         # fuse would hard-fail healthy jobs on a transient RPC blip the
         # coordinator itself tolerates
-        from tony_tpu.coordinator.liveness import liveness_expiry_s
+        from tony_tpu.coordinator.liveness import (
+            heartbeat_rpc_timeout_s,
+            liveness_expiry_s,
+        )
 
         # dedicated short-timeout channel: a blackholed coordinator must
         # not block each ping for the default 30 s RPC timeout, which
         # would push loss detection far past the client's respawn fence
         hb_client = RpcClient(
             self.coord_host, self.coord_port, secret=self.secret,
-            timeout=max(2 * hb_interval_ms / 1000, 2.0))
+            timeout=heartbeat_rpc_timeout_s(self.conf))
         hb = Heartbeater(
             hb_client, self.task_id, hb_interval_ms,
             workdir=self.job_dir, on_lost=coordinator_lost,

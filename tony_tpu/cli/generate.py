@@ -33,6 +33,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="raw prompt as comma-separated ids (repeatable, "
                         "no tokenizer needed)")
     p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--num-beams", type=int, default=1,
+                   help=">1 uses beam search (overrides sampling knobs)")
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy")
     p.add_argument("--top-k", type=int, default=0)
@@ -88,15 +90,22 @@ def main(argv=None) -> int:
     if eos < 0 and getattr(config, "eos_token_id", None) is not None:
         eos = int(config.eos_token_id)
 
+    from tony_tpu.models import beam_search
+
     # one jitted decode per prompt length (left-pad batching would change
     # numerics for absolute-position models; serving loops reuse lengths)
     for ids in prompts:
-        out = generate(model, params["params"],
-                       jnp.asarray([ids], jnp.int32),
-                       max_new_tokens=args.max_new_tokens,
-                       temperature=args.temperature, top_k=args.top_k,
-                       top_p=args.top_p, eos_id=eos,
-                       rng=jax.random.PRNGKey(args.seed))
+        prompt_arr = jnp.asarray([ids], jnp.int32)
+        if args.num_beams > 1:
+            out = beam_search(model, params["params"], prompt_arr,
+                              max_new_tokens=args.max_new_tokens,
+                              num_beams=args.num_beams, eos_id=eos)
+        else:
+            out = generate(model, params["params"], prompt_arr,
+                           max_new_tokens=args.max_new_tokens,
+                           temperature=args.temperature, top_k=args.top_k,
+                           top_p=args.top_p, eos_id=eos,
+                           rng=jax.random.PRNGKey(args.seed))
         new_ids = np.asarray(out)[0].tolist()
         if eos >= 0 and eos in new_ids:
             new_ids = new_ids[:new_ids.index(eos)]

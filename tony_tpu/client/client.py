@@ -275,19 +275,24 @@ class TonyClient:
 
     def _respawn_fence_s(self) -> float:
         """How long to wait before respawning a dead coordinator so the old
-        gang is certainly off the chips: the agents' loss-detection horizon
-        (shared liveness formula + their short heartbeat-RPC timeout + one
-        interval of lag), their checkpoint grace window, the +2 s they
-        sleep so the SIGKILL backstop can run, and a margin."""
-        from tony_tpu.coordinator.liveness import liveness_expiry_s
+        gang is certainly off the chips. Worst-case agent exit after the
+        coordinator dies: the outage clock starts only after the FIRST
+        failed ping returns (one interval wait + one RPC timeout,
+        uncounted), the horizon check fires at the completion of a later
+        ping (one more interval + timeout of granularity), then the
+        checkpoint grace and the agent's +2 s SIGKILL-backstop sleep run.
+        Budget all of it, plus margin."""
+        from tony_tpu.coordinator.liveness import (
+            heartbeat_rpc_timeout_s,
+            liveness_expiry_s,
+        )
 
         hb_s = self.conf.get_int("tony.task.heartbeat-interval-ms",
                                  1000) / 1000
-        hb_rpc_timeout_s = max(2 * hb_s, 2.0)
         grace_s = self.conf.get_int("tony.task.preemption-grace-ms",
                                     15_000) / 1000
-        return (liveness_expiry_s(self.conf) + hb_rpc_timeout_s + hb_s
-                + grace_s + 2 + 3)
+        lag = 2 * (hb_s + heartbeat_rpc_timeout_s(self.conf))
+        return liveness_expiry_s(self.conf) + lag + grace_s + 2 + 3
 
     def _status_from_file(self) -> dict | None:
         path = os.path.join(self.job_dir, "status.json")

@@ -30,6 +30,14 @@ def liveness_expiry_s(conf) -> float:
                      conf.get_int("tony.task.max-missed-heartbeats", 25))
 
 
+def heartbeat_rpc_timeout_s(conf) -> float:
+    """Per-ping RPC timeout on the agent's dedicated heartbeat channel —
+    shared with the client's respawn-fence budget (a split copy of this
+    formula would silently shorten the fence)."""
+    hb_s = conf.get_int("tony.task.heartbeat-interval-ms", 1000) / 1000
+    return max(2 * hb_s, 2.0)
+
+
 class LivenessMonitor:
     def __init__(self, interval_ms: int, max_missed: int,
                  on_expired: Callable[[str], None]):

@@ -1,6 +1,7 @@
 from tony_tpu.data.loader import DataLoader, device_prefetch
 from tony_tpu.data.sources import (
     ArraySource,
+    MixtureSource,
     JsonlSource,
     PackedTokenSource,
     SyntheticImageSource,
@@ -20,6 +21,7 @@ __all__ = [
     "encode_corpus_to_bin",
     "encode_files_to_bin",
     "JsonlSource",
+    "MixtureSource",
     "PackedTokenSource",
     "SyntheticImageSource",
     "SyntheticTokenSource",

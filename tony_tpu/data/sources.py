@@ -170,3 +170,57 @@ class PackedTokenSource(Source):
         window = np.asarray(self._tokens[start:start + self.seq_len + 1],
                             dtype=np.int32)
         return {"tokens": window[:-1], "labels": window[1:]}
+
+
+class MixtureSource(Source):
+    """Weighted mixture of sources — the standard pretraining-corpus blend
+    (e.g. 70% web, 20% code, 10% books).
+
+    Deterministic and multi-host safe: example i's component is drawn from
+    (seed, i) alone and its index within the component advances as an
+    independent deterministic stream, so every process materializes the
+    identical mixture without coordination (same contract as
+    SyntheticTokenSource). Components cycle independently: a small
+    component repeats (standard epoch-mixing) rather than truncating the
+    mixture. All components must share an example schema.
+
+    ``num_examples`` bounds the virtual length (mixtures are usually
+    sampled-with-replacement streams, so length is a budget, not a size).
+    """
+
+    def __init__(self, components: "Sequence[tuple[Source, float]]",
+                 num_examples: int, seed: int = 0):
+        if not components:
+            raise ValueError("MixtureSource needs at least one component")
+        self.sources = [s for s, _ in components]
+        weights = np.asarray([w for _, w in components], np.float64)
+        if (weights <= 0).any():
+            raise ValueError(f"weights must be positive, got {weights}")
+        self.probs = weights / weights.sum()
+        self.num_examples = num_examples
+        self.seed = seed
+        # per-component pick counts are cumulative over the index stream;
+        # computing them per __getitem__ would be O(i), so precompute the
+        # component choice for every index once (num_examples ints)
+        rng = np.random.default_rng((seed, 0xB1E2D))
+        self._choice = rng.choice(len(self.sources), size=num_examples,
+                                  p=self.probs).astype(np.int32)
+        # within-component position: the k-th pick of component c maps to
+        # its example (k mod len(c)); vectorized — a Python loop here
+        # would cost minutes of per-host startup at stream-scale budgets
+        self._pos = np.zeros(num_examples, np.int64)
+        for c in range(len(self.sources)):
+            mask = self._choice == c
+            self._pos[mask] = np.arange(int(mask.sum()), dtype=np.int64)
+
+    def __len__(self) -> int:
+        return self.num_examples
+
+    def __getitem__(self, idx: int) -> Mapping[str, np.ndarray]:
+        c = int(self._choice[idx])
+        src = self.sources[c]
+        return src[int(self._pos[idx]) % len(src)]
+
+    def component_counts(self) -> np.ndarray:
+        """How many of the virtual examples come from each component."""
+        return np.bincount(self._choice, minlength=len(self.sources))

@@ -6,7 +6,8 @@ from tony_tpu.models.resnet import (
     ResNet101,
     ResNet152,
 )
-from tony_tpu.models.generate import generate, init_cache, sample_logits
+from tony_tpu.models.generate import (beam_search, generate, init_cache,
+                                      sample_logits)
 from tony_tpu.models.pipeline import pipelined_forward
 from tony_tpu.models.hf import (
     convert_gpt2_state_dict,
@@ -33,6 +34,7 @@ __all__ = [
     "gpt2_config",
     "llama_config",
     "moe_aux_loss",
+    "beam_search",
     "generate",
     "pipelined_forward",
     "init_cache",

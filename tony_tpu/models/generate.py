@@ -111,3 +111,83 @@ def generate(model, params, prompt, *, max_new_tokens: int,
         rest = jnp.moveaxis(rest, 0, 1)  # [steps, b] -> [b, steps]
         return jnp.concatenate([next_tok[:, None], rest], axis=1)
     return next_tok[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
+                                             "num_beams", "eos_id"))
+def beam_search(model, params, prompt, *, max_new_tokens: int,
+                num_beams: int = 4, eos_id: int = -1,
+                length_penalty: float = 1.0):
+    """Beam-search decode: returns the highest-scoring continuation
+    [b, max_new_tokens] (ties to the KV cache exactly like generate()).
+
+    One jitted program (static num_beams/max_new_tokens): beams live as a
+    widened batch [b*k] so the per-layer cache shards/updates like any
+    batch; each step does one fused top-k over [k*V] joint candidates and
+    reorders the cache with a batch-dim gather. Finished beams (emitted
+    ``eos_id``) are frozen: they re-emit eos at zero added score. The
+    winner per batch row maximizes score / (generated_len **
+    length_penalty), HF-style length normalization.
+    """
+    b, prompt_len = prompt.shape
+    k = num_beams
+    if prompt_len + max_new_tokens > model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds cfg.max_seq_len ({model.cfg.max_seq_len})")
+    vocab = model.cfg.vocab_size
+    neg = jnp.float32(-1e30)
+
+    # prefill ONCE at batch b (all beams share the prompt), then widen the
+    # cache rows to b*k — prefill dominates latency for long prompts and
+    # repeating it per beam would compute k identical copies
+    cache = init_cache(model, params, b)
+    logits, vars_ = model.apply({"params": params, "cache": cache},
+                                prompt, decode=True, mutable=["cache"])
+    cache = jax.tree.map(
+        lambda c: jnp.repeat(c, k, axis=0)
+        if getattr(c, "ndim", 0) and c.shape[0] == b else c, vars_["cache"])
+    logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+    scores, first_tok = jax.lax.top_k(logp0, k)  # [b, k]
+    finished = (first_tok == eos_id)
+    out = jnp.full((b, k, max_new_tokens), eos_id if eos_id >= 0 else 0,
+                   jnp.int32)
+    out = out.at[:, :, 0].set(first_tok)
+    lengths = jnp.ones((b, k), jnp.int32)
+
+    def step(carry, t):
+        cache, tok, scores, finished, out, lengths = carry
+        logits, vars_ = model.apply(
+            {"params": params, "cache": cache},
+            tok.reshape(b * k)[:, None], decode=True, mutable=["cache"])
+        cache = vars_["cache"]
+        logp = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32), axis=-1).reshape(b, k, vocab)
+        if eos_id >= 0:
+            # frozen beams: only eos continues, at no added score
+            eos_only = jnp.full((vocab,), neg).at[eos_id].set(0.0)
+            logp = jnp.where(finished[:, :, None], eos_only[None, None],
+                             logp)
+        cand = scores[:, :, None] + logp  # [b, k, V]
+        new_scores, flat = jax.lax.top_k(cand.reshape(b, k * vocab), k)
+        beam_idx = flat // vocab  # [b, k]
+        new_tok = flat % vocab
+        # reorder beam-major state by the winning parent beams
+        rows = (jnp.arange(b)[:, None] * k + beam_idx).reshape(-1)  # [b*k]
+        cache = jax.tree.map(lambda c: jnp.take(c, rows, axis=0)
+                             if c.ndim and c.shape[0] == b * k else c, cache)
+        out = jnp.take_along_axis(out, beam_idx[:, :, None], axis=1)
+        lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+        was_finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+        out = out.at[:, :, t].set(jnp.where(was_finished, eos_id, new_tok))
+        lengths = jnp.where(was_finished, lengths, lengths + 1)
+        finished = was_finished | (new_tok == eos_id)
+        return (cache, new_tok, new_scores, finished, out, lengths), None
+
+    carry = (cache, first_tok, scores, finished, out, lengths)
+    if max_new_tokens > 1:
+        carry, _ = jax.lax.scan(step, carry, jnp.arange(1, max_new_tokens))
+    _, _, scores, finished, out, lengths = carry
+    norm = scores / (lengths.astype(jnp.float32) ** length_penalty)
+    best = jnp.argmax(norm, axis=1)  # [b]
+    return jnp.take_along_axis(out, best[:, None, None], axis=1)[:, 0]
