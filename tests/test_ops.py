@@ -298,3 +298,21 @@ def test_flash_attention_window_requires_causal():
     q = jnp.ones((1, 16, 2, 8))
     with pytest.raises(ValueError, match="causal"):
         flash_attention(q, q, q, False, 16, 16, window=4)
+
+
+def test_flash_attention_window_with_padded_length():
+    """Unblockable seq lens go through the zero-pad path; the window mask
+    must stay correct on the padded program."""
+    rng = jax.random.PRNGKey(9)
+    q, k, v = (jax.random.normal(key, (1, 50, 2, 8))  # 50: no divisor of 16
+               for key in jax.random.split(rng, 3))
+    ref = reference_attention(q, k, v, causal=True, window=12)
+    out = flash_attention(q, k, v, True, 16, 16, window=12)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    g = jax.grad(lambda q: jnp.sum(
+        flash_attention(q, k, v, True, 16, 16, window=12) ** 2))(q)
+    gr = jax.grad(lambda q: jnp.sum(
+        reference_attention(q, k, v, causal=True, window=12) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               atol=2e-5, rtol=2e-5)

@@ -39,6 +39,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="0 = greedy")
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--repetition-penalty", type=float, default=1.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eos-id", type=int, default=-1,
                    help="stop token (default: model config's eos_token_id)")
@@ -97,6 +98,9 @@ def main(argv=None) -> int:
     for ids in prompts:
         prompt_arr = jnp.asarray([ids], jnp.int32)
         if args.num_beams > 1:
+            if args.repetition_penalty != 1.0:
+                print("warning: --repetition-penalty is not applied under "
+                      "beam search; ignoring", file=sys.stderr)
             out = beam_search(model, params["params"], prompt_arr,
                               max_new_tokens=args.max_new_tokens,
                               num_beams=args.num_beams, eos_id=eos)
@@ -105,6 +109,7 @@ def main(argv=None) -> int:
                            max_new_tokens=args.max_new_tokens,
                            temperature=args.temperature, top_k=args.top_k,
                            top_p=args.top_p, eos_id=eos,
+                           repetition_penalty=args.repetition_penalty,
                            rng=jax.random.PRNGKey(args.seed))
         new_ids = np.asarray(out)[0].tolist()
         if eos >= 0 and eos in new_ids:

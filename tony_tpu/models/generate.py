@@ -65,17 +65,30 @@ def sample_logits(logits, rng, temperature, top_k: int, top_p: float = 1.0):
     return jnp.where(jnp.asarray(temperature) == 0.0, greedy, sampled)
 
 
+def _penalize_repeats(logits, seen, penalty):
+    """CTRL-style repetition penalty: a token already in the sequence has
+    its logit divided by ``penalty`` when positive, multiplied when
+    negative (both push probability down for penalty > 1). Traced operand:
+    penalty=1.0 rides the same compiled program as a no-op."""
+    penalty = jnp.asarray(penalty, logits.dtype)
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
 @functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
                                              "top_k", "top_p"))
 def generate(model, params, prompt, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-             rng: jax.Array | None = None, eos_id: int = -1):
+             rng: jax.Array | None = None, eos_id: int = -1,
+             repetition_penalty: float = 1.0):
     """Generate max_new_tokens continuations of ``prompt`` [b, Lp].
 
     Returns [b, max_new_tokens] int32. Tokens after an eos_id are frozen
     to eos_id (computed but masked — fixed trip count keeps the scan
     static; early-exit would force a while_loop with dynamic shapes
-    downstream).
+    downstream). ``repetition_penalty`` > 1 discourages tokens already in
+    the prompt or generated so far (CTRL-style; traced — sweeping values
+    never recompiles).
     """
     if rng is None:
         rng = jax.random.PRNGKey(0)
@@ -85,27 +98,34 @@ def generate(model, params, prompt, *, max_new_tokens: int,
             f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds cfg.max_seq_len ({model.cfg.max_seq_len}): the KV "
             "cache would overflow")
+    vocab = model.cfg.vocab_size
     cache = init_cache(model, params, b)
+    seen = jnp.zeros((b, vocab), bool)
+    seen = seen.at[jnp.arange(b)[:, None], prompt].set(True)
 
     # prefill: one pass over the whole prompt fills every layer's cache
     logits, vars_ = model.apply({"params": params, "cache": cache}, prompt,
                                 decode=True, mutable=["cache"])
     rng, sub = jax.random.split(rng)
-    next_tok = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
+    last = _penalize_repeats(logits[:, -1], seen, repetition_penalty)
+    next_tok = sample_logits(last, sub, temperature, top_k, top_p)
+    seen = seen.at[jnp.arange(b), next_tok].set(True)
     done = next_tok == eos_id
 
     def step(carry, _):
-        cache, tok, rng, done = carry
+        cache, tok, rng, done, seen = carry
         logits, vars_ = model.apply({"params": params, "cache": cache},
                                     tok[:, None], decode=True,
                                     mutable=["cache"])
         rng, sub = jax.random.split(rng)
-        nxt = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
+        last = _penalize_repeats(logits[:, -1], seen, repetition_penalty)
+        nxt = sample_logits(last, sub, temperature, top_k, top_p)
         nxt = jnp.where(done, eos_id, nxt)
+        seen = seen.at[jnp.arange(b), nxt].set(True)
         done = done | (nxt == eos_id)
-        return (vars_["cache"], nxt, rng, done), nxt
+        return (vars_["cache"], nxt, rng, done, seen), nxt
 
-    carry = (vars_["cache"], next_tok, rng, done)
+    carry = (vars_["cache"], next_tok, rng, done, seen)
     if max_new_tokens > 1:
         _, rest = jax.lax.scan(step, carry, None, length=max_new_tokens - 1)
         rest = jnp.moveaxis(rest, 0, 1)  # [steps, b] -> [b, steps]
@@ -138,15 +158,30 @@ def beam_search(model, params, prompt, *, max_new_tokens: int,
     vocab = model.cfg.vocab_size
     neg = jnp.float32(-1e30)
 
+    def _cache_batch_axis(path, leaf):
+        """Batch axis of a cache leaf, or None for non-batched leaves.
+
+        The KV buffers are [..., b, max_len, kvh, dh] — batch is always
+        4th-from-last; scan_layers models prepend an n_layers axis, so
+        keying on axis 0 (or on a dim happening to equal b) would widen or
+        gather the LAYERS axis and silently corrupt the cache. Index
+        counters (cache_index/pos_index) carry no batch dim."""
+        name = str(path[-1].key if hasattr(path[-1], "key") else path[-1])
+        if name in ("cached_key", "cached_value"):
+            return leaf.ndim - 4
+        return None
+
+    def widen(path, c):
+        ax = _cache_batch_axis(path, c)
+        return c if ax is None else jnp.repeat(c, k, axis=ax)
+
     # prefill ONCE at batch b (all beams share the prompt), then widen the
     # cache rows to b*k — prefill dominates latency for long prompts and
     # repeating it per beam would compute k identical copies
     cache = init_cache(model, params, b)
     logits, vars_ = model.apply({"params": params, "cache": cache},
                                 prompt, decode=True, mutable=["cache"])
-    cache = jax.tree.map(
-        lambda c: jnp.repeat(c, k, axis=0)
-        if getattr(c, "ndim", 0) and c.shape[0] == b else c, vars_["cache"])
+    cache = jax.tree_util.tree_map_with_path(widen, vars_["cache"])
     logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
     scores, first_tok = jax.lax.top_k(logp0, k)  # [b, k]
     finished = (first_tok == eos_id)
@@ -174,8 +209,9 @@ def beam_search(model, params, prompt, *, max_new_tokens: int,
         new_tok = flat % vocab
         # reorder beam-major state by the winning parent beams
         rows = (jnp.arange(b)[:, None] * k + beam_idx).reshape(-1)  # [b*k]
-        cache = jax.tree.map(lambda c: jnp.take(c, rows, axis=0)
-                             if c.ndim and c.shape[0] == b * k else c, cache)
+        cache = jax.tree_util.tree_map_with_path(
+            lambda p, c: c if _cache_batch_axis(p, c) is None
+            else jnp.take(c, rows, axis=_cache_batch_axis(p, c)), cache)
         out = jnp.take_along_axis(out, beam_idx[:, :, None], axis=1)
         lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
         was_finished = jnp.take_along_axis(finished, beam_idx, axis=1)
