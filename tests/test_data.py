@@ -373,3 +373,20 @@ def test_mixture_source_through_loader():
     assert len(batches) == 4
     vals = np.concatenate([np.asarray(bt["x"])[:, 0] for bt in batches])
     assert 10 < vals.sum() < 54  # both components present
+
+
+def test_packed_source_emits_segments(tmp_path):
+    from tony_tpu.data import (ByteTokenizer, PackedTokenSource,
+                               encode_corpus_to_bin)
+
+    tok = ByteTokenizer()
+    docs = ["ab", "cde", "f"]
+    out = str(tmp_path / "c.bin")
+    encode_corpus_to_bin(docs, out, tok.encode, eos_id=tok.eos_id)
+    # stream: a b EOS c d e EOS f EOS  (9 tokens)
+    src = PackedTokenSource(out, seq_len=8, segment_eos_id=tok.eos_id)
+    ex = src[0]
+    assert ex["segments"].tolist() == [0, 0, 0, 1, 1, 1, 1, 2]
+    # without the flag no segments key appears
+    src2 = PackedTokenSource(out, seq_len=8)
+    assert "segments" not in src2[0]

@@ -93,14 +93,14 @@ def main(argv=None) -> int:
 
     from tony_tpu.models import beam_search
 
+    if args.num_beams > 1 and args.repetition_penalty != 1.0:
+        print("warning: --repetition-penalty is not applied under "
+              "beam search; ignoring", file=sys.stderr)
     # one jitted decode per prompt length (left-pad batching would change
     # numerics for absolute-position models; serving loops reuse lengths)
     for ids in prompts:
         prompt_arr = jnp.asarray([ids], jnp.int32)
         if args.num_beams > 1:
-            if args.repetition_penalty != 1.0:
-                print("warning: --repetition-penalty is not applied under "
-                      "beam search; ignoring", file=sys.stderr)
             out = beam_search(model, params["params"], prompt_arr,
                               max_new_tokens=args.max_new_tokens,
                               num_beams=args.num_beams, eos_id=eos)

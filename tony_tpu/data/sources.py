@@ -147,10 +147,15 @@ class PackedTokenSource(Source):
     """
 
     def __init__(self, path: str, seq_len: int, dtype=np.uint16,
-                 stride: int | None = None):
+                 stride: int | None = None,
+                 segment_eos_id: int | None = None):
         self.path = str(path)
         self.seq_len = seq_len
         self.stride = seq_len if stride is None else stride
+        # emit per-window "segments" (document index within the window,
+        # split at this eos id) for segment-masked attention — packed
+        # documents then never attend across their boundaries
+        self.segment_eos_id = segment_eos_id
         if self.stride <= 0:
             raise ValueError(f"stride must be positive, got {self.stride}")
         self._tokens = np.memmap(self.path, dtype=dtype, mode="r")
@@ -169,7 +174,14 @@ class PackedTokenSource(Source):
         start = idx * self.stride
         window = np.asarray(self._tokens[start:start + self.seq_len + 1],
                             dtype=np.int32)
-        return {"tokens": window[:-1], "labels": window[1:]}
+        out = {"tokens": window[:-1], "labels": window[1:]}
+        if self.segment_eos_id is not None:
+            toks = out["tokens"]
+            is_eos = (toks == self.segment_eos_id).astype(np.int32)
+            # segment of position i = number of eos strictly before i
+            # (an eos token still belongs to the document it terminates)
+            out["segments"] = np.cumsum(is_eos) - is_eos
+        return out
 
 
 class MixtureSource(Source):

@@ -104,19 +104,27 @@ class TransformerConfig:
         return kv
 
 
-def _attention(cfg: TransformerConfig, q, k, v):
+def _attention(cfg: TransformerConfig, q, k, v, segment_ids=None):
     if cfg.sliding_window > 0 and cfg.attention_backend not in (
             "reference", "blockwise", "pallas", "ulysses"):
         raise ValueError(
             f"sliding_window is only implemented for the reference, "
             f"blockwise, pallas, and ulysses backends, not "
             f"{cfg.attention_backend!r}")
+    if segment_ids is not None and cfg.attention_backend not in (
+            "reference", "blockwise"):
+        raise ValueError(
+            f"segment_ids (packed-document masking) is only implemented "
+            f"for the reference and blockwise backends, not "
+            f"{cfg.attention_backend!r}")
     if cfg.attention_backend == "reference":
         return reference_attention(q, k, v, causal=True,
-                                   window=cfg.sliding_window)
+                                   window=cfg.sliding_window,
+                                   segment_ids=segment_ids)
     if cfg.attention_backend == "blockwise":
         return blockwise_attention(q, k, v, block_size=cfg.attention_block_size,
-                                   causal=True, window=cfg.sliding_window)
+                                   causal=True, window=cfg.sliding_window,
+                                   segment_ids=segment_ids)
     if cfg.attention_backend == "ring":
         if cfg.mesh is None:
             raise ValueError("ring attention needs cfg.mesh")
@@ -247,7 +255,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, decode: bool = False):
+    def __call__(self, x, decode: bool = False, segment_ids=None):
         cfg = self.cfg
         b, l, _ = x.shape
         # logical sharding axes for these kernels come from path-name
@@ -280,7 +288,7 @@ class Attention(nn.Module):
                 group = cfg.n_heads // cfg.kv_heads
                 k = jnp.repeat(k, group, axis=2)
                 v = jnp.repeat(v, group, axis=2)
-            out = _attention(cfg, q, k, v)
+            out = _attention(cfg, q, k, v, segment_ids)
         out = nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), use_bias=cfg.use_bias, dtype=cfg.dtype,
             param_dtype=jnp.float32, name="o",
@@ -436,9 +444,10 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, decode: bool = False):
+    def __call__(self, x, decode: bool = False, segment_ids=None):
         x = x + Attention(self.cfg, name="attn")(
-            make_norm(self.cfg, "ln1")(x), decode=decode)
+            make_norm(self.cfg, "ln1")(x), decode=decode,
+            segment_ids=segment_ids)
         ffn = (MoEMLP(self.cfg, name="moe") if self.use_moe
                else MLP(self.cfg, name="mlp"))
         x = x + ffn(make_norm(self.cfg, "ln2")(x))
@@ -452,8 +461,9 @@ class _ScanBody(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, _):
-        return Block(self.cfg, name="block")(x, self.decode), None
+    def __call__(self, x, segment_ids):
+        return Block(self.cfg, name="block")(
+            x, self.decode, segment_ids=segment_ids), None
 
 
 class Transformer(nn.Module):
@@ -479,7 +489,7 @@ class Transformer(nn.Module):
             positions = jnp.arange(l)
         return pos_emb[positions][None].astype(cfg.dtype)
 
-    def _scan_blocks(self, x, decode: bool):
+    def _scan_blocks(self, x, decode: bool, segment_ids=None):
         cfg = self.cfg
         body = _ScanBody
         if cfg.remat and not decode:
@@ -489,19 +499,28 @@ class Transformer(nn.Module):
             body,
             variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True},
+            in_axes=nn.broadcast,  # segment_ids: same array every layer
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        x, _ = scanned(cfg, decode, name="layers")(x, None)
+        x, _ = scanned(cfg, decode, name="layers")(x, segment_ids)
         return x
 
     @nn.compact
     def __call__(self, tokens, decode: bool = False,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, segment_ids=None):
         """return_hidden=True yields the final [B, L, D] activations
         (post ln_f) instead of logits, for the chunked large-vocab loss
         (ops.xent.chunked_cross_entropy with params["embedding"]) — the
-        [B, L, V] logits tensor is never materialized."""
+        [B, L, V] logits tensor is never materialized.
+
+        segment_ids [B, L] (packed-document training): attention is
+        restricted to same-segment keys, so documents packed into one
+        window never leak into each other. Training-path only (decode
+        caches have no segment notion); reference/blockwise backends."""
+        if segment_ids is not None and decode:
+            raise ValueError("segment_ids are a training-path feature; "
+                             "decode has no segment notion")
         cfg = self.cfg
         embed = self.param("embedding", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.d_model), jnp.float32)
@@ -509,14 +528,15 @@ class Transformer(nn.Module):
         if cfg.positional == "learned":
             x = x + self._learned_positions(tokens.shape[1], decode)
         if cfg.scan_layers:
-            x = self._scan_blocks(x, decode)
+            x = self._scan_blocks(x, decode, segment_ids)
         else:
             block = Block
             if cfg.remat and not decode:
                 block = nn.remat(Block, static_argnums=(2,))
             for i in range(cfg.n_layers):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
-                x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x, decode)
+                x = block(cfg, use_moe=use_moe, name=f"block_{i}")(
+                    x, decode, segment_ids=segment_ids)
         x = make_norm(cfg, "ln_f")(x)
         if not cfg.tied_embeddings:
             head = self.param("lm_head", nn.initializers.normal(0.02),

@@ -32,12 +32,14 @@ def _block_attn(q, k, v, m, l, o, mask):
     """One online-softmax accumulation step.
 
     q: [B, Lq, H, D]; k/v: [B, Lk, H, D]; m/l: [B, H, Lq]; o like q.
-    mask: [Lq, Lk] boolean (True = attend) or None.
+    mask: boolean (True = attend), [Lq, Lk] shared across the batch or
+    [B, Lq, Lk] per-example (segment masking), or None.
     """
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if mask is not None:
-        s = jnp.where(mask[None, None, :, :], s, NEG_INF)
+        bmask = mask[None, None] if mask.ndim == 2 else mask[:, None]
+        s = jnp.where(bmask, s, NEG_INF)
     m_blk = jnp.max(s, axis=-1)
     m_new = jnp.maximum(m, m_blk)
     # guard fully-masked rows (all NEG_INF): exp underflows to 0 safely
@@ -105,7 +107,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = SEQ,
 
 
 def blockwise_attention(q, k, v, *, block_size: int = 512, causal: bool = True,
-                        window: int = 0):
+                        window: int = 0, segment_ids=None):
     """Single-device memory-efficient attention: the same online-softmax
     accumulation over K/V chunks without the ring — the long-context path
     when seq fits one device but the full [L, L] score matrix does not.
@@ -113,6 +115,10 @@ def blockwise_attention(q, k, v, *, block_size: int = 512, causal: bool = True,
     window > 0 restricts each query to the last ``window`` keys (sliding
     window, HF Mistral semantics: key visible iff 0 <= q_pos - k_pos <
     window); 0 means full causal/bidirectional.
+
+    segment_ids [B, L] (packed-document training) restricts attention to
+    keys in the SAME segment — documents packed into one window never
+    attend across their boundaries.
     """
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -122,6 +128,11 @@ def blockwise_attention(q, k, v, *, block_size: int = 512, causal: bool = True,
     if pad:
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    if segment_ids is not None:
+        seg_q = segment_ids
+        # pad with -1: padded keys match no real segment
+        seg_k = jnp.pad(segment_ids, ((0, 0), (0, pad)),
+                        constant_values=-1) if pad else segment_ids
     q32 = q.astype(jnp.float32)
     m = jnp.full((b, h, lq), NEG_INF, dtype=jnp.float32)
     l = jnp.zeros((b, h, lq), dtype=jnp.float32)
@@ -143,6 +154,10 @@ def blockwise_attention(q, k, v, *, block_size: int = 512, causal: bool = True,
             # must hold even under causal=False
             delta = pos_q[:, None] - pos_k[None, :]
             mask = mask & (delta >= 0) & (delta < window)
+        if segment_ids is not None:
+            seg_k_blk = lax.dynamic_slice_in_dim(seg_k, i * block, block,
+                                                 axis=1)
+            mask = mask[None] & (seg_q[:, :, None] == seg_k_blk[:, None, :])
         m, l, o = _block_attn(q32, k_blk.astype(jnp.float32),
                               v_blk.astype(jnp.float32), m, l, o, mask)
         return (m, l, o), None
@@ -152,9 +167,10 @@ def blockwise_attention(q, k, v, *, block_size: int = 512, causal: bool = True,
     return out.astype(q.dtype)
 
 
-def reference_attention(q, k, v, *, causal: bool = True, window: int = 0):
-    """O(L^2)-memory reference for tests. ``window`` as in
-    blockwise_attention (sliding window over the last ``window`` keys)."""
+def reference_attention(q, k, v, *, causal: bool = True, window: int = 0,
+                        segment_ids=None):
+    """O(L^2)-memory reference for tests. ``window``/``segment_ids`` as in
+    blockwise_attention."""
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
@@ -165,5 +181,8 @@ def reference_attention(q, k, v, *, causal: bool = True, window: int = 0):
     if window > 0:
         visible = (pos_q >= pos_k) & (pos_q - pos_k < window)
         s = jnp.where(visible[None, None], s, NEG_INF)
+    if segment_ids is not None:
+        same = segment_ids[:, :, None] == segment_ids[:, None, :]
+        s = jnp.where(same[:, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
