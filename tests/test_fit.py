@@ -259,3 +259,20 @@ def test_fit_ema_params():
     # and without ema_decay the field stays None
     assert fit(make(True), params, loader(),
                log_every=0).ema_params is None
+
+
+def test_cross_entropy_mask():
+    logits = jnp.asarray(np.random.default_rng(0).standard_normal((2, 4, 8)),
+                         jnp.float32)
+    labels = jnp.zeros((2, 4), jnp.int32)
+    full = float(cross_entropy_loss(logits, labels))
+    ones = float(cross_entropy_loss(logits, labels, jnp.ones((2, 4))))
+    np.testing.assert_allclose(full, ones, rtol=1e-6)
+    # masking half the positions equals the mean over the kept half
+    mask = jnp.asarray([[1, 1, 0, 0], [1, 0, 0, 0]], jnp.float32)
+    got = float(cross_entropy_loss(logits, labels, mask))
+    logp = jax.nn.log_softmax(logits, axis=-1)[..., 0]
+    want = -float((logp * mask).sum() / mask.sum())
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # all-masked: defined (0), not NaN
+    assert float(cross_entropy_loss(logits, labels, jnp.zeros((2, 4)))) == 0.0

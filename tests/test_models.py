@@ -462,10 +462,31 @@ def test_segment_ids_scan_layers_and_rejections():
     with pytest.raises(ValueError, match="decode"):
         model.apply(params, tokens, decode=True, segment_ids=segs,
                     mutable=["cache"])
-    cfg_p = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+    mesh_sp = make_mesh(MeshSpec(data=2, seq=4))
+    cfg_u = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
                               n_layers=1, d_ff=64, max_seq_len=32,
-                              dtype=jnp.float32, attention_backend="pallas")
-    m_p = Transformer(cfg_p)
-    p_p = m_p.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+                              dtype=jnp.float32, attention_backend="ulysses",
+                              mesh=mesh_sp)
+    m_u = Transformer(cfg_u)
+    p_u = m_u.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
     with pytest.raises(ValueError, match="segment_ids"):
-        m_p.apply(p_p, tokens, segment_ids=segs)
+        m_u.apply(p_u, tokens, segment_ids=segs)
+
+
+def test_segment_ids_pallas_backend_matches_reference():
+    cfg_p = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                              n_layers=2, d_ff=64, max_seq_len=32,
+                              dtype=jnp.float32, attention_backend="pallas",
+                              attention_block_size=8)
+    cfg_r = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                              n_layers=2, d_ff=64, max_seq_len=32,
+                              dtype=jnp.float32,
+                              attention_backend="reference")
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 24), 0, 64)
+    segs = jnp.asarray([[0] * 9 + [1] * 15, [0] * 24], jnp.int32)
+    model_r = Transformer(cfg_r)
+    params = model_r.init(jax.random.PRNGKey(1), tokens)
+    ref = model_r.apply(params, tokens, segment_ids=segs)
+    out = Transformer(cfg_p).apply(params, tokens, segment_ids=segs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)

@@ -112,10 +112,10 @@ def _attention(cfg: TransformerConfig, q, k, v, segment_ids=None):
             f"blockwise, pallas, and ulysses backends, not "
             f"{cfg.attention_backend!r}")
     if segment_ids is not None and cfg.attention_backend not in (
-            "reference", "blockwise"):
+            "reference", "blockwise", "pallas"):
         raise ValueError(
             f"segment_ids (packed-document masking) is only implemented "
-            f"for the reference and blockwise backends, not "
+            f"for the reference, blockwise, and pallas backends, not "
             f"{cfg.attention_backend!r}")
     if cfg.attention_backend == "reference":
         return reference_attention(q, k, v, causal=True,
@@ -143,7 +143,8 @@ def _attention(cfg: TransformerConfig, q, k, v, segment_ids=None):
         return flash_attention(q, k, v, causal=True,
                                block_q=cfg.attention_block_size,
                                block_k=cfg.attention_block_size,
-                               window=cfg.sliding_window)
+                               window=cfg.sliding_window,
+                               segment_ids=segment_ids)
     raise ValueError(f"unknown attention backend {cfg.attention_backend}")
 
 
@@ -517,7 +518,8 @@ class Transformer(nn.Module):
         segment_ids [B, L] (packed-document training): attention is
         restricted to same-segment keys, so documents packed into one
         window never leak into each other. Training-path only (decode
-        caches have no segment notion); reference/blockwise backends."""
+        caches have no segment notion); reference/blockwise/pallas
+        backends (the pallas kernels stream the ids as blocked operands)."""
         if segment_ids is not None and decode:
             raise ValueError("segment_ids are a training-path feature; "
                              "decode has no segment notion")

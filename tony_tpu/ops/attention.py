@@ -28,6 +28,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
@@ -99,9 +100,13 @@ def _banded_qi(ki, qi_local, nqb, nq, block_q: int, block_k: int):
     return jnp.minimum(first, nq - nqb) + qi_local
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                  *, causal: bool, block_q: int, block_k: int, scale: float,
-                  window: int = 0):
+def _flash_kernel(q_ref, k_ref, v_ref, *rest,
+                  causal: bool, block_q: int, block_k: int, scale: float,
+                  window: int = 0, has_seg: bool = False):
+    if has_seg:
+        qseg_ref, kseg_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(1)
     ki_local = pl.program_id(2)
     nk = pl.num_programs(2)  # band width (= all kv blocks when unwindowed)
@@ -126,8 +131,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(qi, ki, block_q, block_k, window),
-                          s, NEG_INF)
+            mask = _causal_mask(qi, ki, block_q, block_k, window)
+            if has_seg:
+                mask = mask & (qseg_ref[0, 0][:, None]
+                               == kseg_ref[0, 0][None, :])
+            s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -156,8 +164,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_scr, *, causal: bool, block_q: int,
-                         block_k: int, scale: float, window: int = 0):
+                         *rest, causal: bool, block_q: int,
+                         block_k: int, scale: float, window: int = 0,
+                         has_seg: bool = False):
+    if has_seg:
+        qseg_ref, kseg_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
     """dQ: grid (bh, nq, nk); for each q block, scan kv blocks.
 
     FlashAttention-2 backward math with the normalized P recomputed from
@@ -184,8 +197,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(qi, ki, block_q, block_k, window),
-                          s, NEG_INF)
+            mask = _causal_mask(qi, ki, block_q, block_k, window)
+            if has_seg:
+                mask = mask & (qseg_ref[0, 0][:, None]
+                               == kseg_ref[0, 0][None, :])
+            s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0])  # lse block: [block_q, 1], broadcasts
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -207,9 +223,14 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                          *rest, causal: bool,
                           block_q: int, block_k: int, scale: float,
-                          nq: int, nqb: int, window: int = 0):
+                          nq: int, nqb: int, window: int = 0,
+                          has_seg: bool = False):
+    if has_seg:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     """dK/dV: grid (b*kvh, nk, group*nq); for each KV-HEAD block, the
     innermost scan walks every q block of every q head in this kv group
     (step s: head g = s // nq, q block qi = s % nq), accumulating into one
@@ -241,8 +262,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = jnp.where(_causal_mask(qi, ki, block_q, block_k, window),
-                          s, NEG_INF)
+            mask = _causal_mask(qi, ki, block_q, block_k, window)
+            if has_seg:
+                mask = mask & (qseg_ref[0, 0][:, None]
+                               == kseg_ref[0, 0][None, :])
+            s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0])  # [block_q, block_k]
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -270,7 +294,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
-                   interpret: bool, window: int = 0):
+                   interpret: bool, window: int = 0, segments=None):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     kvh = k.shape[2]
@@ -300,7 +324,26 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
         return row, ki, 0
 
     kernel = functools.partial(_flash_kernel, causal=causal, block_q=block_q,
-                               block_k=block_k, scale=scale, window=window)
+                               block_k=block_k, scale=scale, window=window,
+                               has_seg=segments is not None)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    operands = [qr, kr, vr]
+    if segments is not None:
+        seg3 = segments[:, None, :]  # [B, 1, L]: legal TPU tile shape
+        in_specs += [
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bh, qi, ki: (bh // h, 0, qi)),
+            pl.BlockSpec(
+                (1, 1, block_k),
+                (lambda bh, qi, ki:
+                 (bh // h, 0, _banded_ki(qi, ki, nkb, block_q, block_k)))
+                if causal else (lambda bh, qi, ki: (bh // h, 0, ki))),
+        ]
+        operands += [seg3, seg3]
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
@@ -310,11 +353,7 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
             jax.ShapeDtypeStruct((b * h, lq, 1), jnp.float32),
         ],
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
@@ -326,12 +365,13 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
         ],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(qr, kr, vr)
+    )(*operands)
     return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3), lse
 
 
 def _flash_backward(q, k, v, o, lse, g, *, causal: bool, block_q: int,
-                    block_k: int, interpret: bool, window: int = 0):
+                    block_k: int, interpret: bool, window: int = 0,
+                    segments=None):
     """Pallas dQ/dK/dV (FlashAttention-2 scheme).
 
     GQA: the kv BlockSpec indexes each q head's group row (as in the
@@ -364,25 +404,39 @@ def _flash_backward(q, k, v, o, lse, g, *, causal: bool, block_q: int,
     q_spec_dq = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
     row_spec_dq = pl.BlockSpec((1, block_q, 1),
                                lambda bh, qi, ki: (bh, qi, 0))
+    in_specs_dq = [
+        q_spec_dq,
+        pl.BlockSpec((1, block_k, d), kv_index_dq),
+        pl.BlockSpec((1, block_k, d), kv_index_dq),
+        q_spec_dq,
+        row_spec_dq,
+        row_spec_dq,
+    ]
+    operands_dq = [qr, kr, vr, dor, lse, delta]
+    if segments is not None:
+        seg3 = segments[:, None, :]
+        in_specs_dq += [
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bh, qi, ki: (bh // h, 0, qi)),
+            pl.BlockSpec(
+                (1, 1, block_k),
+                (lambda bh, qi, ki:
+                 (bh // h, 0, _banded_ki(qi, ki, nkb, block_q, block_k)))
+                if causal else (lambda bh, qi, ki: (bh // h, 0, ki))),
+        ]
+        operands_dq += [seg3, seg3]
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal,
                           block_q=block_q, block_k=block_k, scale=scale,
-                          window=window),
+                          window=window, has_seg=segments is not None),
         out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
         grid=(b * h, lq // block_q, nkb),
-        in_specs=[
-            q_spec_dq,
-            pl.BlockSpec((1, block_k, d), kv_index_dq),
-            pl.BlockSpec((1, block_k, d), kv_index_dq),
-            q_spec_dq,
-            row_spec_dq,
-            row_spec_dq,
-        ],
+        in_specs=in_specs_dq,
         out_specs=q_spec_dq,
         scratch_shapes=[_vmem((block_q, d))],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(qr, kr, vr, dor, lse, delta)
+    )(*operands_dq)
 
     # dK/dV grid is per KV head: the innermost axis walks group*nqb steps
     # (the banded q blocks of all q heads in this group), so outputs are
@@ -400,28 +454,45 @@ def _flash_backward(q, k, v, o, lse, g, *, causal: bool, block_q: int,
     q_spec_dkv = pl.BlockSpec((1, block_q, d), q_row_dkv)
     row_spec_dkv = pl.BlockSpec((1, block_q, 1), q_row_dkv)
     kv_spec_dkv = pl.BlockSpec((1, block_k, d), lambda bkv, ki, s: (bkv, ki, 0))
+    in_specs_dkv = [
+        q_spec_dkv,
+        kv_spec_dkv,
+        kv_spec_dkv,
+        q_spec_dkv,
+        row_spec_dkv,
+        row_spec_dkv,
+    ]
+    operands_dkv = [qr, kr, vr, dor, lse, delta]
+    if segments is not None:
+        seg3 = segments[:, None, :]
+        in_specs_dkv += [
+            pl.BlockSpec(
+                (1, 1, block_q),
+                (lambda bkv, ki, s:
+                 (bkv // kvh, 0, _banded_qi(ki, s % nqb, nqb, nq,
+                                            block_q, block_k)))
+                if causal else
+                (lambda bkv, ki, s: (bkv // kvh, 0, s % nqb))),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bkv, ki, s: (bkv // kvh, 0, ki)),
+        ]
+        operands_dkv += [seg3, seg3]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, causal=causal,
                           block_q=block_q, block_k=block_k, scale=scale,
-                          nq=nq, nqb=nqb, window=window),
+                          nq=nq, nqb=nqb, window=window,
+                          has_seg=segments is not None),
         out_shape=[
             jax.ShapeDtypeStruct((b * kvh, lk, d), k.dtype),
             jax.ShapeDtypeStruct((b * kvh, lk, d), v.dtype),
         ],
         grid=(b * kvh, lk // block_k, group * nqb),
-        in_specs=[
-            q_spec_dkv,
-            kv_spec_dkv,
-            kv_spec_dkv,
-            q_spec_dkv,
-            row_spec_dkv,
-            row_spec_dkv,
-        ],
+        in_specs=in_specs_dkv,
         out_specs=[kv_spec_dkv, kv_spec_dkv],
         scratch_shapes=[_vmem((block_k, d)), _vmem((block_k, d))],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(qr, kr, vr, dor, lse, delta)
+    )(*operands_dkv)
 
     dq = dq.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
     dk = dk.reshape(b, kvh, lk, d).transpose(0, 2, 1, 3)
@@ -475,36 +546,45 @@ def _blocks(block_q, block_k, q, k):
     return _pick_block(block_q, q.shape[1]), _pick_block(block_k, k.shape[1])
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_core(q, k, v, causal: bool, block_q: int, block_k: int,
-                          interpret: bool | None, window: int = 0):
-    """custom_vjp core; sequence lengths must have a usable block."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention_core(q, k, v, segments, causal: bool, block_q: int,
+                          block_k: int, interpret: bool | None,
+                          window: int = 0):
+    """custom_vjp core; sequence lengths must have a usable block.
+    ``segments`` is an int operand (or None): zero-cotangent in the vjp."""
     if interpret is None:
         interpret = not _on_tpu()
     bq, bk = _blocks(block_q, block_k, q, k)
     out, _ = _flash_forward(q, k, v, causal=causal, block_q=bq, block_k=bk,
-                            interpret=interpret, window=window)
+                            interpret=interpret, window=window,
+                            segments=segments)
     return out
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret, window=0):
+def _fwd(q, k, v, segments, causal, block_q, block_k, interpret, window=0):
     if interpret is None:
         interpret = not _on_tpu()
     bq, bk = _blocks(block_q, block_k, q, k)
     out, lse = _flash_forward(q, k, v, causal=causal, block_q=bq, block_k=bk,
-                              interpret=interpret, window=window)
-    return out, (q, k, v, out, lse)
+                              interpret=interpret, window=window,
+                              segments=segments)
+    return out, (q, k, v, segments, out, lse)
 
 
 def _bwd(causal, block_q, block_k, interpret, window, res, g):
     """Pallas FlashAttention-2 backward: recomputes P blockwise from the
     saved logsumexp — O(L) memory, no [L, L] tensor, no K/V repeat."""
-    q, k, v, o, lse = res
+    q, k, v, segments, o, lse = res
     if interpret is None:
         interpret = not _on_tpu()
     bq, bk = _blocks(block_q, block_k, q, k)
-    return _flash_backward(q, k, v, o, lse, g, causal=causal, block_q=bq,
-                           block_k=bk, interpret=interpret, window=window)
+    dq, dk, dv = _flash_backward(q, k, v, o, lse, g, causal=causal,
+                                 block_q=bq, block_k=bk, interpret=interpret,
+                                 window=window, segments=segments)
+    # int segments carry the symbolic-zero float0 cotangent
+    dseg = None if segments is None else np.zeros(segments.shape,
+                                                  jax.dtypes.float0)
+    return dq, dk, dv, dseg
 
 
 _flash_attention_core.defvjp(_fwd, _bwd)
@@ -523,7 +603,7 @@ def _padded_len(length: int, limit: int) -> int:
 
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
                     block_k: int = 512, interpret: bool | None = None,
-                    window: int = 0):
+                    window: int = 0, segment_ids=None):
     """Fused attention. q: [B, L, H, D]; k/v: [B, L, KVH, D] with
     H % KVH == 0 (GQA: the kernel indexes each q head's kv group directly —
     no repeated K/V is ever materialized). Returns [B, L, H, D].
@@ -545,17 +625,34 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
         raise ValueError("window > 0 requires causal=True (the sliding "
                          "window is defined over past keys)")
     lq, lk = q.shape[1], k.shape[1]
+    if segment_ids is not None:
+        if not causal:
+            raise ValueError("segment_ids require causal=True (packed-LM "
+                             "masking)")
+        if lq != lk:
+            raise ValueError("segment_ids need self-attention shapes "
+                             f"(lq == lk), got ({lq}, {lk})")
+        segment_ids = segment_ids.astype(jnp.int32)
     plq, plk = _padded_len(lq, block_q), _padded_len(lk, block_k)
     if plq == lq and plk == lk:
-        return _flash_attention_core(q, k, v, causal, block_q, block_k,
-                                     interpret, window)
+        return _flash_attention_core(q, k, v, segment_ids, causal, block_q,
+                                     block_k, interpret, window)
     if not causal:
         raise ValueError(
             f"non-causal flash attention needs blockable seq lens, got "
             f"({lq}, {lk}); pad the sequence or use the blockwise backend")
-    pad_q = [(0, 0), (0, plq - lq), (0, 0), (0, 0)]
-    pad_k = [(0, 0), (0, plk - lk), (0, 0), (0, 0)]
+    # pad BOTH sides to one common blockable length: with block_q !=
+    # block_k, plq != plk would let q-side blocks (and the banded kv
+    # index) run past the shorter array
+    pm = max(plq, plk)
+    pad_q = [(0, 0), (0, pm - lq), (0, 0), (0, 0)]
+    pad_k = [(0, 0), (0, pm - lk), (0, 0), (0, 0)]
+    seg_p = None
+    if segment_ids is not None:
+        # padded positions get segment -1: real queries never attend them
+        seg_p = jnp.pad(segment_ids, [(0, 0), (0, pm - lk)],
+                        constant_values=-1)
     out = _flash_attention_core(
-        jnp.pad(q, pad_q), jnp.pad(k, pad_k), jnp.pad(v, pad_k),
+        jnp.pad(q, pad_q), jnp.pad(k, pad_k), jnp.pad(v, pad_k), seg_p,
         causal, block_q, block_k, interpret, window)
     return out[:, :lq]

@@ -36,11 +36,16 @@ jax.tree_util.register_pytree_node(
 )
 
 
-def cross_entropy_loss(logits, labels):
-    """logits: [..., V], labels: [...] int."""
+def cross_entropy_loss(logits, labels, mask=None):
+    """logits: [..., V], labels: [...] int. ``mask`` (same shape as labels,
+    0/1 or bool) drops positions from the mean — e.g. packed-document
+    training masking the cross-boundary target after each EOS."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    if mask is None:
+        return -jnp.mean(ll)
+    mask = mask.astype(jnp.float32)
+    return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
 @dataclass
