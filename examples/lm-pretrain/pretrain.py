@@ -82,20 +82,29 @@ def main() -> int:
                         jnp.zeros((1, args.seq_len), jnp.int32))
 
     def apply_fn(p, batch):
+        # segment ids (packed real text): documents in one window never
+        # attend across their boundaries
+        segs = batch.get("segments")
         # hidden + chunked CE: the [B, L, V] logits are never materialized
         if cfg.moe_every:
             hidden, mut = model.apply(p, batch["tokens"], return_hidden=True,
-                                      mutable=["losses"])
+                                      segment_ids=segs, mutable=["losses"])
             aux = moe_aux_loss(mut["losses"])
         else:
-            hidden = model.apply(p, batch["tokens"], return_hidden=True)
+            hidden = model.apply(p, batch["tokens"], return_hidden=True,
+                                 segment_ids=segs)
             aux = 0.0
+        # drop the cross-boundary target after each EOS: the next
+        # document's first token is unpredictable noise
+        loss_mask = None if segs is None else segs[:, :-1] == segs[:, 1:]
         ce = chunked_cross_entropy(hidden[:, :-1], p["params"]["embedding"],
-                                   batch["tokens"][:, 1:], chunk_size=256)
+                                   batch["tokens"][:, 1:], chunk_size=256,
+                                   mask=loss_mask)
         return ce + aux
 
     if tok is not None:
-        source = PackedTokenSource(corpus, seq_len=args.seq_len)
+        source = PackedTokenSource(corpus, seq_len=args.seq_len,
+                                   segment_eos_id=tok.eos_id)
     else:
         source = SyntheticTokenSource(
             num_examples=args.global_batch * max(args.steps, 1),

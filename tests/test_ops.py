@@ -383,3 +383,31 @@ def test_flash_attention_segments_mixed_blocks_padded():
     out2 = flash_attention(q, k, v, True, 16, 8)
     np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_chunked_xent_mask_matches_full():
+    from tony_tpu.ops import chunked_cross_entropy, full_cross_entropy
+
+    rng = jax.random.PRNGKey(15)
+    hidden = jax.random.normal(rng, (2, 6, 16))
+    emb = jax.random.normal(jax.random.fold_in(rng, 1), (40, 16))
+    labels = jax.random.randint(jax.random.fold_in(rng, 2), (2, 6), 0, 40)
+    mask = jnp.asarray([[1, 1, 0, 1, 1, 1], [1, 0, 0, 1, 1, 1]], jnp.float32)
+    got = float(chunked_cross_entropy(hidden, emb, labels, chunk_size=16,
+                                      mask=mask))
+    logits = jnp.einsum("bld,vd->blv", hidden, emb)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    want = -float((ll * mask).sum() / mask.sum())
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # mask=None unchanged vs full reference
+    np.testing.assert_allclose(
+        float(chunked_cross_entropy(hidden, emb, labels, chunk_size=16)),
+        float(full_cross_entropy(hidden, emb, labels)), rtol=1e-5)
+
+
+def test_flash_attention_rejects_unequal_unblockable_causal():
+    q = jnp.ones((1, 41, 2, 8))
+    kv = jnp.ones((1, 24, 2, 8))
+    with pytest.raises(ValueError, match="UNEQUAL"):
+        flash_attention(q, kv, kv, True, 8, 8)

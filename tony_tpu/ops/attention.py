@@ -641,6 +641,14 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
         raise ValueError(
             f"non-causal flash attention needs blockable seq lens, got "
             f"({lq}, {lk}); pad the sequence or use the blockwise backend")
+    if lq != lk:
+        # causal cross-attention with lq > lk would let real queries past
+        # lk attend zero-padded keys (score 0 > negative real scores =
+        # silent mass leak); the pad path is only sound for self-attention
+        raise ValueError(
+            f"causal flash attention with unblockable UNEQUAL seq lens "
+            f"({lq}, {lk}) cannot be zero-padded safely; pad the inputs "
+            f"yourself or use the blockwise backend")
     # pad BOTH sides to one common blockable length: with block_q !=
     # block_k, plq != plk would let q-side blocks (and the banded kv
     # index) run past the shorter array

@@ -23,7 +23,8 @@ NEG_INF = -1e30
 
 
 def chunked_cross_entropy(hidden, embedding, labels, *,
-                          chunk_size: int = 8192, z_loss: float = 0.0):
+                          chunk_size: int = 8192, z_loss: float = 0.0,
+                          mask=None):
     """Mean token cross-entropy of ``logits = hidden @ embedding.T`` without
     materializing the logits.
 
@@ -34,13 +35,18 @@ def chunked_cross_entropy(hidden, embedding, labels, *,
       chunk_size: vocab tile width (rounded use: keep a multiple of 128).
       z_loss: optional logsumexp^2 regularizer weight (PaLM-style), keeps
         logits from drifting — free here since lse is already computed.
+      mask: optional per-position 0/1 (or bool) weights shaped like
+        labels — e.g. packed-document training dropping the
+        cross-boundary target after each EOS.
 
-    Returns mean loss (fp32 scalar).
+    Returns mean loss (fp32 scalar) over the unmasked positions.
     """
     if hidden.ndim == 3:
         t = hidden.shape[0] * hidden.shape[1]
         hidden = hidden.reshape(t, hidden.shape[2])
         labels = labels.reshape(t)
+        if mask is not None:
+            mask = mask.reshape(t)
     v, d = embedding.shape
     chunk = min(chunk_size, v)
     n_chunks = (v + chunk - 1) // chunk
@@ -74,9 +80,17 @@ def chunked_cross_entropy(hidden, embedding, labels, *,
     (m, s, lab), _ = lax.scan(jax.checkpoint(body), init,
                               jnp.arange(n_chunks))
     lse = m + jnp.log(s)
-    loss = jnp.mean(lse - lab)
+    per_tok = lse - lab
+    if mask is None:
+        loss = jnp.mean(per_tok)
+        if z_loss:
+            loss = loss + z_loss * jnp.mean(lse * lse)
+        return loss
+    w = mask.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(w), 1.0)
+    loss = jnp.sum(per_tok * w) / denom
     if z_loss:
-        loss = loss + z_loss * jnp.mean(lse * lse)
+        loss = loss + z_loss * jnp.sum(lse * lse * w) / denom
     return loss
 
 
