@@ -369,3 +369,35 @@ def test_beam_search_scan_layers_model():
         np.asarray(beam_search(model, params, prompt, max_new_tokens=4,
                                num_beams=1)),
         np.asarray(generate(model, params, prompt, max_new_tokens=4)))
+
+
+def test_score_cli_on_local_checkpoint(tmp_path):
+    """tony-tpu score: perplexity must match a torch teacher-forced NLL."""
+    import subprocess
+    import sys
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(config).eval()
+    mdir = tmp_path / "ckpt"
+    hf.save_pretrained(str(mdir))
+    import os
+    ids = [1, 2, 3, 4, 5, 6]
+    proc = subprocess.run(
+        [sys.executable, "-m", "tony_tpu.cli.score", "--model", str(mdir),
+         "--token-ids", ",".join(map(str, ids))],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__)))})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("TOTAL")][0]
+    got_nll = float(line.split("nll/token=")[1].split()[0])
+    with torch.no_grad():
+        out = hf(torch.tensor([ids]), labels=torch.tensor([ids]))
+    np.testing.assert_allclose(got_nll, float(out.loss), rtol=1e-3)

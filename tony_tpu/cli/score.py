@@ -1,0 +1,93 @@
+"""``tony-tpu score`` — perplexity/log-likelihood of a local HF checkpoint.
+
+The eval face of the serving stack (sibling of ``tony-tpu generate``):
+import a GPT-2/Llama/Mistral/Qwen2 directory, run the full forward, and
+report per-token negative log-likelihood + perplexity over the given
+text or token ids. Offline; one jitted forward per input length.
+
+    python -m tony_tpu.cli.score --model ./my-llama --text-file eval.txt
+    python -m tony_tpu.cli.score --model ./ckpt --token-ids 1,2,3,4
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tony-tpu score",
+        description="Perplexity of a local HF checkpoint over given text",
+    )
+    p.add_argument("--model", required=True,
+                   help="local checkpoint directory (HF format)")
+    p.add_argument("--text", action="append", default=[],
+                   help="text to score (repeatable; needs a tokenizer in "
+                        "the model dir)")
+    p.add_argument("--text-file", action="append", default=[],
+                   help="file whose contents to score (repeatable)")
+    p.add_argument("--token-ids", action="append", default=[],
+                   help="raw ids, comma-separated (repeatable)")
+    p.add_argument("--max-len", type=int, default=0,
+                   help="truncate inputs to this many tokens "
+                        "(default: the model's max_seq_len)")
+    return p
+
+
+def score_ids(model, params, ids) -> tuple[float, int]:
+    """(total nll, token count) of ids under the model (teacher-forced)."""
+    import jax.nn
+    import jax.numpy as jnp
+
+    tokens = jnp.asarray([ids], jnp.int32)
+    logits = model.apply(params, tokens)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        logp[:, :-1], tokens[:, 1:, None], axis=-1)[0, :, 0]
+    return float(-picked.sum()), len(ids) - 1
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from tony_tpu.cli.generate import load_model
+
+    inputs: list[list[int]] = []
+    texts = list(args.text)
+    for path in args.text_file:
+        with open(path, encoding="utf-8") as f:
+            texts.append(f.read())
+    model, params, config = load_model(args.model)
+    if texts:
+        import transformers
+
+        tokenizer = transformers.AutoTokenizer.from_pretrained(args.model)
+        inputs += [tokenizer.encode(t) for t in texts]
+    inputs += [[int(i) for i in ids.split(",")] for ids in args.token_ids]
+    if not inputs:
+        print("need --text, --text-file, or --token-ids", file=sys.stderr)
+        return 2
+
+    limit = args.max_len or model.cfg.max_seq_len
+    total_nll = 0.0
+    total_tokens = 0
+    for ids in inputs:
+        ids = ids[:limit]
+        if len(ids) < 2:
+            print("skipping input with < 2 tokens", file=sys.stderr)
+            continue
+        nll, n = score_ids(model, params, ids)
+        total_nll += nll
+        total_tokens += n
+        print(f"tokens={n} nll/token={nll / n:.4f} "
+              f"ppl={math.exp(nll / n):.2f}")
+    if total_tokens:
+        avg = total_nll / total_tokens
+        print(f"TOTAL tokens={total_tokens} nll/token={avg:.4f} "
+              f"ppl={math.exp(avg):.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
