@@ -106,6 +106,58 @@ def test_generate_eos_freezes(tiny):
     assert np.asarray(out)[0].tolist() == [first] * 5
 
 
+def test_generate_eos_list_stops_on_any(tiny):
+    """HF-style list of eos ids (Llama-3 ships [128001, 128009]): decode
+    must stop on ANY listed id, freezing to the first."""
+    model, params = tiny
+    prompt = jnp.array([[1, 2]], jnp.int32)
+    first = int(generate(model, params, prompt, max_new_tokens=1)[0, 0])
+    # the hit id listed second: rows must still freeze (to the first id)
+    out = generate(model, params, prompt, max_new_tokens=5,
+                   eos_id=(63, first))
+    toks = np.asarray(out)[0].tolist()
+    assert toks[0] == first and toks[1:] == [63] * 4
+    # empty list = no stop token, same as -1
+    out_none = generate(model, params, prompt, max_new_tokens=5, eos_id=())
+    out_neg = generate(model, params, prompt, max_new_tokens=5, eos_id=-1)
+    assert np.asarray(out_none).tolist() == np.asarray(out_neg).tolist()
+    # negative ids are filtered, never used as freeze token (-1 first in
+    # the list must NOT be emitted into the output)
+    out_f = generate(model, params, prompt, max_new_tokens=5,
+                     eos_id=[-1, first])
+    out_s = generate(model, params, prompt, max_new_tokens=5, eos_id=first)
+    assert np.asarray(out_f).tolist() == np.asarray(out_s).tolist()
+    assert -1 not in np.asarray(out_f).tolist()[0]
+
+
+def test_beam_search_eos_list(tiny):
+    from tony_tpu.models import beam_search
+
+    model, params = tiny
+    prompt = jnp.array([[1, 2]], jnp.int32)
+    first = int(beam_search(model, params, prompt, max_new_tokens=1,
+                            num_beams=2)[0, 0])
+    out = np.asarray(beam_search(model, params, prompt, max_new_tokens=5,
+                                 num_beams=2, eos_id=(first, 63)))[0]
+    eos_seen = False
+    for t in out.tolist():
+        if eos_seen:
+            assert t == first  # frozen to the FIRST listed id
+        if t in (first, 63):
+            eos_seen = True
+    # single-id tuple and a plain LIST (HF config shape; unhashable, so it
+    # must be normalized before the static-arg jit boundary) both behave
+    # exactly like the scalar form
+    a = beam_search(model, params, prompt, max_new_tokens=5, num_beams=2,
+                    eos_id=(first,))
+    b = beam_search(model, params, prompt, max_new_tokens=5, num_beams=2,
+                    eos_id=first)
+    c = beam_search(model, params, prompt, max_new_tokens=5, num_beams=2,
+                    eos_id=[first, -1])
+    assert np.asarray(a).tolist() == np.asarray(b).tolist()
+    assert np.asarray(c).tolist() == np.asarray(b).tolist()
+
+
 def test_generate_top_p_shapes_and_validity(tiny):
     model, params = tiny
     prompt = jnp.array([[1, 2, 3]], jnp.int32)

@@ -411,3 +411,29 @@ def test_flash_attention_rejects_unequal_unblockable_causal():
     kv = jnp.ones((1, 24, 2, 8))
     with pytest.raises(ValueError, match="UNEQUAL"):
         flash_attention(q, kv, kv, True, 8, 8)
+
+
+def test_flash_attention_causal_cross_blockable_lengths():
+    """Regression (ADVICE r1): blockable causal cross-attention with
+    lq > lk must not let the banded diagonal index run past the kv grid —
+    the clamp in _banded_ki restores a full scan + position mask."""
+    key = jax.random.PRNGKey(11)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 32, 2, 8), jnp.float32)
+    k = jax.random.normal(kk, (1, 8, 2, 8), jnp.float32)
+    v = jax.random.normal(kv_, (1, 8, 2, 8), jnp.float32)
+    out = flash_attention(q, k, v, True, 8, 8)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+    # ... and the lq < lk direction (kv-cache-style prefill chunk)
+    out2 = flash_attention(k, q, q, True, 8, 8)
+    ref2 = reference_attention(k, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2), atol=2e-5,
+                               rtol=2e-5)
+    # dQ path shares the banded index: gradient must be finite and match
+    g = jax.grad(lambda q: flash_attention(q, k, v, True, 8, 8).sum())(q)
+    g_ref = jax.grad(
+        lambda q: reference_attention(q, k, v, causal=True).sum())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=5e-5,
+                               rtol=5e-5)

@@ -87,9 +87,12 @@ def main(argv=None) -> int:
     prompts = [tokenizer.encode(t) for t in args.prompt]
     prompts += [[int(i) for i in ids.split(",")] for ids in args.token_ids]
 
-    eos = args.eos_id
-    if eos < 0 and getattr(config, "eos_token_id", None) is not None:
-        eos = int(config.eos_token_id)
+    from tony_tpu.models.generate import normalize_eos_ids
+
+    # HF configs may ship a LIST of eos ids (Llama-3 instruct:
+    # [128001, 128009]); the decode loops stop on ANY of them
+    eos = normalize_eos_ids(args.eos_id) or \
+        normalize_eos_ids(getattr(config, "eos_token_id", None))
 
     from tony_tpu.models import beam_search
 
@@ -112,8 +115,9 @@ def main(argv=None) -> int:
                            repetition_penalty=args.repetition_penalty,
                            rng=jax.random.PRNGKey(args.seed))
         new_ids = np.asarray(out)[0].tolist()
-        if eos >= 0 and eos in new_ids:
-            new_ids = new_ids[:new_ids.index(eos)]
+        stops = [i for i, t in enumerate(new_ids) if t in eos]
+        if stops:
+            new_ids = new_ids[:stops[0]]
         if tokenizer is not None:
             print(tokenizer.decode(ids + new_ids))
         else:

@@ -75,21 +75,58 @@ def _penalize_repeats(logits, seen, penalty):
     return jnp.where(seen, penalized, logits)
 
 
-@functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
-                                             "top_k", "top_p"))
+def normalize_eos_ids(eos_id) -> tuple:
+    """Normalize eos_id to a tuple of valid ids: int (-1/None = none) or
+    a tuple/list of ids (HF configs ship lists — Llama-3 instruct:
+    [128001, 128009]); negatives are dropped. Runs OUTSIDE jit (the >= 0
+    filter inspects values), in the public generate/beam_search wrappers —
+    both decoders see identical semantics for every input shape."""
+    if isinstance(eos_id, (list, tuple)):
+        return tuple(int(e) for e in eos_id if int(e) >= 0)
+    return (int(eos_id),) if eos_id is not None and int(eos_id) >= 0 else ()
+
+
+def _is_eos(tok, eos_ids):
+    """True where ``tok`` equals ANY of the eos ids (stop on any; a
+    generation must not run past end-of-turn just because it isn't the
+    first listed id)."""
+    if not eos_ids:
+        return jnp.zeros(tok.shape, bool)
+    hit = tok == eos_ids[0]
+    for e in eos_ids[1:]:
+        hit = hit | (tok == e)
+    return hit
+
+
 def generate(model, params, prompt, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-             rng: jax.Array | None = None, eos_id: int = -1,
+             rng: jax.Array | None = None, eos_id=-1,
              repetition_penalty: float = 1.0):
     """Generate max_new_tokens continuations of ``prompt`` [b, Lp].
 
-    Returns [b, max_new_tokens] int32. Tokens after an eos_id are frozen
-    to eos_id (computed but masked — fixed trip count keeps the scan
-    static; early-exit would force a while_loop with dynamic shapes
-    downstream). ``repetition_penalty`` > 1 discourages tokens already in
-    the prompt or generated so far (CTRL-style; traced — sweeping values
-    never recompiles).
+    Returns [b, max_new_tokens] int32. ``eos_id`` is an int (-1 = no stop
+    token) or a list/tuple of ids (stop on any; frozen rows re-emit the
+    first) — normalized here, outside jit, so invalid ids never reach the
+    compiled program. Tokens after an eos are frozen (computed but
+    masked — fixed trip count keeps the scan static; early-exit would
+    force a while_loop with dynamic shapes downstream).
+    ``repetition_penalty`` > 1 discourages tokens already in the prompt or
+    generated so far (CTRL-style; traced — sweeping values never
+    recompiles).
     """
+    return _generate(model, params, prompt, max_new_tokens=max_new_tokens,
+                     temperature=temperature, top_k=top_k, top_p=top_p,
+                     rng=rng, eos_ids=normalize_eos_ids(eos_id),
+                     repetition_penalty=repetition_penalty)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
+                                             "top_k", "top_p", "eos_ids"))
+def _generate(model, params, prompt, *, max_new_tokens: int,
+              temperature: float, top_k: int, top_p: float,
+              rng: jax.Array | None, eos_ids: tuple,
+              repetition_penalty: float):
+    freeze = eos_ids[0] if eos_ids else -1
     if rng is None:
         rng = jax.random.PRNGKey(0)
     b, prompt_len = prompt.shape
@@ -110,7 +147,7 @@ def generate(model, params, prompt, *, max_new_tokens: int,
     last = _penalize_repeats(logits[:, -1], seen, repetition_penalty)
     next_tok = sample_logits(last, sub, temperature, top_k, top_p)
     seen = seen.at[jnp.arange(b), next_tok].set(True)
-    done = next_tok == eos_id
+    done = _is_eos(next_tok, eos_ids)
 
     def step(carry, _):
         cache, tok, rng, done, seen = carry
@@ -120,9 +157,9 @@ def generate(model, params, prompt, *, max_new_tokens: int,
         rng, sub = jax.random.split(rng)
         last = _penalize_repeats(logits[:, -1], seen, repetition_penalty)
         nxt = sample_logits(last, sub, temperature, top_k, top_p)
-        nxt = jnp.where(done, eos_id, nxt)
+        nxt = jnp.where(done, freeze, nxt)
         seen = seen.at[jnp.arange(b), nxt].set(True)
-        done = done | (nxt == eos_id)
+        done = done | _is_eos(nxt, eos_ids)
         return (vars_["cache"], nxt, rng, done, seen), nxt
 
     carry = (vars_["cache"], next_tok, rng, done, seen)
@@ -133,10 +170,8 @@ def generate(model, params, prompt, *, max_new_tokens: int,
     return next_tok[:, None]
 
 
-@functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
-                                             "num_beams", "eos_id"))
 def beam_search(model, params, prompt, *, max_new_tokens: int,
-                num_beams: int = 4, eos_id: int = -1,
+                num_beams: int = 4, eos_id=-1,
                 length_penalty: float = 1.0):
     """Beam-search decode: returns the highest-scoring continuation
     [b, max_new_tokens] (ties to the KV cache exactly like generate()).
@@ -144,11 +179,24 @@ def beam_search(model, params, prompt, *, max_new_tokens: int,
     One jitted program (static num_beams/max_new_tokens): beams live as a
     widened batch [b*k] so the per-layer cache shards/updates like any
     batch; each step does one fused top-k over [k*V] joint candidates and
-    reorders the cache with a batch-dim gather. Finished beams (emitted
-    ``eos_id``) are frozen: they re-emit eos at zero added score. The
+    reorders the cache with a batch-dim gather. ``eos_id`` is an int
+    (-1 = none) or a list/tuple of ids — normalized here, outside jit, so
+    lists never hit the static-arg hasher; beams finishing on any listed
+    id are frozen: they re-emit the first eos at zero added score. The
     winner per batch row maximizes score / (generated_len **
     length_penalty), HF-style length normalization.
     """
+    return _beam_search(model, params, prompt,
+                        max_new_tokens=max_new_tokens, num_beams=num_beams,
+                        eos_ids=normalize_eos_ids(eos_id),
+                        length_penalty=length_penalty)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
+                                             "num_beams", "eos_ids"))
+def _beam_search(model, params, prompt, *, max_new_tokens: int,
+                 num_beams: int, eos_ids: tuple, length_penalty: float):
+    freeze = eos_ids[0] if eos_ids else 0
     b, prompt_len = prompt.shape
     k = num_beams
     if prompt_len + max_new_tokens > model.cfg.max_seq_len:
@@ -184,9 +232,8 @@ def beam_search(model, params, prompt, *, max_new_tokens: int,
     cache = jax.tree_util.tree_map_with_path(widen, vars_["cache"])
     logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
     scores, first_tok = jax.lax.top_k(logp0, k)  # [b, k]
-    finished = (first_tok == eos_id)
-    out = jnp.full((b, k, max_new_tokens), eos_id if eos_id >= 0 else 0,
-                   jnp.int32)
+    finished = _is_eos(first_tok, eos_ids)
+    out = jnp.full((b, k, max_new_tokens), freeze, jnp.int32)
     out = out.at[:, :, 0].set(first_tok)
     lengths = jnp.ones((b, k), jnp.int32)
 
@@ -198,9 +245,9 @@ def beam_search(model, params, prompt, *, max_new_tokens: int,
         cache = vars_["cache"]
         logp = jax.nn.log_softmax(
             logits[:, -1].astype(jnp.float32), axis=-1).reshape(b, k, vocab)
-        if eos_id >= 0:
-            # frozen beams: only eos continues, at no added score
-            eos_only = jnp.full((vocab,), neg).at[eos_id].set(0.0)
+        if eos_ids:
+            # frozen beams: only the freeze eos continues, at no added score
+            eos_only = jnp.full((vocab,), neg).at[freeze].set(0.0)
             logp = jnp.where(finished[:, :, None], eos_only[None, None],
                              logp)
         cand = scores[:, :, None] + logp  # [b, k, V]
@@ -215,9 +262,9 @@ def beam_search(model, params, prompt, *, max_new_tokens: int,
         out = jnp.take_along_axis(out, beam_idx[:, :, None], axis=1)
         lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
         was_finished = jnp.take_along_axis(finished, beam_idx, axis=1)
-        out = out.at[:, :, t].set(jnp.where(was_finished, eos_id, new_tok))
+        out = out.at[:, :, t].set(jnp.where(was_finished, freeze, new_tok))
         lengths = jnp.where(was_finished, lengths, lengths + 1)
-        finished = was_finished | (new_tok == eos_id)
+        finished = was_finished | _is_eos(new_tok, eos_ids)
         return (cache, new_tok, new_scores, finished, out, lengths), None
 
     carry = (cache, first_tok, scores, finished, out, lengths)

@@ -74,13 +74,18 @@ def _kv_band(window: int, block_q: int, block_k: int, nk: int) -> int:
     return min(nk, (window + block_q - 2) // block_k + 2)
 
 
-def _banded_ki(qi, ki_local, nkb, block_q: int, block_k: int):
+def _banded_ki(qi, ki_local, nkb, block_q: int, block_k: int, nk: int):
     """Real kv block index for banded grids: the band ends at this q
     block's diagonal tile; local index 0 is ``nkb - 1`` tiles before it
     (clamped at 0 — early q blocks just re-scan the first tiles and rely
     on the visibility predicate). With a full band (nkb == nk) this is the
-    identity, so the same formula serves the unwindowed causal path."""
-    diag = (qi * block_q + block_q - 1) // block_k
+    identity, so the same formula serves the unwindowed causal path.
+
+    ``nk`` is the TOTAL kv-block count: for causal cross-attention with
+    lq > lk the diagonal lies past the kv grid, so it is clamped to the
+    last real tile — every block is then scanned and the position mask
+    alone decides visibility (the pre-band full-scan behavior)."""
+    diag = jnp.minimum((qi * block_q + block_q - 1) // block_k, nk - 1)
     return jnp.maximum(diag - (nkb - 1), 0) + ki_local
 
 
@@ -102,7 +107,7 @@ def _banded_qi(ki, qi_local, nqb, nq, block_q: int, block_k: int):
 
 def _flash_kernel(q_ref, k_ref, v_ref, *rest,
                   causal: bool, block_q: int, block_k: int, scale: float,
-                  window: int = 0, has_seg: bool = False):
+                  nk_total: int, window: int = 0, has_seg: bool = False):
     if has_seg:
         qseg_ref, kseg_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -111,7 +116,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest,
     ki_local = pl.program_id(2)
     nk = pl.num_programs(2)  # band width (= all kv blocks when unwindowed)
     if causal:
-        ki = _banded_ki(qi, ki_local, nk, block_q, block_k)
+        ki = _banded_ki(qi, ki_local, nk, block_q, block_k, nk_total)
     else:
         ki = ki_local
 
@@ -165,8 +170,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          *rest, causal: bool, block_q: int,
-                         block_k: int, scale: float, window: int = 0,
-                         has_seg: bool = False):
+                         block_k: int, scale: float, nk_total: int,
+                         window: int = 0, has_seg: bool = False):
     if has_seg:
         qseg_ref, kseg_ref, dq_ref, dq_scr = rest
     else:
@@ -181,7 +186,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     ki_local = pl.program_id(2)
     nk = pl.num_programs(2)  # band width
     if causal:
-        ki = _banded_ki(qi, ki_local, nk, block_q, block_k)
+        ki = _banded_ki(qi, ki_local, nk, block_q, block_k, nk_total)
     else:
         ki = ki_local
 
@@ -320,12 +325,12 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
         # each of the `group` q heads instead of materializing a repeat
         row = (bh // h) * kvh + (bh % h) // group
         if causal:
-            return row, _banded_ki(qi, ki, nkb, block_q, block_k), 0
+            return row, _banded_ki(qi, ki, nkb, block_q, block_k, nk), 0
         return row, ki, 0
 
     kernel = functools.partial(_flash_kernel, causal=causal, block_q=block_q,
-                               block_k=block_k, scale=scale, window=window,
-                               has_seg=segments is not None)
+                               block_k=block_k, scale=scale, nk_total=nk,
+                               window=window, has_seg=segments is not None)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
         pl.BlockSpec((1, block_k, d), kv_index),
@@ -340,7 +345,7 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec(
                 (1, 1, block_k),
                 (lambda bh, qi, ki:
-                 (bh // h, 0, _banded_ki(qi, ki, nkb, block_q, block_k)))
+                 (bh // h, 0, _banded_ki(qi, ki, nkb, block_q, block_k, nk)))
                 if causal else (lambda bh, qi, ki: (bh // h, 0, ki))),
         ]
         operands += [seg3, seg3]
@@ -398,7 +403,7 @@ def _flash_backward(q, k, v, o, lse, g, *, causal: bool, block_q: int,
     def kv_index_dq(bh, qi, ki):
         row = (bh // h) * kvh + (bh % h) // group
         if causal:
-            return row, _banded_ki(qi, ki, nkb, block_q, block_k), 0
+            return row, _banded_ki(qi, ki, nkb, block_q, block_k, nk), 0
         return row, ki, 0
 
     q_spec_dq = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
@@ -421,14 +426,15 @@ def _flash_backward(q, k, v, o, lse, g, *, causal: bool, block_q: int,
             pl.BlockSpec(
                 (1, 1, block_k),
                 (lambda bh, qi, ki:
-                 (bh // h, 0, _banded_ki(qi, ki, nkb, block_q, block_k)))
+                 (bh // h, 0, _banded_ki(qi, ki, nkb, block_q, block_k, nk)))
                 if causal else (lambda bh, qi, ki: (bh // h, 0, ki))),
         ]
         operands_dq += [seg3, seg3]
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal,
                           block_q=block_q, block_k=block_k, scale=scale,
-                          window=window, has_seg=segments is not None),
+                          nk_total=nk, window=window,
+                          has_seg=segments is not None),
         out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
         grid=(b * h, lq // block_q, nkb),
         in_specs=in_specs_dq,
@@ -621,10 +627,14 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
 
     interpret=None auto-selects: compiled on TPU, interpreter elsewhere.
     """
+    lq, lk = q.shape[1], k.shape[1]
     if window > 0 and not causal:
         raise ValueError("window > 0 requires causal=True (the sliding "
                          "window is defined over past keys)")
-    lq, lk = q.shape[1], k.shape[1]
+    if window > 0 and lq != lk:
+        raise ValueError("window > 0 needs self-attention shapes (lq == "
+                         f"lk): the banded grid width is derived from lk, "
+                         f"got ({lq}, {lk})")
     if segment_ids is not None:
         if not causal:
             raise ValueError("segment_ids require causal=True (packed-LM "
