@@ -1,0 +1,121 @@
+#!/usr/bin/env python3
+"""Fake gcloud for provisioner tests (the MiniYARNCluster analog of the RM
+conversation: tests drive create/describe/delete without GCP).
+
+State lives under $FAKE_GCLOUD_DIR: ``<name>.node.json`` for TPU nodes,
+``<name>.qr.json`` for queued resources (separate namespaces, as in real
+gcloud where a queued resource and its node share a name). Every
+invocation is appended to calls.log. Knobs (env):
+
+  FAKE_GCLOUD_READY_AFTER  node describes before READY (default 2)
+  FAKE_GCLOUD_HOSTS        comma ipAddress list when READY (default 2 IPs)
+  FAKE_GCLOUD_FAIL_CREATE  non-empty -> create exits 1 (quota denial)
+  FAKE_GCLOUD_DOOM         non-empty -> node lands PREEMPTED, not READY
+"""
+
+import json
+import os
+import sys
+
+VALUE_FLAGS = {"--zone", "--project", "--format", "--accelerator-type",
+               "--version", "--runtime-version", "--node-id", "--network",
+               "--labels"}
+
+
+def state_path(key):
+    return os.path.join(os.environ["FAKE_GCLOUD_DIR"], key + ".json")
+
+
+def load(key):
+    try:
+        with open(state_path(key)) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def save(key, st):
+    with open(state_path(key), "w") as f:
+        json.dump(st, f)
+
+
+def main():
+    argv = sys.argv[1:]
+    with open(os.path.join(os.environ["FAKE_GCLOUD_DIR"], "calls.log"),
+              "a") as f:
+        f.write(" ".join(argv) + "\n")
+    pos, flags = [], {}
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in VALUE_FLAGS:
+            flags[a] = argv[i + 1]
+            i += 2
+        elif a.startswith("--"):
+            flags[a] = True
+            i += 1
+        else:
+            pos.append(a)
+            i += 1
+    if pos[:2] != ["compute", "tpus"] or len(pos) < 5:
+        print("fake gcloud: unsupported invocation", file=sys.stderr)
+        return 64
+    kind, verb, name = pos[2], pos[3], pos[4]
+    ready_after = int(os.environ.get("FAKE_GCLOUD_READY_AFTER", "2"))
+    key = f"{name}.qr" if kind == "queued-resources" else f"{name}.node"
+
+    if verb == "create":
+        if os.environ.get("FAKE_GCLOUD_FAIL_CREATE"):
+            print("ERROR: quota exceeded for TPU cores", file=sys.stderr)
+            return 1
+        node = {"name": name, "state": "CREATING", "describes": 0,
+                "accel": flags.get("--accelerator-type", ""),
+                "deleted": False}
+        if kind == "queued-resources":
+            save(key, {"name": name, "kind": "qr", "describes": 0,
+                       "deleted": False})
+        save(f"{name}.node", node)
+        return 0
+
+    st = load(key)
+    if verb == "describe":
+        if st is None or st.get("deleted"):
+            print(f"ERROR: NOT_FOUND: {name}", file=sys.stderr)
+            return 1
+        st["describes"] += 1
+        save(key, st)
+        if kind == "queued-resources":
+            qstate = "ACTIVE" if st["describes"] >= 1 else \
+                "WAITING_FOR_RESOURCES"
+            print(json.dumps({"name": name, "state": {"state": qstate}}))
+            return 0
+        if st["describes"] >= ready_after:
+            st["state"] = "PREEMPTED" if os.environ.get("FAKE_GCLOUD_DOOM") \
+                else "READY"
+            save(key, st)
+        out = {"name": name, "state": st["state"]}
+        if st["state"] == "READY":
+            hosts = os.environ.get("FAKE_GCLOUD_HOSTS",
+                                   "10.0.0.1,10.0.0.2").split(",")
+            out["networkEndpoints"] = [{"ipAddress": h} for h in hosts
+                                       if h.strip()]
+        print(json.dumps(out))
+        return 0
+
+    if verb == "delete":
+        if st is None:
+            return 1
+        st["deleted"] = True
+        save(key, st)
+        if kind == "queued-resources":
+            node = load(f"{name}.node")
+            if node is not None:
+                node["deleted"] = True
+                save(f"{name}.node", node)
+        return 0
+    print(f"fake gcloud: unknown verb {verb}", file=sys.stderr)
+    return 64
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

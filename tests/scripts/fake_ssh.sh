@@ -1,0 +1,13 @@
+#!/bin/bash
+# Fake ssh for launcher tests: drop options, ignore the host, run the
+# remote command locally — so the full ssh launch/kill path (setsid pgid
+# capture, remote kill -- -PGID) is exercised without sshd.
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -o) shift 2;;
+    -*) shift;;
+    *) break;;
+  esac
+done
+host="$1"; shift
+exec sh -c "$*"

@@ -1,0 +1,170 @@
+"""Per-task chip assignment + resource enforcement on shared hosts
+(ref: tony.<role>.gpus as an enforced container resource,
+HadoopCompatibleAdapter.java:71, util/Utils.java:393-419)."""
+
+import json
+import os
+import tempfile
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.config import TonyConf
+from tony_tpu.coordinator.chips import ChipAllocator
+from tony_tpu.coordinator.launcher import parse_memory_bytes
+
+
+def test_chip_allocator_disjoint_sets():
+    alloc = ChipAllocator(4)
+    a = alloc.allocate("worker:0", 2)
+    b = alloc.allocate("worker:1", 2)
+    assert a == [0, 1] and b == [2, 3]
+    with pytest.raises(RuntimeError, match="only 0 of 4 are free"):
+        alloc.allocate("worker:2", 1)
+    alloc.release("worker:0")
+    assert alloc.allocate("worker:2", 2) == [0, 1]
+    # same-task re-allocation returns the existing hold (idempotent)
+    assert alloc.allocate("worker:2", 2) == [0, 1]
+    alloc.reset()
+    assert alloc.allocate("x", 4) == [0, 1, 2, 3]
+
+
+def test_parse_memory_bytes():
+    assert parse_memory_bytes("2g") == 2 * 1024 ** 3
+    assert parse_memory_bytes("512m") == 512 * 1024 ** 2
+    assert parse_memory_bytes("1.5g") == int(1.5 * 1024 ** 3)
+    assert parse_memory_bytes("1024") == 1024
+    assert parse_memory_bytes("") == 0
+    assert parse_memory_bytes("weird") == 0
+
+
+def _fake_tpu_info(tmp, n: int) -> str:
+    path = os.path.join(tmp, "tpu-info")
+    chips = [{"device_id": i} for i in range(n)]
+    with open(path, "w") as f:
+        f.write("#!/bin/sh\necho '%s'\n" % json.dumps(
+            {"accelerator_type": "test", "chips": chips}))
+    os.chmod(path, 0o755)
+    return path
+
+
+def make_coord(tmp, conf):
+    from tony_tpu.coordinator.coordinator import Coordinator
+
+    conf.set("tony.staging-dir", tmp)
+    conf.set("tony.history.location", os.path.join(tmp, "hist"))
+    return Coordinator(conf, "application_chips", os.path.join(tmp, "job"))
+
+
+def test_task_env_assigns_disjoint_chip_subsets(tmp_path):
+    """Two 2-chip tasks on one (fake) 4-chip host must see different
+    device pairs; completion releases the hold."""
+    from tony_tpu.session import RoleRequest, Task
+
+    tmp = str(tmp_path)
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 2)
+    conf.set("tony.worker.chips", 2)
+    conf.set("tony.tpu.info-exec-path", _fake_tpu_info(tmp, 4))
+    coord = make_coord(tmp, conf)
+    try:
+        req = RoleRequest.from_conf(conf, "worker")
+        t0 = Task(role="worker", index=0)
+        t1 = Task(role="worker", index=1)
+        env0 = coord._task_env(req, t0)
+        env1 = coord._task_env(req, t1)
+        assert env0[C.TPU_VISIBLE_DEVICES] == "0,1"
+        assert env1[C.TPU_VISIBLE_DEVICES] == "2,3"
+        coord.chips.release(t0.id)
+        t2 = Task(role="worker", index=2)
+        assert coord._task_env(req, t2)[C.TPU_VISIBLE_DEVICES] == "0,1"
+    finally:
+        coord.rpc.stop()
+        coord.metrics_rpc.stop()
+
+
+def test_task_env_chips_advisory_without_discovery(tmp_path):
+    """No discovered chips + no explicit chips-per-host: chip requests
+    stay advisory (same stance as preflight_chips) — no
+    TPU_VISIBLE_DEVICES, no mid-launch RuntimeError."""
+    from tony_tpu.session import RoleRequest, Task
+
+    tmp = str(tmp_path)
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 2)
+    conf.set("tony.worker.chips", 8)
+    # discovery sees nothing: point the info exec at a chipless fake
+    conf.set("tony.tpu.info-exec-path", _fake_tpu_info(tmp, 0))
+    coord = make_coord(tmp, conf)
+    try:
+        env = coord._task_env(RoleRequest.from_conf(conf, "worker"),
+                              Task(role="worker", index=0))
+        assert C.TPU_VISIBLE_DEVICES not in env
+    finally:
+        coord.rpc.stop()
+        coord.metrics_rpc.stop()
+
+
+def test_task_env_memory_only_when_explicit(tmp_path):
+    """The schema default (2g) must NOT become an rlimit; an explicit
+    tony.<role>.memory must."""
+    from tony_tpu.session import RoleRequest, Task
+
+    tmp = str(tmp_path)
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 1)
+    conf.set("tony.ps.instances", 1)
+    conf.set("tony.ps.memory", "512m")
+    conf.set("tony.ps.vcores", 2)
+    coord = make_coord(tmp, conf)
+    try:
+        wenv = coord._task_env(RoleRequest.from_conf(conf, "worker"),
+                               Task(role="worker", index=0))
+        assert C.TASK_MEMORY not in wenv and C.TASK_VCORES not in wenv
+        penv = coord._task_env(RoleRequest.from_conf(conf, "ps"),
+                               Task(role="ps", index=0))
+        assert penv[C.TASK_MEMORY] == "512m"
+        assert penv[C.TASK_VCORES] == "2"
+    finally:
+        coord.rpc.stop()
+        coord.metrics_rpc.stop()
+
+
+def test_local_launcher_applies_rlimit(tmp_path, monkeypatch):
+    """The agent process runs under RLIMIT_AS == the exported memory."""
+    import time
+
+    from tony_tpu.coordinator import launcher as L
+    from tony_tpu.session import Task
+
+    probe = os.path.join(str(tmp_path), "probe.py")
+    out_file = os.path.join(str(tmp_path), "rlimit.txt")
+    with open(probe, "w") as f:
+        f.write("import resource, os\n"
+                f"open({out_file!r}, 'w').write("
+                "str(resource.getrlimit(resource.RLIMIT_AS)[0]))\n")
+    import sys
+
+    monkeypatch.setattr(L, "AGENT_ARGV", [sys.executable, probe])
+    exits = []
+    lch = L.LocalProcessLauncher(on_exit=lambda t, c: exits.append((t, c)))
+    task = Task(role="worker", index=0)
+    lch.launch(task, {C.TASK_MEMORY: "256m"},
+               os.path.join(str(tmp_path), "w.log"))
+    deadline = time.monotonic() + 15
+    while not os.path.exists(out_file) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(out_file)
+    time.sleep(0.1)
+    assert int(open(out_file).read()) == 256 * 1024 ** 2
+
+
+def test_docker_command_carries_memory_and_cpus():
+    from tony_tpu.coordinator.launcher import build_docker_command
+    from tony_tpu.session import Task
+
+    argv = build_docker_command(
+        Task(role="worker", index=0),
+        {C.TASK_MEMORY: "4g", C.TASK_VCORES: "8"}, image="img")
+    assert argv[argv.index("--memory") + 1] == "4g"
+    assert argv[argv.index("--cpus") + 1] == "8"

@@ -112,3 +112,92 @@ def test_docker_enabled_key_requires_image(tmp_path):
         client = cluster.make_client(conf)
         with pytest.raises(RuntimeError, match="coordinator exited"):
             client.run()
+
+
+# -- ssh launch mode ---------------------------------------------------------
+
+FAKE_SSH = os.path.join(SCRIPTS, "fake_ssh.sh")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait_for(pred, timeout=15.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+def test_ssh_launcher_remote_kill(tmp_path, monkeypatch):
+    """kill_task must kill the REMOTE process tree (via the recorded pgid),
+    not just the local ssh client — otherwise a resized/retried gang
+    overlaps the old one until the agent's coordinator-lost horizon
+    (ref analog: NM container kill, ApplicationMaster.java:735-777)."""
+    from tony_tpu.coordinator import launcher as L
+
+    monkeypatch.setattr(L, "REMOTE_AGENT_CMD", "sleep 300")
+    exits = []
+    lch = L.SshLauncher(["fakehost"], on_exit=lambda t, c: exits.append((t, c)),
+                        ssh_bin=FAKE_SSH)
+    task = Task(role="worker", index=0)
+    pgid_file = L.remote_pgid_file(task)
+    if os.path.exists(pgid_file):
+        os.remove(pgid_file)
+    lch.launch(task, {"TONY_TEST": "1"}, os.path.join(str(tmp_path), "w.log"))
+    assert _wait_for(lambda: os.path.exists(pgid_file)), "pgid never recorded"
+    pid = int(open(pgid_file).read().strip())
+    assert _alive(pid)
+    assert lch.kill_task(task.id)
+    assert _wait_for(lambda: not _alive(pid)), \
+        "remote tree survived kill_task"
+    assert not os.path.exists(pgid_file)  # kill cleans the pgid file
+
+
+def test_ssh_launcher_stop_all_kills_remote_trees(tmp_path, monkeypatch):
+    from tony_tpu.coordinator import launcher as L
+
+    monkeypatch.setattr(L, "REMOTE_AGENT_CMD", "sleep 300")
+    exits = []
+    lch = L.SshLauncher(["h1", "h2"], on_exit=lambda t, c: exits.append(t),
+                        ssh_bin=FAKE_SSH)
+    tasks = [Task(role="worker", index=i) for i in range(2)]
+    pids = []
+    for t in tasks:
+        pgid_file = L.remote_pgid_file(t)
+        if os.path.exists(pgid_file):
+            os.remove(pgid_file)
+        lch.launch(t, {}, os.path.join(str(tmp_path), f"{t.id}.log"))
+    for t in tasks:
+        pgid_file = L.remote_pgid_file(t)
+        assert _wait_for(lambda: os.path.exists(pgid_file))
+        pids.append(int(open(pgid_file).read().strip()))
+    lch.stop_all()
+    for pid in pids:
+        assert _wait_for(lambda: not _alive(pid)), \
+            f"remote pid {pid} survived stop_all"
+    assert exits == []  # teardown exits never reach on_exit
+
+
+def test_ssh_mode_e2e(tmp_path):
+    """Full gang over fake ssh: launch, env contract, clean finish."""
+    with MiniTonyCluster() as cluster:
+        conf = script_conf(cluster, os.path.join(SCRIPTS, "check_env.py"),
+                           {"worker": 2})
+        conf.set("tony.application.launch-mode", "ssh")
+        conf.set("tony.application.hosts", "hostA,hostB")
+        conf.set("tony.application.ssh-bin", FAKE_SSH)
+        conf.set("tony.application.remote-pythonpath", REPO_ROOT)
+        client = cluster.submit(conf)
+        assert client.final_status["status"] == "SUCCEEDED", \
+            client.final_status

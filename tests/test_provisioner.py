@@ -1,0 +1,265 @@
+"""Provisioner tests — the RM capacity-acquisition analog, driven through
+a fake gcloud binary (ref: TonyClient.submitApplication
+TonyClient.java:314-349; per-role container requests
+TaskScheduler.java:93-105, util/Utils.java:420-430)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from tony_tpu.config import ConfError, TonyConf
+from tony_tpu.coordinator.provisioner import (
+    STATE_READY,
+    GcloudRunner,
+    ProvisioningError,
+    StaticProvisioner,
+    TpuVmProvisioner,
+    chips_in_accelerator_type,
+    preflight_chips,
+    provisioner_from_conf,
+    required_chips,
+)
+from tony_tpu.mini import MiniTonyCluster, script_conf
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "scripts")
+FAKE_GCLOUD = os.path.join(SCRIPTS, "fake_gcloud.py")
+FAKE_SSH = os.path.join(SCRIPTS, "fake_ssh.sh")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def gdir(tmp_path, monkeypatch):
+    d = tmp_path / "gcloud-state"
+    d.mkdir()
+    monkeypatch.setenv("FAKE_GCLOUD_DIR", str(d))
+    return str(d)
+
+
+def make_prov(gdir, name="t1", **kw):
+    kw.setdefault("timeout_s", 10)
+    kw.setdefault("poll_interval_s", 0.01)
+    runner = GcloudRunner(FAKE_GCLOUD, project="proj", zone="zone-a")
+    return TpuVmProvisioner(name, "v5p-8", "tpu-ubuntu2204-base", runner,
+                           **kw)
+
+
+def node_state(gdir, name="t1"):
+    with open(os.path.join(gdir, f"{name}.node.json")) as f:
+        return json.load(f)
+
+
+def calls(gdir):
+    path = os.path.join(gdir, "calls.log")
+    if not os.path.exists(path):
+        return ""
+    with open(path) as f:
+        return f.read()
+
+
+# -- sizing -------------------------------------------------------------
+
+
+def test_required_chips_sums_roles():
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 4)
+    conf.set("tony.worker.chips", 4)
+    conf.set("tony.ps.instances", 2)  # no chips -> excluded
+    conf.set("tony.evaluator.instances", 1)
+    conf.set("tony.evaluator.chips", 2)
+    assert required_chips(conf) == 18
+
+
+def test_chips_in_accelerator_type():
+    # v2-v5p name TensorCores (2/chip); v5e/v6e name chips
+    assert chips_in_accelerator_type("v5p-32") == 16
+    assert chips_in_accelerator_type("v4-8") == 4
+    assert chips_in_accelerator_type("v5litepod-16") == 16
+    assert chips_in_accelerator_type("v6e-8") == 8
+    assert chips_in_accelerator_type("") == 0
+    assert chips_in_accelerator_type("weird-shape") == 0
+
+
+# -- TpuVmProvisioner over fake gcloud ----------------------------------
+
+
+def test_provision_creates_awaits_ready_then_deletes(gdir):
+    prov = make_prov(gdir)
+    hosts = prov.provision()
+    assert hosts == ["10.0.0.1", "10.0.0.2"]
+    assert prov.state == STATE_READY
+    log = calls(gdir)
+    assert "tpu-vm create t1" in log and "--accelerator-type v5p-8" in log
+    assert "--zone zone-a" in log and "--project proj" in log
+    prov.deprovision()
+    assert node_state(gdir)["deleted"] is True
+
+
+def test_provision_adopts_existing_slice(gdir):
+    with open(os.path.join(gdir, "t1.node.json"), "w") as f:
+        json.dump({"name": "t1", "state": "READY", "describes": 99,
+                   "deleted": False}, f)
+    prov = make_prov(gdir)
+    hosts = prov.provision()
+    assert hosts == ["10.0.0.1", "10.0.0.2"]
+    assert "create" not in calls(gdir)
+
+
+def test_provision_rejects_existing_when_reuse_off(gdir):
+    with open(os.path.join(gdir, "t1.node.json"), "w") as f:
+        json.dump({"name": "t1", "state": "READY", "describes": 0,
+                   "deleted": False}, f)
+    with pytest.raises(ProvisioningError, match="already exists"):
+        make_prov(gdir, reuse=False).provision()
+
+
+def test_provision_times_out(gdir, monkeypatch):
+    monkeypatch.setenv("FAKE_GCLOUD_READY_AFTER", "100000")
+    with pytest.raises(ProvisioningError, match="not READY within"):
+        make_prov(gdir, timeout_s=0.3).provision()
+
+
+def test_provision_fails_on_doomed_node(gdir, monkeypatch):
+    monkeypatch.setenv("FAKE_GCLOUD_DOOM", "1")
+    with pytest.raises(ProvisioningError, match="PREEMPTED"):
+        make_prov(gdir).provision()
+
+
+def test_provision_create_denied(gdir, monkeypatch):
+    monkeypatch.setenv("FAKE_GCLOUD_FAIL_CREATE", "1")
+    with pytest.raises(ProvisioningError, match="quota"):
+        make_prov(gdir).provision()
+
+
+def test_keep_skips_teardown(gdir):
+    prov = make_prov(gdir, keep=True)
+    prov.provision()
+    prov.deprovision()
+    assert node_state(gdir)["deleted"] is False
+
+
+def test_queued_mode(gdir):
+    prov = make_prov(gdir, queued=True)
+    hosts = prov.provision()
+    assert hosts == ["10.0.0.1", "10.0.0.2"]
+    log = calls(gdir)
+    assert "queued-resources create t1 --node-id t1" in log
+    assert "--runtime-version" in log and "--version " not in log
+    prov.deprovision()
+    assert "queued-resources delete t1" in calls(gdir)
+    assert node_state(gdir)["deleted"] is True
+
+
+# -- conf plumbing ------------------------------------------------------
+
+
+def test_provisioner_from_conf_modes():
+    conf = TonyConf()
+    conf.set("tony.application.hosts", "h1,h2")
+    prov = provisioner_from_conf(conf, "application_1")
+    assert isinstance(prov, StaticProvisioner)
+    assert prov.provision() == ["h1", "h2"]
+
+    conf.set("tony.provisioner.mode", "tpu-vm")
+    conf.set("tony.provisioner.accelerator-type", "v5p-8")
+    prov2 = provisioner_from_conf(conf, "application_1")
+    assert isinstance(prov2, TpuVmProvisioner)
+    assert prov2.name == "tony-application-1"  # derived, app-id qualified
+
+    conf.set("tony.provisioner.mode", "nope")
+    with pytest.raises(ConfError, match="unknown tony.provisioner.mode"):
+        provisioner_from_conf(conf, "application_1")
+
+
+def test_provisioner_from_conf_rejects_undersized_slice():
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 2)
+    conf.set("tony.worker.chips", 4)  # 8 chips wanted
+    conf.set("tony.provisioner.mode", "tpu-vm")
+    conf.set("tony.provisioner.accelerator-type", "v4-8")  # 4 chips
+    with pytest.raises(ConfError, match="4 chips but roles request 8"):
+        provisioner_from_conf(conf, "app")
+
+
+def test_provisioner_from_conf_requires_accel_type():
+    conf = TonyConf()
+    conf.set("tony.provisioner.mode", "tpu-vm")
+    with pytest.raises(ConfError, match="accelerator-type"):
+        provisioner_from_conf(conf, "app")
+
+
+# -- local preflight ----------------------------------------------------
+
+
+def fake_tpu_info(tmp_path, n_chips: int) -> str:
+    path = os.path.join(str(tmp_path), "tpu-info")
+    chips = [{"device_id": i, "hbm_total_bytes": 1} for i in range(n_chips)]
+    body = json.dumps({"accelerator_type": "test", "chips": chips})
+    with open(path, "w") as f:
+        f.write(f"#!/bin/sh\necho '{body}'\n")
+    os.chmod(path, 0o755)
+    return path
+
+
+def test_preflight_chips(tmp_path):
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 2)
+    conf.set("tony.worker.chips", 2)  # 4 wanted
+    conf.set("tony.tpu.info-exec-path", fake_tpu_info(tmp_path, 2))
+    err = preflight_chips(conf)
+    assert err and "request 4 chips" in err and "has 2" in err
+
+    conf.set("tony.tpu.info-exec-path", fake_tpu_info(tmp_path, 4))
+    assert preflight_chips(conf) is None
+
+    conf2 = TonyConf()  # no chip demand -> never checked
+    conf2.set("tony.worker.instances", 8)
+    assert preflight_chips(conf2) is None
+
+
+# -- e2e: submit -> provision -> train -> deprovision -------------------
+
+
+def test_provision_e2e_submit_train_teardown(gdir, monkeypatch):
+    """The full RM story on the mini cluster: the coordinator creates the
+    slice through (fake) gcloud, launches the gang onto its hosts through
+    (fake) ssh, trains, and tears the slice down at stop."""
+    monkeypatch.setenv("FAKE_GCLOUD_HOSTS", "localhost")
+    monkeypatch.setenv("FAKE_GCLOUD_READY_AFTER", "2")
+    with MiniTonyCluster() as cluster:
+        conf = script_conf(cluster, os.path.join(SCRIPTS, "exit_0.py"),
+                           {"worker": 2})
+        conf.set("tony.application.launch-mode", "ssh")
+        conf.set("tony.application.ssh-bin", FAKE_SSH)
+        conf.set("tony.application.remote-pythonpath", REPO_ROOT)
+        conf.set("tony.provisioner.mode", "tpu-vm")
+        conf.set("tony.provisioner.accelerator-type", "v5p-8")
+        conf.set("tony.provisioner.gcloud-bin", FAKE_GCLOUD)
+        conf.set("tony.provisioner.poll-interval-ms", 50)
+        client = cluster.submit(conf)
+        assert client.final_status["status"] == "SUCCEEDED", \
+            client.final_status
+        name = f"tony-{client.app_id.replace('_', '-')}"
+        st = node_state(gdir, name)
+        assert st["deleted"] is True  # torn down at job stop
+        log = calls(gdir)
+        assert f"tpu-vm create {name}" in log
+        assert f"tpu-vm delete {name}" in log
+
+
+def test_provision_failure_fails_job_fast(gdir, monkeypatch):
+    monkeypatch.setenv("FAKE_GCLOUD_FAIL_CREATE", "1")
+    with MiniTonyCluster() as cluster:
+        conf = script_conf(cluster, os.path.join(SCRIPTS, "exit_0.py"),
+                           {"worker": 1})
+        conf.set("tony.application.launch-mode", "ssh")
+        conf.set("tony.application.ssh-bin", FAKE_SSH)
+        conf.set("tony.provisioner.mode", "tpu-vm")
+        conf.set("tony.provisioner.accelerator-type", "v5p-8")
+        conf.set("tony.provisioner.gcloud-bin", FAKE_GCLOUD)
+        client = cluster.make_client(conf)
+        ok = client.run()
+        assert not ok
+        assert "provisioning failed" in str(
+            client.final_status.get("reason", ""))

@@ -34,6 +34,12 @@ KEYS: dict[str, Key] = {
     "tony.application.distributed-mode": Key(
         "GANG", str, "GANG (all tasks rendezvous before start) or FCFS"
     ),
+    "tony.application.security.tls": Key(
+        False, bool, "TLS on the control-plane RPC: the client mints a "
+        "per-job self-signed cert into the job dir; agents/client pin its "
+        "SHA-256 fingerprint (ref: ClientToAM SASL transport, "
+        "ApplicationMaster.java:484-504)"
+    ),
     "tony.application.security.enabled": Key(
         True, bool, "HMAC-authenticate control-plane RPC with a per-job token"
     ),
@@ -106,6 +112,10 @@ KEYS: dict[str, Key] = {
     ),
     "tony.application.remote-pythonpath": Key(
         "", str, "PYTHONPATH exported on ssh-launched hosts (repo/install location)"
+    ),
+    "tony.application.ssh-bin": Key(
+        "ssh", str, "ssh binary for launch-mode=ssh (tests point this at a "
+        "local fake that runs the command in-place)"
     ),
     # coordinator (reference: tony.am.*)
     "tony.coordinator.memory": Key("2g", str, "Coordinator process memory hint"),
@@ -202,6 +212,50 @@ KEYS: dict[str, Key] = {
     ),
     "tony.application.max-total-chips": Key(
         -1, int, "Cap on total TPU chips requested; -1 = unlimited"
+    ),
+    # provisioner — the RM capacity-acquisition analog (ref:
+    # TonyClient.submitApplication :314-349 + setupContainerRequestForRM,
+    # util/Utils.java:420-430; allocation timeout TonyConfigurationKeys
+    # .java:261-262)
+    "tony.provisioner.mode": Key(
+        "none", str, "none (hosts pre-exist / local devices), tpu-vm "
+        "(gcloud compute tpus tpu-vm create), or queued (queued-resources "
+        "capacity queue — the tony.yarn.queue analog)"
+    ),
+    "tony.provisioner.name": Key(
+        "", str, "TPU resource name; default tony-<app_id>"
+    ),
+    "tony.provisioner.zone": Key("", str, "GCE zone for the slice"),
+    "tony.provisioner.project": Key("", str, "GCP project (empty = gcloud default)"),
+    "tony.provisioner.accelerator-type": Key(
+        "", str, "Slice accelerator type (v5p-32, v6e-16, ...); falls back "
+        "to tony.tpu.topology"
+    ),
+    "tony.provisioner.runtime-version": Key(
+        "tpu-ubuntu2204-base", str, "TPU-VM runtime/software version"
+    ),
+    "tony.provisioner.gcloud-bin": Key(
+        "gcloud", str, "gcloud binary path (tests point this at a fake)"
+    ),
+    "tony.provisioner.timeout-ms": Key(
+        900_000, int, "Slice-allocation timeout (ref: 15-min container-"
+        "allocation timeout, TonyConfigurationKeys.java:261-262)"
+    ),
+    "tony.provisioner.poll-interval-ms": Key(
+        10_000, int, "Describe-poll cadence while waiting for READY"
+    ),
+    "tony.provisioner.keep": Key(
+        False, bool, "Leave the slice up at job end (reuse across jobs)"
+    ),
+    "tony.provisioner.reuse": Key(
+        True, bool, "Adopt an existing same-name slice instead of failing"
+    ),
+    "tony.provisioner.spot": Key(
+        False, bool, "Request spot/preemptible capacity"
+    ),
+    "tony.provisioner.network": Key("", str, "VPC network for the slice"),
+    "tony.provisioner.labels": Key(
+        "", str, "Comma k=v labels attached to the slice"
     ),
     # TPU topology (new territory: replaces YARN gpus/vcores resource model)
     "tony.tpu.topology": Key(

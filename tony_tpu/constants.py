@@ -24,6 +24,9 @@ CHECKPOINT_DIR = "TONY_CHECKPOINT_DIR"  # resume: checkpoint root (no ref analog
 RESUME_STEP = "TONY_RESUME_STEP"  # resume: newest step found at (re)launch
 AGENT_PID = "TONY_AGENT_PID"  # pid of the task agent (preemption-notice target)
 NUM_AM_RETRIES = "TONY_NUM_COORD_RETRIES"  # retries left (ref: NUM_AM_RETRIES)
+TASK_MEMORY = "TONY_TASK_MEMORY"  # role memory (launchers enforce: rlimit/--memory)
+TASK_VCORES = "TONY_TASK_VCORES"  # role vcores (docker --cpus; advisory locally)
+TPU_VISIBLE_DEVICES = "TPU_VISIBLE_DEVICES"  # libtpu device-subset contract
 
 # Coordinator (AM) control-plane address, for agents to register back
 # (reference: AM_HOST/AM_PORT consumed in TaskExecutor.initConfigs :240-281).
@@ -31,6 +34,7 @@ COORDINATOR_HOST = "TONY_COORDINATOR_HOST"
 COORDINATOR_PORT = "TONY_COORDINATOR_PORT"
 METRICS_PORT = "TONY_METRICS_PORT"
 JOB_TOKEN = "TONY_JOB_TOKEN"  # HMAC control-plane auth (ref: ClientToAM tokens)
+TLS_FINGERPRINT = "TONY_TLS_FINGERPRINT"  # pin of the per-job cert (rpc/tls.py)
 
 # ---------------------------------------------------------------------------
 # Rendezvous env injected by runtimes (the TPU-native replacement for

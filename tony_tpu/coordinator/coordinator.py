@@ -30,8 +30,16 @@ import time
 
 from tony_tpu import constants as C
 from tony_tpu.config import ConfError, TonyConf
+from tony_tpu.coordinator.chips import ChipAllocator
 from tony_tpu.coordinator.launcher import Launcher, LocalProcessLauncher
 from tony_tpu.coordinator.liveness import LivenessMonitor
+from tony_tpu.coordinator.provisioner import (
+    STATE_READY,
+    ProvisioningError,
+    StaticProvisioner,
+    preflight_chips,
+    provisioner_from_conf,
+)
 from tony_tpu.events import (
     EventHandler,
     application_finished,
@@ -133,7 +141,15 @@ class Coordinator:
         self.am_adapter.validate_and_update_config(conf)
         self.session = Session(conf, session_id=0)
         self.scheduler: TaskScheduler | None = None
-        self.launcher = launcher or self._launcher_from_conf()
+        self.provisioner = provisioner_from_conf(conf, app_id)
+        # launcher construction is deferred until after provisioning: in
+        # ssh mode the host list may only exist once the slice is READY —
+        # but misconfig must still kill the process at startup (ref:
+        # validateAndUpdateConfig fails the submission, not the session)
+        self._launcher: Launcher | None = launcher
+        if launcher is None:
+            self._validate_launcher_conf()
+        self._chips: ChipAllocator | None = None
         self.metrics = MetricsStore()
         self.liveness = LivenessMonitor(
             conf.get_int("tony.task.heartbeat-interval-ms", 1000),
@@ -141,8 +157,19 @@ class Coordinator:
             self._on_task_deemed_dead,
         )
         host = str(conf.get("tony.coordinator.host", "127.0.0.1"))
-        self.rpc = RpcServer(ClientRpcHandler(self), host=host, secret=self.secret)
-        self.metrics_rpc = RpcServer(self.metrics, host=host, secret=self.secret)
+        self.tls: tuple[str, str] | None = None
+        self._tls_fp = ""
+        if conf.get_bool("tony.application.security.tls"):
+            from tony_tpu.rpc.tls import cert_fingerprint, mint_self_signed
+
+            # normally minted by the client at staging; mint here too so a
+            # directly-constructed coordinator (tests, tony-mini) works
+            self.tls = mint_self_signed(job_dir, f"tony-{app_id}")
+            self._tls_fp = cert_fingerprint(self.tls[0])
+        self.rpc = RpcServer(ClientRpcHandler(self), host=host,
+                             secret=self.secret, tls=self.tls)
+        self.metrics_rpc = RpcServer(self.metrics, host=host,
+                                     secret=self.secret, tls=self.tls)
         history_root = str(conf.get("tony.history.location") or
                            os.path.join(job_dir, "history"))
         self.events = EventHandler(history_root, app_id)
@@ -270,6 +297,8 @@ class Coordinator:
             from tony_tpu.elastic import EXIT_RESIZE
 
             self.liveness.unregister(task_id)
+            if self._chips is not None:
+                self._chips.release(task_id)
             with self._lock:
                 task = self.session.get_task_by_id(task_id)
                 if task is not None:
@@ -284,6 +313,8 @@ class Coordinator:
             # unregister first: a completed task must not expire later
             # (ref: 3-way race comment, ApplicationMaster.java:928-956)
             self.liveness.unregister(task_id)
+            if self._chips is not None:
+                self._chips.release(task_id)
             was_registered = task.registered
             self.session.on_task_completed(task.role, task.index, exit_code)
             if preempted and exit_code != 0 and \
@@ -307,6 +338,52 @@ class Coordinator:
                     f"task {task_id} exited ({exit_code}) before registering")
         if self.scheduler is not None:
             self.scheduler.on_role_instance_completed(task.role)
+
+    @property
+    def launcher(self) -> Launcher:
+        if self._launcher is None:
+            self._launcher = self._launcher_from_conf()
+        return self._launcher
+
+    def _validate_launcher_conf(self) -> None:
+        """The subset of _launcher_from_conf's checks that need no
+        provisioned hosts, run eagerly at construction."""
+        mode = str(self.conf.get("tony.application.launch-mode", "local"))
+        docker_on = self.conf.get("tony.docker.enabled")
+        if docker_on and mode not in ("local", "docker"):
+            raise ValueError(
+                f"tony.docker.enabled conflicts with launch-mode={mode}: "
+                "docker launch runs containers on this host only")
+        if (mode == "docker" or docker_on) and \
+                not str(self.conf.get("tony.docker.image", "")):
+            raise ValueError("docker launch requires tony.docker.image")
+        if mode not in ("local", "docker", "ssh"):
+            raise ValueError(f"unknown tony.application.launch-mode: {mode}")
+        if mode == "ssh" and isinstance(self.provisioner, StaticProvisioner) \
+                and not self.provisioner.hosts:
+            raise ValueError(
+                "launch-mode=ssh requires tony.application.hosts or a "
+                "provisioner (tony.provisioner.mode)")
+
+    def _provision(self) -> None:
+        """Acquire capacity before the gang (the RM conversation — ref:
+        TonyClient.submitApplication :314-349). Static mode only preflights
+        local chip demand; tpu-vm/queued modes create/adopt the slice and
+        feed its hosts to the ssh launcher."""
+        mode = str(self.conf.get("tony.application.launch-mode", "local"))
+        if isinstance(self.provisioner, StaticProvisioner):
+            if mode in ("local", "docker"):
+                # both modes share THIS host's chips (_task_env enforces
+                # the same pair) — over-demand must die here, not mid-gang
+                err = preflight_chips(self.conf)
+                if err:
+                    raise ProvisioningError(err)
+            return
+        hosts = self.provisioner.provision()
+        if mode == "ssh" and hosts:
+            # provisioned hosts replace any statically configured list —
+            # the slice we just created IS the capacity for this job
+            self.conf.set("tony.application.hosts", ",".join(hosts))
 
     def _launcher_from_conf(self) -> Launcher:
         """Pick agent placement from tony.application.launch-mode (local
@@ -343,7 +420,9 @@ class Coordinator:
             return SshLauncher(
                 hosts, self._on_task_process_exit,
                 remote_pythonpath=str(
-                    self.conf.get("tony.application.remote-pythonpath", "")))
+                    self.conf.get("tony.application.remote-pythonpath", "")),
+                ssh_bin=str(self.conf.get("tony.application.ssh-bin", "ssh")),
+                app_id=self.app_id)
         if mode != "local":
             raise ValueError(f"unknown tony.application.launch-mode: {mode}")
         return LocalProcessLauncher(self._on_task_process_exit,
@@ -413,6 +492,27 @@ class Coordinator:
             self.launcher.launch(task, env, log_path)
             self.events.emit(task_started(task.role, task.index, local_host_name()))
 
+    @property
+    def chips(self) -> ChipAllocator:
+        """This host's chip pool for tasks sharing the coordinator host
+        (local/docker launch modes). Sized from DISCOVERY only: when the
+        host shows no chips, requests stay advisory (same stance as
+        preflight_chips — a CPU CI host must run, not fail mid-launch;
+        tony.tpu.chips-per-host is a slice-sizing hint, not a claim about
+        this host)."""
+        if self._chips is None:
+            total = 0
+            from tony_tpu.utils.tpu_info import TpuDiscoverer
+
+            try:
+                total = len(TpuDiscoverer(str(self.conf.get(
+                    "tony.tpu.info-exec-path", "")))
+                    .get_device_information().chips)
+            except Exception:
+                log.exception("chip discovery failed; chips advisory")
+            self._chips = ChipAllocator(total)
+        return self._chips
+
     def _task_env(self, req, task) -> dict[str, str]:
         """Agent env (ref: ContainerLauncher env :1168-1188)."""
         retries = self.conf.get_int("tony.coordinator.retry-count", 0)
@@ -433,8 +533,24 @@ class Coordinator:
             "TONY_JOB_DIR": self.job_dir,
             "TONY_TASK_COMMAND": self._task_command(req),
         }
+        mode = str(self.conf.get("tony.application.launch-mode", "local"))
+        if req.chips > 0 and mode in ("local", "docker") \
+                and self.chips.total > 0:
+            # shared host: disjoint device subsets per task (ref: YARN
+            # hands each container its own GPU set, util/Utils.java:393-419)
+            ids = self.chips.allocate(task.id, req.chips)
+            env[C.TPU_VISIBLE_DEVICES] = ",".join(str(i) for i in ids)
+        # memory/vcores reach the launcher ONLY when explicitly configured
+        # for the role: the schema default (2g) must not impose an rlimit
+        # on jax processes that map far more address space than they touch
+        if f"tony.{req.role}.memory" in self.conf:
+            env[C.TASK_MEMORY] = str(req.memory)
+        if f"tony.{req.role}.vcores" in self.conf:
+            env[C.TASK_VCORES] = str(req.vcores)
         if self.secret:
             env[C.JOB_TOKEN] = self.secret
+        if self._tls_fp:
+            env[C.TLS_FINGERPRINT] = self._tls_fp
         ckpt = self._checkpoint_dir()
         if ckpt:
             # restart-with-resume (no ref analog — TonY's AM retry restarts
@@ -558,6 +674,12 @@ class Coordinator:
         retries = self.conf.get_int("tony.coordinator.retry-count", 0)
         status = SessionStatus.FAILED
         try:
+            try:
+                self._provision()
+            except (ProvisioningError, ConfError) as e:
+                log.error("provisioning failed: %s", e)
+                self.session.fail(f"provisioning failed: {e}")
+                return self._stop(SessionStatus.FAILED)
             for self.attempt in range(retries + 1):
                 try:
                     self._start_attempt()
@@ -594,6 +716,8 @@ class Coordinator:
         # a killed task from the old epoch never reports a result, so its
         # liveness entry would expire against the healthy new session
         self.liveness.clear()
+        if self._chips is not None:
+            self._chips.reset()
         old_id = self.session.session_id
         self.session = Session(self.conf, session_id=old_id + 1)
         self._launch_time.clear()
@@ -607,7 +731,9 @@ class Coordinator:
     def _stop(self, status: SessionStatus) -> bool:
         """Ref: stop() :735-777 — stop containers, emit final event, wait
         briefly for the client's finish signal, finalize history."""
-        self.launcher.stop_all()
+        if self._launcher is not None:  # never constructed if provisioning failed
+            self._launcher.stop_all()
+        self.provisioner.deprovision()
         final = "SUCCEEDED" if status == SessionStatus.SUCCEEDED else "FAILED"
         failed = sum(1 for t in self.session.all_tasks() if t.status.name == "FAILED")
         self.events.emit(application_finished(self.app_id, final, failed))
@@ -673,6 +799,7 @@ class Coordinator:
                 "session_id": self.session.session_id,
                 "attempt": self.attempt,
                 "tensorboard_url": self.tensorboard_url,
+                "phase": self.provisioner.state,
             }
         return {
             "status": status.value,
@@ -680,6 +807,9 @@ class Coordinator:
             "session_id": self.session.session_id,
             "attempt": self.attempt,
             "tensorboard_url": self.tensorboard_url,
+            # provisioning state (CREATING/WAITING/READY/...) so the client
+            # can show why no tasks exist yet during slice allocation
+            "phase": self.provisioner.state,
         }
 
 

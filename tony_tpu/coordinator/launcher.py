@@ -29,11 +29,51 @@ import sys
 import threading
 from typing import Callable
 
+from tony_tpu import constants as C
 from tony_tpu.session import Task
 
 log = logging.getLogger(__name__)
 
 OnExit = Callable[[str, int], None]  # (task_id, exit_code)
+
+# agent argv; module-level so launcher tests can swap in a stand-in
+AGENT_ARGV = [sys.executable, "-m", "tony_tpu.agent"]
+
+
+def parse_memory_bytes(spec: str) -> int:
+    """'2g' / '512m' / '1024k' / plain bytes -> int bytes; 0 when blank or
+    unparseable (caller skips enforcement)."""
+    s = str(spec or "").strip().lower()
+    if not s:
+        return 0
+    try:
+        if s[-1] in "kmgt":
+            mult = {"k": 1024, "m": 1024 ** 2, "g": 1024 ** 3,
+                    "t": 1024 ** 4}[s[-1]]
+            return int(float(s[:-1]) * mult)
+        return int(s)
+    except ValueError:
+        log.warning("unparseable memory spec %r; not enforcing", spec)
+        return 0
+
+
+def _memory_preexec(env: dict[str, str]):
+    """preexec_fn applying the role's memory as RLIMIT_AS, when (and only
+    when) the coordinator exported TONY_TASK_MEMORY — i.e. the user set
+    tony.<role>.memory explicitly (ref: YARN enforces the container
+    resource; TonyClient.java:788-857 validates it at submit). Address-
+    space rlimit is the strictest portable analog: jax maps large arenas,
+    which is exactly why the schema default never reaches here."""
+    limit = parse_memory_bytes(env.get(C.TASK_MEMORY, ""))
+    if limit <= 0:
+        return None
+
+    def preexec():
+        import resource
+
+        resource.setrlimit(resource.RLIMIT_AS, (limit, limit))
+
+    return preexec
 
 
 class Launcher:
@@ -67,12 +107,13 @@ class LocalProcessLauncher(Launcher):
         out = open(log_path, "ab", buffering=0)
         try:
             proc = subprocess.Popen(
-                [sys.executable, "-m", "tony_tpu.agent"],
+                AGENT_ARGV,
                 env=full_env,
                 cwd=self.workdir,
                 stdout=out,
                 stderr=subprocess.STDOUT,
                 start_new_session=True,
+                preexec_fn=_memory_preexec(env),
             )
         finally:
             out.close()
@@ -169,6 +210,12 @@ def build_docker_command(task: Task, env: dict[str, str], image: str,
         argv += ["-w", workdir]
     for mount in mounts or []:
         argv += ["-v", mount]
+    # role resources become docker's enforced limits (ref: YARN enforces
+    # the container resource; docker accepts the same '2g' spelling)
+    if env.get(C.TASK_MEMORY):
+        argv += ["--memory", str(env[C.TASK_MEMORY])]
+    if env.get(C.TASK_VCORES):
+        argv += ["--cpus", str(env[C.TASK_VCORES])]
     for k, v in env.items():
         argv += ["-e", f"{k}={v}"]
     argv += extra_args or []
@@ -243,16 +290,39 @@ class DockerLauncher(Launcher):
         self._local.stop_all()
 
 
+# the remote agent entrypoint; module-level so launcher tests can swap in a
+# long-running stand-in (env-contract pattern, see tests/test_launcher.py)
+REMOTE_AGENT_CMD = "python3 -m tony_tpu.agent"
+
+
+def remote_pgid_file(task: Task, app_id: str = "") -> str:
+    """Job- and epoch-qualified pgid path on the REMOTE host (same
+    rationale as docker_container_name, plus the app id: two jobs sharing
+    a static host list must never read each other's pgid records)."""
+    app = f"-{app_id}" if app_id else ""
+    return f"/tmp/tony{app}-s{task.session_id}-{task.id.replace(':', '-')}.pgid"
+
+
 class SshLauncher(Launcher):
     """Place agents on remote hosts over ssh, round-robin per task.
 
     The remote host needs the same repo importable at ``remote_pythonpath``
     (TPU-VM images share a disk image, the NFS/GCS-fuse staging dir carries
     the job files). Exit detection rides the local ssh process's exit code.
+
+    Kill is REMOTE-first: the agent runs as a ``setsid`` session leader
+    whose pgid is written to a per-task file on the remote host, and
+    ``kill_task``/``stop_all`` ssh back in to ``kill -- -PGID`` the whole
+    tree (ref analog: the NM kills the container cgroup,
+    ApplicationMaster.java:735-777). Killing only the local ssh client
+    would orphan the remote tree until its coordinator-lost horizon —
+    leaving a window where two gangs overlap after elastic resize/retry.
     """
 
     def __init__(self, hosts: list[str], on_exit: OnExit,
-                 remote_pythonpath: str = "", ssh_opts: list[str] | None = None):
+                 remote_pythonpath: str = "",
+                 ssh_opts: list[str] | None = None, ssh_bin: str = "ssh",
+                 app_id: str = ""):
         if not hosts:
             raise ValueError("SshLauncher needs at least one host")
         self.hosts = hosts
@@ -260,8 +330,36 @@ class SshLauncher(Launcher):
         self.remote_pythonpath = remote_pythonpath
         self.ssh_opts = ssh_opts or ["-o", "StrictHostKeyChecking=no",
                                      "-o", "BatchMode=yes"]
+        self.ssh_bin = ssh_bin
+        self.app_id = app_id
         self._next = 0
-        self._local = LocalProcessLauncher(on_exit)
+        self._local = LocalProcessLauncher(self._on_local_exit)
+        self._remote: dict[str, tuple[str, str]] = {}  # task -> (host, pgid file)
+        self._remote_lock = threading.Lock()
+
+    def _on_local_exit(self, task_id: str, code: int) -> None:
+        """Natural exit: retire the remote record BEFORE reporting, so a
+        later kill_task/stop_all can never fire a stale pgid at a recycled
+        pid on the shared host. The remote pgid-file removal is async —
+        an unreachable host must not delay completion detection (gang
+        finish, DAG release) by the ssh timeout."""
+        with self._remote_lock:
+            info = self._remote.pop(task_id, None)
+        self.on_exit(task_id, code)
+        if info:
+            threading.Thread(target=self._rm_pgid_file, args=info,
+                             daemon=True,
+                             name=f"pgid-cleanup-{task_id}").start()
+
+    def _rm_pgid_file(self, host: str, pgid_file: str) -> None:
+        try:
+            subprocess.run(
+                [self.ssh_bin, *self.ssh_opts, host,
+                 f"rm -f {shlex.quote(pgid_file)}"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                timeout=20, check=False)
+        except subprocess.SubprocessError:
+            log.debug("stale pgid file cleanup on %s failed", host)
 
     def launch(self, task: Task, env: dict[str, str], log_path: str) -> None:
         host = self.hosts[self._next % len(self.hosts)]
@@ -271,23 +369,65 @@ class SshLauncher(Launcher):
         )
         pp = f"export PYTHONPATH={shlex.quote(self.remote_pythonpath)}:$PYTHONPATH;" \
             if self.remote_pythonpath else ""
-        remote_cmd = f"{exports} {pp} exec python3 -m tony_tpu.agent"
+        pgid_file = remote_pgid_file(task, self.app_id)
+        # setsid makes the wrapper sh the session/group leader; it records
+        # its pid (== the agent's after exec, == the remote pgid) then
+        # becomes the agent, so kill -- -PGID reaps agent + user process.
+        # -w: setsid forks when already a group leader (always, under sshd)
+        # and would otherwise exit 0 instantly — the local ssh client must
+        # stay attached and carry the agent's real exit code
+        mem_kb = parse_memory_bytes(env.get(C.TASK_MEMORY, "")) // 1024
+        ulimit = f"ulimit -v {mem_kb} 2>/dev/null; " if mem_kb > 0 else ""
+        inner = (f"echo $$ > {shlex.quote(pgid_file)}; {ulimit}{exports} "
+                 f"{pp} exec {REMOTE_AGENT_CMD}")
+        remote_cmd = f"exec setsid -w sh -c {shlex.quote(inner)}"
         os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
         out = open(log_path, "ab", buffering=0)
         try:
             proc = subprocess.Popen(
-                ["ssh", *self.ssh_opts, host, remote_cmd],
+                [self.ssh_bin, *self.ssh_opts, host, remote_cmd],
                 stdout=out,
                 stderr=subprocess.STDOUT,
                 start_new_session=True,
             )
         finally:
             out.close()
+        with self._remote_lock:
+            self._remote[task.id] = (host, pgid_file)
         self._local.attach(task.id, proc)
         log.info("launched %s on %s via ssh (pid %d)", task.id, host, proc.pid)
 
+    def _remote_kill(self, host: str, pgid_file: str) -> None:
+        qf = shlex.quote(pgid_file)
+        cmd = (f'p=$(cat {qf} 2>/dev/null); if [ -n "$p" ]; then '
+               f'kill -KILL -- -"$p" 2>/dev/null || kill -KILL "$p" '
+               f'2>/dev/null; fi; rm -f {qf}')
+        try:
+            subprocess.run([self.ssh_bin, *self.ssh_opts, host, cmd],
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL, timeout=20, check=False)
+        except subprocess.SubprocessError:
+            log.warning("remote kill on %s timed out/failed (pgid file %s); "
+                        "the agent's coordinator-lost horizon is the backstop",
+                        host, pgid_file)
+
     def kill_task(self, task_id: str) -> bool:
-        return self._local.kill_task(task_id)
+        with self._remote_lock:
+            info = self._remote.pop(task_id, None)
+        if info:
+            self._remote_kill(*info)
+        # the remote kill usually completes the local ssh client before
+        # the local kill runs — a vanished local proc is still a kill
+        killed_local = self._local.kill_task(task_id)
+        return killed_local or info is not None
 
     def stop_all(self) -> None:
+        # silence local exit detection FIRST: the remote kills below
+        # complete each attached ssh client, which must not re-enter on_exit
+        self._local.pause_exits()
+        with self._remote_lock:
+            remote = list(self._remote.values())
+            self._remote.clear()
+        for host, pgid_file in remote:
+            self._remote_kill(host, pgid_file)
         self._local.stop_all()
