@@ -1,0 +1,35 @@
+"""Real multi-process jax.distributed rendezvous: initialize from the
+injected env, allgather a per-process value across the gang, assert the
+global reduction. This pins the rendezvous CONTRACT itself (coordinator
+address serves, processes join, collectives flow), not just the env
+spelling that check_jax_env.py covers."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from tony_tpu import distributed  # noqa: E402
+
+spec = distributed.initialize(timeout_s=120)
+if spec is None:
+    print("not in a gang")
+    sys.exit(5)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+
+if jax.process_count() != spec["num_processes"]:
+    print("bad process_count", jax.process_count(), spec["num_processes"])
+    sys.exit(6)
+
+val = jnp.asarray([float(spec["process_id"] + 1)])
+total = float(multihost_utils.process_allgather(val).sum())
+n = spec["num_processes"]
+expect = n * (n + 1) / 2
+if abs(total - expect) > 1e-6:
+    print("bad global sum", total, expect)
+    sys.exit(7)
+print("global sum ok:", total)
+sys.exit(0)

@@ -435,3 +435,25 @@ def test_registration_timeout_fails_job(cluster, monkeypatch):
     ok, client = run_job(cluster, conf)
     assert not ok
     assert "register" in str(client.final_status.get("reason", ""))
+
+
+def test_jax_distributed_psum_e2e(cluster):
+    """The rendezvous contract itself: 2 processes initialize
+    jax.distributed from the injected env and allgather across the gang
+    (beyond check_jax_env's env-spelling assertions)."""
+    ok, _ = run_job(cluster, script_conf(cluster, script("check_jax_psum.py"),
+                                         {"worker": 2}))
+    assert ok
+
+
+def test_fcfs_mode_e2e(cluster):
+    """FCFS scheduling through the full cluster (ref: TestTonyE2E FCFS
+    cases over MLGenericRuntime.java:79-99): tasks start without waiting
+    for the whole gang and the job still completes. The tf runtime hosts
+    it (the reference's FCFS jobs are TF) — the jax runtime correctly
+    refuses FCFS, since its rendezvous needs the entire gang."""
+    conf = script_conf(cluster, script("exit_0.py"), {"worker": 2},
+                       framework="tensorflow")
+    conf.set("tony.application.distributed-mode", "FCFS")
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status

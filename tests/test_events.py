@@ -175,3 +175,53 @@ def test_portal_pages_and_api(tmp_path):
             assert e.code == 404
     finally:
         portal.stop()
+
+
+def test_portal_token_auth_and_pagination(tmp_path):
+    """Hardening: with a token set, unauthenticated requests get 401;
+    bearer header and ?token= both pass. The index paginates and the
+    cache caps the scan (ref slot: tony-portal kerberos+HTTPS,
+    app/hadoop/Configuration.java)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from tony_tpu.portal.app import Portal
+
+    root = str(tmp_path)
+    for i in range(5):
+        h = EventHandler(root, f"application_pg{i}")
+        h.start()
+        h.emit(task_started("worker", 0, "host1"))
+        h.stop("SUCCEEDED")
+
+    portal = Portal(root, port=0, token="s3cret", max_jobs=3).start()
+    try:
+        base = f"http://127.0.0.1:{portal.port}"
+
+        def get(path, headers=None):
+            req = urllib.request.Request(base + path, headers=headers or {})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, r.read().decode()
+
+        try:
+            get("/")
+            raise AssertionError("expected 401")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+        status, _ = get("/", {"Authorization": "Bearer s3cret"})
+        assert status == 200
+        status, body = get("/api/?token=s3cret")
+        jobs = _json.loads(body)
+        assert len(jobs) == 3  # max_jobs caps the cached scan
+        # pagination slices the capped list
+        status, body = get("/api/?token=s3cret&per=2&page=2")
+        assert len(_json.loads(body)) == 1
+        status, body = get("/?token=s3cret&per=2&page=1")
+        assert "older" in body  # nav link to the next page
+        # every rendered link must carry the query token forward, or the
+        # next click 401s
+        assert "page=2&per=2&token=s3cret" in body
+        assert "/config?token=s3cret" in body
+    finally:
+        portal.stop()

@@ -296,6 +296,28 @@ def test_multislice_mesh_branch_with_fake_slices(monkeypatch):
     assert mesh.shape["data"] == 4 and mesh.shape["tensor"] == 2
 
 
+def test_multislice_mesh_virtual_slices_executes():
+    """n_slices forces the DCNxICI layout on plain CPU devices (no
+    slice_index): the mesh must be runnable, with the dcn axis spanning
+    the virtual slice groups."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tony_tpu.parallel import MeshSpec, multislice_mesh
+
+    mesh = multislice_mesh(MeshSpec(data=-1, tensor=2),
+                           devices=jax.devices()[:8], n_slices=2)
+    assert mesh.shape["data"] == 4 and mesh.shape["tensor"] == 2
+    x = jnp.arange(8.0).reshape(4, 2)
+    xs = jax.device_put(x, NamedSharding(mesh, P("data", "tensor")))
+    total = jax.jit(lambda v: v.sum())(xs)  # cross-slice + ICI reduction
+    assert float(total) == float(x.sum())
+    # virtual slice 0 = first half of the device list, stacked on data
+    arr = np.asarray(mesh.devices)
+    first_ids = {d.id for d in arr[:2].flatten()}
+    assert first_ids == {d.id for d in jax.devices()[:4]}
+
+
 def test_pipeline_remat_matches_and_differentiates():
     n_stages = 4
     mesh = make_mesh(MeshSpec(data=2, pipe=n_stages))

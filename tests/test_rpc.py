@@ -133,3 +133,61 @@ def test_poll_till_non_null():
     assert c.poll_till_non_null(lambda: next(vals), interval_s=0.01) == "ready"
     with pytest.raises(TimeoutError):
         c.poll_till_non_null(lambda: None, interval_s=0.01, timeout_s=0.05)
+
+
+# -- TLS (the transport-security half of ClientToAM; rpc/tls.py) -------------
+
+
+class _EchoTls:
+    def echo(self, value):
+        return value
+
+
+def test_tls_mint_fingerprint_and_roundtrip(tmp_path):
+    from tony_tpu.rpc import RpcServer
+    from tony_tpu.rpc.tls import cert_fingerprint, mint_self_signed
+
+    cert, key = mint_self_signed(str(tmp_path), "tony-test")
+    # idempotent: second mint returns the same files
+    assert mint_self_signed(str(tmp_path), "tony-test") == (cert, key)
+    fp = cert_fingerprint(cert)
+    assert len(fp) == 64
+
+    server = RpcServer(_EchoTls(), secret="s3", tls=(cert, key)).start()
+    try:
+        c = RpcClient("127.0.0.1", server.port, secret="s3",
+                      tls_fingerprint=fp)
+        assert c.call("echo", value=41) == 41
+        c.close()
+        # wrong pin: refused before any frame flows
+        bad = RpcClient("127.0.0.1", server.port, secret="s3",
+                        tls_fingerprint="0" * 64, timeout=5)
+        with pytest.raises(ConnectionError):
+            bad.call("echo", retries=0, value=1)
+        bad.close()
+        # plaintext client against the TLS server: dropped at handshake
+        plain = RpcClient("127.0.0.1", server.port, secret="s3", timeout=5)
+        with pytest.raises(ConnectionError):
+            plain.call("echo", retries=0, value=1)
+        plain.close()
+    finally:
+        server.stop()
+
+
+def test_tls_e2e_job(tmp_path):
+    """Full gang under HMAC + TLS: client mints at staging, coordinator
+    serves, agents pin from env."""
+    import os
+
+    from tony_tpu.mini import MiniTonyCluster, script_conf
+
+    scripts = os.path.join(os.path.dirname(__file__), "scripts")
+    with MiniTonyCluster() as cluster:
+        conf = script_conf(cluster, os.path.join(scripts, "check_env.py"),
+                           {"worker": 2})
+        conf.set("tony.application.security.enabled", True)
+        conf.set("tony.application.security.tls", True)
+        client = cluster.submit(conf)
+        assert client.final_status["status"] == "SUCCEEDED", \
+            client.final_status
+        assert os.path.exists(os.path.join(client.job_dir, "tls-cert.pem"))

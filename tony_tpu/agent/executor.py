@@ -139,9 +139,12 @@ class TaskAgent:
         self.conf = TonyConf.from_final(conf_path) if conf_path and \
             os.path.exists(conf_path) else TonyConf()
         self.task_id = f"{self.role}:{self.index}"
-        self.client = RpcClient(self.coord_host, self.coord_port, secret=self.secret)
-        self.metrics_client = RpcClient(self.coord_host, self.metrics_port,
-                                        secret=self.secret) if self.metrics_port else None
+        tls_fp = e.get(C.TLS_FINGERPRINT) or None
+        self.client = RpcClient(self.coord_host, self.coord_port,
+                                secret=self.secret, tls_fingerprint=tls_fp)
+        self.metrics_client = RpcClient(
+            self.coord_host, self.metrics_port, secret=self.secret,
+            tls_fingerprint=tls_fp) if self.metrics_port else None
         self.adapter = get_task_adapter(str(self.conf.get("tony.application.framework")))
         self._user_pid: int | None = None
         self.preempted = False
@@ -256,7 +259,8 @@ class TaskAgent:
         # would push loss detection far past the client's respawn fence
         hb_client = RpcClient(
             self.coord_host, self.coord_port, secret=self.secret,
-            timeout=heartbeat_rpc_timeout_s(self.conf))
+            timeout=heartbeat_rpc_timeout_s(self.conf),
+            tls_fingerprint=os.environ.get(C.TLS_FINGERPRINT) or None)
         hb = Heartbeater(
             hb_client, self.task_id, hb_interval_ms,
             workdir=self.job_dir, on_lost=coordinator_lost,

@@ -33,7 +33,15 @@ def main(argv: list[str] | None = None) -> int:
         return C.EXIT_FAIL
     with open(info_path) as f:
         info = json.load(f)
-    client = RpcClient(info["host"], info["port"], secret=args.secret)
+    # TLS jobs: pin the job's cert straight from its job-dir copy
+    tls_fp = None
+    cert = os.path.join(args.job_dir, "tls-cert.pem")
+    if os.path.exists(cert):
+        from tony_tpu.rpc.tls import cert_fingerprint
+
+        tls_fp = cert_fingerprint(cert)
+    client = RpcClient(info["host"], info["port"], secret=args.secret,
+                       tls_fingerprint=tls_fp)
     try:
         ok = client.call("resize_role", role=args.role,
                          instances=args.instances)

@@ -53,6 +53,7 @@ class TonyClient:
         self.rpc: RpcClient | None = None
         self.final_status: dict | None = None
         self.tensorboard_url = ""
+        self.tls_fingerprint: str | None = None
 
     def add_listener(self, listener: TaskUpdateListener) -> None:
         self.listeners.append(listener)
@@ -137,6 +138,14 @@ class TonyClient:
         self._set_sidecar_tb_command()
         if self.conf.get_bool("tony.application.security.enabled"):
             self.secret = pysecrets.token_hex(32)
+        if self.conf.get_bool("tony.application.security.tls"):
+            # per-job self-signed cert minted at staging (the TokenCache
+            # analog); the coordinator serves it, all peers pin it
+            from tony_tpu.rpc.tls import cert_fingerprint, mint_self_signed
+
+            cert, _key = mint_self_signed(self.job_dir,
+                                          f"tony-{self.app_id}")
+            self.tls_fingerprint = cert_fingerprint(cert)
         self.conf.write_final(os.path.join(self.job_dir, C.TONY_FINAL_CONF))
         return self.job_dir
 
@@ -185,7 +194,9 @@ class TonyClient:
             if os.path.exists(path):
                 with open(path) as f:
                     info = json.load(f)
-                return RpcClient(info["host"], info["port"], secret=self.secret)
+                return RpcClient(info["host"], info["port"],
+                                 secret=self.secret,
+                                 tls_fingerprint=self.tls_fingerprint)
             if self.coordinator_proc and self.coordinator_proc.poll() is not None:
                 raise RuntimeError(
                     f"coordinator exited ({self.coordinator_proc.returncode}) "
@@ -253,6 +264,9 @@ class TonyClient:
                 time.sleep(interval)
                 continue
             rendered = self._render_tasks(infos)
+            if not infos and status.get("phase") not in (None, "", "READY"):
+                # slice allocation in flight: show WHY there are no tasks
+                rendered = f"Provisioning TPU capacity: {status['phase']}"
             if rendered != last_rendered:
                 print(rendered)
                 last_rendered = rendered

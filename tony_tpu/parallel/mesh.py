@@ -110,7 +110,7 @@ def num_slices(devices=None) -> int:
 
 
 def multislice_mesh(spec: MeshSpec | None = None, *, devices=None,
-                    dcn_axis: str = DATA) -> Mesh:
+                    dcn_axis: str = DATA, n_slices: int | None = None) -> Mesh:
     """Mesh whose ``dcn_axis`` additionally spans slices while every other
     axis stays within-slice (ICI). ``spec`` is resolved against the
     per-slice device count (a wildcard absorbs the per-slice remainder),
@@ -119,21 +119,36 @@ def multislice_mesh(spec: MeshSpec | None = None, *, devices=None,
     x 2 slices over DCN) x tensor=4 (ICI).
 
     Single-slice (or CPU test) degenerates to ``make_mesh`` — the same
-    code runs everywhere.
+    code runs everywhere. ``n_slices`` forces a slice count when the
+    devices carry no ``slice_index`` (virtual CPU devices in tests and the
+    driver's multichip dryrun): consecutive device groups then stand in
+    for slices, stacked along ``dcn_axis``.
     """
     devices = list(devices if devices is not None else jax.devices())
-    n_slices = num_slices(devices)
+    detected = num_slices(devices)
+    n = n_slices or detected
     spec = spec or MeshSpec()
-    if n_slices == 1:
+    if n == 1:
         return make_mesh(spec, devices=devices)
-    from jax.experimental import mesh_utils
-
-    per_slice = len(devices) // n_slices
+    if len(devices) % n:
+        raise ValueError(f"{len(devices)} devices not divisible into {n} slices")
+    per_slice = len(devices) // n
     ici_sizes = spec.resolve(per_slice)
-    dcn_sizes = {a: (n_slices if a == dcn_axis else 1) for a in ALL_AXES}
-    arr = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=[ici_sizes[a] for a in ALL_AXES],
-        dcn_mesh_shape=[dcn_sizes[a] for a in ALL_AXES],
-        devices=devices,
-    )
-    return Mesh(arr, ALL_AXES)
+    if detected == n:
+        from jax.experimental import mesh_utils
+
+        dcn_sizes = {a: (n if a == dcn_axis else 1) for a in ALL_AXES}
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=[ici_sizes[a] for a in ALL_AXES],
+            dcn_mesh_shape=[dcn_sizes[a] for a in ALL_AXES],
+            devices=devices,
+        )
+        return Mesh(arr, ALL_AXES)
+    # virtual slices: per-slice sub-meshes concatenated along the dcn axis
+    # (exercises the same shardings/collective structure minus the real
+    # slice topology, which CPU devices cannot express)
+    axis_idx = ALL_AXES.index(dcn_axis)
+    shape = [ici_sizes[a] for a in ALL_AXES]
+    subs = [np.array(devices[i * per_slice:(i + 1) * per_slice])
+            .reshape(shape) for i in range(n)]
+    return Mesh(np.concatenate(subs, axis=axis_idx), ALL_AXES)

@@ -42,11 +42,17 @@ th {{ background: #eee; }}
 
 
 class PortalState:
-    """Cached history scan (ref: cache/CacheWrapper.java Guava caches)."""
+    """Cached history scan (ref: cache/CacheWrapper.java Guava caches).
 
-    def __init__(self, history_root: str, ttl_s: float = 5.0):
+    ``max_jobs`` caps what one scan keeps in memory (newest first — the
+    reference's Guava cache is bounded the same way); older jobs stay on
+    disk and age out via the purger."""
+
+    def __init__(self, history_root: str, ttl_s: float = 5.0,
+                 max_jobs: int = 2000):
         self.history_root = history_root
         self.ttl_s = ttl_s
+        self.max_jobs = max_jobs
         self._jobs: list[dict] = []
         self._scanned = 0.0
         self._lock = threading.Lock()
@@ -54,7 +60,8 @@ class PortalState:
     def jobs(self) -> list[dict]:
         with self._lock:
             if time.monotonic() - self._scanned > self.ttl_s:
-                self._jobs = history.list_jobs(self.history_root)
+                self._jobs = history.list_jobs(
+                    self.history_root)[:self.max_jobs]
                 self._scanned = time.monotonic()
             return list(self._jobs)
 
@@ -67,6 +74,7 @@ class PortalState:
 
 class PortalHandler(BaseHTTPRequestHandler):
     state: PortalState  # set by serve()
+    token: str = ""  # non-empty -> bearer/query-token auth required
 
     def log_message(self, fmt, *args):  # quiet
         log.debug(fmt, *args)
@@ -78,13 +86,47 @@ class PortalHandler(BaseHTTPRequestHandler):
             log.exception("portal error")
             self._send(500, f"internal error: {e}", "text/plain")
 
+    _qtok = ""  # query-token of the current request, echoed into links
+
+    def _href(self, path: str, *extra: str) -> str:
+        qs = [e for e in extra if e]
+        if self._qtok:
+            from urllib.parse import quote
+
+            qs.append("token=" + quote(self._qtok))
+        return path + ("?" + "&".join(qs) if qs else "")
+
+    def _authorized(self, params: dict) -> bool:
+        """Optional shared-token gate (the kerberos+HTTPS slot of
+        tony-portal, app/hadoop/Configuration.java, scaled to the
+        stdlib server: header ``Authorization: Bearer <t>`` or ``?token=``
+        for browser use)."""
+        import hmac
+
+        if not self.token:
+            return True
+        header = self.headers.get("Authorization", "")
+        cand = header[7:] if header.startswith("Bearer ") else \
+            (params.get("token") or [""])[0]
+        return hmac.compare_digest(cand, self.token)
+
     def _route(self):
-        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        from urllib.parse import parse_qs
+
+        path, _, query = self.path.partition("?")
+        params = parse_qs(query)
+        if not self._authorized(params):
+            return self._send(401, "unauthorized (token required)",
+                              "text/plain")
+        # browsers authenticate via ?token=; every rendered link must
+        # carry it forward or the next click lands on a 401
+        self._qtok = (params.get("token") or [""])[0] if self.token else ""
+        parts = [p for p in path.split("/") if p]
         api = bool(parts) and parts[0] == "api"
         if api:
             parts = parts[1:]
         if not parts:
-            return self._jobs_index(api)
+            return self._jobs_index(api, params)
         if parts[0] == "job" and len(parts) >= 3:
             app_id, page = parts[1], parts[2]
             job = self.state.find(app_id)
@@ -101,22 +143,46 @@ class PortalHandler(BaseHTTPRequestHandler):
         return self._send(404, "not found", "text/plain")
 
     # -- pages --------------------------------------------------------------
-    def _jobs_index(self, api: bool):
-        jobs = self.state.jobs()
+    def _jobs_index(self, api: bool, params: dict | None = None):
+        """Paginated index: ?page=N (1-based) & per=N (default 200, max
+        2000). The API keeps its bare-list shape, sliced the same way."""
+        params = params or {}
+
+        def _qint(key: str, default: int, lo: int, hi: int) -> int:
+            try:
+                return min(max(int((params.get(key) or [default])[0]), lo), hi)
+            except ValueError:
+                return default
+
+        per = _qint("per", 200, 1, 2000)
+        page = _qint("page", 1, 1, 10 ** 9)
+        all_jobs = self.state.jobs()
+        jobs = all_jobs[(page - 1) * per:page * per]
         if api:
             return self._send(200, json.dumps(jobs), "application/json")
         rows = "".join(
-            f"<tr><td><a href='/job/{j['app_id']}/config'>{j['app_id']}</a></td>"
+            f"<tr><td><a href='{self._href(f'/job/{j['app_id']}/config')}'>"
+            f"{j['app_id']}</a></td>"
             f"<td class='{j['status']}'>{j['status']}</td>"
             f"<td>{j['user'] or '-'}</td>"
             f"<td>{_ts(j['started'])}</td><td>{_ts(j['completed'])}</td>"
-            f"<td><a href='/job/{j['app_id']}/events'>events</a> "
-            f"<a href='/job/{j['app_id']}/logs'>logs</a> "
-            f"<a href='/job/{j['app_id']}/metrics'>metrics</a></td></tr>"
+            f"<td><a href='{self._href(f'/job/{j['app_id']}/events')}'>events</a> "
+            f"<a href='{self._href(f'/job/{j['app_id']}/logs')}'>logs</a> "
+            f"<a href='{self._href(f'/job/{j['app_id']}/metrics')}'>metrics</a>"
+            f"</td></tr>"
             for j in jobs
         )
+        nav = []
+        if page > 1:
+            nav.append(f"<a href='{self._href('/', f'page={page - 1}', f'per={per}')}'"
+                       f">&larr; newer</a>")
+        if page * per < len(all_jobs):
+            nav.append(f"<a href='{self._href('/', f'page={page + 1}', f'per={per}')}'"
+                       f">older &rarr;</a>")
         body = (f"<table><tr><th>application</th><th>status</th><th>user</th>"
-                f"<th>started</th><th>completed</th><th>links</th></tr>{rows}</table>")
+                f"<th>started</th><th>completed</th><th>links</th></tr>{rows}</table>"
+                f"<p>{len(all_jobs)} jobs cached &middot; page {page} "
+                f"&middot; {' '.join(nav)}</p>")
         self._send(200, _PAGE.format(title="tony-tpu job history", body=body))
 
     def _job_config(self, job: dict, api: bool):
@@ -126,7 +192,8 @@ class PortalHandler(BaseHTTPRequestHandler):
         rows = "".join(
             f"<tr><td>{html.escape(str(k))}</td><td>{html.escape(str(v))}</td></tr>"
             for k, v in sorted(conf.items()))
-        body = f"<p><a href='/'>&larr; jobs</a></p><table>{rows}</table>"
+        body = (f"<p><a href='{self._href('/')}'>&larr; jobs</a></p>"
+                f"<table>{rows}</table>")
         self._send(200, _PAGE.format(title=f"{job['app_id']} config", body=body))
 
     def _job_events(self, job: dict, api: bool):
@@ -136,7 +203,8 @@ class PortalHandler(BaseHTTPRequestHandler):
         rows = "".join(
             f"<tr><td>{_ts(e['timestamp'])}</td><td>{e['type']}</td>"
             f"<td>{html.escape(json.dumps(e['event']))}</td></tr>" for e in events)
-        body = f"<p><a href='/'>&larr; jobs</a></p><table>{rows}</table>"
+        body = (f"<p><a href='{self._href('/')}'>&larr; jobs</a></p>"
+                f"<table>{rows}</table>")
         self._send(200, _PAGE.format(title=f"{job['app_id']} events", body=body))
 
     def _job_logs(self, job: dict, api: bool):
@@ -152,7 +220,8 @@ class PortalHandler(BaseHTTPRequestHandler):
         if api:
             return self._send(200, json.dumps(found), "application/json")
         items = "".join(f"<li>{html.escape(p)}</li>" for p in found) or "<li>none</li>"
-        body = f"<p><a href='/'>&larr; jobs</a></p><ul>{items}</ul>"
+        body = (f"<p><a href='{self._href('/')}'>&larr; jobs</a></p>"
+                f"<ul>{items}</ul>")
         self._send(200, _PAGE.format(title=f"{job['app_id']} logs", body=body))
 
     def _job_metrics(self, job: dict, api: bool):
@@ -200,7 +269,7 @@ class PortalHandler(BaseHTTPRequestHandler):
                 for r in rows)
             sections.append(f"<h3>{html.escape(name)}</h3>"
                             f"<table><tr>{head}</tr>{body_rows}</table>")
-        body = ("<p><a href='/'>&larr; jobs</a></p>"
+        body = (f"<p><a href='{self._href('/')}'>&larr; jobs</a></p>"
                 + ("".join(sections) or "<p>no metrics recorded</p>"))
         self._send(200, _PAGE.format(title=f"{job['app_id']} metrics",
                                      body=body))
@@ -231,9 +300,11 @@ def _ts(ms: int) -> str:
 
 class Portal:
     def __init__(self, history_root: str, port: int = 0, host: str = "127.0.0.1",
-                 mover_interval_ms: int = 300_000, retention_sec: int = 2_592_000):
-        self.state = PortalState(history_root)
-        handler = type("BoundHandler", (PortalHandler,), {"state": self.state})
+                 mover_interval_ms: int = 300_000, retention_sec: int = 2_592_000,
+                 token: str = "", max_jobs: int = 2000):
+        self.state = PortalState(history_root, max_jobs=max_jobs)
+        handler = type("BoundHandler", (PortalHandler,),
+                       {"state": self.state, "token": token})
         self.server = ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.server.server_address[:2]
         self.mover_interval_s = mover_interval_ms / 1000
@@ -271,10 +342,19 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="tony-tpu portal")
     parser.add_argument("--history", required=True)
     parser.add_argument("--port", type=int, default=19885)
-    parser.add_argument("--host", default="0.0.0.0")
+    # loopback by default: exposing the portal beyond the host is an
+    # explicit opt-in (pair --host 0.0.0.0 with --token)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--token", default=os.environ.get(
+        "TONY_PORTAL_TOKEN", ""),
+        help="require Authorization: Bearer <token> (or ?token=) on every "
+             "request; defaults to $TONY_PORTAL_TOKEN")
+    parser.add_argument("--max-jobs", type=int, default=2000,
+                        help="cap on history entries held in memory")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    portal = Portal(args.history, port=args.port, host=args.host).start()
+    portal = Portal(args.history, port=args.port, host=args.host,
+                    token=args.token, max_jobs=args.max_jobs).start()
     print(f"tony-tpu portal at http://{portal.host}:{portal.port}")
     try:
         while True:

@@ -24,11 +24,15 @@ class RpcError(RuntimeError):
 
 class RpcClient:
     def __init__(self, host: str, port: int, secret: str | None = None,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, tls_fingerprint: str | None = None):
+        """``tls_fingerprint``: pin the coordinator's per-job self-signed
+        cert by SHA-256 digest (rpc/tls.py); connections whose served cert
+        doesn't match are refused."""
         self.host = host
         self.port = port
         self.secret = secret
         self.timeout = timeout
+        self.tls_fingerprint = tls_fingerprint
         self._sock: socket.socket | None = None
         self._req_id = 0
         self._lock = threading.Lock()
@@ -38,6 +42,16 @@ class RpcClient:
         if self._sock is None:
             sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self.tls_fingerprint:
+                from tony_tpu.rpc.tls import client_wrap
+
+                try:
+                    sock = client_wrap(sock, self.tls_fingerprint)
+                except BaseException:
+                    # handshake failure: the raw fd is not yet tracked in
+                    # self._sock — close it here or every retry leaks one
+                    sock.close()
+                    raise
             self._sock = sock
         return self._sock
 

@@ -24,15 +24,33 @@ log = logging.getLogger(__name__)
 
 class RpcServer:
     def __init__(self, handler: object, host: str = "127.0.0.1", port: int = 0,
-                 secret: str | None = None):
+                 secret: str | None = None,
+                 tls: tuple[str, str] | None = None):
+        """``tls`` = (cert_path, key_path): serve the per-job self-signed
+        cert; peers pin its fingerprint (rpc/tls.py — the SASL-transport
+        analog of ApplicationMaster.java:484-504)."""
         self.handler = handler
         self.secret = secret
+        self._ssl_ctx = None
+        if tls:
+            from tony_tpu.rpc.tls import server_context
+
+            self._ssl_ctx = server_context(*tls)
         outer = self
 
         class _Conn(socketserver.BaseRequestHandler):
             def handle(self) -> None:  # one connection, many frames
                 sock: socket.socket = self.request
                 sock.settimeout(600)
+                if outer._ssl_ctx is not None:
+                    try:
+                        sock = outer._ssl_ctx.wrap_socket(sock,
+                                                          server_side=True)
+                    except (OSError, ConnectionError) as e:
+                        # plaintext/garbled handshake must not kill the
+                        # server thread pool
+                        log.warning("TLS handshake failed: %s", e)
+                        return
                 try:
                     while True:
                         req = wire.recv_frame(sock)
