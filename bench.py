@@ -327,6 +327,58 @@ def bench_transformer(on_tpu: bool) -> dict:
     }
 
 
+# ------------------------------------------------------ attention kernels
+
+
+def bench_attention(on_tpu: bool) -> dict:
+    """Pallas flash vs XLA reference attention, fwd+bwd — the checked-in
+    artifact behind PARITY.md's kernel claims. TPU-only: the pallas
+    interpreter on CPU measures the interpreter, not the kernel."""
+    if not on_tpu:
+        return {"skipped": "kernel A/B is only meaningful on TPU"}
+    from tony_tpu.ops import flash_attention
+    from tony_tpu.parallel import reference_attention
+
+    def timed(fn, args, steps=20):
+        out = fn(*args)  # compile
+        float(jnp.asarray(out).reshape(-1)[0])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        float(jnp.asarray(out).reshape(-1)[0])
+        return (time.perf_counter() - t0) / steps
+
+    def qkv(b, l, h, d, key=0):
+        ks = jax.random.split(jax.random.PRNGKey(key), 3)
+        return tuple(jax.random.normal(k, (b, l, h, d), jnp.bfloat16)
+                     for k in ks)
+
+    def fwd_bwd(attn):
+        def loss(q, k, v):
+            return attn(q, k, v).astype(jnp.float32).sum()
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        return lambda q, k, v: g(q, k, v)[0]
+
+    out = {}
+    # claim 1: flash vs XLA reference at seq 2k (fwd+bwd)
+    args = qkv(4, 2048, 12, 64)
+    t_flash = timed(fwd_bwd(lambda q, k, v: flash_attention(
+        q, k, v, True, 512, 512)), args)
+    t_ref = timed(fwd_bwd(lambda q, k, v: reference_attention(
+        q, k, v, causal=True)), args)
+    out["flash_vs_xla_seq2k"] = round(t_ref / t_flash, 3)
+    out["flash_seq2k_ms"] = round(t_flash * 1e3, 3)
+    # claim 2: banded sliding window vs full causal at seq 8k, window 1k
+    args8 = qkv(1, 8192, 12, 64, key=1)
+    t_full = timed(fwd_bwd(lambda q, k, v: flash_attention(
+        q, k, v, True, 512, 512)), args8)
+    t_win = timed(fwd_bwd(lambda q, k, v: flash_attention(
+        q, k, v, True, 512, 512, window=1024)), args8)
+    out["windowed_vs_full_seq8k_w1k"] = round(t_full / t_win, 3)
+    return out
+
+
 # -------------------------------------------------------- launch latency
 
 
@@ -389,6 +441,10 @@ def main() -> None:
         extras["transformer"] = bench_transformer(on_tpu)
     except Exception as e:  # the headline line must survive a sub-bench
         extras["transformer"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        extras["attention"] = bench_attention(on_tpu)
+    except Exception as e:
+        extras["attention"] = {"error": f"{type(e).__name__}: {e}"}
     try:
         extras["launch"] = bench_launch()
     except Exception as e:

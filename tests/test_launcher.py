@@ -201,3 +201,90 @@ def test_ssh_mode_e2e(tmp_path):
         client = cluster.submit(conf)
         assert client.final_status["status"] == "SUCCEEDED", \
             client.final_status
+
+
+def test_ssh_launcher_packs_hosts_by_free_chips(tmp_path, monkeypatch):
+    """Capacity-aware placement: tasks carrying a chip demand land on the
+    host with the most free chips and get disjoint TPU_VISIBLE_DEVICES
+    subsets; capacity returns only once the ssh client confirms the
+    remote tree is gone (the pod-wide analog of the coordinator-host
+    ChipAllocator)."""
+    from tony_tpu import constants as C
+    from tony_tpu.coordinator import launcher as L
+
+    placements = []
+
+    monkeypatch.setattr(
+        L, "REMOTE_AGENT_CMD",
+        "sh -c 'echo HOSTENV=$TPU_VISIBLE_DEVICES; sleep 60'")
+    lch = L.SshLauncher(["h1", "h2"], on_exit=lambda t, c: None,
+                        ssh_bin=FAKE_SSH, chips_per_host=4)
+    orig_place = lch._place
+
+    def spy(task, env):
+        host, env2 = orig_place(task, env)
+        placements.append((task.id, host, env2.get(C.TPU_VISIBLE_DEVICES)))
+        return host, env2
+
+    monkeypatch.setattr(lch, "_place", spy)
+    tasks = [Task(role="worker", index=i) for i in range(4)]
+    for t in tasks:
+        lch.launch(t, {C.TASK_CHIPS: "2"},
+                   os.path.join(str(tmp_path), f"{t.id}.log"))
+    by_host = {}
+    for tid, host, vis in placements:
+        assert vis is not None
+        by_host.setdefault(host, []).append(vis)
+    # 4 tasks x 2 chips over 2x4-chip hosts: 2 per host, disjoint pairs
+    assert sorted(len(v) for v in by_host.values()) == [2, 2]
+    for host, subsets in by_host.items():
+        assert sorted(subsets) == ["0,1", "2,3"]
+    # a 5th task cannot fit anywhere
+    with pytest.raises(RuntimeError, match="chips"):
+        lch.launch(Task(role="worker", index=4), {C.TASK_CHIPS: "2"},
+                   os.path.join(str(tmp_path), "w4.log"))
+    # kill returns capacity only after the local ssh client confirms the
+    # exit (deferred release: a timed-out remote kill must not let a
+    # relaunch share devices with a live agent)
+    assert lch.kill_task("worker:0")
+    assert _wait_for(lambda: sum(
+        p.free_count for p in lch._pools.values()) == 2), \
+        "capacity not returned after confirmed kill"
+    host, env2 = orig_place(Task(role="worker", index=5),
+                            {C.TASK_CHIPS: "2"})
+    assert env2[C.TPU_VISIBLE_DEVICES] in ("0,1", "2,3")
+    lch.stop_all()
+
+
+def test_ssh_packing_e2e(tmp_path):
+    """Full job: two 2-chip workers packed onto ONE 4-chip ssh host must
+    see disjoint TPU_VISIBLE_DEVICES subsets end-to-end."""
+    import glob
+
+    from tony_tpu import constants as C
+
+    payload = os.path.join(str(tmp_path), "check_chips.py")
+    with open(payload, "w") as f:
+        f.write("import os, sys\n"
+                "vis = os.environ.get('TPU_VISIBLE_DEVICES', '')\n"
+                "ids = [int(x) for x in vis.split(',') if x]\n"
+                "print('TPU_VISIBLE_DEVICES =', vis)\n"
+                "sys.exit(0 if len(ids) == 2 else 9)\n")
+    with MiniTonyCluster() as cluster:
+        conf = script_conf(cluster, payload, {"worker": 2})
+        conf.set("tony.application.launch-mode", "ssh")
+        conf.set("tony.application.hosts", "hX")
+        conf.set("tony.application.ssh-bin", FAKE_SSH)
+        conf.set("tony.application.remote-pythonpath", REPO_ROOT)
+        conf.set("tony.worker.chips", 2)
+        conf.set("tony.tpu.chips-per-host", 4)
+        client = cluster.submit(conf)
+        assert client.final_status["status"] == "SUCCEEDED", \
+            client.final_status
+        subsets = []
+        for lf in glob.glob(os.path.join(client.job_dir, "logs",
+                                         "worker-*.log")):
+            for line in open(lf):
+                if "TPU_VISIBLE_DEVICES =" in line:
+                    subsets.append(line.strip().split("= ")[1])
+        assert sorted(subsets) == ["0,1", "2,3"], subsets

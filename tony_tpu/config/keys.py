@@ -261,7 +261,11 @@ KEYS: dict[str, Key] = {
     "tony.tpu.topology": Key(
         "", str, "Requested TPU slice topology, e.g. v5p-32; empty = local devices"
     ),
-    "tony.tpu.chips-per-host": Key(4, int, "TPU chips per agent host"),
+    "tony.tpu.chips-per-host": Key(
+        0, int, "TPU chips per agent host; > 0 turns on capacity-aware "
+        "packing + per-task TPU_VISIBLE_DEVICES subsets in the ssh "
+        "launcher (0 = unknown: plain round-robin placement)"
+    ),
     "tony.tpu.info-exec-path": Key(
         "", str, "Path to a tpu-info-style command emitting chip metrics JSON "
         "(ref: tony.gpu-exec-path for nvidia-smi)"

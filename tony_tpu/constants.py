@@ -25,6 +25,7 @@ RESUME_STEP = "TONY_RESUME_STEP"  # resume: newest step found at (re)launch
 AGENT_PID = "TONY_AGENT_PID"  # pid of the task agent (preemption-notice target)
 NUM_AM_RETRIES = "TONY_NUM_COORD_RETRIES"  # retries left (ref: NUM_AM_RETRIES)
 TASK_MEMORY = "TONY_TASK_MEMORY"  # role memory (launchers enforce: rlimit/--memory)
+TASK_CHIPS = "TONY_TASK_CHIPS"  # chips requested (ssh launcher packs per host)
 TASK_VCORES = "TONY_TASK_VCORES"  # role vcores (docker --cpus; advisory locally)
 TPU_VISIBLE_DEVICES = "TPU_VISIBLE_DEVICES"  # libtpu device-subset contract
 

@@ -43,6 +43,11 @@ class ChipAllocator:
             self._held[task_id] = ids
             return list(ids)
 
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
     def release(self, task_id: str) -> None:
         with self._lock:
             ids = self._held.pop(task_id, None)

@@ -422,7 +422,9 @@ class Coordinator:
                 remote_pythonpath=str(
                     self.conf.get("tony.application.remote-pythonpath", "")),
                 ssh_bin=str(self.conf.get("tony.application.ssh-bin", "ssh")),
-                app_id=self.app_id)
+                app_id=self.app_id,
+                chips_per_host=self.conf.get_int("tony.tpu.chips-per-host",
+                                                 0))
         if mode != "local":
             raise ValueError(f"unknown tony.application.launch-mode: {mode}")
         return LocalProcessLauncher(self._on_task_process_exit,
@@ -540,6 +542,10 @@ class Coordinator:
             # hands each container its own GPU set, util/Utils.java:393-419)
             ids = self.chips.allocate(task.id, req.chips)
             env[C.TPU_VISIBLE_DEVICES] = ",".join(str(i) for i in ids)
+        elif req.chips > 0 and mode == "ssh":
+            # the ssh launcher owns placement, so it also owns the
+            # per-host chip pools: ship the demand, it packs + assigns
+            env[C.TASK_CHIPS] = str(req.chips)
         # memory/vcores reach the launcher ONLY when explicitly configured
         # for the role: the schema default (2g) must not impose an rlimit
         # on jax processes that map far more address space than they touch

@@ -322,7 +322,7 @@ class SshLauncher(Launcher):
     def __init__(self, hosts: list[str], on_exit: OnExit,
                  remote_pythonpath: str = "",
                  ssh_opts: list[str] | None = None, ssh_bin: str = "ssh",
-                 app_id: str = ""):
+                 app_id: str = "", chips_per_host: int = 0):
         if not hosts:
             raise ValueError("SshLauncher needs at least one host")
         self.hosts = hosts
@@ -336,6 +336,17 @@ class SshLauncher(Launcher):
         self._local = LocalProcessLauncher(self._on_local_exit)
         self._remote: dict[str, tuple[str, str]] = {}  # task -> (host, pgid file)
         self._remote_lock = threading.Lock()
+        # capacity-aware packing: when tasks declare a chip demand
+        # (TONY_TASK_CHIPS) and hosts have a known chip count, place each
+        # task on the host with the most free chips and hand it a disjoint
+        # TPU_VISIBLE_DEVICES subset (the pod-wide analog of the
+        # coordinator-host ChipAllocator; ref: per-container GPU sets,
+        # util/Utils.java:393-419). chips_per_host=0 -> plain round-robin.
+        self._pools: dict[str, "ChipAllocator"] | None = None
+        if chips_per_host > 0:
+            from tony_tpu.coordinator.chips import ChipAllocator
+
+            self._pools = {h: ChipAllocator(chips_per_host) for h in hosts}
 
     def _on_local_exit(self, task_id: str, code: int) -> None:
         """Natural exit: retire the remote record BEFORE reporting, so a
@@ -345,6 +356,8 @@ class SshLauncher(Launcher):
         finish, DAG release) by the ssh timeout."""
         with self._remote_lock:
             info = self._remote.pop(task_id, None)
+        if info and self._pools:
+            self._pools[info[0]].release(task_id)
         self.on_exit(task_id, code)
         if info:
             threading.Thread(target=self._rm_pgid_file, args=info,
@@ -361,9 +374,34 @@ class SshLauncher(Launcher):
         except subprocess.SubprocessError:
             log.debug("stale pgid file cleanup on %s failed", host)
 
-    def launch(self, task: Task, env: dict[str, str], log_path: str) -> None:
+    def _place(self, task: Task, env: dict[str, str]) -> tuple[str, dict]:
+        """Pick the host (and chip subset) for a task. With pools + a chip
+        demand: most-free-chips host (packing); else round-robin."""
+        chips = int(env.get(C.TASK_CHIPS, "0") or 0)
+        if self._pools and chips > 0:
+            host = max(self.hosts,
+                       key=lambda h: self._pools[h].free_count)
+            ids = self._pools[host].allocate(task.id, chips)
+            env = dict(env)
+            env[C.TPU_VISIBLE_DEVICES] = ",".join(str(i) for i in ids)
+            return host, env
         host = self.hosts[self._next % len(self.hosts)]
         self._next += 1
+        return host, env
+
+    def launch(self, task: Task, env: dict[str, str], log_path: str) -> None:
+        host, env = self._place(task, env)
+        try:
+            self._launch_on(host, task, env, log_path)
+        except BaseException:
+            # the task never registered in _remote, so no exit path would
+            # ever return its chips — release the placement hold here
+            if self._pools:
+                self._pools[host].release(task.id)
+            raise
+
+    def _launch_on(self, host: str, task: Task, env: dict[str, str],
+                   log_path: str) -> None:
         exports = " ".join(
             f"export {k}={shlex.quote(str(v))};" for k, v in env.items()
         )
@@ -412,8 +450,13 @@ class SshLauncher(Launcher):
                         host, pgid_file)
 
     def kill_task(self, task_id: str) -> bool:
+        # keep the _remote record: the chip hold is released only by
+        # _on_local_exit once the ssh client confirms the remote tree is
+        # gone — releasing here would let a relaunch share devices with a
+        # kill that timed out (unreachable host keeps its agent until the
+        # coordinator-lost horizon)
         with self._remote_lock:
-            info = self._remote.pop(task_id, None)
+            info = self._remote.get(task_id)
         if info:
             self._remote_kill(*info)
         # the remote kill usually completes the local ssh client before
@@ -428,6 +471,9 @@ class SshLauncher(Launcher):
         with self._remote_lock:
             remote = list(self._remote.values())
             self._remote.clear()
+        if self._pools:
+            for pool in self._pools.values():
+                pool.reset()
         for host, pgid_file in remote:
             self._remote_kill(host, pgid_file)
         self._local.stop_all()
