@@ -327,6 +327,53 @@ def bench_transformer(on_tpu: bool) -> dict:
     }
 
 
+# --------------------------------------------------------------- decode
+
+
+def bench_decode(on_tpu: bool) -> dict:
+    """KV-cache autoregressive decode throughput on the flagship decoder
+    (the serving path: prefill + lax.scan decode under one jit).
+
+    On the tunneled TPU backend the decode program's XLA compile runs
+    >15 min (measured; the nested scan-of-scanned-blocks program hits the
+    tunnel's per-compile overhead hard), which would blow the whole bench
+    budget — so the TPU measurement is opt-in via TONY_BENCH_DECODE=1 and
+    the default run reports the CPU-proxy number only."""
+    from tony_tpu.models import Transformer, TransformerConfig, generate
+
+    if on_tpu and os.environ.get("TONY_BENCH_DECODE") != "1":
+        return {"skipped": "set TONY_BENCH_DECODE=1 (decode compile "
+                           ">15 min on the tunneled TPU backend)"}
+    if on_tpu:
+        # scan_layers: one traced block, not 12 — the decode program's
+        # compile time stays bounded
+        cfg = TransformerConfig(
+            vocab_size=32768, d_model=768, n_layers=12, n_heads=12,
+            d_ff=3072, max_seq_len=512, scan_layers=True)
+        batch, prompt_len, new = 8, 128, 256
+    else:
+        cfg = TransformerConfig(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+            max_seq_len=64)
+        batch, prompt_len, new = 2, 16, 16
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, prompt_len), jnp.int32))["params"]
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (batch, prompt_len),
+                                0, cfg.vocab_size, jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=new)  # compile
+    float(jnp.asarray(out).reshape(-1)[0])
+    t0 = time.perf_counter()
+    out = generate(model, params, prompt, max_new_tokens=new)
+    float(jnp.asarray(out).reshape(-1)[0])
+    dt = time.perf_counter() - t0
+    return {
+        "decode_tokens_per_sec": round(batch * new / dt, 1),
+        "per_token_latency_ms": round(dt / new * 1e3, 3),
+        "batch": batch, "new_tokens": new,
+    }
+
+
 # ------------------------------------------------------ attention kernels
 
 
@@ -445,6 +492,10 @@ def main() -> None:
         extras["attention"] = bench_attention(on_tpu)
     except Exception as e:
         extras["attention"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        extras["decode"] = bench_decode(on_tpu)
+    except Exception as e:
+        extras["decode"] = {"error": f"{type(e).__name__}: {e}"}
     try:
         extras["launch"] = bench_launch()
     except Exception as e:

@@ -471,3 +471,93 @@ def test_exotic_rope_scaling_rejected():
         rope_scaling={"rope_type": "yarn", "factor": 2.0})
     with pytest.raises(ValueError, match="rope_scaling"):
         llama_config(config)
+
+
+# -- Gemma (explicit head_dim, scaled embeddings, unit-offset RMSNorm) -------
+
+
+@pytest.fixture(scope="module")
+def gemma_pair():
+    from tony_tpu.models.hf import from_hf_gemma
+
+    config = transformers.GemmaConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=80,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16,  # 4 x 16 = 64 != hidden 48: the explicit-width path
+        max_position_embeddings=64, attention_dropout=0.0,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = transformers.GemmaForCausalLM(config).eval()
+    model, params = from_hf_gemma(hf)
+    return hf, model, params
+
+
+def test_gemma_config_mapping(gemma_pair):
+    _, model, _ = gemma_pair
+    cfg = model.cfg
+    assert cfg.head_dim == 16 and cfg.explicit_head_dim == 16
+    assert cfg.embed_scale and cfg.norm_unit_offset
+    assert cfg.tied_embeddings and cfg.gated_mlp
+    assert cfg.activation == "gelu_tanh"
+
+
+def test_gemma_logits_parity(gemma_pair):
+    """Exact vs torch GemmaForCausalLM: the sqrt(hidden) embedding
+    normalizer, (1 + weight) RMSNorm, and head_dim > hidden/n_heads all
+    have to agree."""
+    hf, model, params = gemma_pair
+    tokens = np.random.default_rng(3).integers(0, 96, (2, 13))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_gemma_greedy_decode_parity(gemma_pair):
+    from tony_tpu.models import generate
+
+    hf, model, params = gemma_pair
+    prompt = np.random.default_rng(4).integers(0, 96, (1, 7))
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(prompt), max_new_tokens=6,
+                          do_sample=False).numpy()[0, 7:]
+    got = np.asarray(generate(model, params["params"],
+                              jnp.asarray(prompt), max_new_tokens=6))[0]
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_gemma_hub_config_activation_and_untied():
+    """Real hub Gemma configs carry BOTH hidden_act and hidden_activation;
+    transformers' GemmaMLP runs hidden_act — the import must match the
+    installed torch runtime, not the nominal field. Also: untied output
+    heads must be honored, not silently dropped."""
+    from tony_tpu.models.hf import from_hf_gemma
+
+    config = transformers.GemmaConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=80,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, attention_dropout=0.0,
+        hidden_act="gelu", hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=False, attn_implementation="eager")
+    torch.manual_seed(1)
+    hf = transformers.GemmaForCausalLM(config).eval()
+    model, params = from_hf_gemma(hf)
+    assert model.cfg.activation == "gelu"  # hidden_act wins (ACT2FN path)
+    assert not model.cfg.tied_embeddings
+    assert "lm_head" in params["params"]
+    tokens = np.random.default_rng(5).integers(0, 96, (1, 9))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_gemma_importer_rejects_gemma2():
+    from tony_tpu.models.hf import from_hf_gemma
+
+    class FakeModel:
+        class config:
+            model_type = "gemma2"
+
+    with pytest.raises(ValueError, match="gemma2"):
+        from_hf_gemma(FakeModel())

@@ -12,8 +12,10 @@ from tony_tpu.models.pipeline import pipelined_forward
 from tony_tpu.models.hf import (
     convert_gpt2_state_dict,
     convert_llama_state_dict,
+    from_hf_gemma,
     from_hf_gpt2,
     from_hf_llama,
+    gemma_config,
     gpt2_config,
     llama_config,
 )
@@ -29,8 +31,10 @@ __all__ = [
     "MoEMLP",
     "convert_gpt2_state_dict",
     "convert_llama_state_dict",
+    "from_hf_gemma",
     "from_hf_gpt2",
     "from_hf_llama",
+    "gemma_config",
     "gpt2_config",
     "llama_config",
     "moe_aux_loss",

@@ -278,3 +278,63 @@ def from_hf_llama(model) -> tuple[Transformer, Any]:
     cfg = llama_config(model.config)
     params = convert_llama_state_dict(model.state_dict(), cfg)
     return Transformer(cfg), params
+
+
+def gemma_config(hf_config, **overrides) -> TransformerConfig:
+    """TransformerConfig matching a transformers GemmaConfig (Gemma-1).
+
+    Gemma's distinctives vs Llama: explicit per-head width (7B: 16 heads
+    x 256 > hidden 3072), embeddings scaled by sqrt(hidden) in activation
+    dtype, RMSNorm applied as (1 + weight) with zero-init weight, tied
+    embeddings, and gelu-tanh gated MLP. Gemma-2 (attn/final logit
+    softcapping, alternating local attention) is NOT this architecture
+    and is rejected by the model_type check in from_hf_gemma."""
+    # transformers' GemmaMLP runs ACT2FN[config.hidden_act] (verified on
+    # 4.57) even though hub configs ALSO carry hidden_activation — parity
+    # is against the installed torch reference, so mirror its resolution
+    # exactly: hidden_act first, hidden_activation as the fallback
+    act = getattr(hf_config, "hidden_act", None) or \
+        getattr(hf_config, "hidden_activation", None) or "gelu_pytorch_tanh"
+    if act not in _HF_ACTIVATIONS:
+        raise ValueError(f"unsupported Gemma activation {act!r}")
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+        n_layers=hf_config.num_hidden_layers,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        dtype=jnp.float32,
+        attention_backend="reference",
+        norm="rms",
+        positional="rope",
+        use_bias=False,
+        activation=_HF_ACTIVATIONS[act],
+        norm_eps=hf_config.rms_norm_eps,
+        rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
+        # same strictness as llama_config: linear/llama3 map, exotic
+        # scalings reject — never silently ignored
+        rope_scaling=_rope_scaling(hf_config),
+        gated_mlp=True,
+        tied_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+        explicit_head_dim=getattr(hf_config, "head_dim", 0) or 0,
+        embed_scale=True,
+        norm_unit_offset=True,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def from_hf_gemma(model) -> tuple[Transformer, Any]:
+    """(Transformer, params) from a transformers GemmaForCausalLM.
+    The state-dict layout is Llama's (same projection/norm names), so the
+    conversion is shared; only the config semantics differ."""
+    if getattr(model.config, "model_type", "") != "gemma":
+        raise ValueError(
+            f"from_hf_gemma got model_type "
+            f"{getattr(model.config, 'model_type', None)!r} (gemma2's "
+            "softcapping/local-attention architecture is not this model)")
+    cfg = gemma_config(model.config)
+    params = convert_llama_state_dict(model.state_dict(), cfg)
+    return Transformer(cfg), params

@@ -65,6 +65,15 @@ class TransformerConfig:
     # SwiGLU-style gated FFN (Llama family): wo(act(wg(x)) * wi(x));
     # False = classic 2-matmul MLP (GPT-2 family)
     gated_mlp: bool = False
+    # per-head width when it differs from d_model // n_heads (Gemma-7B:
+    # 16 heads x 256 > d_model 3072); 0 = derived
+    explicit_head_dim: int = 0
+    # multiply token embeddings by sqrt(d_model), in activation dtype
+    # (Gemma's normalizer)
+    embed_scale: bool = False
+    # RMSNorm computes x_norm * (1 + scale) with zero-init scale (Gemma's
+    # parameterization; checkpoints store the offset-from-one weight)
+    norm_unit_offset: bool = False
     # False adds a separate lm_head param instead of reusing the input
     # embedding for output logits (Llama unties; GPT-2 ties)
     tied_embeddings: bool = True
@@ -92,7 +101,7 @@ class TransformerConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.explicit_head_dim or self.d_model // self.n_heads
 
     @property
     def kv_heads(self) -> int:
@@ -151,15 +160,20 @@ def _attention(cfg: TransformerConfig, q, k, v, segment_ids=None):
 class RMSNorm(nn.Module):
     dtype: Any = jnp.bfloat16
     eps: float = 1e-6
+    # Gemma parameterization: scale is zero-init and applied as
+    # (1 + scale) — checkpoints store the offset-from-one weight
+    unit_offset: bool = False
 
     @nn.compact
     def __call__(self, x):
-        scale = self.param("scale", nn.initializers.ones_init(), (x.shape[-1],),
-                           jnp.float32)
+        init = nn.initializers.zeros_init() if self.unit_offset \
+            else nn.initializers.ones_init()
+        scale = self.param("scale", init, (x.shape[-1],), jnp.float32)
         x32 = x.astype(jnp.float32)
         norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True)
                                    + self.eps)
-        return (norm * scale).astype(self.dtype)
+        mult = 1.0 + scale if self.unit_offset else scale
+        return (norm * mult).astype(self.dtype)
 
 
 class LayerNorm(nn.Module):
@@ -184,9 +198,12 @@ class LayerNorm(nn.Module):
 
 def make_norm(cfg: TransformerConfig, name: str):
     if cfg.norm == "layer":
+        if cfg.norm_unit_offset:
+            raise ValueError("norm_unit_offset is an RMSNorm (Gemma) knob")
         return LayerNorm(cfg.dtype, cfg.norm_eps, name=name)
     if cfg.norm == "rms":
-        return RMSNorm(cfg.dtype, cfg.norm_eps, name=name)
+        return RMSNorm(cfg.dtype, cfg.norm_eps, cfg.norm_unit_offset,
+                       name=name)
     raise ValueError(f"unknown norm {cfg.norm}")
 
 
@@ -527,6 +544,9 @@ class Transformer(nn.Module):
         embed = self.param("embedding", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.d_model), jnp.float32)
         x = embed[tokens].astype(cfg.dtype)
+        if cfg.embed_scale:
+            # in activation dtype, matching HF Gemma's normalizer cast
+            x = x * jnp.asarray(cfg.d_model ** 0.5, cfg.dtype)
         if cfg.positional == "learned":
             x = x + self._learned_positions(tokens.shape[1], decode)
         if cfg.scan_layers:
