@@ -34,7 +34,6 @@ from tony_tpu.coordinator.chips import ChipAllocator
 from tony_tpu.coordinator.launcher import Launcher, LocalProcessLauncher
 from tony_tpu.coordinator.liveness import LivenessMonitor
 from tony_tpu.coordinator.provisioner import (
-    STATE_READY,
     ProvisioningError,
     StaticProvisioner,
     preflight_chips,

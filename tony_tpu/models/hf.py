@@ -297,33 +297,19 @@ def gemma_config(hf_config, **overrides) -> TransformerConfig:
         getattr(hf_config, "hidden_activation", None) or "gelu_pytorch_tanh"
     if act not in _HF_ACTIVATIONS:
         raise ValueError(f"unsupported Gemma activation {act!r}")
+    # the shared RMSNorm+RoPE+GQA+gated-MLP mapping (and its strictness:
+    # attention/mlp-bias rejection, rope_scaling map-or-reject) lives in
+    # llama_config; only Gemma's distinctives are overridden here
     kw = dict(
-        vocab_size=hf_config.vocab_size,
-        d_model=hf_config.hidden_size,
-        n_heads=hf_config.num_attention_heads,
-        n_kv_heads=getattr(hf_config, "num_key_value_heads", None),
-        n_layers=hf_config.num_hidden_layers,
-        d_ff=hf_config.intermediate_size,
-        max_seq_len=hf_config.max_position_embeddings,
-        dtype=jnp.float32,
-        attention_backend="reference",
-        norm="rms",
-        positional="rope",
-        use_bias=False,
         activation=_HF_ACTIVATIONS[act],
-        norm_eps=hf_config.rms_norm_eps,
-        rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
-        # same strictness as llama_config: linear/llama3 map, exotic
-        # scalings reject — never silently ignored
-        rope_scaling=_rope_scaling(hf_config),
-        gated_mlp=True,
+        qkv_bias=False,
         tied_embeddings=getattr(hf_config, "tie_word_embeddings", True),
         explicit_head_dim=getattr(hf_config, "head_dim", 0) or 0,
         embed_scale=True,
         norm_unit_offset=True,
     )
     kw.update(overrides)
-    return TransformerConfig(**kw)
+    return llama_config(hf_config, **kw)
 
 
 def from_hf_gemma(model) -> tuple[Transformer, Any]:

@@ -96,8 +96,12 @@ class HorovodDriver:
         import tony_tpu
         pkg_parent = os.path.dirname(os.path.dirname(tony_tpu.__file__))
         env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
-        proc = subprocess.Popen(cmd, cwd=workdir, env=env,
-                                start_new_session=True)
+        # NO start_new_session: the rendezvous server must stay in the
+        # driver agent's process group so the launcher's group SIGKILL
+        # (stop_all/_kill_tree) reaps it — as a session leader it survived
+        # every job, since SIGKILL runs no finally/driver.kill() path
+        # (observed: one orphaned rendezvous server per completed job)
+        proc = subprocess.Popen(cmd, cwd=workdir, env=env)
         # preemption forwarding (agent SIGTERM handler) must reach the
         # rendezvous driver too, not only execute_shell children
         from tony_tpu.utils.shell import (

@@ -43,11 +43,24 @@ def request_graceful_shutdown(grace_ms: int = 15_000) -> int:
     from a worker thread, never directly inside a signal handler."""
     with _ACTIVE_LOCK:
         procs = list(_ACTIVE)
-    for proc in procs:
+
+    def signal_proc(proc, sig) -> None:
+        # registered processes are USUALLY their own group leaders
+        # (execute_shell children run under start_new_session), but not
+        # always — the horovod rendezvous server deliberately stays in
+        # the agent's group so the launcher's group kill reaps it. killpg
+        # on a non-leader pid raises ProcessLookupError; fall back to
+        # signalling the process itself rather than silently skipping it
         try:
-            os.killpg(proc.pid, signal.SIGTERM)
+            os.killpg(proc.pid, sig)
         except ProcessLookupError:
-            pass
+            try:
+                proc.send_signal(sig)
+            except (ProcessLookupError, OSError):
+                pass
+
+    for proc in procs:
+        signal_proc(proc, signal.SIGTERM)
 
     def kill_after_grace():
         # one shared deadline: per-proc fresh timeouts would compound to
@@ -59,10 +72,7 @@ def request_graceful_shutdown(grace_ms: int = 15_000) -> int:
             except subprocess.TimeoutExpired:
                 log.warning("grace period (%d ms) expired; SIGKILL pgid %d",
                             grace_ms, proc.pid)
-                try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except ProcessLookupError:
-                    pass
+                signal_proc(proc, signal.SIGKILL)
 
     threading.Thread(target=kill_after_grace, daemon=True).start()
     return len(procs)
