@@ -43,10 +43,35 @@ if _env_platforms and "axon" not in _env_platforms:
 
 
 def _platform() -> str:
+    """Resolve the backend WITHOUT risking a hang: the tunneled TPU
+    backend can block forever at init when the tunnel is down (observed
+    >1 h), and jax.devices() in-process would take the backend lock with
+    it. Probe in a SUBPROCESS with a deadline; on timeout/failure, pin
+    this process to CPU before any backend init so the bench always
+    prints its line. Must be called before any other jax backend use."""
+    import subprocess
+    import sys
+
+    env_p = os.environ.get("JAX_PLATFORMS", "")
+    if env_p and "axon" not in env_p:
+        # an explicit non-TPU request needs no probe (and the probe child
+        # would ignore it anyway: sitecustomize re-pins jax_platforms at
+        # interpreter startup, dialing the tunnel regardless)
+        return env_p.split(",")[0]
     try:
-        return jax.devices()[0].platform
-    except Exception:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=240)
+        platform = probe.stdout.strip().splitlines()[-1] \
+            if probe.returncode == 0 and probe.stdout.strip() else ""
+    except (subprocess.SubprocessError, OSError):
+        platform = ""
+    if not platform:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
         return "cpu"
+    return platform
 
 
 # peak bf16 matmul FLOP/s per chip, by device/accelerator naming
@@ -222,7 +247,8 @@ def bench_resnet(on_tpu: bool) -> dict:
 
     n_chips = max(1, jax.device_count())
     fw_ips = batch * steps / t_fw
-    peak = peak_flops_per_chip()
+    peak = peak_flops_per_chip() if on_tpu else 0.0  # env names the chip
+    # even when this process fell back to CPU; no peak -> no MFU claim
     mfu = (flops_step * steps / t_fw) / (peak * n_chips) if peak else 0.0
     return {
         "images_per_sec_per_chip": round(fw_ips / n_chips, 2),
@@ -313,7 +339,7 @@ def bench_transformer(on_tpu: bool) -> dict:
 
     n_chips = max(1, jax.device_count())
     tok_s = batch * seq * steps / t_step
-    peak = peak_flops_per_chip()
+    peak = peak_flops_per_chip() if on_tpu else 0.0
     mfu = (flops_step * steps / t_step) / (peak * n_chips) if peak else 0.0
     return {
         "tokens_per_sec_per_chip": round(tok_s / n_chips, 1),
@@ -480,10 +506,13 @@ def bench_launch() -> dict:
 
 
 def main() -> None:
-    on_tpu = _platform() in ("tpu", "axon")
+    platform = _platform()  # ONCE: a re-probe after the parent holds the
+    # TPU would fail in the child and falsely demote the run to cpu
+    on_tpu = platform in ("tpu", "axon")
     resnet = bench_resnet(on_tpu)
-    extras = {"resnet": resnet, "platform": _platform(),
-              "peak_flops_per_chip": peak_flops_per_chip()}
+    extras = {"resnet": resnet, "platform": platform,
+              "peak_flops_per_chip":
+                  peak_flops_per_chip() if on_tpu else 0.0}
     try:
         extras["transformer"] = bench_transformer(on_tpu)
     except Exception as e:  # the headline line must survive a sub-bench

@@ -249,27 +249,18 @@ def test_horovod_debug_driver(cluster):
     assert client.final_status["status"] == "SUCCEEDED", client.final_status
 
 
-def test_rendezvous_server_not_orphaned_after_job():
+def test_rendezvous_server_not_orphaned_after_job(cluster):
     """The rendezvous bootstrap must die with the job: as a session leader
     it used to survive the launcher's group SIGKILL of the driver agent,
     leaking one server per completed horovod job (observed: 39 orphans on
     one CI host)."""
     import subprocess
 
-    from tony_tpu.mini import MiniTonyCluster, script_conf
-
-    scripts = os.path.join(os.path.dirname(__file__), "scripts")
-    with MiniTonyCluster() as cluster:
-        conf = script_conf(cluster, os.path.join(scripts, "exit_0.py"),
-                           {"worker": 2}, framework="horovod")
-        conf.set("tony.horovod.test-mode", True)
-        client = cluster.submit(conf)
-        assert client.final_status["status"] == "SUCCEEDED", \
-            client.final_status
-        job_dir = client.job_dir
+    client = cluster.submit(_horovod_conf(cluster, "exit_0.py"))
+    assert client.final_status["status"] == "SUCCEEDED", client.final_status
     # no process may still reference this job's staging dir (the driver's
     # -d argument); pgrep exits non-zero when nothing matches
-    res = subprocess.run(["pgrep", "-f", job_dir], capture_output=True,
-                         text=True)
+    res = subprocess.run(["pgrep", "-f", client.job_dir],
+                         capture_output=True, text=True)
     assert res.returncode != 0, \
-        f"orphaned processes for {job_dir}: {res.stdout}"
+        f"orphaned processes for {client.job_dir}: {res.stdout}"

@@ -175,9 +175,14 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
             getattr(hf_config, "mlp_bias", False):
         raise ValueError("attention_bias/mlp_bias Llama variants are not "
                          "supported (only Qwen2-style qkv biases are)")
-    act = getattr(hf_config, "hidden_act", "silu")
-    if act not in _HF_ACTIVATIONS:
-        raise ValueError(f"unsupported hidden_act {act!r}")
+    if "activation" in overrides:
+        act_name = overrides["activation"]  # caller (gemma_config) already
+        # resolved the family's activation-field semantics
+    else:
+        act = getattr(hf_config, "hidden_act", "silu")
+        if act not in _HF_ACTIVATIONS:
+            raise ValueError(f"unsupported hidden_act {act!r}")
+        act_name = _HF_ACTIVATIONS[act]
     kw = dict(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -193,7 +198,7 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
         use_bias=False,
         qkv_bias=getattr(hf_config, "model_type", "") == "qwen2",
         sliding_window=_effective_sliding_window(hf_config),
-        activation=_HF_ACTIVATIONS[act],
+        activation=act_name,
         norm_eps=hf_config.rms_norm_eps,
         rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
         rope_scaling=_rope_scaling(hf_config),
