@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import jax
@@ -41,37 +43,87 @@ _env_platforms = os.environ.get("JAX_PLATFORMS", "")
 if _env_platforms and "axon" not in _env_platforms:
     jax.config.update("jax_platforms", _env_platforms)
 
+REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+# last-known-good on-chip artifact: written after every TPU run, embedded
+# into the line when a flaky tunnel forces a CPU fallback (VERDICT r2 #1a)
+LKG_PATH = os.path.join(REPO_DIR, "BENCH_LKG_TPU.json")
+
+
+def _probe_platform(timeout_s: float) -> str:
+    """One subprocess platform probe; '' on timeout/failure."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return probe.stdout.strip().splitlines()[-1] \
+            if probe.returncode == 0 and probe.stdout.strip() else ""
+    except (subprocess.SubprocessError, OSError):
+        return ""
+
 
 def _platform() -> str:
     """Resolve the backend WITHOUT risking a hang: the tunneled TPU
     backend can block forever at init when the tunnel is down (observed
     >1 h), and jax.devices() in-process would take the backend lock with
-    it. Probe in a SUBPROCESS with a deadline; on timeout/failure, pin
-    this process to CPU before any backend init so the bench always
-    prints its line. Must be called before any other jax backend use."""
-    import subprocess
-    import sys
-
+    it. Probe in a SUBPROCESS with a deadline, RETRYING with backoff — a
+    momentary tunnel blip must not demote a whole round's artifact to CPU
+    (VERDICT r2 #1a). Only when every attempt fails is this process
+    pinned to CPU (before any backend init) so the bench always prints
+    its line. Must be called before any other jax backend use."""
     env_p = os.environ.get("JAX_PLATFORMS", "")
     if env_p and "axon" not in env_p:
         # an explicit non-TPU request needs no probe (and the probe child
         # would ignore it anyway: sitecustomize re-pins jax_platforms at
         # interpreter startup, dialing the tunnel regardless)
         return env_p.split(",")[0]
+    tries = max(1, int(os.environ.get("TONY_BENCH_PROBE_RETRIES", "3")))
+    timeout = float(os.environ.get("TONY_BENCH_PROBE_TIMEOUT", "150"))
+    backoff = (20.0, 60.0)  # between attempts; the probe itself waits too
+    for attempt in range(tries):
+        if attempt:
+            time.sleep(backoff[min(attempt - 1, len(backoff) - 1)])
+        platform = _probe_platform(timeout)
+        if platform:
+            return platform
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
+
+
+def _git_commit() -> str:
     try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=240)
-        platform = probe.stdout.strip().splitlines()[-1] \
-            if probe.returncode == 0 and probe.stdout.strip() else ""
+        out = subprocess.run(["git", "-C", REPO_DIR, "rev-parse", "HEAD"],
+                             capture_output=True, text=True, timeout=10)
+        return out.stdout.strip()[:12]
     except (subprocess.SubprocessError, OSError):
-        platform = ""
-    if not platform:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        jax.config.update("jax_platforms", "cpu")
-        return "cpu"
-    return platform
+        return ""
+
+
+def save_lkg(line: dict) -> None:
+    """Persist an on-chip run (numbers + timestamp + commit) so later
+    CPU-fallback runs still carry TPU evidence with provenance."""
+    import datetime
+
+    doc = {
+        "timestamp": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "commit": _git_commit(),
+        "source": "bench.py on-chip run",
+        "line": line,
+    }
+    tmp = LKG_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, LKG_PATH)
+
+
+def load_lkg() -> dict | None:
+    try:
+        with open(LKG_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 # peak bf16 matmul FLOP/s per chip, by device/accelerator naming
@@ -166,8 +218,9 @@ def bench_resnet(on_tpu: bool) -> dict:
         steps, repeats = 100, 5
         compute = jnp.bfloat16
     else:
-        model, batch, size = ResNet18(num_classes=100, num_filters=16), 8, 32
-        steps, repeats = 3, 3
+        model, batch, size = ResNet18(num_classes=100, num_filters=16), 16, 32
+        steps, repeats = 8, 5  # the 1-core CI box jitters; median of 5
+        # interleaved rounds keeps the proxy ratio within a few percent
         compute = None
 
     rng = jax.random.PRNGKey(0)
@@ -222,10 +275,14 @@ def bench_resnet(on_tpu: bool) -> dict:
                       donate=True, compute_dtype=compute)
     state = trainer.init_state(params)
     b_sh = batch_sharding(mesh)
+    # bs rides in the batch tree, so it must carry the batch sharding the
+    # step declares for every batch leaf (the global [C] view is the same;
+    # on one chip the layouts coincide, on a virtual multi-device mesh a
+    # replicated placement is a hard in_shardings mismatch)
     train_batch = {
         "x": jax.device_put(images, b_sh),
         "y": jax.device_put(labels, b_sh),
-        "bs": jax.device_put(batch_stats, NamedSharding(mesh, P())),
+        "bs": jax.device_put(batch_stats, b_sh),
     }
     step_fn, placed = trainer.build_step(state)
 
@@ -272,18 +329,23 @@ def bench_transformer(on_tpu: bool) -> dict:
     from tony_tpu.train import Trainer, fit
 
     if on_tpu:
+        # flagship: 386M-param decoder (28 x d1024/ff4096 + 33.6M tied
+        # embedding), seq 2048, bf16, pallas flash attention, scanned
+        # layer stack (O(1)-in-depth compile over the tunnel) with remat
+        # (VERDICT r2 #1b: >=350M params, seq >=2k, remat-tuned)
         cfg = TransformerConfig(
-            vocab_size=32768, d_model=768, n_layers=12, n_heads=12,
-            d_ff=3072, max_seq_len=1024, attention_backend="pallas",
-            attention_block_size=512)
-        batch, seq, steps, fit_steps = 8, 1024, 30, 30
+            vocab_size=32768, d_model=1024, n_layers=28, n_heads=16,
+            d_ff=4096, max_seq_len=2048, attention_backend="pallas",
+            attention_block_size=512, scan_layers=True, remat=True)
+        batch, seq, steps = 8, 2048, 30
         compute = jnp.bfloat16  # MXU-native; fp32 master params in Trainer
     else:
         cfg = TransformerConfig(
             vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=128,
             max_seq_len=128, attention_backend="blockwise",
             attention_block_size=32)
-        batch, seq, steps, fit_steps = 2, 64, 3, 8
+        # batch must divide over however many (virtual) devices CI forces
+        batch, seq, steps = max(2, jax.device_count()), 64, 10
         compute = None
 
     model = Transformer(cfg)
@@ -318,23 +380,32 @@ def bench_transformer(on_tpu: bool) -> dict:
         return new_state, metrics["loss"]
 
     _, placed = timed_round(fw_step, placed, 2)  # compile + prime
-    t_step, placed = timed_round(fw_step, placed, steps)
+    rounds = []
+    for _ in range(3):  # median round: single-shot jitters on shared CPUs
+        t_round, placed = timed_round(fw_step, placed, steps)
+        rounds.append(t_round)
+    t_step = sorted(rounds)[1]
 
-    # the same step through train.fit: loop overhead must be ~0. Two sink
-    # stamps at the half/end log boundaries bracket the steady-state
-    # second half: fit's one-time recompile lands in the first half, and
-    # only one metrics fetch sits inside the measured window (per-step
-    # stamps would measure the tunnel's fetch round-trip, not the loop)
+    # the same step through train.fit: loop overhead must be ~0. fit()'s
+    # metric fetches are async (emitted one boundary late), so with three
+    # log windows the sinks fire at: boundary 2, boundary 3, and the
+    # end-of-loop flush. stamps[1]-stamps[0] spans exactly the steady-
+    # state window between boundaries 2 and 3 — fit's one-time recompile
+    # lands in window 1, and no synchronous fetch sits inside the
+    # measured window at all.
+    window = max(steps // 2, 10)  # short windows on the CPU proxy
+    # measure OS jitter, not loop overhead
+    fit_steps = 3 * window
+
     def batches():
         for _ in range(fit_steps):
             yield train_batch
 
-    half = max(fit_steps // 2, 1)
     stamps: list[float] = []
     fit(trainer, fresh(params), batches(), num_steps=fit_steps,
-        log_every=half,
+        log_every=window,
         metric_sinks=[lambda s, m: stamps.append(time.perf_counter())])
-    t_fit_step = (stamps[-1] - stamps[-2]) / half if len(stamps) >= 2 \
+    t_fit_step = (stamps[1] - stamps[0]) / window if len(stamps) >= 2 \
         else float("nan")
 
     n_chips = max(1, jax.device_count())
@@ -345,10 +416,17 @@ def bench_transformer(on_tpu: bool) -> dict:
         "tokens_per_sec_per_chip": round(tok_s / n_chips, 1),
         "mfu": round(mfu, 4),
         "n_params": n_params,
+        "seq_len": seq,
+        "config": f"d{cfg.d_model}xL{cfg.n_layers}h{cfg.n_heads}"
+                  f"ff{cfg.d_ff} scan={cfg.scan_layers} remat={cfg.remat} "
+                  f"attn={cfg.attention_backend}/{cfg.attention_block_size}",
         "flops_per_step": flops_step,
-        # ~1.0 = fit() adds nothing over the raw jitted step (its per-step
-        # sink sync adds a couple of scalar fetches)
+        # ~1.0 = fit() adds nothing over the raw jitted step (metric
+        # fetches are async; no sync sits on the step path). <1.0 is
+        # measurement noise between the two windows, not real speedup.
         "fit_overhead_ratio": round(t_fit_step / (t_step / steps), 4),
+        "raw_step_ms": round(t_step / steps * 1e3, 3),
+        "fit_step_ms": round(t_fit_step * 1e3, 3),
         "timed_steps": steps,
     }
 
@@ -360,16 +438,16 @@ def bench_decode(on_tpu: bool) -> dict:
     """KV-cache autoregressive decode throughput on the flagship decoder
     (the serving path: prefill + lax.scan decode under one jit).
 
-    On the tunneled TPU backend the decode program's XLA compile runs
-    >15 min (measured; the nested scan-of-scanned-blocks program hits the
-    tunnel's per-compile overhead hard), which would blow the whole bench
-    budget — so the TPU measurement is opt-in via TONY_BENCH_DECODE=1 and
-    the default run reports the CPU-proxy number only."""
+    Runs un-gated (VERDICT r2 #2): the persistent compilation cache
+    enabled in main() bounds the tunneled backend's >15-min decode
+    compile to ONE cold run ever — every later process loads the
+    serialized executable. TONY_BENCH_DECODE=0 skips explicitly when a
+    cold cache + a dead-slow tunnel make even that one compile
+    unaffordable."""
     from tony_tpu.models import Transformer, TransformerConfig, generate
 
-    if on_tpu and os.environ.get("TONY_BENCH_DECODE") != "1":
-        return {"skipped": "set TONY_BENCH_DECODE=1 (decode compile "
-                           ">15 min on the tunneled TPU backend)"}
+    if on_tpu and os.environ.get("TONY_BENCH_DECODE") == "0":
+        return {"skipped": "TONY_BENCH_DECODE=0"}
     if on_tpu:
         # scan_layers: one traced block, not 12 — the decode program's
         # compile time stays bounded
@@ -461,18 +539,27 @@ def bench_launch() -> dict:
     coordinator (gang schedule, agent launch) -> agent (register, exec) ->
     payload (jit + one step). The payload pins JAX to CPU: the parent
     bench owns the TPU chip, and this metric is orchestration latency,
-    not accelerator speed."""
+    not accelerator speed.
+
+    Submitted TWICE against one shared compile-cache dir (shell-env
+    overrides the per-job default): the second job's payload loads its
+    jitted step from the persistent cache, so the cold-vs-warm delta IS
+    the launch-latency win of VERDICT r2 #2 carried through the real
+    submit path."""
     import tempfile
 
     from tony_tpu.mini import MiniTonyCluster, script_conf
 
-    payload = os.path.join(tempfile.mkdtemp(prefix="tony_bench_"),
-                           "first_step.py")
+    workdir = tempfile.mkdtemp(prefix="tony_bench_")
+    payload = os.path.join(workdir, "first_step.py")
+    shared_cache = os.path.join(workdir, "compile-cache")
     with open(payload, "w") as f:
         f.write(
             "import json, os, time\n"
             "t = {'payload_start': time.time()}\n"
             "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "from tony_tpu.utils import compilecache\n"
+            "t['compile_cache'] = compilecache.enable()\n"
             "import jax, jax.numpy as jnp\n"
             "out = jax.jit(lambda x: (x @ x).sum())(jnp.ones((256, 256)))\n"
             "out.block_until_ready()\n"
@@ -480,8 +567,11 @@ def bench_launch() -> dict:
             "with open(os.path.join(os.environ['TONY_JOB_DIR'],\n"
             "          'launch_times.json'), 'w') as fh:\n"
             "    json.dump(t, fh)\n")
-    with MiniTonyCluster() as cluster:
+
+    def one_job(cluster) -> dict | None:
         conf = script_conf(cluster, payload, {"worker": 1})
+        conf.set("tony.application.shell-env",
+                 f"TONY_COMPILE_CACHE_DIR={shared_cache}")
         client = cluster.make_client(conf)
         t_submit = time.time()
         ok = client.run()
@@ -495,17 +585,84 @@ def bench_launch() -> dict:
         cj = os.path.join(client.job_dir, "coordinator.json")
         if os.path.exists(cj):
             coord_up = os.path.getmtime(cj) - t_submit
-    if not ok or "first_step_done" not in times:
+        if not ok or "first_step_done" not in times:
+            return None
+        return {
+            "submit_to_first_step_s": round(
+                times["first_step_done"] - t_submit, 3),
+            "submit_to_coordinator_up_s":
+                round(coord_up, 3) if coord_up else None,
+            "submit_to_task_start_s": round(
+                times["payload_start"] - t_submit, 3),
+            "submit_to_job_complete_s": round(t_done - t_submit, 3),
+        }
+
+    with MiniTonyCluster() as cluster:
+        cold = one_job(cluster)
+        warm = one_job(cluster)
+    if cold is None:
         return {"error": "launch bench job failed"}
-    return {
-        "submit_to_first_step_s": round(times["first_step_done"] - t_submit, 3),
-        "submit_to_coordinator_up_s": round(coord_up, 3) if coord_up else None,
-        "submit_to_task_start_s": round(times["payload_start"] - t_submit, 3),
-        "submit_to_job_complete_s": round(t_done - t_submit, 3),
-    }
+    out = dict(cold)
+    if warm is not None:
+        out["warm_submit_to_first_step_s"] = warm["submit_to_first_step_s"]
+        out["warm_start_delta_s"] = round(
+            cold["submit_to_first_step_s"] - warm["submit_to_first_step_s"],
+            3)
+    return out
+
+
+def _maybe_reexec_on_tpu(line: dict) -> dict:
+    """End-of-run second chance: the CPU benches took minutes — if the
+    tunnel recovered meanwhile, re-run the WHOLE bench pinned to TPU in a
+    fresh process (this one is irrevocably pinned to CPU) and ship its
+    line instead. Guarded against recursion; the CPU line survives any
+    child failure."""
+    if os.environ.get("TONY_BENCH_NO_REEXEC") == "1":
+        return line
+    if _env_platforms and "axon" not in _env_platforms:
+        return line  # an explicit CPU request is not a fallback
+    if _probe_platform(
+            float(os.environ.get("TONY_BENCH_PROBE_TIMEOUT", "150"))) \
+            not in ("tpu", "axon"):
+        return line  # still down (a 'cpu' probe is not a recovery)
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["TONY_BENCH_NO_REEXEC"] = "1"  # child gets ONE shot, no retries
+    env["TONY_BENCH_PROBE_RETRIES"] = "1"
+    try:
+        child = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, env=env,
+            timeout=float(os.environ.get("TONY_BENCH_REEXEC_TIMEOUT",
+                                         "2700")))
+        for ln in reversed(child.stdout.strip().splitlines()):
+            try:
+                parsed = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(parsed, dict) and "metric" in parsed:
+                child_platform = parsed.get("extras", {}).get("platform")
+                if child_platform not in ("tpu", "axon"):
+                    return line  # tunnel dropped again mid-child; keep
+                    # the cpu line rather than shipping a second one
+                    # with false TPU provenance
+                parsed.setdefault("extras", {})["reexec"] = \
+                    "tpu tunnel recovered after cpu fallback; re-ran on tpu"
+                return parsed
+    except (subprocess.SubprocessError, OSError):
+        pass
+    return line
 
 
 def main() -> None:
+    from tony_tpu.utils import compilecache
+
+    # persistent XLA compile cache, repo-scoped: bench reruns (and the
+    # driver's end-of-round run) load yesterday's executables instead of
+    # recompiling — this is what un-gates the decode bench on the tunnel
+    cache_dir = compilecache.enable(
+        os.environ.get("TONY_COMPILE_CACHE_DIR")
+        or os.path.join(REPO_DIR, ".jax_compile_cache"))
+
     platform = _platform()  # ONCE: a re-probe after the parent holds the
     # TPU would fail in the child and falsely demote the run to cpu
     on_tpu = platform in ("tpu", "axon")
@@ -529,15 +686,26 @@ def main() -> None:
         extras["launch"] = bench_launch()
     except Exception as e:
         extras["launch"] = {"error": f"{type(e).__name__}: {e}"}
+    if cache_dir:
+        extras["compile_cache"] = {
+            "dir": cache_dir, "entries": len(compilecache.entries(cache_dir))}
 
-    print(json.dumps({
+    line = {
         "metric": "resnet_images_per_sec_per_chip"
                   + ("" if on_tpu else "_cpu_proxy"),
         "value": resnet["images_per_sec_per_chip"],
         "unit": "images/sec/chip",
         "vs_baseline": resnet["vs_native"],
         "extras": extras,
-    }))
+    }
+    if on_tpu:
+        save_lkg(line)
+    else:
+        lkg = load_lkg()
+        if lkg:
+            extras["last_known_good_tpu"] = lkg
+        line = _maybe_reexec_on_tpu(line)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -16,6 +16,7 @@ Raw-token mode (no tokenizer needed): ``--token-ids 1,2,3``.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 
@@ -43,6 +44,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eos-id", type=int, default=-1,
                    help="stop token (default: model config's eos_token_id)")
+    p.add_argument("--compile-cache",
+                   default=os.path.join(os.path.expanduser("~"), ".cache",
+                                        "tony_tpu", "compile-cache"),
+                   help="persistent XLA compile-cache dir; decode programs "
+                        "compile once per (model, length) ever, not once "
+                        "per process ('' disables)")
     return p
 
 
@@ -72,6 +79,11 @@ def main(argv=None) -> int:
     if not args.prompt and not args.token_ids:
         print("need --prompt or --token-ids", file=sys.stderr)
         return 2
+
+    if args.compile_cache:
+        from tony_tpu.utils import compilecache
+
+        compilecache.enable(args.compile_cache)
 
     import jax
     import jax.numpy as jnp

@@ -22,6 +22,9 @@ DISTRIBUTED_MODE = "TONY_DISTRIBUTED_MODE"  # GANG | FCFS
 ATTEMPT_NUMBER = "TONY_ATTEMPT_NUMBER"  # coordinator retry attempt (ref: ATTEMPT_NUMBER)
 CHECKPOINT_DIR = "TONY_CHECKPOINT_DIR"  # resume: checkpoint root (no ref analog, SURVEY 5.4)
 RESUME_STEP = "TONY_RESUME_STEP"  # resume: newest step found at (re)launch
+JOB_DIR = "TONY_JOB_DIR"  # per-job working dir (staging, logs, events)
+COMPILE_CACHE_DIR = "TONY_COMPILE_CACHE_DIR"  # persistent XLA compile cache
+# (job-dir scoped: retry attempts reuse each other's compiles)
 AGENT_PID = "TONY_AGENT_PID"  # pid of the task agent (preemption-notice target)
 NUM_AM_RETRIES = "TONY_NUM_COORD_RETRIES"  # retries left (ref: NUM_AM_RETRIES)
 TASK_MEMORY = "TONY_TASK_MEMORY"  # role memory (launchers enforce: rlimit/--memory)

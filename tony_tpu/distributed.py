@@ -41,6 +41,12 @@ def initialize(timeout_s: int | None = None) -> dict | None:
     """Call jax.distributed.initialize from injected env. No-op (returns
     None) when running outside a gang or with a single process."""
     from tony_tpu.profiler import maybe_start_server
+    from tony_tpu.utils import compilecache
+
+    # before any compile: point XLA's persistent cache at the job-scoped
+    # dir so retries/resumes (and other gang members on this host) reuse
+    # compiled executables. No-op outside a job.
+    compilecache.enable()
 
     spec = env_spec()
     if spec is None or spec["num_processes"] <= 1:

@@ -186,6 +186,27 @@ def fit(trainer: Trainer, params: Any, train_data: Iterable, *,
         for sink in sinks:
             sink(step, metrics)
 
+    # Log-boundary metrics are fetched ASYNCHRONOUSLY: a synchronous
+    # float() at the boundary parks the host on a device->host round trip
+    # (milliseconds over a tunneled chip) while the dispatch queue drains —
+    # the measured few-percent fit() overhead of r2 (VERDICT r2 #5). Instead
+    # the boundary starts a device->host copy and the values are emitted at
+    # the NEXT boundary (or at loop end), by which time the copy long
+    # finished and float() costs nothing. Sinks therefore observe each
+    # boundary one log period late, with identical (step, metrics) pairs.
+    pending: tuple[int, Any, float] | None = None
+
+    def flush_pending() -> None:
+        nonlocal pending
+        if pending is None:
+            return
+        p_step, p_metrics, p_rate = pending
+        pending = None
+        fetched = {k: float(v) for k, v in p_metrics.items()}
+        log.info("step %d: %s (%.2f steps/s)", p_step,
+                 {k: round(v, 4) for k, v in fetched.items()}, p_rate)
+        emit(p_step, {**fetched, "steps_per_sec": p_rate})
+
     data_iter = None
     if target is None or start_step < target:  # budget not already met
         if resumed_from and hasattr(train_data, "from_step"):
@@ -212,20 +233,29 @@ def fit(trainer: Trainer, params: Any, train_data: Iterable, *,
             steps_run += 1
             step = start_step + steps_run
             if log_every and steps_run % log_every == 0:
-                fetched = {k: float(v) for k, v in last_metrics.items()}
-                rate = steps_run / (time.monotonic() - t0)
-                log.info("step %d: %s (%.2f steps/s)", step,
-                         {k: round(v, 4) for k, v in fetched.items()}, rate)
-                emit(step, {**fetched, "steps_per_sec": rate})
+                flush_pending()  # previous boundary's copy is done by now
+                for v in last_metrics.values():
+                    if hasattr(v, "copy_to_host_async"):
+                        v.copy_to_host_async()
+                pending = (step, last_metrics,
+                           steps_run / (time.monotonic() - t0))
             if manager and checkpoint_every and \
                     steps_run % checkpoint_every == 0:
                 manager.save(step, placed)
             if eval_step and eval_data is not None and eval_every and \
                     steps_run % eval_every == 0:
+                flush_pending()  # keep history/sinks step-ordered
                 ev = _run_eval(eval_step, placed.params, eval_data)
                 if ev:
                     emit(step, ev)
     finally:
+        # emit the deferred boundary even when the loop dies mid-window —
+        # the last logged metrics are exactly what a crash post-mortem
+        # needs. A flush failure must not mask the original exception.
+        try:
+            flush_pending()
+        except Exception:
+            log.exception("fit: failed to flush pending metrics")
         # release the loader's prefetch thread + staged device batches
         if data_iter is not None and hasattr(data_iter, "close"):
             data_iter.close()

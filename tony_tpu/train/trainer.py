@@ -73,6 +73,11 @@ class Trainer:
     # averaged, ONE optimizer update) — the way to train at a global batch
     # whose activations don't fit HBM without changing the data pipeline
     accum_steps: int = 1
+    # opt-in telemetry: global_norm re-reads every grad leaf (an extra
+    # full-params HBM pass per step), so the DEFAULT step computes exactly
+    # the math the model requires and nothing else — the framework step
+    # must cost what a hand-written step costs (BASELINE north star)
+    log_grad_norm: bool = False
 
     def init_state(self, params) -> TrainState:
         return TrainState(
@@ -161,13 +166,16 @@ class Trainer:
             updates, opt_state = self.optimizer.update(
                 grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
-            gnorm = optax.global_norm(grads)
+            metrics = {"loss": loss}
+            if self.log_grad_norm:
+                metrics["grad_norm"] = optax.global_norm(grads)
             new_state = TrainState(step=state.step + 1, params=params,
                                    opt_state=opt_state)
-            return new_state, {"loss": loss, "grad_norm": gnorm}
+            return new_state, metrics
 
-        metric_sh = {"loss": NamedSharding(self.mesh, P()),
-                     "grad_norm": NamedSharding(self.mesh, P())}
+        metric_sh = {"loss": NamedSharding(self.mesh, P())}
+        if self.log_grad_norm:
+            metric_sh["grad_norm"] = NamedSharding(self.mesh, P())
         # b_sh is a pytree prefix: one sharding broadcast over the batch tree
         return jax.jit(
             step_fn,
