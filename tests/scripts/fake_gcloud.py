@@ -19,7 +19,7 @@ import sys
 
 VALUE_FLAGS = {"--zone", "--project", "--format", "--accelerator-type",
                "--version", "--runtime-version", "--node-id", "--network",
-               "--labels"}
+               "--labels", "--node-count", "--node-prefix"}
 
 
 def state_path(key):
@@ -68,12 +68,26 @@ def main():
         if os.environ.get("FAKE_GCLOUD_FAIL_CREATE"):
             print("ERROR: quota exceeded for TPU cores", file=sys.stderr)
             return 1
+        n_nodes = int(flags.get("--node-count", "0") or 0)
+        if kind == "queued-resources" and n_nodes > 1:
+            # multislice shape: one queued resource, N nodes <prefix>-i;
+            # each node gets its own 10.0.<i>.x endpoints when READY
+            prefix = flags.get("--node-prefix", name)
+            names = [f"{prefix}-{i}" for i in range(n_nodes)]
+            save(key, {"name": name, "kind": "qr", "describes": 0,
+                       "deleted": False, "nodes": names})
+            for i, node_name in enumerate(names):
+                save(f"{node_name}.node",
+                     {"name": node_name, "state": "CREATING", "describes": 0,
+                      "accel": flags.get("--accelerator-type", ""),
+                      "deleted": False, "node_index": i})
+            return 0
         node = {"name": name, "state": "CREATING", "describes": 0,
                 "accel": flags.get("--accelerator-type", ""),
                 "deleted": False}
         if kind == "queued-resources":
             save(key, {"name": name, "kind": "qr", "describes": 0,
-                       "deleted": False})
+                       "deleted": False, "nodes": [name]})
         save(f"{name}.node", node)
         return 0
 
@@ -95,8 +109,12 @@ def main():
             save(key, st)
         out = {"name": name, "state": st["state"]}
         if st["state"] == "READY":
-            hosts = os.environ.get("FAKE_GCLOUD_HOSTS",
-                                   "10.0.0.1,10.0.0.2").split(",")
+            if "node_index" in st:  # one node of a multi-node resource
+                idx = st["node_index"]
+                hosts = [f"10.0.{idx}.1", f"10.0.{idx}.2"]
+            else:
+                hosts = os.environ.get("FAKE_GCLOUD_HOSTS",
+                                       "10.0.0.1,10.0.0.2").split(",")
             out["networkEndpoints"] = [{"ipAddress": h} for h in hosts
                                        if h.strip()]
         print(json.dumps(out))
@@ -108,10 +126,11 @@ def main():
         st["deleted"] = True
         save(key, st)
         if kind == "queued-resources":
-            node = load(f"{name}.node")
-            if node is not None:
-                node["deleted"] = True
-                save(f"{name}.node", node)
+            for node_name in st.get("nodes", [name]):
+                node = load(f"{node_name}.node")
+                if node is not None:
+                    node["deleted"] = True
+                    save(f"{node_name}.node", node)
         return 0
     print(f"fake gcloud: unknown verb {verb}", file=sys.stderr)
     return 64

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Fake gsutil for remotefs tests: serves gs://<bucket>/<path> from the
+# local directory $FAKE_GCS_ROOT/<bucket>/<path>. Supports `cp [-r]`,
+# including a trailing /* source glob (the src-dir fetch shape).
+[ "$1" = cp ] || exit 64
+shift
+rec=""
+if [ "$1" = -r ]; then rec="-r"; shift; fi
+src="$1"; dest="$2"
+local="$FAKE_GCS_ROOT/${src#gs://}"
+case "$local" in
+  */\*) exec cp $rec "${local%/\*}"/* "$dest";;
+  *) exec cp $rec "$local" "$dest";;
+esac

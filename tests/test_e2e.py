@@ -457,3 +457,38 @@ def test_fcfs_mode_e2e(cluster):
     conf.set("tony.application.distributed-mode", "FCFS")
     ok, client = run_job(cluster, conf)
     assert ok, client.final_status
+
+
+def test_preprocess_stdout_feeds_training_env(cluster, tmp_path):
+    """VERDICT r2 #7: preprocess-then-train — the coordinator runs the
+    preprocess command first and its scraped 'Model parameters: ' stdout
+    changes worker behavior via the MODEL_PARAMS env (ref:
+    doPreprocessingJob, ApplicationMaster.java:780-832)."""
+    prep = tmp_path / "prep.py"
+    prep.write_text("print('preprocess warming up')\n"
+                    "print('Model parameters: ' + str(6 * 7))\n")
+    worker = tmp_path / "worker.py"
+    worker.write_text("import os, sys\n"
+                      "sys.exit(0 if os.environ.get('MODEL_PARAMS') == '42' "
+                      "else 9)\n")
+    conf = script_conf(cluster, str(worker), {"worker": 2})
+    conf.set("tony.application.enable-preprocess", True)
+    conf.set("tony.coordinator.command", f"python3 {prep}")
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+    assert client.final_status["status"] == "SUCCEEDED"
+
+
+def test_preprocess_failure_skips_training(cluster, tmp_path):
+    """A failed preprocess short-circuits: no training task ever launches
+    (ref: 'Short circuit if preprocessing job fails', :813-817)."""
+    marker = tmp_path / "worker_ran"
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"open({str(marker)!r}, 'w').write('x')\n")
+    conf = script_conf(cluster, str(worker), {"worker": 1})
+    conf.set("tony.application.enable-preprocess", True)
+    conf.set("tony.coordinator.command", "exit 3")
+    ok, client = run_job(cluster, conf)
+    assert not ok
+    assert client.final_status["status"] == "FAILED"
+    assert not marker.exists(), "worker launched despite preprocess failure"

@@ -225,3 +225,56 @@ def test_portal_token_auth_and_pagination(tmp_path):
         assert "/config?token=s3cret" in body
     finally:
         portal.stop()
+
+
+def test_portal_tls_with_pinned_fingerprint(tmp_path):
+    """VERDICT r2 #9: the portal serves HTTPS with the per-job cert
+    machinery from rpc/tls.py; a client pinning the SHA-256 fingerprint
+    gets the jobs API, and a tampered pin is rejected (the HTTPS+keystore
+    slot of tony-portal's app/hadoop config)."""
+    import json
+    import socket
+
+    import pytest
+
+    from tony_tpu.portal.app import Portal
+    from tony_tpu.rpc.tls import cert_fingerprint, client_wrap, \
+        mint_self_signed
+
+    root = str(tmp_path)
+    h = EventHandler(root, "application_tls1")
+    h.start()
+    h.emit(task_started("worker", 0, "host1"))
+    h.stop("SUCCEEDED")
+
+    cert, key = mint_self_signed(str(tmp_path / "tls"), "tony-portal-test")
+    fp = cert_fingerprint(cert)
+    portal = Portal(root, port=0, tls_cert=cert, tls_key=key).start()
+    try:
+        def https_get(path, pin):
+            raw = socket.create_connection(("127.0.0.1", portal.port),
+                                           timeout=10)
+            try:
+                tls_sock = client_wrap(raw, pin)
+            except BaseException:
+                raw.close()
+                raise
+            with tls_sock:
+                tls_sock.sendall(f"GET {path} HTTP/1.1\r\n"
+                                 f"Host: 127.0.0.1\r\n"
+                                 f"Connection: close\r\n\r\n".encode())
+                buf = b""
+                while chunk := tls_sock.recv(65536):
+                    buf += chunk
+            head, _, body = buf.partition(b"\r\n\r\n")
+            return int(head.split()[1]), body
+
+        status, body = https_get("/api", fp)
+        assert status == 200
+        jobs = json.loads(body[body.index(b"["):].decode())
+        assert jobs and jobs[0]["app_id"] == "application_tls1"
+
+        with pytest.raises(ConnectionError, match="fingerprint mismatch"):
+            https_get("/api", "0" * 64)
+    finally:
+        portal.stop()

@@ -263,3 +263,38 @@ def test_provision_failure_fails_job_fast(gdir, monkeypatch):
         assert not ok
         assert "provisioning failed" in str(
             client.final_status.get("reason", ""))
+
+
+# -- multislice (multi-node queued resources) ---------------------------
+
+
+def test_queued_multi_node_multislice(gdir):
+    """VERDICT r2 #4: tony.tpu.num-slices>1 provisions ONE queued resource
+    with N nodes (--node-count/--node-prefix); hosts concatenate in node
+    order so contiguous flat-index ranges map onto one slice."""
+    prov = make_prov(gdir, queued=True, node_count=2)
+    assert prov.node_names() == ["t1-0", "t1-1"]
+    hosts = prov.provision()
+    assert hosts == ["10.0.0.1", "10.0.0.2", "10.0.1.1", "10.0.1.2"]
+    log = calls(gdir)
+    assert "queued-resources create t1 --node-count 2 --node-prefix t1" \
+        in log
+    assert "--node-id" not in log
+    prov.deprovision()
+    assert node_state(gdir, "t1-0")["deleted"] is True
+    assert node_state(gdir, "t1-1")["deleted"] is True
+
+
+def test_multi_node_requires_queued_mode(gdir):
+    with pytest.raises(ConfError, match="requires"):
+        make_prov(gdir, node_count=2)
+
+
+def test_provisioner_from_conf_multislice():
+    conf = TonyConf()
+    conf.set("tony.provisioner.mode", "queued")
+    conf.set("tony.provisioner.accelerator-type", "v5p-8")
+    conf.set("tony.tpu.num-slices", 3)
+    prov = provisioner_from_conf(conf, "app_x")
+    assert isinstance(prov, TpuVmProvisioner)
+    assert prov.node_count == 3 and prov.queued

@@ -1,0 +1,139 @@
+"""gs:// remote-scheme inputs (VERDICT r2 #8).
+
+Reference parity: remote-scheme --conf_file and resource paths
+(TonyClient.java:657-691; LocalizableResource.java:30-114 remote branch).
+The copier is mocked with tests/scripts/fake_gsutil.sh serving a local
+"bucket" directory via $FAKE_GCS_ROOT — no network anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zipfile
+
+import pytest
+
+from tony_tpu.utils import remotefs
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "scripts")
+FAKE_GSUTIL = os.path.join(SCRIPTS, "fake_gsutil.sh")
+
+
+@pytest.fixture
+def bucket(tmp_path, monkeypatch):
+    """A local 'GCS bucket': gs://testbkt/... resolves under it."""
+    root = tmp_path / "gcs"
+    (root / "testbkt").mkdir(parents=True)
+    monkeypatch.setenv("TONY_GSUTIL", FAKE_GSUTIL)
+    monkeypatch.setenv("FAKE_GCS_ROOT", str(root))
+    return root / "testbkt"
+
+
+def test_is_remote():
+    assert remotefs.is_remote("gs://b/k")
+    assert not remotefs.is_remote("/local/path")
+    assert not remotefs.is_remote("relative/path")
+
+
+def test_fetch_file(bucket, tmp_path):
+    (bucket / "data.txt").write_text("payload")
+    dest = remotefs.fetch_to_dir("gs://testbkt/data.txt", str(tmp_path / "d"))
+    assert open(dest).read() == "payload"
+    assert os.path.basename(dest) == "data.txt"
+
+
+def test_fetch_failure_raises(bucket, tmp_path):
+    with pytest.raises(RuntimeError, match="fetch gs://testbkt/missing"):
+        remotefs.fetch("gs://testbkt/missing", str(tmp_path / "x"))
+
+
+def test_copier_requires_tool(monkeypatch):
+    monkeypatch.delenv("TONY_GSUTIL", raising=False)
+    monkeypatch.setenv("PATH", "/nonexistent")
+    with pytest.raises(RuntimeError, match="TONY_GSUTIL"):
+        remotefs.fetch("gs://b/k", "/tmp/never")
+
+
+def test_conf_file_from_gcs(bucket):
+    """build_conf accepts a gs:// --conf_file."""
+    from tony_tpu.config import build_conf
+
+    (bucket / "job.json").write_text(json.dumps({
+        "tony": {"worker": {"instances": 3},
+                 "application": {"name": "gcs-job"}}}))
+    conf = build_conf(conf_file="gs://testbkt/job.json")
+    assert conf.get_int("tony.worker.instances", 0) == 3
+    assert str(conf.get("tony.application.name")) == "gcs-job"
+
+
+def test_resource_localization_from_gcs(bucket, tmp_path):
+    """tony.<role>.resources accepts gs:// paths, plain and #archive."""
+    from tony_tpu.utils.fs import parse_resources
+
+    (bucket / "vocab.txt").write_text("a b c")
+    with zipfile.ZipFile(bucket / "assets.zip", "w") as zf:
+        zf.writestr("inner/weights.bin", "W")
+
+    dest = tmp_path / "job"
+    specs = parse_resources(
+        "gs://testbkt/vocab.txt::v.txt,gs://testbkt/assets.zip#archive")
+    out = [r.localize(str(dest)) for r in specs]
+    assert open(out[0]).read() == "a b c"
+    assert os.path.basename(out[0]) == "v.txt"
+    assert open(os.path.join(out[1], "inner", "weights.bin")).read() == "W"
+    # the fetched archive itself is not left behind in the job dir
+    assert not [f for f in os.listdir(dest) if f.endswith(".fetch.zip")]
+
+
+def test_client_stage_with_gcs_srcdir_and_venv(bucket, tmp_path):
+    """TonyClient.stage pulls a gs:// src tree and venv zip into the job
+    dir (ref: processTonyConfResources HDFS download, :701-780)."""
+    from tony_tpu.client import TonyClient
+    from tony_tpu.config import build_conf
+
+    (bucket / "src").mkdir()
+    (bucket / "src" / "train.py").write_text("print('hi')")
+    with zipfile.ZipFile(bucket / "venv.zip", "w") as zf:
+        zf.writestr("bin/activate", "# venv")
+
+    conf = build_conf(overrides=[
+        "tony.application.src-dir=gs://testbkt/src",
+        "tony.application.python-venv=gs://testbkt/venv.zip",
+        f"tony.staging-dir={tmp_path / 'staging'}",
+        "tony.worker.instances=1",
+        "tony.application.executes=train.py",
+    ])
+    client = TonyClient(conf)
+    job_dir = client.stage()
+    assert open(os.path.join(job_dir, "train.py")).read() == "print('hi')"
+    assert os.path.exists(os.path.join(job_dir, "venv", "bin", "activate"))
+
+
+def test_checkpoint_manager_passes_gs_path_through(monkeypatch):
+    """A gs:// checkpoint root must reach orbax verbatim — no local
+    makedirs/abspath mangling. Orbax itself is stubbed: the assertion is
+    about the path contract, not GCS IO."""
+    import sys
+    import types
+
+    from tony_tpu.train.checkpoint import CheckpointManager
+
+    seen = {}
+
+    fake = types.ModuleType("orbax.checkpoint")
+
+    class FakeManager:
+        def __init__(self, directory, options=None):
+            seen["dir"] = directory
+
+    fake.CheckpointManager = FakeManager
+    fake.CheckpointManagerOptions = lambda **kw: None
+    fake.args = types.SimpleNamespace()
+    orbax_pkg = types.ModuleType("orbax")
+    orbax_pkg.checkpoint = fake
+    monkeypatch.setitem(sys.modules, "orbax", orbax_pkg)
+    monkeypatch.setitem(sys.modules, "orbax.checkpoint", fake)
+
+    CheckpointManager("gs://bkt/ckpts")
+    assert seen["dir"] == "gs://bkt/ckpts"

@@ -196,3 +196,54 @@ def test_tb_port_policy():
 def test_unknown_framework():
     with pytest.raises(ValueError, match="unknown framework"):
         get_runtime("caffe")
+
+
+def test_jax_multislice_env_contract():
+    """VERDICT r2 #4: with tony.tpu.num-slices>1 the jax runtime injects
+    the real multi-slice Cloud TPU env — MEGASCALE_* (DCN rendezvous) and
+    per-slice TPU_WORKER_HOSTNAMES/TPU_WORKER_ID (libtpu ICI bring-up)."""
+    from tony_tpu.runtime.jax_runtime import JaxTaskAdapter
+
+    conf = TonyConf()
+    conf.set("tony.tpu.num-slices", 2)
+    spec = {"worker": ["h0:1111", "h1:1111", "h2:1111", "h3:1111"]}
+
+    def env_for(idx):
+        return JaxTaskAdapter().build_task_env(
+            ctx_for(role="worker", index=idx, spec=spec, conf=conf))
+
+    e0, e2, e3 = env_for(0), env_for(2), env_for(3)
+    for e in (e0, e2, e3):
+        assert e["MEGASCALE_NUM_SLICES"] == "2"
+        assert e["MEGASCALE_COORDINATOR_ADDRESS"] == "h0:8080"
+    assert e0["MEGASCALE_SLICE_ID"] == "0"
+    assert e0["TPU_WORKER_HOSTNAMES"] == "h0,h1"
+    assert e0["TPU_WORKER_ID"] == "0"
+    assert e2["MEGASCALE_SLICE_ID"] == "1"
+    assert e2["TPU_WORKER_HOSTNAMES"] == "h2,h3"
+    assert e2["TPU_WORKER_ID"] == "0"
+    assert e3["TPU_WORKER_ID"] == "1"
+    # jax.distributed coordination stays GLOBAL (all 4 processes)
+    assert e2["TONY_NUM_PROCESSES"] == "4"
+
+
+def test_jax_multislice_env_single_slice_is_clean():
+    from tony_tpu.runtime.jax_runtime import JaxTaskAdapter
+
+    conf = TonyConf()
+    env = JaxTaskAdapter().build_task_env(
+        ctx_for(role="worker", index=0, spec={"worker": ["h0:1", "h1:1"]}, conf=conf))
+    assert not any(k.startswith("MEGASCALE") for k in env)
+    assert "TPU_WORKER_HOSTNAMES" not in env
+
+
+def test_jax_multislice_env_rejects_indivisible_gang():
+    from tony_tpu.config import ConfError
+    from tony_tpu.runtime.jax_runtime import JaxTaskAdapter
+
+    conf = TonyConf()
+    conf.set("tony.tpu.num-slices", 2)
+    with pytest.raises(ConfError, match="does not divide"):
+        JaxTaskAdapter().build_task_env(
+            ctx_for(role="worker", index=0,
+                    spec={"worker": ["h0:1", "h1:1", "h2:1"]}, conf=conf))

@@ -120,12 +120,23 @@ class TonyClient:
         self.app_id = new_app_id()
         root = staging_root(str(self.conf.get("tony.staging-dir", "")))
         self.job_dir = app_staging_dir(root, self.app_id)
+        from tony_tpu.utils import remotefs
+
         src_dir = str(self.conf.get("tony.application.src-dir", ""))
-        if src_dir:
+        if src_dir and remotefs.is_remote(src_dir):
+            # gs:// src tree lands directly in the job dir (the local-path
+            # zip/unzip below exists only to filter + flatten a local dir)
+            remotefs.fetch(src_dir.rstrip("/") + "/*", self.job_dir,
+                           recursive=True)
+        elif src_dir:
             z = zip_dir(src_dir, os.path.join(self.job_dir, C.TONY_SRC_ZIP))
             unzip(z, self.job_dir)  # agents exec with cwd=job_dir
         venv = str(self.conf.get("tony.application.python-venv", ""))
-        if venv:
+        if venv and remotefs.is_remote(venv):
+            fetched = remotefs.fetch(
+                venv, os.path.join(self.job_dir, C.TONY_VENV_ZIP))
+            unzip(fetched, os.path.join(self.job_dir, "venv"))
+        elif venv:
             if venv.endswith(".zip"):
                 unzip(venv, os.path.join(self.job_dir, "venv"))
             else:

@@ -140,7 +140,16 @@ class TonyConf:
 
     # -- layering -----------------------------------------------------------
     def load_file(self, path: str) -> None:
-        """Merge a TOML or JSON conf file. Nested tables flatten with dots."""
+        """Merge a TOML or JSON conf file. Nested tables flatten with dots.
+        ``gs://`` paths are fetched to a temp file first (ref: remote-scheme
+        --conf_file, TonyClient.java:657-691)."""
+        from tony_tpu.utils import remotefs
+
+        if remotefs.is_remote(path):
+            import tempfile
+
+            with tempfile.TemporaryDirectory(prefix="tony_conf_") as tmp:
+                return self.load_file(remotefs.fetch_to_dir(path, tmp))
         with open(path, "rb") as f:
             if path.endswith(".json"):
                 data = json.load(f)

@@ -117,8 +117,25 @@ KEYS: dict[str, Key] = {
         "ssh", str, "ssh binary for launch-mode=ssh (tests point this at a "
         "local fake that runs the command in-place)"
     ),
+    "tony.ssh.ship-job-dir": Key(
+        True, bool, "launch-mode=ssh: tar-pipe the staged job dir (src, "
+        "venv, conf, resources) to each host before its first task; hosts "
+        "that already see the dir (shared mount) are probed and skipped "
+        "(ref: HDFS upload + per-container extract, TonyClient.java:229-310)"
+    ),
+    "tony.ssh.remote-job-root": Key(
+        "", str, "launch-mode=ssh: directory on the remote hosts to place "
+        "the shipped job dir under (job-dir paths in the task env are "
+        "rewritten); empty = mirror the coordinator's absolute job-dir path"
+    ),
     # coordinator (reference: tony.am.*)
     "tony.coordinator.memory": Key("2g", str, "Coordinator process memory hint"),
+    "tony.coordinator.command": Key(
+        "", str, "Preprocess command run on the coordinator before training "
+        "roles launch (with tony.application.enable-preprocess); its stdout "
+        "'Model parameters: ...' line is exported to tasks as MODEL_PARAMS "
+        "(ref: tony.am.command + doPreprocessingJob stdout scrape)"
+    ),
     "tony.coordinator.retry-count": Key(
         0, int, "Times the coordinator rebuilds the session after failure (ref: tony.am.retry-count)"
     ),
@@ -269,6 +286,16 @@ KEYS: dict[str, Key] = {
     "tony.tpu.info-exec-path": Key(
         "", str, "Path to a tpu-info-style command emitting chip metrics JSON "
         "(ref: tony.gpu-exec-path for nvidia-smi)"
+    ),
+    "tony.tpu.num-slices": Key(
+        1, int, "Multislice job shape: >1 groups the gang into N equal "
+        "DCN-connected slices — the jax runtime injects MEGASCALE_* + "
+        "per-slice TPU_WORKER_HOSTNAMES env, and the queued provisioner "
+        "creates an N-node queued resource (--node-count)"
+    ),
+    "tony.tpu.megascale-port": Key(
+        8080, int, "Port of the megascale DCN coordinator (slice 0, host 0) "
+        "baked into MEGASCALE_COORDINATOR_ADDRESS"
     ),
     # test fault injection via conf (reference: tony.horovod.mode.test etc.)
     "tony.test.crash-coordinator": Key(

@@ -26,6 +26,10 @@ JOB_DIR = "TONY_JOB_DIR"  # per-job working dir (staging, logs, events)
 COMPILE_CACHE_DIR = "TONY_COMPILE_CACHE_DIR"  # persistent XLA compile cache
 # (job-dir scoped: retry attempts reuse each other's compiles)
 AGENT_PID = "TONY_AGENT_PID"  # pid of the task agent (preemption-notice target)
+PREPROCESSING_JOB = "PREPROCESSING_JOB"  # "true" inside the preprocess task
+# (ref: Constants.PREPROCESSING_JOB :75)
+MODEL_PARAMS = "MODEL_PARAMS"  # preprocess stdout "Model parameters: ..."
+# remainder, exported to every training task (ref: TASK_PARAM_KEY :90)
 NUM_AM_RETRIES = "TONY_NUM_COORD_RETRIES"  # retries left (ref: NUM_AM_RETRIES)
 TASK_MEMORY = "TONY_TASK_MEMORY"  # role memory (launchers enforce: rlimit/--memory)
 TASK_CHIPS = "TONY_TASK_CHIPS"  # chips requested (ssh launcher packs per host)

@@ -134,6 +134,9 @@ class Coordinator:
         self.secret = os.environ.get(C.JOB_TOKEN) or None
         if not conf.get_bool("tony.application.security.enabled"):
             self.secret = None
+        # preprocess-stage stdout params fed to training containers
+        # (ref: containerEnv[TASK_PARAM_KEY], ApplicationMaster.java:826)
+        self._model_params: str | None = None
         self.framework = str(conf.get("tony.application.framework"))
         self.mode = str(conf.get("tony.application.distributed-mode"))
         self.am_adapter = get_am_adapter(self.framework)
@@ -423,7 +426,11 @@ class Coordinator:
                 ssh_bin=str(self.conf.get("tony.application.ssh-bin", "ssh")),
                 app_id=self.app_id,
                 chips_per_host=self.conf.get_int("tony.tpu.chips-per-host",
-                                                 0))
+                                                 0),
+                ship_job_dir=self.job_dir
+                if self.conf.get_bool("tony.ssh.ship-job-dir") else "",
+                remote_job_root=str(
+                    self.conf.get("tony.ssh.remote-job-root", "")))
         if mode != "local":
             raise ValueError(f"unknown tony.application.launch-mode: {mode}")
         return LocalProcessLauncher(self._on_task_process_exit,
@@ -465,13 +472,19 @@ class Coordinator:
         os.replace(path + ".tmp", path)
 
     def _start_attempt(self) -> None:
-        """Ref: start() :578-609 — build session, schedule the gang."""
+        """Ref: start() :578-609 — build session, schedule the gang.
+        With enable-preprocess AND training roles, the preprocess command
+        runs first on the coordinator and its scraped stdout params feed
+        the training containers (ref: run() :578-609 calls
+        doPreprocessingJob then falls through to buildTonySession)."""
         if os.environ.get(C.TEST_COORD_THROW) and self.attempt == 0:
             raise RuntimeError("injected coordinator exception (TEST_COORD_THROW)")
+        single_node = not self.session.requests
         if self.conf.get_bool("tony.application.enable-preprocess") or \
-                not self.session.requests:
-            self._run_preprocess()
-            return
+                single_node:
+            ok = self._run_preprocess(single_node=single_node)
+            if single_node or not ok:
+                return  # terminal: status set by _run_preprocess
         self.am_adapter.set_session(self.session)
         self.scheduler = TaskScheduler(self.session, self._allocate_role, self.conf)
         self.events.emit(application_inited(
@@ -560,6 +573,8 @@ class Coordinator:
             env[C.JOB_TOKEN] = self.secret
         if self._tls_fp:
             env[C.TLS_FINGERPRINT] = self._tls_fp
+        if self._model_params is not None:
+            env[C.MODEL_PARAMS] = self._model_params
         ckpt = self._checkpoint_dir()
         if ckpt:
             # restart-with-resume (no ref analog — TonY's AM retry restarts
@@ -578,6 +593,13 @@ class Coordinator:
         path = str(self.conf.get("tony.application.checkpoint-dir", ""))
         if not path:
             return None
+        from tony_tpu.utils.remotefs import is_remote
+
+        if is_remote(path):
+            # gs:// checkpoint roots pass through untouched: orbax/
+            # tensorstore write them natively; scan_latest_step simply
+            # reports no local steps (resume still works via orbax)
+            return path
         if not os.path.isabs(path):
             path = os.path.join(self.job_dir, path)
         os.makedirs(path, exist_ok=True)
@@ -598,22 +620,51 @@ class Coordinator:
             return f"{venv} {executes} {params}".strip()
         return f"{executes} {params}".strip()
 
-    def _run_preprocess(self) -> None:
+    def _run_preprocess(self, single_node: bool = True) -> bool:
         """Single-node / preprocess mode: the coordinator hosts the user
-        process itself (ref: doPreprocessingJob :780-832)."""
-        cmd = self._task_command_single()
+        process itself (ref: doPreprocessingJob :780-832). Returns True on
+        success. In preprocess-then-train mode (``single_node=False``) a
+        success is NOT terminal: the task's stdout is scraped for a
+        ``Model parameters: <params>`` line and the remainder is exported
+        to every training container as ``MODEL_PARAMS`` (ref:
+        :819-832 scraping amstdout.log into Constants.TASK_PARAM_KEY)."""
+        cmd = str(self.conf.get("tony.coordinator.command", "")) \
+            if not single_node else ""
+        cmd = cmd or self._task_command_single()
         log.info("running preprocess/single-node command: %s", cmd)
+        task_log = os.path.join(self.job_dir, "logs", "coordinator-task.log")
         code = execute_shell(
             cmd,
             self.conf.get_int("tony.task.executor.execution-timeout-ms", 0),
-            env={C.JOB_ID: self.app_id, C.JOB_NAME: "coordinator"},
-            log_path=os.path.join(self.job_dir, "logs", "coordinator-task.log"),
+            env={C.JOB_ID: self.app_id, C.JOB_NAME: "coordinator",
+                 C.PREPROCESSING_JOB: "true"},
+            log_path=task_log,
         )
         if code != 0:
             self.session.fail(f"preprocess/single-node task exited {code}")
-        else:
+            self._preprocess_ran = True
+            return False
+        if single_node:
             self.session.status = SessionStatus.SUCCEEDED
-        self._preprocess_ran = True
+            self._preprocess_ran = True
+            return True
+        self._model_params = self._scrape_model_params(task_log)
+        return True
+
+    @staticmethod
+    def _scrape_model_params(task_log: str) -> str | None:
+        """First ``Model parameters: `` stdout line's remainder, or None
+        (ref: ApplicationMaster.java:819-832)."""
+        marker = "Model parameters: "
+        try:
+            with open(task_log, errors="replace") as f:
+                for line in f:
+                    if marker in line:
+                        return line.split(marker, 1)[1].rstrip("\n")
+        except OSError:
+            log.warning("preprocess log %s unreadable; no MODEL_PARAMS",
+                        task_log)
+        return None
 
     def _task_command_single(self) -> str:
         executes = str(self.conf.get("tony.application.executes", ""))

@@ -307,8 +307,24 @@ class SshLauncher(Launcher):
     """Place agents on remote hosts over ssh, round-robin per task.
 
     The remote host needs the same repo importable at ``remote_pythonpath``
-    (TPU-VM images share a disk image, the NFS/GCS-fuse staging dir carries
-    the job files). Exit detection rides the local ssh process's exit code.
+    (TPU-VM images share a disk image). Exit detection rides the local ssh
+    process's exit code.
+
+    **Job-file distribution** (``ship_job_dir``): before the first launch
+    on each host, the job dir — the client already staged src, venv,
+    resources, and tony-final.json into it — is tar-piped over ssh to
+    the host. The reference uploads zipped src/venv/confs to HDFS and
+    every container downloads + extracts them (TonyClient.java:229-310,
+    util/Utils.java:750 extractResources); tony-tpu's client stages the
+    EXTRACTED tree, so the per-host analog is one stream of that tree
+    over the same ssh channel the launch uses — no DFS round trip, no
+    per-container unzip, and a host that already sees the job dir (NFS /
+    GCS-fuse shared mount) is detected and skipped. With
+    ``remote_job_root`` set, the tree lands under
+    ``<root>/<basename(job_dir)>`` instead of the identical absolute
+    path, and every job-dir path in the task env (TONY_JOB_DIR, conf
+    path, venv interpreter in the task command, compile cache,
+    checkpoint dir) is rewritten for the remote side.
 
     Kill is REMOTE-first: the agent runs as a ``setsid`` session leader
     whose pgid is written to a per-task file on the remote host, and
@@ -322,7 +338,8 @@ class SshLauncher(Launcher):
     def __init__(self, hosts: list[str], on_exit: OnExit,
                  remote_pythonpath: str = "",
                  ssh_opts: list[str] | None = None, ssh_bin: str = "ssh",
-                 app_id: str = "", chips_per_host: int = 0):
+                 app_id: str = "", chips_per_host: int = 0,
+                 ship_job_dir: str = "", remote_job_root: str = ""):
         if not hosts:
             raise ValueError("SshLauncher needs at least one host")
         self.hosts = hosts
@@ -332,6 +349,19 @@ class SshLauncher(Launcher):
                                      "-o", "BatchMode=yes"]
         self.ssh_bin = ssh_bin
         self.app_id = app_id
+        self.ship_job_dir = os.path.abspath(ship_job_dir) if ship_job_dir \
+            else ""
+        self.remote_job_dir = ""
+        if self.ship_job_dir:
+            self.remote_job_dir = os.path.join(
+                remote_job_root, os.path.basename(self.ship_job_dir)) \
+                if remote_job_root else self.ship_job_dir
+        self._shipped: set[str] = set()
+        # one lock per host: ships to different hosts run concurrently,
+        # and a launch headed to an already-shipped host never waits on
+        # an in-flight multi-GB stream to another host
+        self._ship_locks = {h: threading.Lock() for h in hosts}
+        self._shipped_lock = threading.Lock()
         self._next = 0
         self._local = LocalProcessLauncher(self._on_local_exit)
         self._remote: dict[str, tuple[str, str]] = {}  # task -> (host, pgid file)
@@ -400,8 +430,82 @@ class SshLauncher(Launcher):
                 self._pools[host].release(task.id)
             raise
 
+    def _ensure_shipped(self, host: str) -> None:
+        """Ship the job dir to ``host`` exactly once per launcher (probe
+        first: a shared mount already carrying the files is skipped). A
+        failed ship raises, failing the task launch — the same contract
+        as the reference's failed resource localization, which fails the
+        container (ApplicationMaster onStartContainerError)."""
+        if not self.ship_job_dir:
+            return
+        with self._shipped_lock:
+            if host in self._shipped:
+                return
+            lock = self._ship_locks.setdefault(host, threading.Lock())
+        with lock:
+            with self._shipped_lock:
+                if host in self._shipped:
+                    return
+            marker = os.path.join(self.remote_job_dir, C.TONY_FINAL_CONF)
+            try:
+                probe = subprocess.run(
+                    [self.ssh_bin, *self.ssh_opts, host,
+                     f"test -e {shlex.quote(marker)}"],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                    timeout=30, check=False)
+            except subprocess.SubprocessError as e:
+                # an unreachable probe must FAIL the launch, not default
+                # to shipping: on a shared mount (the case the probe
+                # detects) a blind tar would overwrite the live job dir
+                # this coordinator is reading
+                raise RuntimeError(
+                    f"job-dir probe on {host} failed; refusing to ship "
+                    f"blindly over a possibly-shared mount: {e}") from e
+            if probe.returncode != 0:
+                self._ship(host)
+            with self._shipped_lock:
+                self._shipped.add(host)
+
+    def _ship(self, host: str) -> None:
+        qd = shlex.quote(self.remote_job_dir)
+        tar = subprocess.Popen(
+            ["tar", "-C", self.ship_job_dir, "-czf", "-", "."],
+            stdout=subprocess.PIPE)
+        try:
+            recv = subprocess.run(
+                [self.ssh_bin, *self.ssh_opts, host,
+                 f"mkdir -p {qd} && tar -C {qd} -xzf -"],
+                stdin=tar.stdout, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE,
+                timeout=float(os.environ.get("TONY_SHIP_TIMEOUT_S", "600")),
+                check=False)
+        finally:
+            if tar.stdout:
+                tar.stdout.close()
+            tar_rc = tar.wait()
+        if recv.returncode or tar_rc:
+            raise RuntimeError(
+                f"shipping job dir to {host}:{self.remote_job_dir} failed "
+                f"(tar rc {tar_rc}, ssh rc {recv.returncode}): "
+                f"{recv.stderr.decode(errors='replace')[-500:]}")
+        log.info("shipped job dir %s -> %s:%s", self.ship_job_dir, host,
+                 self.remote_job_dir)
+
+    def _remote_env(self, env: dict[str, str]) -> dict[str, str]:
+        """Rewrite job-dir paths in env values for a remote placement that
+        does NOT mirror the local absolute path (remote_job_root mode).
+        Covers TONY_JOB_DIR, the conf path, the venv interpreter inside
+        TONY_TASK_COMMAND, compile-cache and checkpoint dirs — every
+        value the coordinator derived from its own job dir."""
+        if not self.remote_job_dir or self.remote_job_dir == self.ship_job_dir:
+            return env
+        return {k: str(v).replace(self.ship_job_dir, self.remote_job_dir)
+                for k, v in env.items()}
+
     def _launch_on(self, host: str, task: Task, env: dict[str, str],
                    log_path: str) -> None:
+        self._ensure_shipped(host)
+        env = self._remote_env(env)
         exports = " ".join(
             f"export {k}={shlex.quote(str(v))};" for k, v in env.items()
         )

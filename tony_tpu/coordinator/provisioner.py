@@ -165,13 +165,19 @@ class TpuVmProvisioner(Provisioner):
                  queued: bool = False, spot: bool = False,
                  reuse: bool = True, keep: bool = False,
                  timeout_s: float = 900.0, poll_interval_s: float = 10.0,
-                 network: str = "", labels: str = ""):
+                 network: str = "", labels: str = "", node_count: int = 1):
         if not name:
             raise ConfError("provisioner needs tony.provisioner.name")
         if not accelerator_type:
             raise ConfError(
                 "tony.provisioner.accelerator-type (or tony.tpu.topology) "
                 "is required for provisioner mode tpu-vm/queued")
+        if node_count > 1 and not queued:
+            # only the queued-resources API creates multiple nodes under
+            # one resource (the multislice shape, VERDICT r2 #4)
+            raise ConfError(
+                f"tony.tpu.num-slices={node_count} requires "
+                "tony.provisioner.mode=queued (multi-node queued-resources)")
         self.name = name
         self.accelerator_type = accelerator_type
         self.runtime_version = runtime_version
@@ -184,14 +190,22 @@ class TpuVmProvisioner(Provisioner):
         self.poll_interval_s = poll_interval_s
         self.network = network
         self.labels = labels
+        self.node_count = max(1, node_count)
         self.state = STATE_NONE
         self._created = False  # only delete what we created (unless adopt)
 
+    def node_names(self) -> list[str]:
+        """Single-node: the resource name itself. Multi-node queued
+        resources: gcloud derives ``<prefix>-0..N-1`` from --node-prefix."""
+        if self.node_count <= 1:
+            return [self.name]
+        return [f"{self.name}-{i}" for i in range(self.node_count)]
+
     # ------------------------------------------------------------- describe
-    def _describe_node(self) -> dict | None:
+    def _describe_node(self, node_name: str | None = None) -> dict | None:
         try:
             return self.runner.run("compute", "tpus", "tpu-vm", "describe",
-                                   self.name, parse_json=True)
+                                   node_name or self.name, parse_json=True)
         except ProvisioningError:
             return None
 
@@ -223,7 +237,13 @@ class TpuVmProvisioner(Provisioner):
             args += ["--network", self.network]
         if self.labels:
             args += ["--labels", self.labels]
-        if self.queued:
+        if self.queued and self.node_count > 1:
+            # one queued resource, N nodes = N slices (DCN-connected);
+            # gcloud names them <prefix>-0..N-1
+            self.runner.run("compute", "tpus", "queued-resources", "create",
+                            self.name, "--node-count", str(self.node_count),
+                            "--node-prefix", self.name, *args)
+        elif self.queued:
             self.runner.run("compute", "tpus", "queued-resources", "create",
                             self.name, "--node-id", self.name, *args)
         else:
@@ -234,7 +254,7 @@ class TpuVmProvisioner(Provisioner):
         self._created = True
 
     def provision(self) -> list[str]:
-        existing = self._describe_node()
+        existing = self._describe_node(self.node_names()[0])
         if existing is not None:
             state = str(existing.get("state", ""))
             if not self.reuse:
@@ -270,18 +290,32 @@ class TpuVmProvisioner(Provisioner):
                         raise ProvisioningError(
                             f"queued resource {self.name} is {qstate}: "
                             f"{json.dumps(qr.get('state', {}))[:300]}")
-            node = self._describe_node()
-            if node is not None:
+            # every node (1 for single-slice, N for multislice) must be
+            # READY with endpoints; hosts concatenate in node order so
+            # contiguous flat-index ranges land on one slice — the same
+            # grouping multislice_env assumes
+            all_hosts: list[str] = []
+            ready = 0
+            for node_name in self.node_names():
+                node = self._describe_node(node_name)
+                if node is None:
+                    last = f"node {node_name} not yet describable"
+                    break
                 nstate = str(node.get("state", ""))
-                last = f"node {nstate}"
+                last = f"node {node_name} {nstate}"
                 if nstate in _DOOMED_NODE_STATES:
                     raise ProvisioningError(
-                        f"TPU {self.name} entered {nstate} while waiting")
-                if nstate in _READY_NODE_STATES:
-                    hosts = self.hosts_from_node(node)
-                    if hosts:
-                        return hosts
-                    last = "node READY but no networkEndpoints yet"
+                        f"TPU {node_name} entered {nstate} while waiting")
+                if nstate not in _READY_NODE_STATES:
+                    break
+                hosts = self.hosts_from_node(node)
+                if not hosts:
+                    last = f"node {node_name} READY but no networkEndpoints"
+                    break
+                ready += 1
+                all_hosts.extend(hosts)
+            if ready == len(self.node_names()):
+                return all_hosts
             time.sleep(self.poll_interval_s)
         raise ProvisioningError(
             f"TPU {self.name} not READY within {self.timeout_s:.0f}s "
@@ -323,11 +357,13 @@ def provisioner_from_conf(conf: TonyConf, app_id: str) -> Provisioner:
     accel = str(conf.get("tony.provisioner.accelerator-type", "")) or \
         str(conf.get("tony.tpu.topology", ""))
     need = required_chips(conf)
-    have = chips_in_accelerator_type(accel)
+    n_nodes = max(1, conf.get_int("tony.tpu.num-slices", 1))
+    have = chips_in_accelerator_type(accel) * n_nodes
     if need > 0 and have > 0 and have < need:
         raise ConfError(
-            f"accelerator type {accel} has {have} chips but roles request "
-            f"{need} (sum of instances x tony.<role>.chips)")
+            f"accelerator type {accel} x {n_nodes} node(s) has {have} chips "
+            f"but roles request {need} (sum of instances x "
+            f"tony.<role>.chips)")
     runner = GcloudRunner(
         str(conf.get("tony.provisioner.gcloud-bin", "gcloud")),
         str(conf.get("tony.provisioner.project", "")),
@@ -347,7 +383,8 @@ def provisioner_from_conf(conf: TonyConf, app_id: str) -> Provisioner:
         poll_interval_s=conf.get_int(
             "tony.provisioner.poll-interval-ms", 10_000) / 1000,
         network=str(conf.get("tony.provisioner.network", "")),
-        labels=str(conf.get("tony.provisioner.labels", "")))
+        labels=str(conf.get("tony.provisioner.labels", "")),
+        node_count=conf.get_int("tony.tpu.num-slices", 1))
 
 
 def preflight_chips(conf: TonyConf) -> str | None:

@@ -301,11 +301,27 @@ def _ts(ms: int) -> str:
 class Portal:
     def __init__(self, history_root: str, port: int = 0, host: str = "127.0.0.1",
                  mover_interval_ms: int = 300_000, retention_sec: int = 2_592_000,
-                 token: str = "", max_jobs: int = 2000):
+                 token: str = "", max_jobs: int = 2000,
+                 tls_cert: str = "", tls_key: str = ""):
         self.state = PortalState(history_root, max_jobs=max_jobs)
         handler = type("BoundHandler", (PortalHandler,),
                        {"state": self.state, "token": token})
         self.server = ThreadingHTTPServer((host, port), handler)
+        self.tls = bool(tls_cert and tls_key)
+        if self.tls:
+            # same transport story as the control plane (rpc/tls.py): a
+            # self-signed per-deployment cert, clients pin its SHA-256
+            # fingerprint (the HTTPS+keystore slot of tony-portal,
+            # app/hadoop/Requirements.java / portal keystore conf)
+            from tony_tpu.rpc.tls import server_context
+
+            # handshake DEFERRED to the per-request thread: with the
+            # default handshake-on-accept, one client that connects and
+            # stalls (plain-http probe, TCP health check) would park the
+            # single accept loop and freeze the whole portal
+            self.server.socket = server_context(tls_cert, tls_key) \
+                .wrap_socket(self.server.socket, server_side=True,
+                             do_handshake_on_connect=False)
         self.host, self.port = self.server.server_address[:2]
         self.mover_interval_s = mover_interval_ms / 1000
         self.retention_sec = retention_sec
@@ -320,7 +336,8 @@ class Portal:
                              daemon=True)
         m.start()
         self._threads = [t, m]
-        log.info("portal at http://%s:%d", self.host, self.port)
+        log.info("portal at %s://%s:%d",
+                 "https" if self.tls else "http", self.host, self.port)
         return self
 
     def _housekeeping(self) -> None:
@@ -351,11 +368,27 @@ def main(argv: list[str] | None = None) -> int:
              "request; defaults to $TONY_PORTAL_TOKEN")
     parser.add_argument("--max-jobs", type=int, default=2000,
                         help="cap on history entries held in memory")
+    parser.add_argument("--tls-cert", default="",
+                        help="serve HTTPS with this certificate (pair with "
+                             "--tls-key)")
+    parser.add_argument("--tls-key", default="")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    tls_cert, tls_key = args.tls_cert, args.tls_key
+    if args.host not in ("127.0.0.1", "localhost", "::1") and not tls_cert:
+        # non-loopback without a cert: mint one rather than serving the
+        # history in cleartext off-host; clients pin the printed digest
+        from tony_tpu.rpc.tls import cert_fingerprint, mint_self_signed
+
+        tls_cert, tls_key = mint_self_signed(
+            os.path.join(args.history, ".portal-tls"), "tony-portal")
+        print(f"minted portal TLS cert; pin fingerprint "
+              f"{cert_fingerprint(tls_cert)}")
     portal = Portal(args.history, port=args.port, host=args.host,
-                    token=args.token, max_jobs=args.max_jobs).start()
-    print(f"tony-tpu portal at http://{portal.host}:{portal.port}")
+                    token=args.token, max_jobs=args.max_jobs,
+                    tls_cert=tls_cert, tls_key=tls_key).start()
+    scheme = "https" if portal.tls else "http"
+    print(f"tony-tpu portal at {scheme}://{portal.host}:{portal.port}")
     try:
         while True:
             time.sleep(3600)

@@ -41,6 +41,55 @@ class JaxAMAdapter(AMAdapter):
             raise ConfError("jax runtime requires GANG distributed mode")
 
 
+def flat_slots(cluster_spec: dict[str, list[str]]) -> list[str]:
+    """All host:port slots in flat-index order (the same role-order walk
+    as TaskContext.flat_index — the two MUST agree for slice grouping)."""
+    out: list[str] = []
+    for slots in cluster_spec.values():
+        out.extend(slots)
+    return out
+
+
+def multislice_env(conf, cluster_spec: dict[str, list[str]], pid: int,
+                   num: int) -> dict[str, str]:
+    """The real multi-slice Cloud TPU env contract (VERDICT r2 #4).
+
+    A >1 ``tony.tpu.num-slices`` job groups its processes into contiguous
+    equal slices: within a slice, collectives ride ICI; across slices,
+    libtpu's megascale transport rides DCN, discovered via the
+    ``MEGASCALE_*`` env (the TPU-native analog of the reference's
+    NCCL/Gloo rendezvous env, SURVEY.md section 2.5):
+
+    - ``MEGASCALE_COORDINATOR_ADDRESS``: slice-0 host 0 at the megascale
+      port — every slice dials it to exchange DCN endpoints;
+    - ``MEGASCALE_NUM_SLICES`` / ``MEGASCALE_SLICE_ID``: the DCN mesh
+      shape, consumed by jax as ``jax.devices()[i].slice_index`` which
+      ``parallel.mesh.multislice_mesh`` lays out over the dcn axis;
+    - ``TPU_WORKER_HOSTNAMES`` / ``TPU_WORKER_ID``: libtpu's WITHIN-slice
+      host list (ICI ring bring-up) — per slice, not global.
+    """
+    n_slices = conf.get_int("tony.tpu.num-slices", 1)
+    if n_slices <= 1:
+        return {}
+    if num % n_slices:
+        raise ConfError(
+            f"tony.tpu.num-slices={n_slices} does not divide the "
+            f"{num}-process gang into equal slices")
+    per = num // n_slices
+    slots = flat_slots(cluster_spec)
+    hosts = [s.rsplit(":", 1)[0] for s in slots]
+    mport = conf.get_int("tony.tpu.megascale-port", 8080)
+    slice_id = pid // per
+    return {
+        "MEGASCALE_COORDINATOR_ADDRESS": f"{hosts[0]}:{mport}",
+        "MEGASCALE_NUM_SLICES": str(n_slices),
+        "MEGASCALE_SLICE_ID": str(slice_id),
+        "TPU_WORKER_HOSTNAMES": ",".join(
+            hosts[slice_id * per:(slice_id + 1) * per]),
+        "TPU_WORKER_ID": str(pid % per),
+    }
+
+
 class JaxTaskAdapter(TaskAdapter):
     def build_task_env(self, ctx: TaskContext) -> dict[str, str]:
         env = super().build_task_env(ctx)
@@ -54,6 +103,7 @@ class JaxTaskAdapter(TaskAdapter):
         topology = str(ctx.conf.get("tony.tpu.topology", ""))
         if topology:
             env["TONY_TPU_TOPOLOGY"] = topology
+        env.update(multislice_env(ctx.conf, ctx.cluster_spec, pid, num))
         return env
 
 

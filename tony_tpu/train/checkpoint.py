@@ -23,9 +23,16 @@ class CheckpointManager:
                  save_interval_steps: int = 1):
         import orbax.checkpoint as ocp
 
+        from tony_tpu.utils.remotefs import is_remote
+
         self._ocp = ocp
-        self.directory = os.path.abspath(directory)
-        os.makedirs(self.directory, exist_ok=True)
+        if is_remote(directory):
+            # gs:// roots go to orbax verbatim (tensorstore speaks GCS);
+            # abspath/makedirs are local-path concepts
+            self.directory = directory
+        else:
+            self.directory = os.path.abspath(directory)
+            os.makedirs(self.directory, exist_ok=True)
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(

@@ -74,9 +74,21 @@ class LocalizableResource:
 
     def localize(self, dest_dir: str) -> str:
         """Materialize into ``dest_dir`` (dirs are zipped by the client;
-        archives are extracted, ref: Utils.extractResources)."""
+        archives are extracted, ref: Utils.extractResources). ``gs://``
+        sources are fetched first (ref: LocalizableResource.java:30-114
+        remote branch — HDFS download becomes a GCS copy)."""
+        from tony_tpu.utils import remotefs
+
         os.makedirs(dest_dir, exist_ok=True)
         dest = os.path.join(dest_dir, self.local_name)
+        if remotefs.is_remote(self.source):
+            if self.is_archive:
+                fetched = remotefs.fetch(self.source, dest + ".fetch.zip")
+                try:
+                    return unzip(fetched, dest)
+                finally:
+                    os.remove(fetched)
+            return remotefs.fetch(self.source, dest)
         if self.is_archive:
             return unzip(self.source, dest)
         if os.path.isdir(self.source):
