@@ -1,0 +1,44 @@
+"""Payload: ssh-host-death drill. In session epoch 0, worker:1 SIGKILLs
+its own agent's process group mid-training — standing in for the TPU-VM
+host dying without warning (no RPC result, the ssh client just drops).
+Progress persists in a per-index file; the relaunched epoch resumes from
+it and finishes. The job's final SUCCEEDED status + both progress files
+at TARGET are the assertion."""
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.environ["TONY_REPO_ROOT"])
+
+from tony_tpu import elastic
+
+TARGET = 15
+
+
+def main() -> int:
+    role = os.environ["TONY_JOB_NAME"]
+    index = os.environ["TONY_TASK_INDEX"]
+    epoch = elastic.session_epoch()
+    ckpt = os.path.join(os.getcwd(), f"hostdown-progress-{role}-{index}.txt")
+    step = 0
+    if os.path.exists(ckpt):
+        with open(ckpt) as f:
+            step = int(f.read().strip() or 0)
+        print(f"resumed at step {step} (epoch {epoch})", flush=True)
+    while step < TARGET:
+        step += 1
+        with open(ckpt, "w") as f:
+            f.write(str(step))
+        if epoch == 0 and index == "1" and step == 5:
+            print("host dying now", flush=True)
+            os.killpg(os.getpgid(int(os.environ["TONY_AGENT_PID"])),
+                      signal.SIGKILL)
+            time.sleep(30)  # unreachable: we are in that group
+        time.sleep(0.05)
+    print(f"done at step {step} (epoch {epoch})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

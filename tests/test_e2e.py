@@ -492,3 +492,31 @@ def test_preprocess_failure_skips_training(cluster, tmp_path):
     assert not ok
     assert client.final_status["status"] == "FAILED"
     assert not marker.exists(), "worker launched despite preprocess failure"
+
+
+def test_preprocess_failure_then_retry_succeeds(cluster, tmp_path):
+    """A failed preprocess must not poison the retry attempt: the retried
+    epoch re-runs preprocess, scrapes fresh params, and trains (regression:
+    a sticky _preprocess_ran flag made _monitor bail before the retried
+    gang ran)."""
+    marker = tmp_path / "prep_attempts"
+    prep = tmp_path / "prep.py"
+    prep.write_text(
+        "import os\n"
+        f"p = {str(marker)!r}\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        "if n == 0:\n"
+        "    raise SystemExit(3)\n"
+        "print('Model parameters: ok42')\n")
+    worker = tmp_path / "worker.py"
+    worker.write_text("import os, sys\n"
+                      "sys.exit(0 if os.environ.get('MODEL_PARAMS') == "
+                      "'ok42' else 9)\n")
+    conf = script_conf(cluster, str(worker), {"worker": 1})
+    conf.set("tony.application.enable-preprocess", True)
+    conf.set("tony.coordinator.command", f"python3 {prep}")
+    conf.set("tony.coordinator.retry-count", 1)
+    ok, client = run_job(cluster, conf)
+    assert ok, client.final_status
+    assert marker.read_text() == "2"  # preprocess genuinely re-ran

@@ -105,3 +105,181 @@ def test_elastic_resize_e2e():
                 events += [json.loads(line) for line in f if line.strip()]
         assert any(e["type"] == "SESSION_RESIZED" for e in events), \
             [e["type"] for e in events]
+
+
+def test_double_resize_last_wins_and_merges_roles():
+    """Two queued resizes before the monitor drains them: same-role
+    requests coalesce to the newest; distinct roles merge into one
+    atomic resize batch."""
+    import tempfile
+
+    from tony_tpu.config import TonyConf
+    from tony_tpu.coordinator.coordinator import Coordinator
+
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 2)
+    conf.set("tony.ps.instances", 1)
+    conf.set("tony.application.security.enabled", False)
+    with tempfile.TemporaryDirectory() as tmp:
+        conf.set("tony.staging-dir", tmp)
+        conf.set("tony.history.location", os.path.join(tmp, "hist"))
+        coord = Coordinator(conf, "application_rsz2", os.path.join(tmp, "job"))
+        try:
+            assert coord.request_resize("worker", 4)
+            assert coord.request_resize("worker", 6)  # supersedes 4
+            assert coord.request_resize("ps", 2)
+            assert coord._take_pending_resize() == {"worker": 6, "ps": 2}
+            # queue drained atomically: a second take sees nothing
+            assert coord._take_pending_resize() == {}
+            # a resize queued AFTER a drain survives for the next cycle
+            # (e.g. requested while a retry epoch is being rebuilt)
+            assert coord.request_resize("worker", 3)
+            assert coord._take_pending_resize() == {"worker": 3}
+        finally:
+            coord.rpc.stop()
+            coord.metrics_rpc.stop()
+
+
+def _request_resize_when_running(client, role, n):
+    """Poll the client's coordinator RPC until the gang is up, then queue
+    a resize; returns the thread."""
+    def run():
+        for _ in range(300):
+            if client.rpc is not None:
+                try:
+                    infos = client.rpc.call("get_task_infos")
+                    if infos and all(i["status"] in ("RUNNING", "READY")
+                                     for i in infos):
+                        client.rpc.call("resize_role", role=role,
+                                        instances=n)
+                        return
+                except Exception:
+                    pass
+            time.sleep(0.1)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_elastic_shrink_e2e():
+    """Shrink 3 -> 1: the new epoch runs a single worker, the removed
+    indices never reappear, progress resumes (ref semantics:
+    ApplicationMaster.java:612-628 session reset at new sizes)."""
+    with MiniTonyCluster() as c:
+        conf = script_conf(c, os.path.join(SCRIPTS, "elastic_worker.py"),
+                           {"worker": 3})
+        conf.set("tony.elastic.grace-ms", 5000)
+        conf.set("tony.application.shell-env", f"TONY_REPO_ROOT={REPO}")
+        client = c.make_client(conf)
+        _request_resize_when_running(client, "worker", 1)
+        ok = client.run()
+        assert ok, client.final_status
+        job_dir = client.job_dir
+
+        sizes = {}
+        for path in glob.glob(os.path.join(job_dir, "sizes-worker-*.txt")):
+            idx = path.rsplit("-", 1)[1].split(".")[0]
+            with open(path) as f:
+                sizes[idx] = f.read().strip().splitlines()
+        # worker 0 lived in both epochs: 3-wide then 1-wide, with resume
+        assert sizes["0"][0] == "0:3", sizes
+        assert "1:1" in sizes["0"], sizes
+        # removed indices never joined epoch 1
+        for idx in ("1", "2"):
+            assert all(line.startswith("0:") for line in sizes.get(idx, [])), \
+                sizes
+        log0 = os.path.join(job_dir, "logs", "worker-0-user.log")
+        assert "resumed at step" in open(log0).read()
+
+
+def test_resize_while_task_failing_with_retry_e2e():
+    """Resize racing a task failure (+ the resulting retry epoch): in
+    every interleaving the job must converge — the pending resize
+    survives a session reset, the resized gang passes, and no epoch
+    hangs. Payload: worker:1 exits 1 only in session epoch 0."""
+    with MiniTonyCluster() as c:
+        conf = c.base_conf()
+        conf.set("tony.worker.instances", 2)
+        conf.set(
+            "tony.worker.command",
+            "python -c \"import os,sys,time; time.sleep(0.5); "
+            "sys.exit(1 if os.environ['TONY_SESSION_ID']=='0' and "
+            "os.environ['TONY_TASK_INDEX']=='1' else 0)\"")
+        conf.set("tony.coordinator.retry-count", 2)
+        conf.set("tony.elastic.grace-ms", 3000)
+        hist = str(conf.get("tony.history.location"))
+        client = c.make_client(conf)
+        _request_resize_when_running(client, "worker", 3)
+        ok = client.run()
+        assert ok, client.final_status
+        # the job ended in a later session epoch (resize and/or retry
+        # both bump it; the resize must not have been lost)
+        assert client.final_status["session_id"] >= 1, client.final_status
+        # the resize itself happened in SOME epoch — a pending resize
+        # must survive a session reset, not vanish with the failed epoch
+        events = []
+        for path in glob.glob(os.path.join(hist, "**", "*.jhist.jsonl"),
+                              recursive=True):
+            with open(path) as f:
+                events += [json.loads(line) for line in f if line.strip()]
+        assert any(e["type"] == "SESSION_RESIZED" for e in events), \
+            sorted({e["type"] for e in events})
+
+
+def _mini_coord(tmp, **conf_kv):
+    from tony_tpu.config import TonyConf
+    from tony_tpu.coordinator.coordinator import Coordinator
+
+    conf = TonyConf()
+    conf.set("tony.worker.instances", 2)
+    conf.set("tony.application.security.enabled", False)
+    for k, v in conf_kv.items():
+        conf.set(k, v)
+    conf.set("tony.staging-dir", tmp)
+    conf.set("tony.history.location", os.path.join(tmp, "hist"))
+    return Coordinator(conf, "application_eu", os.path.join(tmp, "job"))
+
+
+def test_exit_resize_inside_window_is_clean_outside_is_policy(tmp_path):
+    """EXIT_RESIZE (75) during the resize grace window is a cooperative
+    clean exit; the same code OUTSIDE the window goes through the normal
+    exit-status policy (here: fail-on-worker-failure)."""
+    from tony_tpu.elastic import EXIT_RESIZE
+    from tony_tpu.session import SessionStatus
+
+    coord = _mini_coord(
+        str(tmp_path), **{"tony.application.fail-on-worker-failure-enabled":
+                          True})
+    try:
+        for i in (0, 1):
+            coord.session.init_task("worker", i)
+        coord._resizing = True
+        coord._complete_task("worker:0", EXIT_RESIZE)
+        assert coord.session.get_task_by_id("worker:0").exit_code == 0
+        assert coord.session.status == SessionStatus.RUNNING
+
+        coord._resizing = False
+        coord._complete_task("worker:1", EXIT_RESIZE)
+        assert coord.session.status == SessionStatus.FAILED
+    finally:
+        coord.rpc.stop()
+        coord.metrics_rpc.stop()
+
+
+def test_pending_resize_survives_session_reset(tmp_path):
+    """The property the resize-vs-failure race rests on: a queued resize
+    outlives _reset_session (the retry epoch performs it), while stale
+    pending COMMANDS do not leak across epochs."""
+    coord = _mini_coord(str(tmp_path))
+    try:
+        coord.session.init_task("worker", 0)
+        assert coord.request_resize("worker", 5)
+        coord._pending_commands["worker:0"] = [{"type": "save_and_exit"}]
+        coord._reset_session()
+        assert coord.session.session_id == 1
+        assert coord._pending_commands == {}
+        assert coord._take_pending_resize() == {"worker": 5}
+    finally:
+        coord.rpc.stop()
+        coord.metrics_rpc.stop()

@@ -410,3 +410,42 @@ def test_ssh_ship_e2e_no_shared_mount(tmp_path):
         # the host's own root
         shipped = remote_root / os.path.basename(client.job_dir)
         assert (shipped / "train.py").exists()
+
+
+def test_ssh_host_down_mid_gang_retry_resume():
+    """VERDICT r2 #6: an ssh host dying mid-gang (agent process group
+    SIGKILLed, no RPC result — only the dropped ssh client) must drive
+    the failure-detection -> retry -> resume path end-to-end: the retry
+    epoch relaunches the gang and every worker resumes its progress
+    (ref reset semantics: ApplicationMaster.java:612-628)."""
+    with MiniTonyCluster() as cluster:
+        conf = script_conf(cluster,
+                           os.path.join(SCRIPTS, "ssh_host_down_resume.py"),
+                           {"worker": 2})
+        conf.set("tony.application.launch-mode", "ssh")
+        conf.set("tony.application.hosts", "vmA,vmB")
+        conf.set("tony.application.ssh-bin", FAKE_SSH)
+        conf.set("tony.application.remote-pythonpath", REPO_ROOT)
+        conf.set("tony.coordinator.retry-count", 1)
+        # SPMD gang semantics: one lost member fails the gang (the
+        # reference DEFAULT tolerates partial worker failure,
+        # TonySession.java:331-344 — wrong for jax.distributed jobs)
+        conf.set("tony.application.fail-on-worker-failure-enabled", True)
+        conf.set("tony.application.shell-env", f"TONY_REPO_ROOT={REPO_ROOT}")
+        client = cluster.submit(conf)
+        assert client.final_status["status"] == "SUCCEEDED", \
+            client.final_status
+        assert client.final_status["session_id"] == 1, client.final_status
+        job_dir = client.job_dir
+        for idx in ("0", "1"):
+            path = os.path.join(job_dir,
+                                f"hostdown-progress-worker-{idx}.txt")
+            assert open(path).read().strip() == "15", (idx, path)
+        # the relaunched epoch genuinely RESUMED (some log carries the
+        # markers; user-process stdout lands in the *-user.log files)
+        import glob
+
+        logs = "".join(open(p).read() for p in
+                       glob.glob(os.path.join(job_dir, "logs", "*.log")))
+        assert "host dying now" in logs
+        assert "resumed at step" in logs

@@ -137,3 +137,23 @@ def test_checkpoint_manager_passes_gs_path_through(monkeypatch):
 
     CheckpointManager("gs://bkt/ckpts")
     assert seen["dir"] == "gs://bkt/ckpts"
+
+
+def test_client_stage_with_gcs_venv_directory(bucket, tmp_path):
+    """A gs:// venv DIRECTORY (no .zip) stages like the local copytree
+    branch."""
+    from tony_tpu.client import TonyClient
+    from tony_tpu.config import build_conf
+
+    (bucket / "venv" / "bin").mkdir(parents=True)
+    (bucket / "venv" / "bin" / "activate").write_text("# venv dir")
+
+    conf = build_conf(overrides=[
+        "tony.application.python-venv=gs://testbkt/venv",
+        f"tony.staging-dir={tmp_path / 'staging'}",
+        "tony.worker.instances=1",
+        "tony.application.executes=train.py",
+    ])
+    job_dir = TonyClient(conf).stage()
+    assert open(os.path.join(job_dir, "venv", "bin",
+                             "activate")).read() == "# venv dir"

@@ -133,9 +133,15 @@ class TonyClient:
             unzip(z, self.job_dir)  # agents exec with cwd=job_dir
         venv = str(self.conf.get("tony.application.python-venv", ""))
         if venv and remotefs.is_remote(venv):
-            fetched = remotefs.fetch(
-                venv, os.path.join(self.job_dir, C.TONY_VENV_ZIP))
-            unzip(fetched, os.path.join(self.job_dir, "venv"))
+            if venv.endswith(".zip"):
+                fetched = remotefs.fetch(
+                    venv, os.path.join(self.job_dir, C.TONY_VENV_ZIP))
+                unzip(fetched, os.path.join(self.job_dir, "venv"))
+            else:  # a directory prefix, like the local copytree branch
+                dest = os.path.join(self.job_dir, "venv")
+                os.makedirs(dest, exist_ok=True)
+                remotefs.fetch(venv.rstrip("/") + "/*", dest,
+                               recursive=True)
         elif venv:
             if venv.endswith(".zip"):
                 unzip(venv, os.path.join(self.job_dir, "venv"))

@@ -782,6 +782,10 @@ class Coordinator:
         self.session = Session(self.conf, session_id=old_id + 1)
         self._launch_time.clear()
         self._worker_termination_done = False
+        # a failed preprocess must not poison the retry: the flag would
+        # make _monitor return before the fresh attempt's gang runs
+        self._preprocess_ran = False
+        self._model_params = None
         with self._lock:
             # undrained commands must not leak into the new epoch's tasks
             self._pending_commands.clear()

@@ -461,15 +461,28 @@ class SshLauncher(Launcher):
                 raise RuntimeError(
                     f"job-dir probe on {host} failed; refusing to ship "
                     f"blindly over a possibly-shared mount: {e}") from e
-            if probe.returncode != 0:
+            if probe.returncode not in (0, 1):
+                # `test -e` answers only 0/1; 255 etc. is ssh transport
+                # failure — same blind-ship hazard as the timeout above
+                raise RuntimeError(
+                    f"job-dir probe on {host} exited {probe.returncode} "
+                    "(ssh transport error); refusing to ship blindly")
+            if probe.returncode == 1:
                 self._ship(host)
             with self._shipped_lock:
                 self._shipped.add(host)
 
     def _ship(self, host: str) -> None:
         qd = shlex.quote(self.remote_job_dir)
+        # logs/ is excluded: already-launched tasks' ssh clients append to
+        # coordinator-side log files while this tar reads the dir (each
+        # host writes its own logs anyway). GNU tar rc 1 = "file changed
+        # as we read it" (status/event files churn) — the snapshot of the
+        # static payload (src/venv/conf/resources) is still complete;
+        # only rc >= 2 is a real failure.
         tar = subprocess.Popen(
-            ["tar", "-C", self.ship_job_dir, "-czf", "-", "."],
+            ["tar", "-C", self.ship_job_dir, "--exclude=./logs",
+             "--exclude=./compile-cache", "-czf", "-", "."],
             stdout=subprocess.PIPE)
         try:
             recv = subprocess.run(
@@ -483,7 +496,7 @@ class SshLauncher(Launcher):
             if tar.stdout:
                 tar.stdout.close()
             tar_rc = tar.wait()
-        if recv.returncode or tar_rc:
+        if recv.returncode or tar_rc > 1:
             raise RuntimeError(
                 f"shipping job dir to {host}:{self.remote_job_dir} failed "
                 f"(tar rc {tar_rc}, ssh rc {recv.returncode}): "

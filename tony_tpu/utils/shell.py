@@ -91,6 +91,11 @@ def execute_shell(
     full_env = dict(os.environ)
     if env:
         full_env.update({k: str(v) for k, v in env.items()})
+    if log_path:
+        # a shipped job dir arrives without logs/ (excluded from the tar
+        # stream); the exec point owns creating its own log home
+        os.makedirs(os.path.dirname(os.path.abspath(log_path)),
+                    exist_ok=True)
     out = open(log_path, "ab", buffering=0) if log_path else None
     try:
         proc = subprocess.Popen(
