@@ -57,7 +57,12 @@ def load_model(model_dir: str):
     """(Transformer, params, hf_config) from a local checkpoint dir."""
     import transformers
 
-    from tony_tpu.models import from_hf_gemma, from_hf_gpt2, from_hf_llama
+    from tony_tpu.models import (
+        from_hf_gemma,
+        from_hf_gpt2,
+        from_hf_llama,
+        from_hf_mixtral,
+    )
 
     config = transformers.AutoConfig.from_pretrained(model_dir)
     hf = transformers.AutoModelForCausalLM.from_pretrained(model_dir)
@@ -67,10 +72,12 @@ def load_model(model_dir: str):
         model, params = from_hf_llama(hf)
     elif config.model_type == "gemma":
         model, params = from_hf_gemma(hf)
+    elif config.model_type == "mixtral":
+        model, params = from_hf_mixtral(hf)
     else:
         raise SystemExit(
             f"unsupported model_type {config.model_type!r} "
-            "(supported: gpt2, llama, mistral, qwen2, gemma)")
+            "(supported: gpt2, llama, mistral, qwen2, gemma, mixtral)")
     return model, params, config
 
 

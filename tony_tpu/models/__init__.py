@@ -15,6 +15,7 @@ from tony_tpu.models.hf import (
     from_hf_gemma,
     from_hf_gpt2,
     from_hf_llama,
+    from_hf_mixtral,
     gemma_config,
     gpt2_config,
     llama_config,
@@ -34,6 +35,7 @@ __all__ = [
     "from_hf_gemma",
     "from_hf_gpt2",
     "from_hf_llama",
+    "from_hf_mixtral",
     "gemma_config",
     "gpt2_config",
     "llama_config",

@@ -209,8 +209,13 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
     return TransformerConfig(**kw)
 
 
-def convert_llama_state_dict(state_dict: dict, cfg: TransformerConfig) -> Any:
-    """torch Llama state_dict -> tony-tpu Transformer params pytree.
+def _convert_rms_decoder(state_dict: dict, cfg: TransformerConfig, *,
+                         family: str, ffn_consumed, ffn_build) -> Any:
+    """Shared RMSNorm+RoPE+GQA decoder conversion (Llama-layout state
+    dicts): embedding / final norm / lm_head, per-layer norms and
+    q/k/v/o, with the strict leftover check. The FFN leaf — dense SwiGLU
+    vs sparse MoE — comes from the caller: ``ffn_consumed(i)`` names its
+    tensors, ``ffn_build(i, proj)`` returns ``(param_name, leaf_dict)``.
 
     torch ``nn.Linear`` stores [out, in]; jax kernels are [in, out], so
     every projection transposes. q/k/v rows are head-major, so the
@@ -226,11 +231,11 @@ def convert_llama_state_dict(state_dict: dict, cfg: TransformerConfig) -> Any:
         consumed |= {f"layers.{i}.{s}.weight" for s in (
             "input_layernorm", "post_attention_layernorm",
             "self_attn.q_proj", "self_attn.k_proj", "self_attn.v_proj",
-            "self_attn.o_proj", "mlp.gate_proj", "mlp.up_proj",
-            "mlp.down_proj")}
+            "self_attn.o_proj")}
         if cfg.qkv_bias:
             consumed |= {f"layers.{i}.self_attn.{p}_proj.bias"
                          for p in "qkv"}
+        consumed |= ffn_consumed(i)
     # strictness: an unmapped tensor means this checkpoint is NOT the
     # architecture the config claimed (e.g. stray projection biases when
     # qkv_bias is off) and the import would be silently wrong. inv_freq
@@ -239,8 +244,8 @@ def convert_llama_state_dict(state_dict: dict, cfg: TransformerConfig) -> Any:
                 if k not in consumed and not k.endswith("inv_freq")}
     if leftover:
         raise ValueError(
-            f"state_dict has tensors the Llama importer does not map "
-            f"(not a plain-Llama architecture?): {sorted(leftover)[:8]}")
+            f"state_dict has tensors the {family} importer does not map "
+            f"(not a plain-{family} architecture?): {sorted(leftover)[:8]}")
     params: dict[str, Any] = {
         "embedding": _np(sd["embed_tokens.weight"]),
         "ln_f": {"scale": _np(sd["norm.weight"])},
@@ -258,6 +263,7 @@ def convert_llama_state_dict(state_dict: dict, cfg: TransformerConfig) -> Any:
                     sd[pre + name + ".bias"]).reshape(heads, dh)
             return leaf
 
+        ffn_name, ffn_leaf = ffn_build(i, proj)
         params[f"block_{i}"] = {
             "ln1": {"scale": _np(sd[pre + "input_layernorm.weight"])},
             "ln2": {"scale": _np(
@@ -268,13 +274,28 @@ def convert_llama_state_dict(state_dict: dict, cfg: TransformerConfig) -> Any:
                 "v": head_proj("self_attn.v_proj", kvh),
                 "o": {"kernel": proj("self_attn.o_proj").reshape(h, dh, d)},
             },
-            "mlp": {
-                "wg": {"kernel": proj("mlp.gate_proj")},
-                "wi": {"kernel": proj("mlp.up_proj")},
-                "wo": {"kernel": proj("mlp.down_proj")},
-            },
+            ffn_name: ffn_leaf,
         }
     return {"params": jax.tree.map(jnp.asarray, params)}
+
+
+def convert_llama_state_dict(state_dict: dict, cfg: TransformerConfig) -> Any:
+    """torch Llama state_dict -> tony-tpu Transformer params pytree."""
+
+    def ffn_consumed(i):
+        return {f"layers.{i}.mlp.{p}.weight"
+                for p in ("gate_proj", "up_proj", "down_proj")}
+
+    def ffn_build(i, proj):
+        return "mlp", {
+            "wg": {"kernel": proj("mlp.gate_proj")},
+            "wi": {"kernel": proj("mlp.up_proj")},
+            "wo": {"kernel": proj("mlp.down_proj")},
+        }
+
+    return _convert_rms_decoder(state_dict, cfg, family="Llama",
+                                ffn_consumed=ffn_consumed,
+                                ffn_build=ffn_build)
 
 
 def from_hf_llama(model) -> tuple[Transformer, Any]:
@@ -282,6 +303,77 @@ def from_hf_llama(model) -> tuple[Transformer, Any]:
     Mistral/Qwen2-compatible) instance — local weights, no network."""
     cfg = llama_config(model.config)
     params = convert_llama_state_dict(model.state_dict(), cfg)
+    return Transformer(cfg), params
+
+
+def mixtral_config(hf_config, **overrides) -> TransformerConfig:
+    """TransformerConfig matching a transformers MixtralConfig.
+
+    Mixtral = Mistral attention (RMSNorm + RoPE + GQA + optional sliding
+    window) with EVERY dense MLP replaced by a top-k sparse MoE of SwiGLU
+    experts whose gate weights are softmax-then-renormalized over the
+    selected k (transformers MixtralSparseMoeBlock). Import maps onto
+    ``moe_every=1`` + the Mixtral knobs, with ``moe_dropless=True`` so
+    evaluation is EXACT (no capacity dropping) — the capacity-routed
+    training path stays available by flipping moe_dropless/capacity."""
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act not in _HF_ACTIVATIONS:
+        raise ValueError(f"unsupported Mixtral hidden_act {act!r}; "
+                         f"supported: {sorted(_HF_ACTIVATIONS)}")
+    kw = dict(
+        gated_mlp=False,  # no dense MLP anywhere; moe_every=1 covers all
+        moe_every=1,
+        moe_num_experts=hf_config.num_local_experts,
+        moe_top_k=hf_config.num_experts_per_tok,
+        moe_gated=True,
+        moe_renormalize=True,
+        moe_dropless=True,
+        moe_activation=_HF_ACTIVATIONS[act],
+        moe_d_ff=hf_config.intermediate_size,
+    )
+    kw.update(overrides)
+    return llama_config(hf_config, **kw)
+
+
+def convert_mixtral_state_dict(state_dict: dict,
+                               cfg: TransformerConfig) -> Any:
+    """torch Mixtral state_dict -> tony-tpu params. The attention/norm
+    layout is Llama's (shared converter); each block's MoE maps
+    gate.weight [E, D] -> router [D, E] and experts.e.{w1,w3,w2} ->
+    stacked wg/wi/wo with the expert-leading orientation of
+    parallel/moe.py."""
+    e = cfg.moe_num_experts
+
+    def ffn_consumed(i):
+        return {f"layers.{i}.block_sparse_moe.gate.weight"} | {
+            f"layers.{i}.block_sparse_moe.experts.{x}.{w}.weight"
+            for x in range(e) for w in ("w1", "w2", "w3")}
+
+    def ffn_build(i, proj):
+        return "moe", {
+            "router": proj("block_sparse_moe.gate"),  # [D, E]
+            "wg": np.stack([proj(f"block_sparse_moe.experts.{x}.w1")
+                            for x in range(e)]),  # [E, D, FF]
+            "wi": np.stack([proj(f"block_sparse_moe.experts.{x}.w3")
+                            for x in range(e)]),  # [E, D, FF]
+            "wo": np.stack([proj(f"block_sparse_moe.experts.{x}.w2")
+                            for x in range(e)]),  # [E, FF, D]
+        }
+
+    return _convert_rms_decoder(state_dict, cfg, family="Mixtral",
+                                ffn_consumed=ffn_consumed,
+                                ffn_build=ffn_build)
+
+
+def from_hf_mixtral(model) -> tuple[Transformer, Any]:
+    """(Transformer, params) from a transformers MixtralForCausalLM —
+    local weights, no network. Evaluation is exact (dropless dense MoE)."""
+    if getattr(model.config, "model_type", "") != "mixtral":
+        raise ValueError(
+            f"from_hf_mixtral got model_type "
+            f"{getattr(model.config, 'model_type', None)!r}")
+    cfg = mixtral_config(model.config)
+    params = convert_mixtral_state_dict(model.state_dict(), cfg)
     return Transformer(cfg), params
 
 

@@ -83,6 +83,15 @@ class TransformerConfig:
     moe_num_experts: int = 8
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Mixtral-family MoE: SwiGLU experts + renormalized top-k gates +
+    # dropless (exact dense) evaluation; moe_d_ff sizes the experts when
+    # it differs from the dense d_ff (0 = same). moe_activation is
+    # separate from the dense-MLP activation knob.
+    moe_gated: bool = False
+    moe_renormalize: bool = False
+    moe_dropless: bool = False
+    moe_activation: str = "gelu"
+    moe_d_ff: int = 0
     # scan the layer stack with nn.scan: one traced/compiled block instead
     # of n_layers copies — XLA compile time and HBM for code stay O(1) in
     # depth (the standard TPU deep-stack idiom). Params gain a leading
@@ -422,12 +431,17 @@ class MoEMLP(nn.Module):
         from tony_tpu.parallel.moe import MoEConfig, moe_layer
 
         cfg = self.cfg
+        d_ff = cfg.moe_d_ff or cfg.d_ff
         moe_cfg = MoEConfig(
             num_experts=cfg.moe_num_experts,
             capacity_factor=cfg.moe_capacity_factor,
             top_k=cfg.moe_top_k,
             d_model=cfg.d_model,
-            d_ff=cfg.d_ff,
+            d_ff=d_ff,
+            gated=cfg.moe_gated,
+            activation=cfg.moe_activation,
+            renormalize_top_k=cfg.moe_renormalize,
+            dropless=cfg.moe_dropless,
         )
         init = nn.initializers.normal(0.02)
         params = {
@@ -435,18 +449,21 @@ class MoEMLP(nn.Module):
                                  (cfg.d_model, cfg.moe_num_experts),
                                  jnp.float32),
             "wi": self.param("wi", init,
-                             (cfg.moe_num_experts, cfg.d_model, cfg.d_ff),
+                             (cfg.moe_num_experts, cfg.d_model, d_ff),
                              jnp.float32),
             "wo": self.param("wo", init,
-                             (cfg.moe_num_experts, cfg.d_ff, cfg.d_model),
+                             (cfg.moe_num_experts, d_ff, cfg.d_model),
                              jnp.float32),
         }
+        if cfg.moe_gated:
+            params["wg"] = self.param(
+                "wg", init, (cfg.moe_num_experts, cfg.d_model, d_ff),
+                jnp.float32)
         # experts compute in cfg.dtype (bf16 on TPU); the router stays fp32 —
         # bf16 routing logits quantize near-tied gate probabilities and flip
         # top-k choices step to step, destabilizing load balancing
-        cast = {"router": params["router"],
-                "wi": params["wi"].astype(cfg.dtype),
-                "wo": params["wo"].astype(cfg.dtype)}
+        cast = {k: (v if k == "router" else v.astype(cfg.dtype))
+                for k, v in params.items()}
         out, aux = moe_layer(cast, x, moe_cfg)
         if not self.is_initializing():
             # sowing during init would put a "losses" collection into the
@@ -639,7 +656,8 @@ def logical_axis_rules_tree(params: Any) -> Any:
         # (single source of truth for 3-dim expert params). Dense MLP
         # kernels live at .../wi/kernel; MoE expert arrays are the leaf
         # .../moe/wi itself
-        elif "/wi/" in joined or "/wg/" in joined or joined.endswith("/wi"):
+        elif "/wi/" in joined or "/wg/" in joined \
+                or joined.endswith(("/wi", "/wg")):
             base = moe_logical_axes()["wi"] if leaf_dims == 3 \
                 else ("embed", "mlp")
         elif "/wo/" in joined or joined.endswith("/wo"):

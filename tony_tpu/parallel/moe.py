@@ -22,12 +22,64 @@ class MoEConfig:
     top_k: int = 2
     d_model: int = 512
     d_ff: int = 2048
+    # Mixtral-family experts: SwiGLU, wo(act(wg x) * (wi x)) per expert,
+    # instead of the 2-matmul wo(act(wi x)) expert
+    gated: bool = False
+    activation: str = "gelu"  # gelu | silu
+    # HF Mixtral renormalizes the selected top-k gate weights to sum to 1
+    renormalize_top_k: bool = False
+    # dropless=True computes EVERY expert on every token and combines by
+    # gate weight — exact (no capacity dropping), memory O(E*T*ff), the
+    # eval/checkpoint-parity path. False = capacity-limited dispatch
+    # einsums (all-to-all under pjit), the training path.
+    dropless: bool = False
+
+
+def _act(name: str):
+    """Same semantics as models/transformer._activation: 'gelu' is the
+    erf form, 'gelu_tanh' the approximation (HF gelu_new/pytorch_tanh)."""
+    table = {
+        "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+        "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+        "silu": jax.nn.silu,
+    }
+    if name not in table:
+        raise ValueError(f"unsupported MoE activation {name!r} "
+                         f"(supported: {sorted(table)})")
+    return table[name]
+
+
+def _gates(logits: jnp.ndarray, k: int, renormalize: bool):
+    """Shared routing math for the routed and dropless paths: softmax
+    probs, top-k gate (values, indices) — optionally renormalized to sum
+    to 1 per token (Mixtral) — and the load-balancing aux loss."""
+    e = logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [T, k]
+    if renormalize:
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    return probs, gate_vals, gate_idx, _aux_loss(probs, gate_idx, e, k)
+
+
+def _expert_ffn(params: dict, x: jnp.ndarray, cfg: "MoEConfig",
+                up_spec: str, down_spec: str) -> jnp.ndarray:
+    """Per-expert FFN shared by both paths: 2-matmul act(wi) or SwiGLU
+    act(wg)*wi (``cfg.gated``), then wo. The einsum specs carry the
+    layout difference (routed [E,C,D] vs dropless [T,D]-broadcast)."""
+    act = _act(cfg.activation)
+    up = jnp.einsum(up_spec, x, params["wi"])
+    if cfg.gated:
+        h = act(jnp.einsum(up_spec, x, params["wg"])) * up
+    else:
+        h = act(up)
+    return jnp.einsum(down_spec, h, params["wo"])
 
 
 def init_moe_params(key, cfg: MoEConfig, dtype=jnp.float32) -> dict:
-    k1, k2, k3 = jax.random.split(key, 3)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
     scale_in = cfg.d_model ** -0.5
-    return {
+    params = {
         "router": jax.random.normal(k1, (cfg.d_model, cfg.num_experts),
                                     dtype) * scale_in,
         # leading expert dim -> sharded on the "expert" mesh axis
@@ -36,6 +88,10 @@ def init_moe_params(key, cfg: MoEConfig, dtype=jnp.float32) -> dict:
         "wo": jax.random.normal(k3, (cfg.num_experts, cfg.d_ff, cfg.d_model),
                                 dtype) * (cfg.d_ff ** -0.5),
     }
+    if cfg.gated:
+        params["wg"] = jax.random.normal(
+            k4, (cfg.num_experts, cfg.d_model, cfg.d_ff), dtype) * scale_in
+    return params
 
 
 def moe_logical_axes() -> dict:
@@ -43,24 +99,28 @@ def moe_logical_axes() -> dict:
     return {
         "router": (None, None),
         "wi": ("expert", None, "mlp"),
+        "wg": ("expert", None, "mlp"),
         "wo": ("expert", "mlp", None),
     }
 
 
-def top_k_gating(logits: jnp.ndarray, k: int, capacity: int):
+def _aux_loss(probs, gate_idx, e, k):
+    """Switch/GShard load-balancing loss from routing decisions."""
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jnp.sum(jax.nn.one_hot(gate_idx, e), axis=1), axis=0)
+    return e * jnp.sum(me * ce) / k
+
+
+def top_k_gating(logits: jnp.ndarray, k: int, capacity: int,
+                 renormalize: bool = False):
     """Top-k token->expert routing with per-expert capacity.
 
     logits: [T, E]. Returns (dispatch [T, E, C] one-hot, combine [T, E, C]
-    weights, aux_loss scalar).
+    weights, aux_loss scalar). ``renormalize`` rescales the k selected
+    gate weights to sum to 1 per token (Mixtral's convention).
     """
     t, e = logits.shape
-    probs = jax.nn.softmax(logits, axis=-1)
-    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [T, k]
-    # load-balancing auxiliary loss (Switch/GShard style)
-    me = jnp.mean(probs, axis=0)
-    ce = jnp.mean(
-        jnp.sum(jax.nn.one_hot(gate_idx, e), axis=1), axis=0)
-    aux_loss = e * jnp.sum(me * ce) / k
+    probs, gate_vals, gate_idx, aux_loss = _gates(logits, k, renormalize)
 
     dispatch = jnp.zeros((t, e, capacity), dtype=logits.dtype)
     combine = jnp.zeros((t, e, capacity), dtype=logits.dtype)
@@ -89,6 +149,25 @@ def top_k_gating(logits: jnp.ndarray, k: int, capacity: int):
     return dispatch, combine, aux_loss
 
 
+def _dropless_moe(params: dict, tokens: jnp.ndarray, logits: jnp.ndarray,
+                  cfg: MoEConfig):
+    """Exact dense evaluation: every expert runs on every token; outputs
+    combine by (optionally renormalized) top-k gate weight. No capacity,
+    no dropping — the checkpoint-parity/eval path (compute O(E) of the
+    routed path, memory O(E*T*ff))."""
+    t, e = logits.shape
+    probs, gate_vals, gate_idx, aux = _gates(logits, cfg.top_k,
+                                             cfg.renormalize_top_k)
+    # [T, E] combine weights: selected experts carry their gate weight
+    weights = jnp.sum(
+        jax.nn.one_hot(gate_idx, e, dtype=gate_vals.dtype)
+        * gate_vals[..., None], axis=1)
+    expert_out = _expert_ffn(params, tokens, cfg,
+                             "td,edf->etf", "etf,efd->etd")
+    out = jnp.einsum("etd,te->td", expert_out, weights)
+    return out, aux
+
+
 def moe_layer(params: dict, x: jnp.ndarray, cfg: MoEConfig):
     """x: [B, L, D] -> ([B, L, D], aux_loss).
 
@@ -98,11 +177,15 @@ def moe_layer(params: dict, x: jnp.ndarray, cfg: MoEConfig):
     b, l, d = x.shape
     tokens = x.reshape(b * l, d)
     logits = tokens @ params["router"]
+    if cfg.dropless:
+        out, aux = _dropless_moe(params, tokens, logits, cfg)
+        return out.reshape(b, l, d), aux
     capacity = max(1, int(cfg.capacity_factor * (b * l) / cfg.num_experts))
-    dispatch, combine, aux = top_k_gating(logits, cfg.top_k, capacity)
+    dispatch, combine, aux = top_k_gating(logits, cfg.top_k, capacity,
+                                          renormalize=cfg.renormalize_top_k)
     # [E, C, D]: gather each expert's tokens (all-to-all under pjit)
     expert_in = jnp.einsum("td,tec->ecd", tokens, dispatch)
-    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["wi"]))
-    expert_out = jnp.einsum("ecf,efd->ecd", h, params["wo"])
+    expert_out = _expert_ffn(params, expert_in, cfg,
+                             "ecd,edf->ecf", "ecf,efd->ecd")
     out = jnp.einsum("ecd,tec->td", expert_out, combine)
     return out.reshape(b, l, d), aux
