@@ -632,3 +632,108 @@ def test_mixtral_importer_rejects_unmapped(mixtral_pair):
         torch.zeros(2, 2)
     with pytest.raises(ValueError, match="does not map"):
         convert_mixtral_state_dict(sd, mixtral_config(hf.config))
+
+
+# -- GPT-NeoX / Pythia family ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def neox_pair():
+    from tony_tpu.models.hf import from_hf_neox
+
+    config = transformers.GPTNeoXConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.5,
+        use_parallel_residual=True, tie_word_embeddings=False,
+        attention_dropout=0.0, hidden_dropout=0.0,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = transformers.GPTNeoXForCausalLM(config).eval()
+    model, params = from_hf_neox(hf)
+    return hf, model, params
+
+
+def test_neox_config_mapping(neox_pair):
+    _, model, _ = neox_pair
+    cfg = model.cfg
+    assert cfg.norm == "layer" and cfg.positional == "rope"
+    assert cfg.use_bias and cfg.parallel_residual
+    assert cfg.rotary_dims == 6  # 0.5 * head_dim 12
+    assert not cfg.gated_mlp and not cfg.tied_embeddings
+
+
+def test_neox_logits_parity(neox_pair):
+    """Partial rotary (rotary_pct) + parallel residual + biased dense,
+    exact vs torch GPTNeoXForCausalLM."""
+    hf, model, params = neox_pair
+    tokens = np.random.default_rng(7).integers(0, 96, (2, 15))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_neox_decode_parity(neox_pair):
+    """KV-cache decode with partial rotary matches the full forward."""
+    hf, model, params = neox_pair
+    tokens = np.random.default_rng(8).integers(0, 96, (1, 9))
+    full = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    cache = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens),
+                       decode=True)["cache"]
+    steps = []
+    for i in range(tokens.shape[1]):
+        logits, mut = model.apply(
+            {"params": params["params"], "cache": cache},
+            jnp.asarray(tokens[:, i:i + 1]), decode=True, mutable=["cache"])
+        cache = mut["cache"]
+        steps.append(np.asarray(logits[:, 0]))
+    np.testing.assert_allclose(np.stack(steps, axis=1), full,
+                               atol=1e-3, rtol=1e-3)
+
+
+def test_neox_sequential_residual_variant():
+    """use_parallel_residual=False (GPT-NeoX small configs) maps onto the
+    sequential block and still matches torch."""
+    from tony_tpu.models.hf import from_hf_neox
+
+    config = transformers.GPTNeoXConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=32, rotary_pct=1.0,
+        use_parallel_residual=False, tie_word_embeddings=False,
+        attention_dropout=0.0, hidden_dropout=0.0,
+        attn_implementation="eager")
+    torch.manual_seed(1)
+    hf = transformers.GPTNeoXForCausalLM(config).eval()
+    model, params = from_hf_neox(hf)
+    assert not model.cfg.parallel_residual and model.cfg.rotary_dims == 0
+    tokens = np.random.default_rng(9).integers(0, 64, (2, 11))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_neox_importer_rejects_unmapped(neox_pair):
+    from tony_tpu.models.hf import convert_neox_state_dict, neox_config
+
+    hf, _, _ = neox_pair
+    sd = dict(hf.state_dict())
+    sd["gpt_neox.layers.0.attention.stray.weight"] = torch.zeros(2, 2)
+    with pytest.raises(ValueError, match="does not map"):
+        convert_neox_state_dict(sd, neox_config(hf.config))
+
+
+def test_neox_rejects_biasless_and_exotic_rope():
+    from tony_tpu.models.hf import neox_config
+
+    base = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=1, num_attention_heads=4,
+                max_position_embeddings=32, rotary_pct=1.0)
+    with pytest.raises(ValueError, match="attention_bias"):
+        neox_config(transformers.GPTNeoXConfig(**base,
+                                               attention_bias=False))
+    with pytest.raises(ValueError, match="rope_scaling"):
+        neox_config(transformers.GPTNeoXConfig(
+            **base, rope_scaling={"rope_type": "yarn", "factor": 2.0}))

@@ -62,6 +62,7 @@ def load_model(model_dir: str):
         from_hf_gpt2,
         from_hf_llama,
         from_hf_mixtral,
+        from_hf_neox,
     )
 
     config = transformers.AutoConfig.from_pretrained(model_dir)
@@ -74,10 +75,13 @@ def load_model(model_dir: str):
         model, params = from_hf_gemma(hf)
     elif config.model_type == "mixtral":
         model, params = from_hf_mixtral(hf)
+    elif config.model_type == "gpt_neox":
+        model, params = from_hf_neox(hf)
     else:
         raise SystemExit(
             f"unsupported model_type {config.model_type!r} "
-            "(supported: gpt2, llama, mistral, qwen2, gemma, mixtral)")
+            "(supported: gpt2, llama, mistral, qwen2, gemma, mixtral, "
+            "gpt_neox)")
     return model, params, config
 
 

@@ -16,6 +16,7 @@ from tony_tpu.models.hf import (
     from_hf_gpt2,
     from_hf_llama,
     from_hf_mixtral,
+    from_hf_neox,
     gemma_config,
     gpt2_config,
     llama_config,
@@ -36,6 +37,7 @@ __all__ = [
     "from_hf_gpt2",
     "from_hf_llama",
     "from_hf_mixtral",
+    "from_hf_neox",
     "gemma_config",
     "gpt2_config",
     "llama_config",

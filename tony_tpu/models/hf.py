@@ -377,6 +377,130 @@ def from_hf_mixtral(model) -> tuple[Transformer, Any]:
     return Transformer(cfg), params
 
 
+def neox_config(hf_config, **overrides) -> TransformerConfig:
+    """TransformerConfig matching a transformers GPTNeoXConfig (Pythia /
+    GPT-NeoX-20B family): LayerNorm (with bias) + PARTIAL rotary
+    (rotary_pct of each head) + biased dense everywhere + classic
+    2-matmul gelu MLP, and — on every released Pythia checkpoint —
+    the parallel residual (x + attn(ln1 x) + mlp(ln2 x))."""
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act not in _HF_ACTIVATIONS:
+        raise ValueError(f"unsupported GPT-NeoX hidden_act {act!r}; "
+                         f"supported: {sorted(_HF_ACTIVATIONS)}")
+    if not getattr(hf_config, "attention_bias", True):
+        # bias-free NeoX variants lack tensors this importer maps; a
+        # silent mis-model is worse than a refusal (strictness convention)
+        raise ValueError("attention_bias=False GPT-NeoX variants are not "
+                         "supported")
+    head_dim = hf_config.hidden_size // hf_config.num_attention_heads
+    rotary_dims = int(head_dim * getattr(hf_config, "rotary_pct", 1.0))
+    if rotary_dims % 2:
+        # the half-split rotation needs an even width (true of every
+        # released NeoX/Pythia checkpoint; HF's rotate_half would produce
+        # mismatched halves for an odd width too)
+        raise ValueError(
+            f"rotary_pct x head_dim = {rotary_dims} is odd; partial "
+            "rotary needs an even rotary width")
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_heads=hf_config.num_attention_heads,
+        n_layers=hf_config.num_hidden_layers,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        dtype=jnp.float32,
+        attention_backend="reference",
+        norm="layer",
+        positional="rope",
+        use_bias=True,
+        activation=_HF_ACTIVATIONS[act],
+        norm_eps=hf_config.layer_norm_eps,
+        rope_theta=float(getattr(hf_config, "rotary_emb_base", 10_000.0)),
+        rope_scaling=_rope_scaling(hf_config),  # map linear / reject exotic
+        rotary_dims=0 if rotary_dims >= head_dim else rotary_dims,
+        parallel_residual=getattr(hf_config, "use_parallel_residual", True),
+        tied_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def convert_neox_state_dict(state_dict: dict, cfg: TransformerConfig) -> Any:
+    """torch GPT-NeoX state_dict -> tony-tpu params. The fused
+    query_key_value projection packs rows head-major as [q_h, k_h, v_h]
+    per head: transposed [d, 3hd] reshapes to [d, h, 3, dh] and splits
+    on the packed axis."""
+    d, h, dh = cfg.d_model, cfg.n_heads, cfg.head_dim
+    sd = {k.removeprefix("gpt_neox."): v for k, v in state_dict.items()}
+    consumed = {"embed_in.weight", "final_layer_norm.weight",
+                "final_layer_norm.bias", "embed_out.weight"}
+    for i in range(cfg.n_layers):
+        consumed |= {f"layers.{i}.{s}.{wb}" for wb in ("weight", "bias")
+                     for s in ("input_layernorm", "post_attention_layernorm",
+                               "attention.query_key_value",
+                               "attention.dense", "mlp.dense_h_to_4h",
+                               "mlp.dense_4h_to_h")}
+    buffers = ("inv_freq", "attention.bias", "attention.masked_bias",
+               "rotary_emb.inv_freq")
+    leftover = {k for k in sd if k not in consumed
+                and not k.endswith(buffers)}
+    if leftover:
+        raise ValueError(
+            f"state_dict has tensors the GPT-NeoX importer does not map "
+            f"(not a plain-NeoX architecture?): {sorted(leftover)[:8]}")
+    params: dict[str, Any] = {
+        "embedding": _np(sd["embed_in.weight"]),
+        "ln_f": {"scale": _np(sd["final_layer_norm.weight"]),
+                 "bias": _np(sd["final_layer_norm.bias"])},
+    }
+    if not cfg.tied_embeddings:
+        params["lm_head"] = _np(sd["embed_out.weight"])
+    for i in range(cfg.n_layers):
+        pre = f"layers.{i}."
+        qkv_w = _np(sd[pre + "attention.query_key_value.weight"]).T \
+            .reshape(d, h, 3, dh)
+        qkv_b = _np(sd[pre + "attention.query_key_value.bias"]) \
+            .reshape(h, 3, dh)
+
+        def lin(name):
+            return {"kernel": _np(sd[pre + name + ".weight"]).T,
+                    "bias": _np(sd[pre + name + ".bias"])}
+
+        params[f"block_{i}"] = {
+            "ln1": {"scale": _np(sd[pre + "input_layernorm.weight"]),
+                    "bias": _np(sd[pre + "input_layernorm.bias"])},
+            "ln2": {"scale": _np(
+                        sd[pre + "post_attention_layernorm.weight"]),
+                    "bias": _np(
+                        sd[pre + "post_attention_layernorm.bias"])},
+            "attn": {
+                "q": {"kernel": qkv_w[:, :, 0], "bias": qkv_b[:, 0]},
+                "k": {"kernel": qkv_w[:, :, 1], "bias": qkv_b[:, 1]},
+                "v": {"kernel": qkv_w[:, :, 2], "bias": qkv_b[:, 2]},
+                "o": {"kernel": _np(sd[pre + "attention.dense.weight"])
+                      .T.reshape(h, dh, d),
+                      "bias": _np(sd[pre + "attention.dense.bias"])},
+            },
+            "mlp": {
+                "wi": lin("mlp.dense_h_to_4h"),
+                "wo": lin("mlp.dense_4h_to_h"),
+            },
+        }
+    return {"params": jax.tree.map(jnp.asarray, params)}
+
+
+def from_hf_neox(model) -> tuple[Transformer, Any]:
+    """(Transformer, params) from a transformers GPTNeoXForCausalLM
+    (Pythia family) — local weights, no network."""
+    if getattr(model.config, "model_type", "") != "gpt_neox":
+        raise ValueError(
+            f"from_hf_neox got model_type "
+            f"{getattr(model.config, 'model_type', None)!r}")
+    cfg = neox_config(model.config)
+    params = convert_neox_state_dict(model.state_dict(), cfg)
+    return Transformer(cfg), params
+
+
 def gemma_config(hf_config, **overrides) -> TransformerConfig:
     """TransformerConfig matching a transformers GemmaConfig (Gemma-1).
 

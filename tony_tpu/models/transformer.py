@@ -68,6 +68,11 @@ class TransformerConfig:
     # per-head width when it differs from d_model // n_heads (Gemma-7B:
     # 16 heads x 256 > d_model 3072); 0 = derived
     explicit_head_dim: int = 0
+    # GPT-NeoX/Pythia family: rotate only the first rotary_dims of each
+    # head (rotary_pct; 0 = full head_dim), and compute attention + MLP
+    # from the SAME block input in parallel (x + attn(ln1 x) + mlp(ln2 x))
+    rotary_dims: int = 0
+    parallel_residual: bool = False
     # multiply token embeddings by sqrt(d_model), in activation dtype
     # (Gemma's normalizer)
     embed_scale: bool = False
@@ -262,10 +267,17 @@ class RopeScaling:
 
 
 def rotary_embedding(x, positions, theta: float = 10_000.0,
-                     scaling: RopeScaling | None = None):
+                     scaling: RopeScaling | None = None,
+                     rotary_dims: int = 0):
     """RoPE over head_dim (TPU-friendly: pure elementwise, fuses away).
-    Half-split rotation convention (matches HF Llama's rotate_half)."""
+    Half-split rotation convention (matches HF Llama's rotate_half).
+    ``rotary_dims`` < head_dim rotates only the leading slice and passes
+    the rest through (GPT-NeoX/Pythia rotary_pct)."""
     d = x.shape[-1]
+    if rotary_dims and rotary_dims < d:
+        rotated = rotary_embedding(x[..., :rotary_dims], positions, theta,
+                                   scaling)
+        return jnp.concatenate([rotated, x[..., rotary_dims:]], axis=-1)
     half = d // 2
     freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     if scaling is not None:
@@ -301,9 +313,9 @@ class Attention(nn.Module):
             if cfg.positional == "rope":
                 positions = jnp.arange(l)
                 q = rotary_embedding(q, positions, cfg.rope_theta,
-                                     cfg.rope_scaling)
+                                     cfg.rope_scaling, cfg.rotary_dims)
                 k = rotary_embedding(k, positions, cfg.rope_theta,
-                                     cfg.rope_scaling)
+                                     cfg.rope_scaling, cfg.rotary_dims)
             if cfg.kv_heads != cfg.n_heads and \
                     cfg.attention_backend != "pallas":
                 # GQA: broadcast K/V head groups up to n_heads for the
@@ -352,9 +364,9 @@ class Attention(nn.Module):
         if cfg.positional == "rope":
             positions = cur + jnp.arange(l)
             q = rotary_embedding(q, positions, cfg.rope_theta,
-                                 cfg.rope_scaling)
+                                 cfg.rope_scaling, cfg.rotary_dims)
             k = rotary_embedding(k, positions, cfg.rope_theta,
-                                 cfg.rope_scaling)
+                                 cfg.rope_scaling, cfg.rotary_dims)
         keys = jax.lax.dynamic_update_slice(cached_k.value, k, (0, cur, 0, 0))
         values = jax.lax.dynamic_update_slice(cached_v.value, v, (0, cur, 0, 0))
         cached_k.value = keys
@@ -480,13 +492,17 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, decode: bool = False, segment_ids=None):
-        x = x + Attention(self.cfg, name="attn")(
+        attn_out = Attention(self.cfg, name="attn")(
             make_norm(self.cfg, "ln1")(x), decode=decode,
             segment_ids=segment_ids)
         ffn = (MoEMLP(self.cfg, name="moe") if self.use_moe
                else MLP(self.cfg, name="mlp"))
-        x = x + ffn(make_norm(self.cfg, "ln2")(x))
-        return x
+        if self.cfg.parallel_residual:
+            # GPT-NeoX: both sublayers read the block INPUT; one residual
+            # add (fuses into a single elementwise epilogue on TPU)
+            return x + attn_out + ffn(make_norm(self.cfg, "ln2")(x))
+        x = x + attn_out
+        return x + ffn(make_norm(self.cfg, "ln2")(x))
 
 
 class _ScanBody(nn.Module):
