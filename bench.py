@@ -133,8 +133,16 @@ _PEAK_BF16 = (
     ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
 )
 
+# HBM bandwidth per chip, bytes/s — the decode-path roofline (decode is
+# bandwidth-bound: every generated token re-reads the parameters)
+_HBM_BW = (
+    ("v6e", 1638e9), ("trillium", 1638e9), ("v5p", 2765e9),
+    ("v5litepod", 819e9), ("v5 lite", 819e9), ("v5e", 819e9),
+    ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+)
 
-def peak_flops_per_chip() -> float:
+
+def _chip_lookup(table) -> float:
     names = [os.environ.get("TPU_ACCELERATOR_TYPE", "")]
     try:
         names.append(jax.devices()[0].device_kind)
@@ -142,10 +150,18 @@ def peak_flops_per_chip() -> float:
         pass
     for name in names:
         low = name.lower()
-        for key, val in _PEAK_BF16:
+        for key, val in table:
             if key in low:
                 return val
     return 0.0
+
+
+def peak_flops_per_chip() -> float:
+    return _chip_lookup(_PEAK_BF16)
+
+
+def hbm_bw_per_chip() -> float:
+    return _chip_lookup(_HBM_BW)
 
 
 def compiled_flops(jitted, *args) -> float:
@@ -471,11 +487,25 @@ def bench_decode(on_tpu: bool) -> dict:
     out = generate(model, params, prompt, max_new_tokens=new)
     float(jnp.asarray(out).reshape(-1)[0])
     dt = time.perf_counter() - t0
-    return {
+    result = {
         "decode_tokens_per_sec": round(batch * new / dt, 1),
         "per_token_latency_ms": round(dt / new * 1e3, 3),
         "batch": batch, "new_tokens": new,
     }
+    bw = hbm_bw_per_chip() if on_tpu else 0.0
+    if bw:
+        # decode roofline: each step re-reads every parameter byte once
+        # (amortized over the batch); utilization = achieved param
+        # traffic / peak HBM bandwidth. The compute-MFU analog for the
+        # serving path — near 1.0 means the decode loop is as fast as
+        # the memory system allows at this batch size.
+        param_bytes = sum(
+            x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+        steps_per_sec = new / dt
+        result["params_bytes"] = param_bytes
+        result["hbm_bw_utilization"] = round(
+            steps_per_sec * param_bytes / bw, 4)
+    return result
 
 
 # ------------------------------------------------------ attention kernels

@@ -495,3 +495,44 @@ def test_score_bucket_len():
     assert bucket_len(64, 2048) == 64
     assert bucket_len(1500, 2048) == 2048
     assert bucket_len(5000, 2048) == 2048  # capped (caller truncates ids)
+
+
+def test_generate_cli_batches_same_length_prompts(tmp_path):
+    """Multiple --token-ids of equal length decode as ONE batch; outputs
+    print in input order and match per-prompt greedy decodes exactly
+    (no padding, so batching cannot change numerics)."""
+    import os
+    import subprocess
+    import sys
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(config).eval()
+    mdir = tmp_path / "ckpt"
+    hf.save_pretrained(str(mdir))
+    prompts = ["1,2,3", "9,8,7", "5,6"]  # two same-length + one distinct
+    proc = subprocess.run(
+        [sys.executable, "-m", "tony_tpu.cli.generate", "--model", str(mdir),
+         *sum((["--token-ids", p] for p in prompts), []),
+         "--max-new-tokens", "4", "--eos-id", "63"],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__)))})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = proc.stdout.strip().splitlines()
+    assert len(lines) == 3
+    for line, p in zip(lines, prompts):
+        got = [int(x) for x in line.split(",")]
+        start = [int(x) for x in p.split(",")]
+        assert got[:len(start)] == start
+        with torch.no_grad():
+            ref = hf.generate(torch.tensor([start]), max_new_tokens=4,
+                              do_sample=False, pad_token_id=0,
+                              eos_token_id=63)
+        assert got == ref[0].tolist(), (line, p)
