@@ -498,13 +498,21 @@ def bench_decode(on_tpu: bool) -> dict:
         # (amortized over the batch); utilization = achieved param
         # traffic / peak HBM bandwidth. The compute-MFU analog for the
         # serving path — near 1.0 means the decode loop is as fast as
-        # the memory system allows at this batch size.
+        # the memory system allows at this batch size. The prefill pass
+        # is EXCLUDED: a max_new_tokens=1 run (prefill + one step) is
+        # subtracted so only true decode steps divide the wall time.
+        one = generate(model, params, prompt, max_new_tokens=1)  # compile
+        float(jnp.asarray(one).reshape(-1)[0])
+        t1 = time.perf_counter()
+        one = generate(model, params, prompt, max_new_tokens=1)
+        float(jnp.asarray(one).reshape(-1)[0])
+        dt_prefill = time.perf_counter() - t1
+        decode_dt = max(dt - dt_prefill, 1e-9)
         param_bytes = sum(
             x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
-        steps_per_sec = new / dt
         result["params_bytes"] = param_bytes
         result["hbm_bw_utilization"] = round(
-            steps_per_sec * param_bytes / bw, 4)
+            ((new - 1) / decode_dt) * param_bytes / bw, 4)
     return result
 
 

@@ -390,3 +390,57 @@ def test_packed_source_emits_segments(tmp_path):
     # without the flag no segments key appears
     src2 = PackedTokenSource(out, seq_len=8)
     assert "segments" not in src2[0]
+
+
+def test_instruction_source_masks_prompt_and_padding(tmp_path):
+    """SFT source: loss mask covers ONLY completion (+eos) positions; the
+    wiring into cross_entropy_loss trains toward completions alone."""
+    import json
+
+    from tony_tpu.data import InstructionSource, JsonlSource
+    from tony_tpu.data.tokenize import ByteTokenizer
+
+    path = tmp_path / "sft.jsonl"
+    rows = [{"prompt": "ab", "completion": "cd"},
+            {"prompt": "xyz", "completion": "q"}]
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    tok = ByteTokenizer()
+    src = InstructionSource(JsonlSource(str(path)), tok, seq_len=8,
+                            eos_id=0, pad_id=0)
+    assert len(src) == 2
+
+    ex = src[0]
+    assert ex["tokens"].shape == (8,) and ex["loss_mask"].shape == (8,)
+    p, c = tok.encode("ab"), tok.encode("cd")
+    assert ex["tokens"][:2].tolist() == p
+    assert ex["tokens"][2:5].tolist() == c + [0]  # completion + eos
+    # mask: prompt 0, completion+eos 1, padding 0
+    assert ex["loss_mask"].tolist() == [0, 0, 1, 1, 1, 0, 0, 0]
+
+    # shifted-mask loss contract: only completion targets contribute
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.train import cross_entropy_loss
+
+    logits = jnp.asarray(
+        np.random.default_rng(0).standard_normal((1, 7, 260)), jnp.float32)
+    tokens = jnp.asarray(ex["tokens"][None])
+    mask = jnp.asarray(ex["loss_mask"][None])
+    got = float(cross_entropy_loss(logits, tokens[:, 1:], mask[:, 1:]))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = np.take_along_axis(np.asarray(logp),
+                                np.asarray(tokens[:, 1:, None]), 2)[0, :, 0]
+    m = np.asarray(mask[0, 1:])
+    np.testing.assert_allclose(got, -(picked * m).sum() / m.sum(), rtol=1e-5)
+
+
+def test_instruction_source_overlong_prompt_zero_mask():
+    from tony_tpu.data import InstructionSource
+    from tony_tpu.data.tokenize import ByteTokenizer
+
+    pairs = [{"prompt": "abcdefghij", "completion": "z"}]
+    src = InstructionSource(pairs, ByteTokenizer(), seq_len=6)
+    ex = src[0]
+    assert ex["loss_mask"].sum() == 0  # nothing to train on, no crash
+    assert ex["tokens"].shape == (6,)

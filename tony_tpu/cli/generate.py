@@ -131,34 +131,34 @@ def main(argv=None) -> int:
     # per-prompt so a (prompt, --seed) pair reproduces the same text
     # regardless of what else is in the invocation; beam search's batch
     # dim is the beam.
-    batchable = args.num_beams <= 1 and args.temperature == 0.0
     outputs: dict[int, list[int]] = {}
-    by_len: dict[int, list[int]] = {}
-    for pos, ids in enumerate(prompts):
-        key = len(ids) if batchable else pos
-        by_len.setdefault(key, []).append(pos)
-    max_group = 32  # bounds the batched KV-cache footprint for bulk evals
-    for whole in by_len.values():
-        for start in range(0, len(whole), max_group):
-            group = whole[start:start + max_group]
-            if args.num_beams > 1:
-                for pos in group:
-                    out = beam_search(model, params["params"],
-                                      jnp.asarray([prompts[pos]], jnp.int32),
-                                      max_new_tokens=args.max_new_tokens,
-                                      num_beams=args.num_beams, eos_id=eos)
-                    outputs[pos] = np.asarray(out)[0].tolist()
-                continue
-            prompt_arr = jnp.asarray([prompts[pos] for pos in group],
-                                     jnp.int32)
-            out = generate(model, params["params"], prompt_arr,
-                           max_new_tokens=args.max_new_tokens,
-                           temperature=args.temperature, top_k=args.top_k,
-                           top_p=args.top_p, eos_id=eos,
-                           repetition_penalty=args.repetition_penalty,
-                           rng=jax.random.PRNGKey(args.seed))
-            for row, pos in enumerate(group):
-                outputs[pos] = np.asarray(out)[row].tolist()
+    if args.num_beams > 1:  # beam search's batch dim IS the beam
+        for pos, ids in enumerate(prompts):
+            out = beam_search(model, params["params"],
+                              jnp.asarray([ids], jnp.int32),
+                              max_new_tokens=args.max_new_tokens,
+                              num_beams=args.num_beams, eos_id=eos)
+            outputs[pos] = np.asarray(out)[0].tolist()
+    else:
+        batchable = args.temperature == 0.0
+        by_len: dict[int, list[int]] = {}
+        for pos, ids in enumerate(prompts):
+            by_len.setdefault(len(ids) if batchable else pos, []).append(pos)
+        max_group = 32  # bounds the batched KV-cache footprint
+        for whole in by_len.values():
+            for start in range(0, len(whole), max_group):
+                group = whole[start:start + max_group]
+                prompt_arr = jnp.asarray(
+                    [prompts[pos] for pos in group], jnp.int32)
+                out = generate(model, params["params"], prompt_arr,
+                               max_new_tokens=args.max_new_tokens,
+                               temperature=args.temperature,
+                               top_k=args.top_k,
+                               top_p=args.top_p, eos_id=eos,
+                               repetition_penalty=args.repetition_penalty,
+                               rng=jax.random.PRNGKey(args.seed))
+                for row, pos in enumerate(group):
+                    outputs[pos] = np.asarray(out)[row].tolist()
     for pos, ids in enumerate(prompts):  # print in input order
         new_ids = outputs[pos]
         stops = [i for i, t in enumerate(new_ids) if t in eos]

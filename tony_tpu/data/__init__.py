@@ -2,6 +2,7 @@ from tony_tpu.data.loader import DataLoader, device_prefetch
 from tony_tpu.data.sources import (
     ArraySource,
     MixtureSource,
+    InstructionSource,
     JsonlSource,
     PackedTokenSource,
     SyntheticImageSource,
@@ -20,6 +21,7 @@ __all__ = [
     "device_prefetch",
     "encode_corpus_to_bin",
     "encode_files_to_bin",
+    "InstructionSource",
     "JsonlSource",
     "MixtureSource",
     "PackedTokenSource",

@@ -130,6 +130,60 @@ class JsonlSource(Source):
         return out
 
 
+class InstructionSource(Source):
+    """Supervised fine-tuning examples: prompt/completion pairs ->
+    fixed-length ``{"tokens", "loss_mask"}`` where the mask is 1 ONLY on
+    completion (+eos) token positions — prompts and padding contribute
+    nothing to the objective. The standard SFT recipe wired to the
+    in-tree loss::
+
+        loss = cross_entropy_loss(logits[:, :-1], batch["tokens"][:, 1:],
+                                  mask=batch["loss_mask"][:, 1:])
+
+    (``loss_mask[t]`` marks token t as a PREDICTION TARGET; shifting by
+    one aligns it with the teacher-forced logits, exactly like the
+    packed-corpus segment masking.)
+
+    ``pairs`` is any Source/sequence of dicts carrying text under
+    ``prompt_key``/``completion_key`` (e.g. a ``JsonlSource`` over an
+    instruction dataset). ``tokenizer`` is any object with ``encode()``
+    (the in-tree ``ByteTokenizer`` works fully offline). Tokenization is
+    lazy per example — nothing is materialized up front. Examples whose
+    prompt alone fills ``seq_len`` yield an all-zero mask (0 loss), not
+    an error: bulk datasets carry a tail of overlong rows.
+    """
+
+    def __init__(self, pairs, tokenizer, seq_len: int, *,
+                 prompt_key: str = "prompt",
+                 completion_key: str = "completion",
+                 eos_id: int | None = None, pad_id: int = 0):
+        if seq_len < 2:
+            raise ValueError("seq_len must be >= 2 (one target at least)")
+        self.pairs = pairs
+        self.tokenizer = tokenizer
+        self.seq_len = seq_len
+        self.prompt_key = prompt_key
+        self.completion_key = completion_key
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+
+    def __len__(self) -> int:
+        return len(self.pairs)
+
+    def __getitem__(self, idx: int) -> Mapping[str, np.ndarray]:
+        row = self.pairs[idx]
+        prompt = self.tokenizer.encode(str(row[self.prompt_key]))
+        completion = self.tokenizer.encode(str(row[self.completion_key]))
+        if self.eos_id is not None:
+            completion = completion + [self.eos_id]
+        tokens = np.full((self.seq_len,), self.pad_id, np.int32)
+        mask = np.zeros((self.seq_len,), np.float32)
+        ids = (prompt + completion)[:self.seq_len]
+        tokens[:len(ids)] = ids
+        mask[len(prompt):len(ids)] = 1.0  # completion positions only
+        return {"tokens": tokens, "loss_mask": mask}
+
+
 class PackedTokenSource(Source):
     """Flat binary token stream (np.memmap) sliced into fixed-length
     windows — the standard packed-pretraining format (one giant .bin of
