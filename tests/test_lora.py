@@ -1,0 +1,153 @@
+"""LoRA fine-tuning (train/lora.py): adapter init/merge math, Trainer
+integration with frozen base params, and serving after materialization."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tony_tpu.models import Transformer, TransformerConfig, generate
+from tony_tpu.parallel import data_parallel_mesh
+from tony_tpu.parallel.sharding import batch_sharding
+from tony_tpu.train import (
+    Trainer,
+    cross_entropy_loss,
+    lora_init,
+    lora_param_count,
+    materialize_lora,
+    merge_lora,
+    wrap_apply_fn,
+)
+
+
+@pytest.fixture(scope="module")
+def base():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_seq_len=16, dtype=jnp.float32,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def test_zero_init_is_exact_base(base):
+    """B starts at zero, so step-0 LoRA output == base model output
+    bit-for-bit — the property that makes LoRA a safe warm start."""
+    model, params = base
+    lora = lora_init(jax.random.PRNGKey(1), params, rank=4)
+    merged = merge_lora(params, lora)
+    tokens = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(model.apply(params, tokens)),
+        np.asarray(model.apply(merged, tokens)))
+
+
+def test_targets_and_shapes(base):
+    """Default targets adapt q/v kernels only (incl. the multi-dim
+    DenseGeneral output [d, heads, dh] / GQA kv shape), nothing else."""
+    _, params = base
+    lora = lora_init(jax.random.PRNGKey(1), params, rank=4)
+    flat = {tuple(p.key for p in path): leaf for path, leaf in
+            jax.tree_util.tree_flatten_with_path(lora)[0]}
+    kinds = {path[-3] for path in flat}  # .../attn/<q|v>/<a|b>... parent
+    assert kinds == {"q", "v"}, kinds
+    blk = lora["params"]["block_0"]["attn"]
+    assert blk["q"]["kernel"]["a"].shape == (32, 4)
+    assert blk["q"]["kernel"]["b"].shape == (4, 4, 8)   # [r, heads, dh]
+    assert blk["v"]["kernel"]["b"].shape == (4, 2, 8)   # GQA kv heads
+    # adapters are tiny next to the model
+    n_model = sum(x.size for x in jax.tree.leaves(params))
+    assert lora_param_count(lora) < 0.1 * n_model
+
+
+def test_merge_math_matches_manual(base):
+    _, params = base
+    lora = lora_init(jax.random.PRNGKey(2), params, rank=3)
+    blk = lora["params"]["block_1"]["attn"]["q"]["kernel"]
+    # make B nonzero so the delta is visible
+    blk["b"] = jnp.ones_like(blk["b"]) * 0.01
+    merged = merge_lora(params, lora, alpha=6.0)
+    w = params["params"]["block_1"]["attn"]["q"]["kernel"]
+    want = w + (6.0 / 3) * jnp.tensordot(blk["a"], blk["b"],
+                                         axes=([1], [0]))
+    np.testing.assert_allclose(
+        np.asarray(merged["params"]["block_1"]["attn"]["q"]["kernel"]),
+        np.asarray(want), rtol=1e-6)
+    # untouched kernels are identical objects' values
+    np.testing.assert_array_equal(
+        np.asarray(merged["params"]["block_1"]["attn"]["k"]["kernel"]),
+        np.asarray(params["params"]["block_1"]["attn"]["k"]["kernel"]))
+
+
+def test_lora_rejects_no_match(base):
+    _, params = base
+    with pytest.raises(ValueError, match="no kernels matched"):
+        lora_init(jax.random.PRNGKey(0), params, targets=("nope",))
+
+
+def test_lora_training_and_serving(base):
+    """End-to-end: Trainer optimizes ONLY the adapters (optimizer state is
+    LoRA-sized), loss falls, and the materialized params serve through
+    generate() while the base stays frozen."""
+    model, params = base
+    mesh = data_parallel_mesh()
+    n_dev = max(1, jax.device_count())
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (n_dev, 8), 0, 64)
+    batch = {"tokens": jax.device_put(tokens, batch_sharding(mesh))}
+
+    def base_apply(p, b):
+        logits = model.apply(p, b["tokens"])
+        return cross_entropy_loss(logits[:, :-1], b["tokens"][:, 1:])
+
+    lora = lora_init(jax.random.PRNGKey(4), params, rank=4)
+    trainer = Trainer(mesh=mesh,
+                      apply_fn=wrap_apply_fn(base_apply, params, alpha=8.0),
+                      optimizer=optax.adam(3e-2), donate=False)
+    state = trainer.init_state(lora)
+    opt_leaves = sum(x.size for x in jax.tree.leaves(state.opt_state)
+                     if hasattr(x, "size"))
+    assert opt_leaves <= 3 * lora_param_count(lora)  # adam moments, LoRA-sized
+
+    step_fn, placed = trainer.build_step(state)
+    losses = []
+    for _ in range(60):
+        placed, metrics = step_fn(placed, batch)
+        losses.append(float(metrics["loss"]))
+    # q/v-only rank-4 adapters over a random base have modest capacity;
+    # a clear monotone-ish drop is the mechanism assertion
+    assert losses[-1] < losses[0] - 0.3, (losses[0], losses[-1])
+
+    served = materialize_lora(params, placed.params, alpha=8.0)
+    out_base = np.asarray(generate(model, params["params"],
+                                   tokens[:1, :4], max_new_tokens=3))
+    out_tuned = np.asarray(generate(model, served["params"],
+                                    tokens[:1, :4], max_new_tokens=3))
+    assert out_tuned.shape == out_base.shape  # serves fine; training moved
+    # the merged weights (logits differ even if argmax happens to agree)
+    lb = model.apply(params, tokens[:1])
+    lt = model.apply(served, tokens[:1])
+    assert not np.allclose(np.asarray(lb), np.asarray(lt))
+
+
+def test_wrap_apply_fn_compute_dtype_casts_base(base):
+    """Mixed precision flows through the wrapper: with
+    compute_dtype=bf16 the merged weights reaching the model are bf16
+    (an fp32 base would silently promote the whole forward)."""
+    model, params = base
+    lora = lora_init(jax.random.PRNGKey(5), params, rank=2)
+    seen = {}
+
+    def base_apply(p, batch):
+        seen["dtype"] = p["params"]["block_0"]["attn"]["q"]["kernel"].dtype
+        return jnp.float32(0.0)
+
+    wrapped = wrap_apply_fn(base_apply, params,
+                            compute_dtype=jnp.bfloat16)
+    wrapped(lora, {})
+    assert seen["dtype"] == jnp.bfloat16
+    # and without the knob the base dtype passes through untouched
+    wrap_apply_fn(base_apply, params)(lora, {})
+    assert seen["dtype"] == jnp.float32

@@ -6,6 +6,13 @@ from tony_tpu.train.checkpoint import (
     scan_latest_step,
 )
 from tony_tpu.train.loop import FitResult, JsonlMetricsLogger, fit
+from tony_tpu.train.lora import (
+    lora_init,
+    lora_param_count,
+    materialize_lora,
+    merge_lora,
+    wrap_apply_fn,
+)
 from tony_tpu.train.trainer import (
     Trainer,
     TrainState,
@@ -14,6 +21,11 @@ from tony_tpu.train.trainer import (
 )
 
 __all__ = [
+    "lora_init",
+    "lora_param_count",
+    "materialize_lora",
+    "merge_lora",
+    "wrap_apply_fn",
     "CheckpointManager",
     "auto_resume",
     "fit",

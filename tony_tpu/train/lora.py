@@ -1,0 +1,138 @@
+"""LoRA fine-tuning: low-rank adapters over the flagship models.
+
+No reference analog (TonY has no model stack). TPU-first design: LoRA
+is implemented FUNCTIONALLY over the params pytree — no module changes,
+no flax surgery. ``lora_init`` builds a small adapter tree mirroring the
+targeted kernels; ``merge_lora`` produces ``W + (alpha/r)·A@B`` inside
+the jitted step, where XLA fuses the rank-r matmul + add into the
+epilogue of the consumer (the adapters are a few MB; the merge costs
+``in·out·r`` FLOPs per target — noise next to the forward pass). The
+frozen base params enter the jitted step as CLOSURE CONSTANTS, which
+keep whatever placement they already have: on a multi-device mesh,
+``jax.device_put`` the base tree to its serving shardings (replicated
+or fsdp) BEFORE wrapping — jit preserves committed shardings of
+constants — and HBM then holds one (sharded) copy of the model plus
+optimizer state only for the adapters, the reason LoRA fits where full
+fine-tuning does not.
+
+Typical wiring (see tests/test_lora.py)::
+
+    lora = lora_init(jax.random.PRNGKey(0), params, rank=8)
+    def apply_fn(lp, batch):                  # TRAINED tree = adapters
+        merged = merge_lora(params, lp, alpha=16.0)
+        return loss_of(model.apply(merged, batch["tokens"]), batch)
+    trainer = Trainer(mesh=mesh, apply_fn=apply_fn, optimizer=optax.adamw(...))
+    ...fit(trainer, lora, loader)             # optimizer state is LoRA-sized
+    serving = materialize_lora(params, trained_lora, alpha=16.0)  # bake in
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# default targets: attention q/v projections — the classic LoRA recipe
+DEFAULT_TARGETS = ("q", "v")
+
+
+def _is_target(path: tuple, targets: Sequence[str]) -> bool:
+    """A leaf is adapted when it is a 2-D+ 'kernel' whose parent module
+    name matches a target (e.g. .../attn/q/kernel)."""
+    names = [getattr(p, "key", None) for p in path]
+    return len(names) >= 2 and names[-1] == "kernel" \
+        and names[-2] in targets
+
+
+def _ab_shapes(shape: tuple, rank: int) -> tuple[tuple, tuple]:
+    """A: [in, r]; B: [r, *out]. DenseGeneral kernels may have multi-dim
+    outputs ([d, heads, dh]) — B carries the full output shape so the
+    merge contracts only the rank axis."""
+    return (shape[0], rank), (rank,) + tuple(shape[1:])
+
+
+def lora_init(rng, params: Any, rank: int = 8,
+              targets: Sequence[str] = DEFAULT_TARGETS) -> Any:
+    """Adapter tree mirroring ``params``: targeted kernels get
+    ``{"a": N(0, 1/r) [in, r], "b": zeros [r, *out]}`` (zero-init B makes
+    step 0 EXACTLY the base model); everything else is absent. Raises if
+    nothing matches — a silent no-op adapter is a footgun."""
+    flat = {}
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    keys = jax.random.split(rng, max(len(leaves), 1))
+    for key, (path, leaf) in zip(keys, leaves):
+        if not _is_target(path, targets) or leaf.ndim < 2:
+            continue
+        a_shape, b_shape = _ab_shapes(leaf.shape, rank)
+        flat[path] = {
+            "a": jax.random.normal(key, a_shape, jnp.float32) / rank,
+            "b": jnp.zeros(b_shape, jnp.float32),
+        }
+    if not flat:
+        raise ValueError(f"no kernels matched LoRA targets {targets!r}")
+    out: dict = {}
+    for path, ab in flat.items():
+        node = out
+        names = [p.key for p in path]
+        for name in names[:-1]:
+            node = node.setdefault(name, {})
+        node[names[-1]] = ab
+    return out
+
+
+def _delta(ab: dict, dtype) -> jnp.ndarray:
+    """(A@B) contracted over the rank axis, shaped like the kernel."""
+    return jnp.tensordot(ab["a"].astype(dtype), ab["b"].astype(dtype),
+                         axes=([1], [0]))
+
+
+def merge_lora(params: Any, lora: Any, alpha: float = 16.0) -> Any:
+    """``W + (alpha/r)·A@B`` for every adapted kernel (r is read off the
+    adapter itself); all other leaves pass through untouched. Safe under
+    jit (pure pytree math)."""
+
+    def walk(p_node, l_node):
+        if isinstance(l_node, dict) and set(l_node) == {"a", "b"} \
+                and not isinstance(p_node, dict):
+            scale = alpha / l_node["a"].shape[-1]
+            return (p_node + scale * _delta(l_node, p_node.dtype)) \
+                .astype(p_node.dtype)
+        if isinstance(l_node, dict):
+            return {k: walk(p_node[k], l_node[k]) if k in l_node else
+                    p_node[k] for k in p_node}
+        return p_node
+
+    return walk(params, lora)
+
+
+def materialize_lora(params: Any, lora: Any, alpha: float = 16.0) -> Any:
+    """One-time bake for serving: identical math to merge_lora, returned
+    as a standalone params tree (feed to generate()/checkpointing)."""
+    return merge_lora(params, lora, alpha=alpha)
+
+
+def lora_param_count(lora: Any) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(lora))
+
+
+def wrap_apply_fn(base_apply: Callable[[Any, Any], Any], params: Any,
+                  alpha: float = 16.0,
+                  compute_dtype: Any = None) -> Callable[[Any, Any], Any]:
+    """Convenience: lift apply_fn(params, batch) into
+    apply_fn(lora, batch) with the base params frozen inside.
+
+    Mixed precision goes HERE, not through ``Trainer.compute_dtype``:
+    the trainer's cast covers only the TRAINED tree (the adapters), so
+    an fp32 base would promote every downstream op back to fp32.
+    ``compute_dtype=jnp.bfloat16`` casts the frozen base's floating
+    leaves once, and the merge then runs in that dtype end to end."""
+    if compute_dtype is not None:
+        params = jax.tree.map(
+            lambda x: x.astype(compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+
+    def apply_fn(lora, batch):
+        return base_apply(merge_lora(params, lora, alpha=alpha), batch)
+
+    return apply_fn
