@@ -1,0 +1,120 @@
+"""LoRA supervised fine-tuning on the full tony-tpu stack:
+JsonlSource -> InstructionSource (prompt-masked loss) -> frozen base +
+low-rank adapters -> fit() -> materialize + greedy-decode the trained
+completions as the final self-check.
+
+No reference analog (tony-examples are MNIST-era). This is the
+post-training face of the framework: the optimizer state is
+adapter-sized, the base stays frozen, and the job script is ~70 lines of
+configuration.
+
+Runs standalone (single process, writes its own toy dataset) or under a
+tony-tpu gang:
+
+    python -m tony_tpu.cli.local --conf_file examples/sft-lora/job.toml
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))  # repo root, for standalone runs
+
+import jax
+import jax.numpy as jnp
+import optax
+
+PAIRS = [{"prompt": "2+2=", "completion": "4"},
+         {"prompt": "3+3=", "completion": "6"},
+         {"prompt": "1+1=", "completion": "2"},
+         {"prompt": "4+4=", "completion": "8"}]
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--global-batch", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=8)
+    p.add_argument("--rank", type=int, default=8)
+    p.add_argument("--alpha", type=float, default=16.0)
+    p.add_argument("--data", default="",
+                   help="instruction jsonl (prompt/completion per line); "
+                        "default: a generated toy arithmetic set")
+    args = p.parse_args()
+
+    from tony_tpu import distributed
+    from tony_tpu.data import (ByteTokenizer, DataLoader, InstructionSource,
+                               JsonlSource)
+    from tony_tpu.models import Transformer, TransformerConfig, generate
+    from tony_tpu.parallel import data_parallel_mesh
+    from tony_tpu.parallel.sharding import batch_sharding
+    from tony_tpu.train import (Trainer, cross_entropy_loss, fit, lora_init,
+                                lora_param_count, materialize_lora,
+                                wrap_apply_fn)
+
+    distributed.initialize()  # no-op outside a gang
+    mesh = data_parallel_mesh()
+
+    data = args.data
+    if not data:
+        work = os.environ.get("TONY_JOB_DIR") or tempfile.mkdtemp(
+            prefix="sft-lora-")
+        # per-task filename: gang workers share the job dir, and a late
+        # writer truncating a file another worker is reading tears lines
+        idx = os.environ.get("TONY_TASK_INDEX", "0")
+        data = os.path.join(work, f"sft-{idx}.jsonl")
+        with open(data, "w") as f:
+            f.write("\n".join(json.dumps(r) for r in PAIRS * 4) + "\n")
+
+    tok = ByteTokenizer()
+    src = InstructionSource(JsonlSource(data), tok, seq_len=args.seq_len,
+                            eos_id=tok.eos_id)
+    loader = DataLoader(src, global_batch_size=args.global_batch, seed=1,
+                        num_epochs=None, sharding=batch_sharding(mesh))
+
+    cfg = TransformerConfig(
+        vocab_size=tok.vocab_size, d_model=64, n_heads=4, n_layers=2,
+        d_ff=128, max_seq_len=args.seq_len, dtype=jnp.float32,
+        attention_backend="reference")
+    model = Transformer(cfg)
+    base = model.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, args.seq_len), jnp.int32))
+
+    def base_apply(params, batch):
+        logits = model.apply(params, batch["tokens"])
+        return cross_entropy_loss(logits[:, :-1], batch["tokens"][:, 1:],
+                                  mask=batch["loss_mask"][:, 1:])
+
+    lora = lora_init(jax.random.PRNGKey(1), base, rank=args.rank,
+                     targets=("q", "v", "o", "wi", "wo"))
+    print(f"LoRA adapters: {lora_param_count(lora)} params "
+          f"(base frozen: {sum(x.size for x in jax.tree.leaves(base))})")
+    trainer = Trainer(
+        mesh=mesh,
+        apply_fn=wrap_apply_fn(base_apply, base, alpha=args.alpha),
+        optimizer=optax.adam(1e-2), donate=False)
+    result = fit(trainer, lora, loader, num_steps=args.steps,
+                 log_every=max(args.steps // 4, 1))
+
+    if args.data:
+        return 0  # user datasets have no known answer key to decode against
+    served = materialize_lora(base, result.state.params, alpha=args.alpha)
+    hits = 0
+    for row in PAIRS:
+        out = generate(model, served["params"],
+                       jnp.asarray([tok.encode(row["prompt"])], jnp.int32),
+                       max_new_tokens=1)
+        got = tok.decode([int(out[0, 0])])
+        hits += got == row["completion"]
+        print(f"  {row['prompt']!r} -> {got!r} (want {row['completion']!r})")
+    print(f"learned {hits}/{len(PAIRS)} completions after {args.steps} steps")
+    return 0 if hits >= len(PAIRS) - 1 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

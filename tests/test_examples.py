@@ -101,10 +101,20 @@ def test_examples_run_standalone():
         ("lm-pretrain/pretrain.py", ["--steps", "6", "--global-batch", "8",
                                      "--seq-len", "32", "--vocab", "64",
                                      "--moe"]),
+        ("sft-lora/finetune.py", ["--steps", "120"]),
     ]:
+        entry_env = dict(env)
+        if rel.startswith("sft-lora"):
+            # single device: no virtual mesh -> no CPU collective
+            # rendezvous to stall on this loaded 1-core box. Replace only
+            # the device-count flag; keep any other inherited XLA flags.
+            kept = [f for f in entry_env.get("XLA_FLAGS", "").split()
+                    if "xla_force_host_platform_device_count" not in f]
+            entry_env["XLA_FLAGS"] = " ".join(
+                kept + ["--xla_force_host_platform_device_count=1"])
         proc = subprocess.run(
             [sys.executable, os.path.join(EXAMPLES, rel), *args],
-            env=env, capture_output=True, text=True, timeout=120)
+            env=entry_env, capture_output=True, text=True, timeout=180)
         assert proc.returncode == 0, (rel, proc.stdout, proc.stderr)
 
 
@@ -146,3 +156,20 @@ def test_lm_pretrain_on_raw_text(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=str(tmp_path))
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "tokenized 1 file(s)" in proc.stdout
+
+
+def test_sft_lora_example(cluster):
+    """Post-training flagship: InstructionSource masked loss + frozen base
+    + LoRA adapters; the script's own greedy-decode check is the exit
+    status."""
+    conf = example_conf(
+        cluster, "sft-lora",
+        **{"tony.application.task-params": "--steps 120 --global-batch 8",
+           # single-device worker: CPU collective rendezvous on this
+           # loaded 1-core box times out sporadically; SPMD coverage
+           # lives in the parallel/e2e suites, this test asserts the
+           # SFT+LoRA pipeline
+           "tony.application.shell-env":
+           "XLA_FLAGS=--xla_force_host_platform_device_count=1"})
+    client = cluster.submit(conf)
+    assert client.final_status["status"] == "SUCCEEDED", client.final_status

@@ -131,6 +131,23 @@ class TonyClient:
         elif src_dir:
             z = zip_dir(src_dir, os.path.join(self.job_dir, C.TONY_SRC_ZIP))
             unzip(z, self.job_dir)  # agents exec with cwd=job_dir
+        else:
+            # no staging AT ALL (neither src-dir nor role resources): a
+            # relative `executes` that resolves from the SUBMITTER's cwd
+            # (the `--conf_file examples/x/job.toml` shape) would
+            # otherwise be re-resolved against the task's cwd (the job
+            # dir) and break; pin it to the client-side file. When
+            # anything IS staged, a relative executes names the staged
+            # copy inside the job dir — it must stay relative so the
+            # ssh launcher's shipped/rewritten job dir resolves it.
+            any_resources = any(
+                str(self.conf.role_get(role, "resources"))
+                for role in self.conf.roles())
+            executes = str(self.conf.get("tony.application.executes", ""))
+            if executes and not any_resources and \
+                    not os.path.isabs(executes) and os.path.exists(executes):
+                self.conf.set("tony.application.executes",
+                              os.path.abspath(executes))
         venv = str(self.conf.get("tony.application.python-venv", ""))
         if venv and remotefs.is_remote(venv):
             if venv.endswith(".zip"):
