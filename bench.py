@@ -230,7 +230,11 @@ def bench_resnet(on_tpu: bool) -> dict:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if on_tpu:
-        model, batch, size = ResNet50(num_classes=1000), 128, 224
+        # batch tunable for on-chip experiments; 128 is the known-good
+        # v5e default (r2: 30.7% MFU) — a blind bump could OOM the
+        # headline bench, so bigger batches are opt-in
+        batch = int(os.environ.get("TONY_BENCH_RESNET_BATCH", "128"))
+        model, size = ResNet50(num_classes=1000), 224
         steps, repeats = 100, 5
         compute = jnp.bfloat16
     else:
@@ -353,7 +357,8 @@ def bench_transformer(on_tpu: bool) -> dict:
             vocab_size=32768, d_model=1024, n_layers=28, n_heads=16,
             d_ff=4096, max_seq_len=2048, attention_backend="pallas",
             attention_block_size=512, scan_layers=True, remat=True)
-        batch, seq, steps = 8, 2048, 30
+        batch = int(os.environ.get("TONY_BENCH_LM_BATCH", "8"))
+        seq, steps = 2048, 30
         compute = jnp.bfloat16  # MXU-native; fp32 master params in Trainer
     else:
         cfg = TransformerConfig(
@@ -387,9 +392,17 @@ def bench_transformer(on_tpu: bool) -> dict:
     state = trainer.init_state(fresh(params))
     step_fn, placed = trainer.build_step(state)
     train_batch = {"tokens": jax.device_put(tokens, batch_sharding(mesh))}
-    flops_step = compiled_flops(step_fn, placed, train_batch)
-    if flops_step <= 0:  # backend without cost analysis: 6ND fwd+bwd
-        flops_step = 6.0 * n_params * batch * seq
+    # XLA-executed FLOPs (includes remat recompute); 0 when the backend
+    # reports no cost analysis — mfu_hw is then omitted rather than faked
+    flops_ca = compiled_flops(step_fn, placed, train_batch)
+
+    # MODEL FLOPs (PaLM-style MFU accounting): 6·N per token fwd+bwd for
+    # the dense stack + causal attention matmuls (fwd 4·b·s²·d, bwd 2x,
+    # halved for causality -> 6·b·s²·d·L). The compiled cost analysis is
+    # kept as a diagnostic, but with remat on it counts the RECOMPUTED
+    # forward too and would overstate MFU.
+    flops_model = 6.0 * n_params * batch * seq \
+        + 6.0 * batch * seq * seq * cfg.d_model * cfg.n_layers
 
     def fw_step(carry):
         new_state, metrics = step_fn(carry, train_batch)
@@ -427,16 +440,22 @@ def bench_transformer(on_tpu: bool) -> dict:
     n_chips = max(1, jax.device_count())
     tok_s = batch * seq * steps / t_step
     peak = peak_flops_per_chip() if on_tpu else 0.0
-    mfu = (flops_step * steps / t_step) / (peak * n_chips) if peak else 0.0
+    mfu = (flops_model * steps / t_step) / (peak * n_chips) if peak else 0.0
+    # hardware utilization over EXECUTED flops (incl. remat recompute);
+    # only meaningful when the backend actually reported them
+    mfu_hw = (flops_ca * steps / t_step) / (peak * n_chips) \
+        if peak and flops_ca > 0 else 0.0
     return {
         "tokens_per_sec_per_chip": round(tok_s / n_chips, 1),
         "mfu": round(mfu, 4),
+        "mfu_hw_executed": round(mfu_hw, 4),
+        "model_flops_per_step": flops_model,
         "n_params": n_params,
         "seq_len": seq,
         "config": f"d{cfg.d_model}xL{cfg.n_layers}h{cfg.n_heads}"
                   f"ff{cfg.d_ff} scan={cfg.scan_layers} remat={cfg.remat} "
                   f"attn={cfg.attention_backend}/{cfg.attention_block_size}",
-        "flops_per_step": flops_step,
+        "flops_per_step": flops_ca,
         # ~1.0 = fit() adds nothing over the raw jitted step (metric
         # fetches are async; no sync sits on the step path). <1.0 is
         # measurement noise between the two windows, not real speedup.
