@@ -538,6 +538,19 @@ def bench_decode(on_tpu: bool) -> dict:
 # ------------------------------------------------------ attention kernels
 
 
+def timed_kernel(fn, args, steps: int = 20) -> float:
+    """Kernel A/B harness shared by the attention and quant benches:
+    compile + prime, then time `steps` dispatches closed by a scalar
+    host fetch (the un-fakeable barrier, see timed_round)."""
+    out = fn(*args)  # compile
+    float(jnp.asarray(out).reshape(-1)[0].astype(jnp.float32))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    float(jnp.asarray(out).reshape(-1)[0].astype(jnp.float32))
+    return (time.perf_counter() - t0) / steps
+
+
 def bench_attention(on_tpu: bool) -> dict:
     """Pallas flash vs XLA reference attention, fwd+bwd — the checked-in
     artifact behind PARITY.md's kernel claims. TPU-only: the pallas
@@ -547,14 +560,7 @@ def bench_attention(on_tpu: bool) -> dict:
     from tony_tpu.ops import flash_attention
     from tony_tpu.parallel import reference_attention
 
-    def timed(fn, args, steps=20):
-        out = fn(*args)  # compile
-        float(jnp.asarray(out).reshape(-1)[0])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            out = fn(*args)
-        float(jnp.asarray(out).reshape(-1)[0])
-        return (time.perf_counter() - t0) / steps
+    timed = timed_kernel
 
     def qkv(b, l, h, d, key=0):
         ks = jax.random.split(jax.random.PRNGKey(key), 3)
@@ -594,6 +600,37 @@ def bench_attention(on_tpu: bool) -> dict:
     t_win = timed(fwd_bwd(lambda q, k, v: flash_attention(
         q, k, v, True, 512, 512, window=1024)), args8)
     out["windowed_vs_full_seq8k_w1k"] = round(t_full / t_win, 3)
+    return out
+
+
+def bench_quant(on_tpu: bool) -> dict:
+    """int8 weight-only matmul vs bf16 at decode shapes (ops/quant.py).
+    Decode is HBM-bound, so the int8 kernel's ceiling is ~2x; the
+    measured ratio is the realized fraction of that. TPU-only: the
+    pallas interpreter would measure itself."""
+    if not on_tpu:
+        return {"skipped": "kernel A/B is only meaningful on TPU"}
+    from tony_tpu.ops import q8_matmul, quantize_q8
+
+    m, k, n = 8, 4096, 4096  # decode-step projection shape
+    x = jax.random.normal(jax.random.PRNGKey(0), (m, k), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (k, n), jnp.bfloat16)
+    w_q, scale = quantize_q8(w)
+    bf16_mm = jax.jit(lambda a, b: a @ b)
+
+    t_bf16 = timed_kernel(bf16_mm, (x, w), steps=50)
+    t_q8 = timed_kernel(lambda a, wq, s: q8_matmul(a, wq, s),
+                        (x, w_q, scale), steps=50)
+    out = {
+        "int8_vs_bf16_decode_shape": round(t_bf16 / t_q8, 3),
+        "bf16_us": round(t_bf16 * 1e6, 1),
+        "int8_us": round(t_q8 * 1e6, 1),
+        # achieved weight-byte bandwidth of the int8 kernel (table-free)
+        "int8_achieved_gbps": round(k * n / t_q8 / 1e9, 1),
+    }
+    bw = hbm_bw_per_chip()
+    if bw:
+        out["int8_bw_utilization"] = round(k * n / t_q8 / bw, 4)
     return out
 
 
@@ -749,6 +786,10 @@ def main() -> None:
         extras["decode"] = bench_decode(on_tpu)
     except Exception as e:
         extras["decode"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        extras["quant"] = bench_quant(on_tpu)
+    except Exception as e:
+        extras["quant"] = {"error": f"{type(e).__name__}: {e}"}
     try:
         extras["launch"] = bench_launch()
     except Exception as e:

@@ -1,0 +1,92 @@
+"""Weight-only int8 quantization + pallas dequant-matmul kernel.
+
+Decode roofline (docs/PERF.md): generation is HBM-bound — every token
+re-reads the weights — so storing kernels as int8 with per-output-channel
+scales HALVES the bytes per decode step vs bf16. The pallas kernel
+dequantizes tiles in VMEM right at the MXU: HBM traffic stays int8, the
+matmul runs at full precision, and the scale multiply fuses into the
+output epilogue. A plain ``int8.astype(bf16) * scale`` in jax would be
+hoisted out of the decode scan as a loop invariant and materialize full
+bf16 weights — exactly the traffic the format exists to avoid.
+
+Quantization is symmetric per OUTPUT channel (absmax / 127), the
+standard weight-only recipe: activations stay bf16/fp32, so there is no
+calibration step and no accuracy cliff for serving-sized models.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def quantize_q8(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """w: [in, out] float -> (w_q int8 [in, out], scale fp32 [out]).
+    Symmetric absmax per output channel; dequant is ``w_q * scale``."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    w_q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127) \
+        .astype(jnp.int8)
+    return w_q, scale
+
+
+def dequantize_q8(w_q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    return w_q.astype(jnp.float32) * scale[None, :]
+
+
+def _q8_matmul_kernel(x_ref, w_ref, s_ref, o_ref):
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)  # int8 tile dequant happens IN VMEM
+    acc = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    o_ref[:] = (acc * s_ref[:].astype(jnp.float32)[None, :]) \
+        .astype(o_ref.dtype)
+
+
+def _interp() -> bool:
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except Exception:
+        return True
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n",
+                                             "out_dtype"))
+def q8_matmul(x, w_q, scale, *, block_m: int = 128, block_n: int = 256,
+              out_dtype=None):
+    """x: [m, k] float @ int8 weights [k, n] (+ scale [n]) -> [m, k]·W.
+
+    Grid tiles (m, n); each block reads an int8 [k, bn] weight tile from
+    HBM and dequantizes in VMEM. K is kept whole per block (serving dims
+    k<=8192 fit comfortably: bm·k fp32 + k·bn int8 < VMEM)."""
+    m, k = x.shape
+    k2, n = w_q.shape
+    if k != k2 or scale.shape != (n,):
+        raise ValueError(f"shape mismatch: x{x.shape} w{w_q.shape} "
+                         f"scale{scale.shape}")
+    def fit_block(size: int, want: int) -> int:
+        """Largest divisor of ``size`` <= ``want`` — never fall back to a
+        whole-dimension block (an undivisible LM-head n would otherwise
+        demand a k x n VMEM tile)."""
+        b = min(want, size)
+        while size % b:
+            b -= 1
+        return b
+
+    bm = fit_block(m, block_m)
+    bn = fit_block(n, block_n)
+    out_dtype = out_dtype or x.dtype
+    return pl.pallas_call(
+        _q8_matmul_kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        grid=(m // bm, n // bn),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((bn,), lambda i, j: (j,)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        interpret=_interp(),
+    )(x, w_q, scale)
