@@ -1,0 +1,174 @@
+"""int8 weight-only serving (models/quantize.py + QuantDense).
+
+Correctness anchor: the quantized model must match a full-precision
+forward over the SAME dequantized weights (the kernel adds no error
+beyond quantization itself), across architecture families and the
+KV-cache decode path.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.models import (
+    Transformer,
+    TransformerConfig,
+    generate,
+    quantize_for_serving,
+)
+from tony_tpu.ops.quant import dequantize_q8
+
+
+def _dequant_params(params, reference):
+    """Quantized tree -> fp tree shaped like ``reference``."""
+
+    def walk(node, ref):
+        if isinstance(node, dict) and "kernel_q8" in node:
+            w = np.asarray(dequantize_q8(node["kernel_q8"], node["scale"]))
+            out = {"kernel": jnp.asarray(
+                w.reshape(np.asarray(ref["kernel"]).shape), jnp.float32)}
+            if "bias" in node:
+                out["bias"] = node["bias"]
+            return out
+        if isinstance(node, dict):
+            return {k: walk(v, ref[k]) for k, v in node.items()}
+        return node
+
+    return walk(params, reference)
+
+
+CONFIGS = {
+    "llama_gqa": dict(norm="rms", positional="rope", use_bias=False,
+                      gated_mlp=True, n_kv_heads=2),
+    "gpt2": dict(norm="layer", positional="learned", use_bias=True,
+                 activation="gelu_tanh"),
+    "neox": dict(norm="layer", positional="rope", use_bias=True,
+                 parallel_residual=True, rotary_dims=4),
+}
+
+
+@pytest.mark.parametrize("family", sorted(CONFIGS))
+def test_quantized_forward_matches_dequant_reference(family):
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq_len=16,
+                            dtype=jnp.float32,
+                            attention_backend="reference",
+                            **CONFIGS[family])
+    model = Transformer(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 7), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    qmodel, qparams = quantize_for_serving(model, params)
+    assert qmodel.cfg.quantized
+    got = np.asarray(qmodel.apply(qparams, tokens))
+    ref = np.asarray(model.apply(_dequant_params(qparams, params), tokens))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    # and close to the ORIGINAL fp model (int8 error only)
+    fp = np.asarray(model.apply(params, tokens))
+    assert np.abs(got - fp).mean() / (np.abs(fp).mean() + 1e-9) < 0.05
+
+
+def test_quantized_decode_matches_quantized_forward():
+    """KV-cache decode through QuantDense == the quantized full forward
+    (the serving path generate() drives)."""
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq_len=16,
+                            dtype=jnp.float32,
+                            attention_backend="reference", gated_mlp=True)
+    model = Transformer(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 6), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    qmodel, qparams = quantize_for_serving(model, params)
+    full = np.asarray(qmodel.apply(qparams, tokens))
+    cache = qmodel.init(jax.random.PRNGKey(0), tokens, decode=True)["cache"]
+    steps = []
+    variables = {"params": qparams["params"], "cache": cache}
+    for i in range(tokens.shape[1]):
+        logits, mut = qmodel.apply(variables, tokens[:, i:i + 1],
+                                   decode=True, mutable=["cache"])
+        variables = {"params": qparams["params"], "cache": mut["cache"]}
+        steps.append(np.asarray(logits[:, 0]))
+    np.testing.assert_allclose(np.stack(steps, axis=1), full,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_quantized_generate_runs_greedy():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq_len=16,
+                            dtype=jnp.float32,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+    qmodel, qparams = quantize_for_serving(model, params)
+    out = generate(qmodel, qparams["params"], prompt, max_new_tokens=4)
+    assert out.shape == (1, 4)
+    assert bool(jnp.all((out >= 0) & (out < 64)))
+
+
+def test_quantize_rejects_unsupported_configs():
+    base = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                max_seq_len=16, dtype=jnp.float32)
+    moe = Transformer(TransformerConfig(**base, moe_every=1))
+    with pytest.raises(ValueError, match="MoE"):
+        quantize_for_serving(moe, {})
+    scan = Transformer(TransformerConfig(**base, scan_layers=True))
+    with pytest.raises(ValueError, match="scan_layers"):
+        quantize_for_serving(scan, {})
+
+
+def test_quantized_params_are_half_the_bytes():
+    cfg = TransformerConfig(vocab_size=64, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=128, max_seq_len=16,
+                            dtype=jnp.float32,
+                            attention_backend="reference", gated_mlp=True,
+                            tied_embeddings=True)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))
+    _, qparams = quantize_for_serving(model, params)
+
+    def nbytes(tree):
+        return sum(np.asarray(x).nbytes for x in jax.tree.leaves(tree))
+
+    # dense kernels went fp32 -> int8 (+ tiny scales); embeddings/norms
+    # stay fp32, so the total shrinks by well over 2x for kernel-heavy
+    # trees and the kernels themselves by ~4x
+    assert nbytes(qparams) < 0.5 * nbytes(params)
+
+
+def test_q8_matmul_prime_rows_pads_not_degenerates():
+    """A prime activation row count (batch*prompt_len) must pad to block
+    multiples, not collapse to 1-row blocks."""
+    from tony_tpu.ops import dequantize_q8, q8_matmul, quantize_q8
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((257, 64)), jnp.float32)  # prime m
+    w = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+    w_q, scale = quantize_q8(w)
+    got = np.asarray(q8_matmul(x, w_q, scale, block_m=128))
+    want = np.asarray(x) @ np.asarray(dequantize_q8(w_q, scale))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_quantized_params_replicated_logical_axes():
+    """Quantized leaves get all-None logical axes (replicated) — the fp
+    head/kv rules would shard the flattened kernels wrongly."""
+    from tony_tpu.models.transformer import logical_axis_rules_tree
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=1, d_ff=64, max_seq_len=16,
+                            dtype=jnp.float32,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))
+    _, qparams = quantize_for_serving(model, params)
+    axes = logical_axis_rules_tree(qparams)
+    blk = axes["params"]["block_0"]["attn"]["q"]
+    assert blk["kernel_q8"] == (None, None)
+    assert blk["scale"] == (None,)
+    # fp leaves (embedding) keep their rules
+    assert axes["params"]["embedding"] == ("vocab", "embed")

@@ -44,6 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eos-id", type=int, default=-1,
                    help="stop token (default: model config's eos_token_id)")
+    p.add_argument("--int8", action="store_true",
+                   help="serve with int8 weight-only quantization "
+                        "(pallas dequant-matmul; half the weight bytes "
+                        "per decode step)")
     p.add_argument("--compile-cache",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "tony_tpu", "compile-cache"),
@@ -103,6 +107,13 @@ def main(argv=None) -> int:
     from tony_tpu.models import generate
 
     model, params, config = load_model(args.model)
+    if args.int8:
+        from tony_tpu.models import quantize_for_serving
+
+        try:
+            model, params = quantize_for_serving(model, params)
+        except ValueError as e:
+            raise SystemExit(f"--int8: {e}")
 
     tokenizer = None
     if args.prompt:

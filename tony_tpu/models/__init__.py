@@ -9,6 +9,7 @@ from tony_tpu.models.resnet import (
 from tony_tpu.models.generate import (beam_search, generate, init_cache,
                                       sample_logits)
 from tony_tpu.models.pipeline import pipelined_forward
+from tony_tpu.models.quantize import quantize_for_serving
 from tony_tpu.models.hf import (
     convert_gpt2_state_dict,
     convert_llama_state_dict,
@@ -45,6 +46,7 @@ __all__ = [
     "beam_search",
     "generate",
     "pipelined_forward",
+    "quantize_for_serving",
     "init_cache",
     "sample_logits",
     "ResNet",

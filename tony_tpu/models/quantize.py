@@ -1,0 +1,77 @@
+"""int8 weight-only serving conversion for the flagship transformer.
+
+``quantize_for_serving(model, params)`` rewrites every dense kernel of a
+trained/imported model into the ``{kernel_q8 int8, scale fp32}`` form
+that ``TransformerConfig(quantized=True)``'s QuantDense consumes through
+the pallas dequant-matmul (ops/quant.py) — HALF the weight bytes per
+decode step (docs/PERF.md decode roofline). Embeddings, norms, biases,
+and the LM head stay full precision: they are a small fraction of the
+bytes and dominate quality.
+
+Scope: the dense transformer family (everything models/hf.py imports —
+GPT-2, Llama/Mistral/Qwen2, Gemma, GPT-NeoX). MoE blocks and
+scan-stacked layers are rejected rather than half-converted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from tony_tpu.models.transformer import Transformer, TransformerConfig
+from tony_tpu.ops.quant import quantize_q8
+
+# parent module names whose "kernel" leaf becomes int8
+_DENSE_NAMES = ("q", "k", "v", "o", "wi", "wg", "wo")
+
+
+def _quantize_kernel(kernel, is_o: bool):
+    """kernel [in, *out] (q/k/v/wi/wg/wo) or [*in, out] (o) -> 2-D
+    int8 + per-output-channel scale, matching QuantDense's flatten."""
+    arr = np.asarray(kernel)
+    if is_o:  # o: [heads, dh, d] — leading axes are the INPUT
+        in_flat = arr.shape[0] * arr.shape[1] if arr.ndim == 3 \
+            else arr.shape[0]
+        w2 = arr.reshape(in_flat, arr.shape[-1])
+    else:  # [in, *out]
+        w2 = arr.reshape(arr.shape[0], -1)
+    w_q, scale = quantize_q8(w2)
+    return {"kernel_q8": w_q, "scale": scale}
+
+
+def quantize_transformer_params(params: Any) -> Any:
+    """params pytree (as from model.init / hf import) -> quantized tree.
+    Biases ride along unchanged; every other leaf passes through."""
+
+    def walk(node, name=""):
+        if not isinstance(node, dict):
+            return node
+        if "kernel" in node and name in _DENSE_NAMES:
+            out = _quantize_kernel(node["kernel"], is_o=(name == "o"))
+            if "bias" in node:
+                out["bias"] = node["bias"]
+            extra = set(node) - {"kernel", "bias"}
+            if extra:
+                raise ValueError(f"unexpected leaves under {name}: {extra}")
+            return out
+        return {k: walk(v, k) for k, v in node.items()}
+
+    return walk(params)
+
+
+def quantize_for_serving(model: Transformer, params: Any
+                         ) -> tuple[Transformer, Any]:
+    """(model, params) -> (quantized model, quantized params): the
+    returned pair drops into generate()/score exactly like the original.
+    """
+    cfg = model.cfg
+    if cfg.moe_every:
+        raise ValueError("int8 serving conversion does not cover MoE "
+                         "expert weights yet")
+    if cfg.scan_layers:
+        raise ValueError("int8 serving conversion expects per-block "
+                         "params (scan_layers stacks them)")
+    qcfg = dataclasses.replace(cfg, quantized=True)
+    return Transformer(qcfg), quantize_transformer_params(params)

@@ -73,6 +73,12 @@ class TransformerConfig:
     # from the SAME block input in parallel (x + attn(ln1 x) + mlp(ln2 x))
     rotary_dims: int = 0
     parallel_residual: bool = False
+    # SERVING-ONLY int8 weight-only mode: dense kernels are stored as
+    # {kernel_q8, scale} and run through the pallas dequant-matmul
+    # (ops/quant.py) — use models.quantize.quantize_for_serving to
+    # convert a trained/imported model; training this config is
+    # unsupported (int8 weights have no useful gradients)
+    quantized: bool = False
     # multiply token embeddings by sqrt(d_model), in activation dtype
     # (Gemma's normalizer)
     embed_scale: bool = False
@@ -299,10 +305,14 @@ class Attention(nn.Module):
         b, l, _ = x.shape
         # logical sharding axes for these kernels come from path-name
         # matching in logical_axis_rules_tree, not from annotations here
-        dense = lambda name, feats, bias: nn.DenseGeneral(  # noqa: E731
-            feats, axis=-1, use_bias=bias, dtype=cfg.dtype,
-            param_dtype=jnp.float32, name=name,
-            kernel_init=nn.initializers.normal(0.02))
+        if cfg.quantized:
+            dense = lambda name, feats, bias: QuantDense(  # noqa: E731
+                feats, in_axes=1, use_bias=bias, dtype=cfg.dtype, name=name)
+        else:
+            dense = lambda name, feats, bias: nn.DenseGeneral(  # noqa: E731
+                feats, axis=-1, use_bias=bias, dtype=cfg.dtype,
+                param_dtype=jnp.float32, name=name,
+                kernel_init=nn.initializers.normal(0.02))
         qkv_bias = cfg.use_bias or cfg.qkv_bias
         q = dense("q", (cfg.n_heads, cfg.head_dim), qkv_bias)(x)
         k = dense("k", (cfg.kv_heads, cfg.head_dim), qkv_bias)(x)
@@ -328,10 +338,15 @@ class Attention(nn.Module):
                 k = jnp.repeat(k, group, axis=2)
                 v = jnp.repeat(v, group, axis=2)
             out = _attention(cfg, q, k, v, segment_ids)
-        out = nn.DenseGeneral(
-            cfg.d_model, axis=(-2, -1), use_bias=cfg.use_bias, dtype=cfg.dtype,
-            param_dtype=jnp.float32, name="o",
-            kernel_init=nn.initializers.normal(0.02))(out)
+        if cfg.quantized:
+            out = QuantDense((cfg.d_model,), in_axes=2,
+                             use_bias=cfg.use_bias, dtype=cfg.dtype,
+                             name="o")(out)
+        else:
+            out = nn.DenseGeneral(
+                cfg.d_model, axis=(-2, -1), use_bias=cfg.use_bias,
+                dtype=cfg.dtype, param_dtype=jnp.float32, name="o",
+                kernel_init=nn.initializers.normal(0.02))(out)
         return out
 
     def _decode_attention(self, q, k, v):
@@ -404,16 +419,60 @@ class Attention(nn.Module):
         return out.reshape(b, l, h, dh).astype(q.dtype)
 
 
+class QuantDense(nn.Module):
+    """int8 weight-only dense for SERVING (``cfg.quantized``): parameters
+    are the converter's ``{kernel_q8 int8 [in_flat, out_flat], scale
+    [out_flat], bias?}`` (see ``models.quantize``); the matmul runs
+    through the pallas dequant kernel, so HBM traffic for weights is
+    int8 — the decode-path bandwidth win (docs/PERF.md). Multi-dim
+    in/out axes (head projections) flatten around the 2-D kernel."""
+
+    features: tuple
+    in_axes: int = 1
+    use_bias: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from tony_tpu.ops.quant import q8_matmul
+
+        feats = self.features if isinstance(self.features, tuple) \
+            else (self.features,)
+        in_flat = 1
+        for s in x.shape[-self.in_axes:]:
+            in_flat *= s
+        out_flat = 1
+        for s in feats:
+            out_flat *= s
+        w_q = self.param("kernel_q8", nn.initializers.zeros,
+                         (in_flat, out_flat), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones, (out_flat,),
+                           jnp.float32)
+        lead = x.shape[:-self.in_axes]
+        y = q8_matmul(x.reshape(-1, in_flat).astype(self.dtype), w_q,
+                      scale, out_dtype=self.dtype)
+        y = y.reshape(*lead, *feats)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, feats,
+                              jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
 class MLP(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        dense = lambda name, feats: nn.Dense(  # noqa: E731
-            feats, use_bias=cfg.use_bias, dtype=cfg.dtype,
-            param_dtype=jnp.float32, name=name,
-            kernel_init=nn.initializers.normal(0.02))
+        if cfg.quantized:
+            dense = lambda name, feats: QuantDense(  # noqa: E731
+                (feats,), use_bias=cfg.use_bias, dtype=cfg.dtype, name=name)
+        else:
+            dense = lambda name, feats: nn.Dense(  # noqa: E731
+                feats, use_bias=cfg.use_bias, dtype=cfg.dtype,
+                param_dtype=jnp.float32, name=name,
+                kernel_init=nn.initializers.normal(0.02))
         h = _activation(cfg)(dense("wi" if not cfg.gated_mlp else "wg",
                                    cfg.d_ff)(x))
         if cfg.gated_mlp:
@@ -647,6 +706,13 @@ def logical_axis_rules_tree(params: Any) -> Any:
         off = 1 if is_stacked(joined) else 0
         leaf_dims = x.ndim - off
         base: tuple
+        if "kernel_q8" in joined or joined.endswith("/scale"):
+            # quantized serving leaves: flattened [in_flat, out_flat]
+            # kernels don't match the fp rules' head/kv semantics —
+            # replicate rather than shard them wrongly (int8 serving is
+            # single-chip today; tp sharding of q8 weights is future work)
+            return ("layers",) + (None,) * leaf_dims if off \
+                else (None,) * leaf_dims
         if joined.endswith("/bias"):
             base = bias_axes(joined, x, off, leaf_dims)
         elif "pos_embedding" in joined:

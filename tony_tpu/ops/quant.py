@@ -66,22 +66,30 @@ def q8_matmul(x, w_q, scale, *, block_m: int = 128, block_n: int = 256,
     if k != k2 or scale.shape != (n,):
         raise ValueError(f"shape mismatch: x{x.shape} w{w_q.shape} "
                          f"scale{scale.shape}")
-    def fit_block(size: int, want: int) -> int:
-        """Largest divisor of ``size`` <= ``want`` — never fall back to a
-        whole-dimension block (an undivisible LM-head n would otherwise
-        demand a k x n VMEM tile)."""
-        b = min(want, size)
-        while size % b:
-            b -= 1
-        return b
-
-    bm = fit_block(m, block_m)
-    bn = fit_block(n, block_n)
     out_dtype = out_dtype or x.dtype
-    return pl.pallas_call(
+    # n (a WEIGHT dim): largest divisor <= block_n — padding weights per
+    # call would re-copy k*n bytes and forfeit the bandwidth win. Dense
+    # dims are MXU-sized in practice; if only a tiny divisor exists the
+    # kernel would degenerate (per-column dispatches), so fall back to
+    # the XLA dequant matmul — correct, merely without the int8 traffic
+    # saving for that pathological shape.
+    bn = min(block_n, n)
+    while n % bn:
+        bn -= 1
+    if bn < 64 and n > 64:
+        return (jnp.dot(x.astype(jnp.float32), dequantize_q8(w_q, scale))
+                ).astype(out_dtype)
+    # m (the ACTIVATION dim): pad rows up to a block multiple and slice —
+    # cheap (activations are small), and it avoids the prime-length
+    # cliff where a divisor search would collapse to 1-row blocks that
+    # each re-read the whole weight tile.
+    bm = min(block_m, m)
+    m_pad = -(-m // bm) * bm
+    x_in = x if m_pad == m else jnp.pad(x, ((0, m_pad - m), (0, 0)))
+    out = pl.pallas_call(
         _q8_matmul_kernel,
-        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
-        grid=(m // bm, n // bn),
+        out_shape=jax.ShapeDtypeStruct((m_pad, n), out_dtype),
+        grid=(m_pad // bm, n // bn),
         in_specs=[
             pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
             pl.BlockSpec((k, bn), lambda i, j: (0, j)),
@@ -89,4 +97,5 @@ def q8_matmul(x, w_q, scale, *, block_m: int = 128, block_n: int = 256,
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
         interpret=_interp(),
-    )(x, w_q, scale)
+    )(x_in, w_q, scale)
+    return out if m_pad == m else out[:m]
