@@ -2,25 +2,35 @@
 
   {"metric", "value", "unit", "vs_baseline", "extras": {...}}
 
-Three measurements (BASELINE.md rows 2-3 + VERDICT r1 next-steps 2-4):
+Measurements (BASELINE.md rows 2-3 + VERDICT next-steps, r1-r3):
 
 1. ResNet-50 images/sec/chip, tony-tpu Trainer vs the STRONGEST native
    JAX step (donated buffers, threaded state, matching bf16 compute,
    >=100 timed steps on TPU). vs_baseline = native_time / framework_time
-   (>= 0.9 meets the north star). MFU is computed from XLA's compiled
-   cost analysis against the chip's peak bf16 FLOP/s — the
-   hardware-truth line the ratio alone can't give.
+   (>= 0.9 meets the north star).
 
-2. Flagship transformer (GPT-2-small-class decoder: pallas flash
-   attention, bf16 compute, chunked CE) tokens/sec/chip + MFU through
-   Trainer.build_step, and the same step through train.fit to show loop
-   overhead ~= 0.
+2. Flagship transformer (386M decoder, seq 2048: pallas flash attention,
+   scan_layers + remat, bf16 compute, chunked CE) tokens/sec/chip +
+   PaLM-style model-FLOPs MFU through Trainer.build_step (docs/PERF.md
+   roofline), and the same step through train.fit to show loop overhead
+   ~= 0 (async metric sinks: no sync on the step path).
 
-3. Launch -> first-step latency through the REAL submit path
+3. Kernel A/Bs (TPU-only): pallas flash vs XLA attention fwd+bwd with a
+   measured block-size sweep; banded sliding-window vs full causal; int8
+   weight-only dequant-matmul vs bf16 at decode shapes.
+
+4. KV-cache decode throughput + HBM-bandwidth utilization (prefill
+   subtracted) — the serving-path roofline.
+
+5. Launch -> first-step latency through the REAL submit path
    (TonyClient -> coordinator -> agent -> payload jit step) on the mini
-   cluster, with submit->coordinator-up / ->task-start breakdowns
-   (reference cadence analogs: client poll 1 s TonyClient.java:1035, AM
-   monitor 5 s ApplicationMaster.java:711).
+   cluster, cold AND warm (persistent compile cache) — reference cadence
+   analogs: client poll 1 s TonyClient.java:1035, AM monitor 5 s
+   ApplicationMaster.java:711.
+
+Resilience: the platform probe retries with backoff; a CPU fallback
+embeds the last-known-good on-chip artifact (BENCH_LKG_TPU.json) and
+re-execs onto TPU if the tunnel recovers by the end of the run.
 
 Off-TPU (CI boxes) every piece shrinks so the line still prints quickly.
 """
