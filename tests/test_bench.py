@@ -1,0 +1,134 @@
+"""bench.py resilience machinery (VERDICT r2 #1a): probe retry/backoff,
+last-known-good persistence, and the TPU re-exec guards. The driver's
+end-of-round artifact depends on these paths running unattended."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_platform_explicit_cpu_request_skips_probe(bench, monkeypatch):
+    # _platform() reads the env var directly (module-global
+    # _env_platforms only gates import-time config + the reexec path)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    calls = []
+    monkeypatch.setattr(bench, "_probe_platform",
+                        lambda t: calls.append(t) or "tpu")
+    assert bench._platform() == "cpu"
+    assert calls == []  # no probe, no tunnel dial
+
+
+def test_platform_retries_with_backoff_then_pins_cpu(bench, monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("TONY_BENCH_PROBE_RETRIES", "3")
+    sleeps, probes = [], []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: sleeps.append(s))
+    monkeypatch.setattr(bench, "_probe_platform",
+                        lambda t: probes.append(t) or "")
+    assert bench._platform() == "cpu"
+    assert len(probes) == 3
+    assert sleeps == [20.0, 60.0]  # backoff BETWEEN attempts
+    assert os.environ["JAX_PLATFORMS"] == "cpu"  # pinned for the run
+
+
+def test_platform_recovers_on_second_probe(bench, monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    results = iter(["", "axon"])
+    monkeypatch.setattr(bench, "_probe_platform",
+                        lambda t: next(results))
+    assert bench._platform() == "axon"
+
+
+def test_lkg_roundtrip(bench, monkeypatch, tmp_path):
+    monkeypatch.setattr(bench, "LKG_PATH", str(tmp_path / "lkg.json"))
+    line = {"metric": "m", "value": 1.0, "extras": {"platform": "axon"}}
+    bench.save_lkg(line)
+    doc = bench.load_lkg()
+    assert doc["line"] == line
+    assert doc["source"] == "bench.py on-chip run"
+    assert doc["timestamp"] and "commit" in doc
+    # corrupt file -> None, never an exception into the bench
+    (tmp_path / "lkg.json").write_text("{broken")
+    assert bench.load_lkg() is None
+
+
+def test_reexec_skips_when_probe_says_cpu(bench, monkeypatch):
+    """A 'cpu' probe result is NOT a tunnel recovery: no child re-run."""
+    monkeypatch.setattr(bench, "_env_platforms", "")
+    monkeypatch.delenv("TONY_BENCH_NO_REEXEC", raising=False)
+    monkeypatch.setattr(bench, "_probe_platform", lambda t: "cpu")
+    ran = []
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: ran.append(a))
+    line = {"metric": "x"}
+    assert bench._maybe_reexec_on_tpu(line) is line
+    assert ran == []
+
+
+def test_reexec_rejects_child_that_fell_back_to_cpu(bench, monkeypatch):
+    """Tunnel flaps mid-child: a cpu-platform child line must not ship
+    with TPU provenance — the parent keeps its own line."""
+    monkeypatch.setattr(bench, "_env_platforms", "")
+    monkeypatch.delenv("TONY_BENCH_NO_REEXEC", raising=False)
+    monkeypatch.setattr(bench, "_probe_platform", lambda t: "axon")
+
+    class Child:
+        returncode = 0
+        stdout = json.dumps({"metric": "resnet_cpu_proxy",
+                             "extras": {"platform": "cpu"}})
+
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: Child())
+    line = {"metric": "x"}
+    assert bench._maybe_reexec_on_tpu(line) is line
+
+
+def test_reexec_adopts_tpu_child_line(bench, monkeypatch):
+    monkeypatch.setattr(bench, "_env_platforms", "")
+    monkeypatch.delenv("TONY_BENCH_NO_REEXEC", raising=False)
+    monkeypatch.setattr(bench, "_probe_platform", lambda t: "axon")
+    child_line = {"metric": "resnet", "extras": {"platform": "axon"}}
+
+    class Child:
+        returncode = 0
+        stdout = "noise\n" + json.dumps(child_line)
+
+    captured = {}
+
+    def fake_run(argv, **kw):
+        captured["env"] = kw["env"]
+        return Child()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    got = bench._maybe_reexec_on_tpu({"metric": "cpu_line"})
+    assert got["metric"] == "resnet"
+    assert "reexec" in got["extras"]
+    # the child must not inherit the parent's CPU pin, and must not
+    # recurse into a third process
+    assert "JAX_PLATFORMS" not in captured["env"]
+    assert captured["env"]["TONY_BENCH_NO_REEXEC"] == "1"
+
+
+def test_reexec_guard_blocks_recursion(bench, monkeypatch):
+    monkeypatch.setattr(bench, "_env_platforms", "")
+    monkeypatch.setenv("TONY_BENCH_NO_REEXEC", "1")
+    probes = []
+    monkeypatch.setattr(bench, "_probe_platform",
+                        lambda t: probes.append(t) or "axon")
+    line = {"metric": "x"}
+    assert bench._maybe_reexec_on_tpu(line) is line
+    assert probes == []
