@@ -87,7 +87,10 @@ def _platform() -> str:
         # would ignore it anyway: sitecustomize re-pins jax_platforms at
         # interpreter startup, dialing the tunnel regardless)
         return env_p.split(",")[0]
-    tries = max(1, int(os.environ.get("TONY_BENCH_PROBE_RETRIES", "3")))
+    # default worst case = 2 x 150s probes + 20s backoff ~= 320s, close
+    # to the r2-proven single 240s probe: a down tunnel must not balloon
+    # the driver's bench run past its patience (knobs raise it)
+    tries = max(1, int(os.environ.get("TONY_BENCH_PROBE_RETRIES", "2")))
     timeout = float(os.environ.get("TONY_BENCH_PROBE_TIMEOUT", "150"))
     backoff = (20.0, 60.0)  # between attempts; the probe itself waits too
     for attempt in range(tries):
