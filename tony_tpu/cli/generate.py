@@ -108,12 +108,9 @@ def main(argv=None) -> int:
 
     model, params, config = load_model(args.model)
     if args.int8:
-        from tony_tpu.models import quantize_for_serving
+        from tony_tpu.models.quantize import quantize_cli
 
-        try:
-            model, params = quantize_for_serving(model, params)
-        except ValueError as e:
-            raise SystemExit(f"--int8: {e}")
+        model, params = quantize_cli(model, params)
 
     tokenizer = None
     if args.prompt:

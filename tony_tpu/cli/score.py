@@ -40,6 +40,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-len", type=int, default=0,
                    help="truncate inputs to this many tokens "
                         "(default: the model's max_seq_len)")
+    p.add_argument("--int8", action="store_true",
+                   help="score with int8 weight-only quantization (the "
+                        "serving config; measures the quality cost of "
+                        "--int8 generation)")
     return p
 
 
@@ -97,6 +101,10 @@ def main(argv=None) -> int:
         with open(path, encoding="utf-8") as f:
             texts.append(f.read())
     model, params, config = load_model(args.model)
+    if args.int8:
+        from tony_tpu.models.quantize import quantize_cli
+
+        model, params = quantize_cli(model, params)
     if texts:
         import transformers
 

@@ -75,3 +75,12 @@ def quantize_for_serving(model: Transformer, params: Any
                          "params (scan_layers stacks them)")
     qcfg = dataclasses.replace(cfg, quantized=True)
     return Transformer(qcfg), quantize_transformer_params(params)
+
+
+def quantize_cli(model, params):
+    """CLI-facing wrapper: unsupported configs exit with a clean message
+    instead of a traceback (shared by the generate and score CLIs)."""
+    try:
+        return quantize_for_serving(model, params)
+    except ValueError as e:
+        raise SystemExit(f"--int8: {e}")
