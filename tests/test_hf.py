@@ -737,3 +737,87 @@ def test_neox_rejects_biasless_and_exotic_rope():
     with pytest.raises(ValueError, match="rope_scaling"):
         neox_config(transformers.GPTNeoXConfig(
             **base, rope_scaling={"rope_type": "yarn", "factor": 2.0}))
+
+
+# -- Phi family --------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def phi_pair():
+    from tony_tpu.models.hf import from_hf_phi
+
+    config = transformers.PhiConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=64,
+        partial_rotary_factor=0.5, tie_word_embeddings=False,
+        attention_dropout=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = transformers.PhiForCausalLM(config).eval()
+    model, params = from_hf_phi(hf)
+    return hf, model, params
+
+
+def test_phi_config_mapping(phi_pair):
+    _, model, _ = phi_pair
+    cfg = model.cfg
+    assert cfg.norm == "layer" and cfg.parallel_residual
+    assert cfg.rotary_dims == 6  # 0.5 * head_dim 12
+    assert cfg.use_bias and cfg.lm_head_bias and not cfg.tied_embeddings
+
+
+def test_phi_logits_parity(phi_pair):
+    """Shared-norm parallel residual (ln1 duplicated into ln2) + partial
+    rotary + biased lm_head, exact vs torch PhiForCausalLM."""
+    hf, model, params = phi_pair
+    tokens = np.random.default_rng(11).integers(0, 96, (2, 13))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_phi_decode_parity(phi_pair):
+    hf, model, params = phi_pair
+    tokens = np.random.default_rng(12).integers(0, 96, (1, 8))
+    full = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    cache = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens),
+                       decode=True)["cache"]
+    steps = []
+    for i in range(tokens.shape[1]):
+        logits, mut = model.apply(
+            {"params": params["params"], "cache": cache},
+            jnp.asarray(tokens[:, i:i + 1]), decode=True, mutable=["cache"])
+        cache = mut["cache"]
+        steps.append(np.asarray(logits[:, 0]))
+    np.testing.assert_allclose(np.stack(steps, axis=1), full,
+                               atol=1e-3, rtol=1e-3)
+
+
+def test_phi_importer_rejects_unmapped(phi_pair):
+    from tony_tpu.models.hf import convert_phi_state_dict, phi_config
+
+    hf, _, _ = phi_pair
+    sd = dict(hf.state_dict())
+    sd["model.layers.0.mlp.fc9.weight"] = torch.zeros(2, 2)
+    with pytest.raises(ValueError, match="does not map"):
+        convert_phi_state_dict(sd, phi_config(hf.config))
+
+
+def test_lm_head_bias_param_exists_in_hidden_mode():
+    """init(return_hidden=True) must yield the FULL param set for a
+    lm_head_bias config — a tree missing the bias would fail normal
+    logits-mode apply later (the chunked-CE training -> eval handoff)."""
+    from tony_tpu.models import Transformer, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=32, d_model=16, n_heads=2,
+                            n_layers=1, d_ff=32, max_seq_len=8,
+                            dtype=jnp.float32,
+                            attention_backend="reference",
+                            tied_embeddings=False, lm_head_bias=True)
+    m = Transformer(cfg)
+    t = jnp.zeros((1, 4), jnp.int32)
+    p = m.init(jax.random.PRNGKey(0), t, return_hidden=True)
+    assert "lm_head_bias" in p["params"]
+    assert m.apply(p, t).shape == (1, 4, 32)

@@ -67,6 +67,7 @@ def load_model(model_dir: str):
         from_hf_llama,
         from_hf_mixtral,
         from_hf_neox,
+        from_hf_phi,
     )
 
     config = transformers.AutoConfig.from_pretrained(model_dir)
@@ -81,11 +82,13 @@ def load_model(model_dir: str):
         model, params = from_hf_mixtral(hf)
     elif config.model_type == "gpt_neox":
         model, params = from_hf_neox(hf)
+    elif config.model_type == "phi":
+        model, params = from_hf_phi(hf)
     else:
         raise SystemExit(
             f"unsupported model_type {config.model_type!r} "
             "(supported: gpt2, llama, mistral, qwen2, gemma, mixtral, "
-            "gpt_neox)")
+            "gpt_neox, phi)")
     return model, params, config
 
 

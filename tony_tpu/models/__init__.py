@@ -18,6 +18,7 @@ from tony_tpu.models.hf import (
     from_hf_llama,
     from_hf_mixtral,
     from_hf_neox,
+    from_hf_phi,
     gemma_config,
     gpt2_config,
     llama_config,
@@ -39,6 +40,7 @@ __all__ = [
     "from_hf_llama",
     "from_hf_mixtral",
     "from_hf_neox",
+    "from_hf_phi",
     "gemma_config",
     "gpt2_config",
     "llama_config",

@@ -501,6 +501,118 @@ def from_hf_neox(model) -> tuple[Transformer, Any]:
     return Transformer(cfg), params
 
 
+def phi_config(hf_config, **overrides) -> TransformerConfig:
+    """TransformerConfig matching a transformers PhiConfig (Phi-1/1.5/2):
+    LayerNorm + partial rotary (``partial_rotary_factor``) + biased dense
+    everywhere + parallel residual where BOTH branches read the SAME
+    input LayerNorm, + an untied lm_head WITH bias. The shared norm maps
+    onto this model's two-norm parallel block by duplicating the weights
+    into ln2 (identical input -> identical math)."""
+    act = getattr(hf_config, "hidden_act", "gelu_new")
+    if act not in _HF_ACTIVATIONS:
+        raise ValueError(f"unsupported Phi hidden_act {act!r}; "
+                         f"supported: {sorted(_HF_ACTIVATIONS)}")
+    head_dim = hf_config.hidden_size // hf_config.num_attention_heads
+    rotary_dims = int(head_dim * getattr(hf_config, "partial_rotary_factor",
+                                         0.5))
+    if rotary_dims % 2:
+        raise ValueError(
+            f"partial_rotary_factor x head_dim = {rotary_dims} is odd; "
+            "partial rotary needs an even rotary width")
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+        n_layers=hf_config.num_hidden_layers,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        dtype=jnp.float32,
+        attention_backend="reference",
+        norm="layer",
+        positional="rope",
+        use_bias=True,
+        activation=_HF_ACTIVATIONS[act],
+        norm_eps=hf_config.layer_norm_eps,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10_000.0)),
+        rope_scaling=_rope_scaling(hf_config),
+        rotary_dims=0 if rotary_dims >= head_dim else rotary_dims,
+        parallel_residual=True,
+        tied_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        lm_head_bias=True,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def convert_phi_state_dict(state_dict: dict, cfg: TransformerConfig) -> Any:
+    """torch Phi state_dict -> tony-tpu params. Llama-style per-layer
+    names but LayerNorm (weight+bias), biased q/k/v/dense/fc1/fc2, a
+    single input_layernorm duplicated into ln1+ln2 (shared-norm parallel
+    residual), and a biased untied lm_head."""
+    d, h, dh, kvh = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.kv_heads
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    consumed = {"embed_tokens.weight", "final_layernorm.weight",
+                "final_layernorm.bias", "lm_head.weight", "lm_head.bias"}
+    for i in range(cfg.n_layers):
+        consumed |= {f"layers.{i}.{s}.{wb}" for wb in ("weight", "bias")
+                     for s in ("input_layernorm", "self_attn.q_proj",
+                               "self_attn.k_proj", "self_attn.v_proj",
+                               "self_attn.dense", "mlp.fc1", "mlp.fc2")}
+    leftover = {k for k in sd if k not in consumed
+                and not k.endswith("inv_freq")}
+    if leftover:
+        raise ValueError(
+            f"state_dict has tensors the Phi importer does not map "
+            f"(not a plain-Phi architecture?): {sorted(leftover)[:8]}")
+    params: dict[str, Any] = {
+        "embedding": _np(sd["embed_tokens.weight"]),
+        "ln_f": {"scale": _np(sd["final_layernorm.weight"]),
+                 "bias": _np(sd["final_layernorm.bias"])},
+        "lm_head": _np(sd["lm_head.weight"]),
+        "lm_head_bias": _np(sd["lm_head.bias"]),
+    }
+    for i in range(cfg.n_layers):
+        pre = f"layers.{i}."
+        proj = lambda name: _np(sd[pre + name + ".weight"]).T  # noqa: E731
+        bias = lambda name: _np(sd[pre + name + ".bias"])  # noqa: E731
+        norm = {"scale": _np(sd[pre + "input_layernorm.weight"]),
+                "bias": _np(sd[pre + "input_layernorm.bias"])}
+
+        def head_proj(name, heads):
+            return {"kernel": proj(name).reshape(d, heads, dh),
+                    "bias": bias(name).reshape(heads, dh)}
+
+        params[f"block_{i}"] = {
+            "ln1": dict(norm),
+            "ln2": dict(norm),  # shared input norm -> both branches
+            "attn": {
+                "q": head_proj("self_attn.q_proj", h),
+                "k": head_proj("self_attn.k_proj", kvh),
+                "v": head_proj("self_attn.v_proj", kvh),
+                "o": {"kernel": proj("self_attn.dense").reshape(h, dh, d),
+                      "bias": bias("self_attn.dense")},
+            },
+            "mlp": {
+                "wi": {"kernel": proj("mlp.fc1"), "bias": bias("mlp.fc1")},
+                "wo": {"kernel": proj("mlp.fc2"), "bias": bias("mlp.fc2")},
+            },
+        }
+    return {"params": jax.tree.map(jnp.asarray, params)}
+
+
+def from_hf_phi(model) -> tuple[Transformer, Any]:
+    """(Transformer, params) from a transformers PhiForCausalLM — local
+    weights, no network."""
+    if getattr(model.config, "model_type", "") != "phi":
+        raise ValueError(
+            f"from_hf_phi got model_type "
+            f"{getattr(model.config, 'model_type', None)!r}")
+    cfg = phi_config(model.config)
+    params = convert_phi_state_dict(model.state_dict(), cfg)
+    return Transformer(cfg), params
+
+
 def gemma_config(hf_config, **overrides) -> TransformerConfig:
     """TransformerConfig matching a transformers GemmaConfig (Gemma-1).
 

@@ -69,5 +69,8 @@ def pipelined_forward(model: Transformer, params, tokens, *, mesh: Mesh,
     if return_hidden:
         return x.astype(jnp.float32)
     head = embed if cfg.tied_embeddings else p["lm_head"]
-    return jnp.einsum("bld,vd->blv", x.astype(jnp.float32),
-                      jnp.asarray(head))
+    logits = jnp.einsum("bld,vd->blv", x.astype(jnp.float32),
+                        jnp.asarray(head))
+    if cfg.lm_head_bias:
+        logits = logits + jnp.asarray(p["lm_head_bias"])
+    return logits

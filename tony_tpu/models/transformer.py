@@ -88,6 +88,8 @@ class TransformerConfig:
     # False adds a separate lm_head param instead of reusing the input
     # embedding for output logits (Llama unties; GPT-2 ties)
     tied_embeddings: bool = True
+    # Phi family: the untied output projection carries a bias
+    lm_head_bias: bool = False
     # MoE (expert-parallel FFN): 0 = dense MLP everywhere; k > 0 replaces the
     # MLP of every k-th block with a mixture-of-experts layer
     moe_every: int = 0
@@ -655,12 +657,20 @@ class Transformer(nn.Module):
         if not cfg.tied_embeddings:
             head = self.param("lm_head", nn.initializers.normal(0.02),
                               (cfg.vocab_size, cfg.d_model), jnp.float32)
+        # created BEFORE the return_hidden branch (like lm_head) so init
+        # yields the full param set regardless of mode
+        head_bias = self.param(
+            "lm_head_bias", nn.initializers.zeros, (cfg.vocab_size,),
+            jnp.float32) if cfg.lm_head_bias else None
         if return_hidden:
             # chunked large-vocab loss: pair with params["lm_head"] when
-            # untied, params["embedding"] when tied (ops.xent)
+            # untied, params["embedding"] when tied (ops.xent). NB: the
+            # caller owns applying params["lm_head_bias"] if configured.
             return x.astype(jnp.float32)
         head = embed if cfg.tied_embeddings else head
         logits = jnp.einsum("bld,vd->blv", x.astype(jnp.float32), head)
+        if head_bias is not None:
+            logits = logits + head_bias
         return logits
 
 
