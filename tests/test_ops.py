@@ -501,3 +501,23 @@ def test_q8_matmul_undivisible_n_uses_divisor_block():
     got = np.asarray(q8_matmul(x, w_q, scale, block_n=256))
     want = np.asarray(x) @ np.asarray(dequantize_q8(w_q, scale))
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_chunked_xent_with_output_bias():
+    """bias= (Phi lm_head_bias) must match full logits + bias exactly,
+    across chunk boundaries."""
+    from tony_tpu.ops import chunked_cross_entropy
+
+    rng = np.random.default_rng(5)
+    t, d, v = 6, 16, 50  # v not a chunk multiple
+    hidden = jnp.asarray(rng.standard_normal((t, d)), jnp.float32)
+    emb = jnp.asarray(rng.standard_normal((v, d)), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((v,)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v, t), jnp.int32)
+    got = float(chunked_cross_entropy(hidden, emb, labels, chunk_size=16,
+                                      bias=bias))
+    logits = hidden @ emb.T + bias[None, :]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    want = float(-jnp.take_along_axis(
+        logp, labels[:, None], axis=-1).mean())
+    np.testing.assert_allclose(got, want, rtol=1e-5)

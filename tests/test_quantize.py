@@ -47,6 +47,9 @@ CONFIGS = {
                  activation="gelu_tanh"),
     "neox": dict(norm="layer", positional="rope", use_bias=True,
                  parallel_residual=True, rotary_dims=4),
+    "phi": dict(norm="layer", positional="rope", use_bias=True,
+                parallel_residual=True, rotary_dims=4,
+                tied_embeddings=False, lm_head_bias=True),
 }
 
 

@@ -664,8 +664,8 @@ class Transformer(nn.Module):
             jnp.float32) if cfg.lm_head_bias else None
         if return_hidden:
             # chunked large-vocab loss: pair with params["lm_head"] when
-            # untied, params["embedding"] when tied (ops.xent). NB: the
-            # caller owns applying params["lm_head_bias"] if configured.
+            # untied, params["embedding"] when tied (ops.xent) — and pass
+            # params["lm_head_bias"] as its bias= when configured.
             return x.astype(jnp.float32)
         head = embed if cfg.tied_embeddings else head
         logits = jnp.einsum("bld,vd->blv", x.astype(jnp.float32), head)
@@ -728,7 +728,8 @@ def logical_axis_rules_tree(params: Any) -> Any:
         elif "pos_embedding" in joined:
             base = (None, "embed")
         elif "embedding" in joined or "lm_head" in joined:
-            base = ("vocab", "embed")
+            # truncation matters: lm_head_bias is rank-1 ("vocab",)
+            base = ("vocab", "embed")[:leaf_dims]
         elif "/q/" in joined:
             base = ("embed", "heads", "kv")[:leaf_dims]
         elif any(s in joined for s in ("/k/", "/v/")):

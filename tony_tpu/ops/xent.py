@@ -24,7 +24,7 @@ NEG_INF = -1e30
 
 def chunked_cross_entropy(hidden, embedding, labels, *,
                           chunk_size: int = 8192, z_loss: float = 0.0,
-                          mask=None):
+                          mask=None, bias=None):
     """Mean token cross-entropy of ``logits = hidden @ embedding.T`` without
     materializing the logits.
 
@@ -38,6 +38,9 @@ def chunked_cross_entropy(hidden, embedding, labels, *,
       mask: optional per-position 0/1 (or bool) weights shaped like
         labels — e.g. packed-document training dropping the
         cross-boundary target after each EOS.
+      bias: optional [V] output bias (Phi-family ``lm_head_bias``),
+        added per vocab tile — the chunked twin of
+        ``logits = h @ W.T + b``.
 
     Returns mean loss (fp32 scalar) over the unmasked positions.
     """
@@ -52,6 +55,9 @@ def chunked_cross_entropy(hidden, embedding, labels, *,
     n_chunks = (v + chunk - 1) // chunk
     pad = n_chunks * chunk - v
     emb = jnp.pad(embedding, ((0, pad), (0, 0))) if pad else embedding
+    if bias is not None:
+        bias = jnp.pad(bias, (0, pad)) if pad else bias
+        bias = bias.astype(jnp.float32)
     h32 = hidden.astype(jnp.float32)
     labels = labels.astype(jnp.int32)
 
@@ -59,6 +65,9 @@ def chunked_cross_entropy(hidden, embedding, labels, *,
         m, s, lab = carry
         e_chunk = lax.dynamic_slice(emb, (i * chunk, 0), (chunk, d))
         logits = h32 @ e_chunk.astype(jnp.float32).T  # [T, chunk]
+        if bias is not None:
+            logits = logits + lax.dynamic_slice(bias, (i * chunk,),
+                                                (chunk,))[None, :]
         pos = i * chunk + jnp.arange(chunk)
         logits = jnp.where(pos[None, :] < v, logits, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
