@@ -276,3 +276,39 @@ def test_cross_entropy_mask():
     np.testing.assert_allclose(got, want, rtol=1e-6)
     # all-masked: defined (0), not NaN
     assert float(cross_entropy_loss(logits, labels, jnp.zeros((2, 4)))) == 0.0
+
+
+def test_trainer_batch_shardings_override():
+    """Per-leaf batch input shardings (sequence-parallel inputs land
+    seq-sharded): step accepts mixed shardings, with and without accum."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tony_tpu.parallel import MeshSpec, make_mesh
+    from tony_tpu.parallel.mesh import DATA, SEQ
+
+    mesh = make_mesh(MeshSpec(data=2, seq=4))
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((8, 16, 4)).astype(np.float32)
+    seg = np.repeat(np.arange(2, dtype=np.int32)[None, :], 8,
+                    axis=0).repeat(8, axis=1)
+
+    def apply_fn(p, batch):
+        # segment-gated mean: touches both differently-sharded inputs
+        gate = (batch["segments"] == 0).astype(jnp.float32)[..., None]
+        return jnp.mean((batch["x"] * gate) @ p["w"])
+
+    shardings = {
+        "x": NamedSharding(mesh, P(DATA)),
+        "segments": NamedSharding(mesh, P(DATA, SEQ)),
+    }
+    params = {"w": jnp.ones((4, 1), jnp.float32)}
+    for accum in (1, 2):
+        trainer = Trainer(mesh=mesh, apply_fn=apply_fn,
+                          optimizer=optax.sgd(0.1), donate=False,
+                          batch_shardings=shardings, accum_steps=accum)
+        step, placed = trainer.build_step(trainer.init_state(params))
+        batch = {"x": jax.device_put(jnp.asarray(x), shardings["x"]),
+                 "segments": jax.device_put(jnp.asarray(seg),
+                                            shardings["segments"])}
+        _, metrics = step(placed, batch)
+        assert np.isfinite(float(metrics["loss"]))

@@ -821,3 +821,16 @@ def test_lm_head_bias_param_exists_in_hidden_mode():
     p = m.init(jax.random.PRNGKey(0), t, return_hidden=True)
     assert "lm_head_bias" in p["params"]
     assert m.apply(p, t).shape == (1, 4, 32)
+
+
+def test_phi_rejects_tied_embeddings():
+    """ADVICE r3: a tied Phi would silently drop the converted biased
+    lm_head — refuse at config mapping (no released Phi ties)."""
+    from tony_tpu.models.hf import phi_config
+
+    config = transformers.PhiConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        partial_rotary_factor=0.5, tie_word_embeddings=True)
+    with pytest.raises(ValueError, match="tie_word_embeddings"):
+        phi_config(config)

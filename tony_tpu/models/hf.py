@@ -519,6 +519,14 @@ def phi_config(hf_config, **overrides) -> TransformerConfig:
         raise ValueError(
             f"partial_rotary_factor x head_dim = {rotary_dims} is odd; "
             "partial rotary needs an even rotary width")
+    # No released Phi ties embeddings; a tied variant would silently drop
+    # the converted biased lm_head (tied logits read the embedding), so
+    # refuse rather than mismodel — same convention as the NeoX
+    # attention_bias=False refusal above.
+    if getattr(hf_config, "tie_word_embeddings", False):
+        raise ValueError("tie_word_embeddings=True Phi variants are not "
+                         "supported (the importer emits an untied biased "
+                         "lm_head; a tied model would silently ignore it)")
     kw = dict(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,

@@ -136,18 +136,6 @@ class TransformerConfig:
 
 
 def _attention(cfg: TransformerConfig, q, k, v, segment_ids=None):
-    if cfg.sliding_window > 0 and cfg.attention_backend not in (
-            "reference", "blockwise", "pallas", "ulysses"):
-        raise ValueError(
-            f"sliding_window is only implemented for the reference, "
-            f"blockwise, pallas, and ulysses backends, not "
-            f"{cfg.attention_backend!r}")
-    if segment_ids is not None and cfg.attention_backend not in (
-            "reference", "blockwise", "pallas"):
-        raise ValueError(
-            f"segment_ids (packed-document masking) is only implemented "
-            f"for the reference, blockwise, and pallas backends, not "
-            f"{cfg.attention_backend!r}")
     if cfg.attention_backend == "reference":
         return reference_attention(q, k, v, causal=True,
                                    window=cfg.sliding_window,
@@ -159,7 +147,9 @@ def _attention(cfg: TransformerConfig, q, k, v, segment_ids=None):
     if cfg.attention_backend == "ring":
         if cfg.mesh is None:
             raise ValueError("ring attention needs cfg.mesh")
-        return ring_attention(q, k, v, cfg.mesh, causal=True)
+        return ring_attention(q, k, v, cfg.mesh, causal=True,
+                              window=cfg.sliding_window,
+                              segment_ids=segment_ids)
     if cfg.attention_backend == "ulysses":
         if cfg.mesh is None:
             raise ValueError("ulysses attention needs cfg.mesh")
@@ -167,7 +157,8 @@ def _attention(cfg: TransformerConfig, q, k, v, segment_ids=None):
 
         return ulysses_attention(q, k, v, cfg.mesh, causal=True,
                                  block_size=cfg.attention_block_size,
-                                 window=cfg.sliding_window)
+                                 window=cfg.sliding_window,
+                                 segment_ids=segment_ids)
     if cfg.attention_backend == "pallas":
         from tony_tpu.ops.attention import flash_attention
 

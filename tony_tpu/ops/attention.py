@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from tony_tpu.ops.platform import on_tpu as _on_tpu
+
 NEG_INF = -1e30
 
 
@@ -520,15 +522,6 @@ def _compiler_params():
             dimension_semantics=("parallel", "parallel", "arbitrary"))
     except Exception:
         return None
-
-
-def _on_tpu() -> bool:
-    try:
-        # "axon" is a tunneled TPU platform; its pallas lowering is the
-        # same Mosaic path, so compiled (not interpreted) kernels apply
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
-        return False
 
 
 def _pick_block(limit: int, length: int) -> int:

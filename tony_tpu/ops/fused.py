@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from tony_tpu.ops.platform import interpret_mode as _interp
+
 
 def _rmsnorm_kernel(x_ref, scale_ref, o_ref, *, eps: float):
     x = x_ref[:].astype(jnp.float32)
@@ -27,13 +29,6 @@ def _add_rmsnorm_kernel(x_ref, res_ref, scale_ref, o_ref, sum_ref, *, eps: float
     var = jnp.mean(s * s, axis=-1, keepdims=True)
     o_ref[:] = (s * jax.lax.rsqrt(var + eps) * scale_ref[:].astype(jnp.float32)
                 ).astype(o_ref.dtype)
-
-
-def _interp() -> bool:
-    try:
-        return jax.devices()[0].platform != "tpu"
-    except Exception:
-        return True
 
 
 def rmsnorm(x, scale, *, eps: float = 1e-6, block_rows: int = 256):

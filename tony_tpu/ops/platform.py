@@ -1,0 +1,29 @@
+"""Single source of truth for "are pallas kernels compiled here?".
+
+Pallas kernels lower through Mosaic on real TPU backends; everywhere else
+they must run in interpret mode. The tunneled single-chip backend reports
+platform "axon", not "tpu" — it is the same Mosaic lowering path, so it
+counts as compiled TPU. Keeping the check in one place stops the failure
+mode ADVICE r3 flagged: ops/quant.py treated axon as non-TPU and silently
+ran the interpreter on the real chip, forfeiting the int8 bandwidth win
+while the bench artifact carried TPU provenance.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def on_tpu() -> bool:
+    """True when the default backend compiles pallas via Mosaic."""
+    try:
+        return jax.devices()[0].platform in _TPU_PLATFORMS
+    except Exception:
+        return False
+
+
+def interpret_mode() -> bool:
+    """Value for ``pallas_call(interpret=...)`` on this backend."""
+    return not on_tpu()

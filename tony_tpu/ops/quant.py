@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from tony_tpu.ops.platform import interpret_mode as _interp
+
 
 def quantize_q8(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """w: [in, out] float -> (w_q int8 [in, out], scale fp32 [out]).
@@ -43,13 +45,6 @@ def _q8_matmul_kernel(x_ref, w_ref, s_ref, o_ref):
     acc = jnp.dot(x, w, preferred_element_type=jnp.float32)
     o_ref[:] = (acc * s_ref[:].astype(jnp.float32)[None, :]) \
         .astype(o_ref.dtype)
-
-
-def _interp() -> bool:
-    try:
-        return jax.devices()[0].platform != "tpu"
-    except Exception:
-        return True
 
 
 @functools.partial(jax.jit, static_argnames=("block_m", "block_n",

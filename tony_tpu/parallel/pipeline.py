@@ -159,7 +159,8 @@ def interleave_stage_params(stacked_params, n_stages: int, repeats: int):
 def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh: Mesh,
                    n_microbatches: int, axis_name: str = PIPE,
                    remat: bool = False, circular_repeats: int = 1,
-                   interleaved: bool = False):
+                   interleaved: bool = False, batch_axis: str | None = None,
+                   param_specs=None):
     """Run ``x`` through ``n_stages`` pipeline stages.
 
     stage_fn(params, x_mb) -> y_mb with y_mb.shape == x_mb.shape (uniform
@@ -180,6 +181,15 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh: Mesh,
       it, pipeline_apply permutes per call — a full cross-device reshuffle
       of the parameters every step when the stack lives pipe-sharded, so
       training loops should pre-interleave.
+    batch_axis: mesh axis to shard the per-microbatch batch dim over
+      (data parallelism composed with the pipeline: each data shard runs
+      the same schedule on its slice; grad reduction over the axis is the
+      shard_map transpose of the params' replication — automatic).
+    param_specs: pytree of PartitionSpecs for stacked_params composing
+      OTHER mesh axes into the stage weights (tensor parallelism: e.g.
+      ``P(PIPE, None, TENSOR)``; stage_fn is then responsible for the
+      matching ``lax.psum`` over the tensor axis, Megatron-style). Every
+      leaf spec must lead with ``axis_name``. Default: ``P(axis_name)``.
     """
     n_stages = mesh.shape[axis_name]
     if circular_repeats < 1:
@@ -211,12 +221,22 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh: Mesh,
         local = functools.partial(_pipeline_local, stage_fn=stage_fn,
                                   axis_name=axis_name)
 
-    params_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    if param_specs is None:
+        params_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    else:
+        for leaf in jax.tree.leaves(param_specs,
+                                    is_leaf=lambda s: isinstance(s, P)):
+            if not leaf or leaf[0] != axis_name:
+                raise ValueError(
+                    f"param_specs leaves must lead with the pipe axis "
+                    f"{axis_name!r}, got {leaf}")
+        params_specs = param_specs
+    x_spec = P(None, batch_axis) if batch_axis else P()
     fn = shard_map(
         local,
         mesh=mesh,
-        in_specs=(params_specs, P()),
-        out_specs=P(),
+        in_specs=(params_specs, x_spec),
+        out_specs=x_spec,
         check_vma=False,
     )
     out = fn(stacked_params, x_micro)

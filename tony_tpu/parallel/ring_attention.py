@@ -51,9 +51,18 @@ def _block_attn(q, k, v, m, l, o, mask):
     return m_new, l_new, o_new
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+def _ring_attention_local(q, k, v, segments, *, axis_name: str, causal: bool,
+                          window: int):
     """Per-shard body under shard_map. Shapes are the local shards:
-    q/k/v: [B, L_local, H, D]."""
+    q/k/v: [B, L_local, H, D]; segments: [B, L_local] int or None.
+
+    ``window``/``segments`` masking is positional, and every ring step
+    knows the global positions of the visiting K/V block from its source
+    shard index — so the sliding-window cut and packed-document masks are
+    exact across shard boundaries. Segment ids rotate around the ring
+    with their K/V block (one extra int ppermute per step, negligible
+    next to the K/V traffic).
+    """
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, lq, h, d = q.shape
@@ -63,47 +72,64 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     q32 = q.astype(jnp.float32)
 
     pos_q = my_idx * lq + jnp.arange(lq)
+    perm = [(j, (j - 1) % n) for j in range(n)]
+    seg_blk0 = segments if segments is not None else jnp.zeros((b, 0),
+                                                               jnp.int32)
 
     def step(carry, i):
-        k_blk, v_blk, m, l, o = carry
+        k_blk, v_blk, seg_blk, m, l, o = carry
         src_idx = (my_idx + i) % n  # which shard this k/v block came from
+        pos_k = src_idx * lq + jnp.arange(lq)
+        mask = None
         if causal:
-            pos_k = src_idx * lq + jnp.arange(lq)
             mask = pos_q[:, None] >= pos_k[None, :]
-        else:
-            mask = None
+        if window > 0:
+            delta = pos_q[:, None] - pos_k[None, :]
+            wmask = (delta >= 0) & (delta < window)
+            mask = wmask if mask is None else mask & wmask
+        if segments is not None:
+            same = segments[:, :, None] == seg_blk[:, None, :]
+            mask = same if mask is None else mask[None] & same
         m, l, o = _block_attn(q32, k_blk.astype(jnp.float32),
                               v_blk.astype(jnp.float32), m, l, o, mask)
         # rotate k/v to the next ring position (receive from right neighbor)
-        perm = [(j, (j - 1) % n) for j in range(n)]
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (k_blk, v_blk, m, l, o), None
+        if segments is not None:
+            seg_blk = lax.ppermute(seg_blk, axis_name, perm)
+        return (k_blk, v_blk, seg_blk, m, l, o), None
 
-    (k, v, m, l, o), _ = lax.scan(step, (k, v, m, l, o), jnp.arange(n))
+    (k, v, _, m, l, o), _ = lax.scan(step, (k, v, seg_blk0, m, l, o),
+                                     jnp.arange(n))
     out = o / jnp.maximum(l[..., None].transpose(0, 2, 1, 3), 1e-30)
     return out.astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = SEQ,
                    causal: bool = True,
-                   batch_spec: P | None = None):
+                   batch_spec: P | None = None,
+                   window: int = 0, segment_ids=None):
     """Sequence-parallel attention.
 
     q/k/v: [B, L, H, D] globally, sharded along L over ``axis_name``.
-    Returns [B, L, H, D] with the same sharding.
+    Returns [B, L, H, D] with the same sharding. ``window`` > 0 applies
+    sliding-window masking (key visible iff 0 <= q_pos - k_pos < window);
+    ``segment_ids`` [B, L] (sharded like the sequence) restricts attention
+    to keys in the same segment (packed documents).
     """
     qspec = P(batch_spec, axis_name, None, None) if batch_spec else \
         P(None, axis_name, None, None)
-    fn = shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal),
-        mesh=mesh,
-        in_specs=(qspec, qspec, qspec),
-        out_specs=qspec,
-        check_vma=False,
-    )
-    return fn(q, k, v)
+    sspec = P(batch_spec, axis_name) if batch_spec else P(None, axis_name)
+    local = functools.partial(_ring_attention_local, axis_name=axis_name,
+                              causal=causal, window=window)
+    if segment_ids is None:
+        fn = shard_map(lambda q, k, v: local(q, k, v, None), mesh=mesh,
+                       in_specs=(qspec, qspec, qspec), out_specs=qspec,
+                       check_vma=False)
+        return fn(q, k, v)
+    fn = shard_map(local, mesh=mesh, in_specs=(qspec, qspec, qspec, sspec),
+                   out_specs=qspec, check_vma=False)
+    return fn(q, k, v, segment_ids.astype(jnp.int32))
 
 
 def blockwise_attention(q, k, v, *, block_size: int = 512, causal: bool = True,

@@ -60,6 +60,14 @@ RULES: dict[str, dict[str, Any]] = {
         "seq": None, "embed": None, "heads": None, "kv": None, "kv_heads": None,
         "mlp": None, "vocab": None, "layers": None,
     },
+    # expert + tensor combined (large MoE: experts over the expert axis,
+    # each expert's ffn dim + attention heads over tensor, batch over data)
+    "ep_tp": {
+        "batch": (DATA, FSDP),
+        "expert": EXPERT, "heads": TENSOR, "mlp": TENSOR, "vocab": TENSOR,
+        "seq": None, "embed": None, "kv": None, "kv_heads": None,
+        "layers": None,
+    },
     # pipeline: layers sharded across stages (used with parallel.pipeline)
     "pp": {
         "batch": (DATA, FSDP),

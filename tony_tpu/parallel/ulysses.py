@@ -27,16 +27,21 @@ from tony_tpu.parallel.mesh import SEQ
 from tony_tpu.parallel.ring_attention import blockwise_attention
 
 
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
+def _ulysses_local(q, k, v, segments, *, axis_name: str, causal: bool,
                    block_size: int, window: int):
-    """Per-shard body. Local shapes in: [B, L/n, H, D]."""
+    """Per-shard body. Local shapes in: [B, L/n, H, D]; segments
+    [B, L/n] int or None (packed-document ids, all-gathered to the full
+    sequence so the local full-seq attention can mask exactly)."""
     # seq-shard -> head-shard: split heads (axis 2) n ways, gather seq (1)
     q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    if segments is not None:
+        segments = lax.all_gather(segments, axis_name, axis=1, tiled=True)
     # full-sequence attention over this device's head group
     out = blockwise_attention(q, k, v, block_size=block_size,
-                              causal=causal, window=window)
+                              causal=causal, window=window,
+                              segment_ids=segments)
     # head-shard -> seq-shard
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
@@ -44,14 +49,19 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
 
 def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = SEQ,
                       causal: bool = True, block_size: int = 512,
-                      batch_spec: P | None = None, window: int = 0):
+                      batch_spec: P | None = None, window: int = 0,
+                      segment_ids=None):
     """Sequence-parallel attention via all-to-all head redistribution.
 
     q/k/v: [B, L, H, D] globally, sharded along L over ``axis_name``.
     Requires H % mesh.shape[axis_name] == 0. Returns the same sharding.
-    ``window`` adds sliding-window masking (each device already holds the
-    full sequence post-all-to-all, so the cut is local and free).
+    ``window`` adds sliding-window masking and ``segment_ids`` [B, L]
+    packed-document masking (each device holds the full sequence
+    post-all-to-all, so both cuts are local; segment ids need one cheap
+    int all-gather along the seq axis).
     """
+    import jax.numpy as jnp
+
     n = mesh.shape.get(axis_name, 1)
     heads = q.shape[2]
     if heads % n != 0:
@@ -60,12 +70,15 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = SEQ,
             f"axis size ({n}); use ring attention otherwise")
     qspec = P(batch_spec, axis_name, None, None) if batch_spec else \
         P(None, axis_name, None, None)
-    fn = shard_map(
-        functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
-                          block_size=block_size, window=window),
-        mesh=mesh,
-        in_specs=(qspec, qspec, qspec),
-        out_specs=qspec,
-        check_vma=False,
-    )
-    return fn(q, k, v)
+    sspec = P(batch_spec, axis_name) if batch_spec else P(None, axis_name)
+    local = functools.partial(_ulysses_local, axis_name=axis_name,
+                              causal=causal, block_size=block_size,
+                              window=window)
+    if segment_ids is None:
+        fn = shard_map(lambda q, k, v: local(q, k, v, None), mesh=mesh,
+                       in_specs=(qspec, qspec, qspec), out_specs=qspec,
+                       check_vma=False)
+        return fn(q, k, v)
+    fn = shard_map(local, mesh=mesh, in_specs=(qspec, qspec, qspec, sspec),
+                   out_specs=qspec, check_vma=False)
+    return fn(q, k, v, segment_ids.astype(jnp.int32))

@@ -78,6 +78,11 @@ class Trainer:
     # the math the model requires and nothing else — the framework step
     # must cost what a hand-written step costs (BASELINE north star)
     log_grad_norm: bool = False
+    # batch input shardings: None = batch dim over (data, fsdp) for every
+    # leaf. A pytree (e.g. {"tokens": sh, "segments": sh2}) overrides per
+    # leaf — sequence-parallel training lands seq-sharded inputs (packed
+    # segment ids, pre-split sequences) without a per-step relayout.
+    batch_shardings: Any = None
 
     def init_state(self, params) -> TrainState:
         return TrainState(
@@ -103,7 +108,8 @@ class Trainer:
     def compile_step(self, shardings):
         """The jitted step for a given TrainState sharding tree (shardings
         may come from a real or an abstract — jax.eval_shape — state)."""
-        b_sh = batch_sharding(self.mesh)
+        b_sh = self.batch_shardings if self.batch_shardings is not None \
+            else batch_sharding(self.mesh)
         accum = max(self.accum_steps, 1)
 
         if self.compute_dtype is not None:
@@ -130,7 +136,7 @@ class Trainer:
             if accum == 1:
                 return jax.value_and_grad(loss_fn)(params, batch)
 
-            def micro(x):
+            def micro(x, sh):
                 b = x.shape[0]
                 if b % accum:
                     raise ValueError(
@@ -144,9 +150,18 @@ class Trainer:
                 # since grads are averaged over all microbatches)
                 x = x.reshape(b // accum, accum, *x.shape[1:]).swapaxes(0, 1)
                 return jax.lax.with_sharding_constraint(
-                    x, NamedSharding(self.mesh, P(None, *b_sh.spec)))
+                    x, NamedSharding(self.mesh, P(None, *sh.spec)))
 
-            micros = jax.tree.map(micro, batch)
+            if isinstance(b_sh, NamedSharding):
+                micros = jax.tree.map(lambda x: micro(x, b_sh), batch)
+            else:
+                # b_sh is a pytree PREFIX of batch (same contract as jit
+                # in_shardings): broadcast each sharding over its subtree
+                micros = jax.tree.map(
+                    lambda sh, sub: jax.tree.map(
+                        lambda x: micro(x, sh), sub),
+                    b_sh, batch,
+                    is_leaf=lambda x: isinstance(x, NamedSharding))
 
             def body(carry, mb):
                 loss_sum, grad_sum = carry

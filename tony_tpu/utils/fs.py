@@ -88,7 +88,26 @@ class LocalizableResource:
                     return unzip(fetched, dest)
                 finally:
                     os.remove(fetched)
-            return remotefs.fetch(self.source, dest)
+            # Directory-prefix resources (the remote analog of the local
+            # isdir/copytree branch below; ref HDFS dir localization):
+            # a trailing slash is an explicit dir, otherwise fall back to
+            # a recursive fetch ONLY when the flat copy reports a
+            # miss/dir-shaped error — auth or network failures must
+            # surface as-is, not be masked by a doomed -r retry.
+            if self.source.endswith("/"):
+                return remotefs.fetch(self.source.rstrip("/"), dest,
+                                      recursive=True)
+            try:
+                return remotefs.fetch(self.source, dest)
+            except RuntimeError as e:
+                msg = str(e).lower()
+                dir_shaped = any(s in msg for s in (
+                    "no such", "not found", "matched no objects",
+                    "no urls matched", "omitting directory",
+                    "is a directory"))
+                if not dir_shaped:
+                    raise
+                return remotefs.fetch(self.source, dest, recursive=True)
         if self.is_archive:
             return unzip(self.source, dest)
         if os.path.isdir(self.source):
