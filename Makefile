@@ -1,0 +1,43 @@
+# Build/verify entry points (reference parity: the gradle build's
+# check/test wiring, build.gradle:113-116 + .circleci/config.yml).
+#
+#   make lint   - static analysis: ruff when installed, else the in-tree
+#                 AST checker (tools/lint.py) — same core rules
+#   make smoke  - <60 s unit tier (no jax-heavy model/e2e suites):
+#                 config, session, scheduler, rpc, events, utils,
+#                 remotefs, runtimes, workflow, tpu_info, compilecache,
+#                 proxy, profiler
+#   make check  - lint + smoke (the pre-commit gate)
+#   make test   - the full suite (~15-20 min on a 1-core box)
+#   make bench  - the driver-contract benchmark (one JSON line)
+
+PY ?= python
+
+LINT_PATHS = tony_tpu tests examples tools bench.py __graft_entry__.py
+
+SMOKE_TESTS = tests/test_config.py tests/test_session.py \
+	tests/test_scheduler.py tests/test_rpc.py tests/test_events.py \
+	tests/test_utils.py tests/test_remotefs.py tests/test_runtimes.py \
+	tests/test_workflow.py tests/test_tpu_info.py \
+	tests/test_compilecache.py tests/test_proxy.py tests/test_profiler.py
+
+.PHONY: lint smoke check test bench
+
+lint:
+	@if command -v ruff >/dev/null 2>&1; then \
+		echo "ruff check"; ruff check $(LINT_PATHS); \
+	else \
+		echo "tools/lint.py (no ruff in image)"; \
+		$(PY) tools/lint.py $(LINT_PATHS); \
+	fi
+
+smoke:
+	$(PY) -m pytest $(SMOKE_TESTS) -q -p no:cacheprovider
+
+check: lint smoke
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py

@@ -8,6 +8,14 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# one local device per process: the test pins the multi-PROCESS contract;
+# inheriting the suite's 8-virtual-device XLA_FLAGS would put 16 virtual
+# devices' collective rendezvous on a loaded 1-core box — the gang-flake
+# source VERDICT r3 #8 asks to pin
+os.environ["XLA_FLAGS"] = " ".join(
+    [f for f in os.environ.get("XLA_FLAGS", "").split()
+     if "xla_force_host_platform_device_count" not in f]
+    + ["--xla_force_host_platform_device_count=1"])
 
 from tony_tpu import distributed  # noqa: E402
 

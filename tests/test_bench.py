@@ -5,7 +5,6 @@ end-of-round artifact depends on these paths running unattended."""
 import importlib.util
 import json
 import os
-import sys
 
 import pytest
 

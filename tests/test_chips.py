@@ -4,7 +4,6 @@ HadoopCompatibleAdapter.java:71, util/Utils.java:393-419)."""
 
 import json
 import os
-import tempfile
 
 import pytest
 

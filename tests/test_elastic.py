@@ -7,7 +7,6 @@ import os
 import threading
 import time
 
-import pytest
 
 from tony_tpu import elastic
 from tony_tpu.mini import MiniTonyCluster, script_conf

@@ -574,3 +574,41 @@ def test_score_cli_int8_close_to_fp(tmp_path):
     fp = run()
     q8 = run("--int8")
     assert abs(q8 - fp) / fp < 0.05, (fp, q8)
+
+
+def test_score_cli_kv_int8_close_to_fp(tmp_path):
+    """--kv-int8 scores THROUGH the quantized KV cache (decode/prefill
+    path): nll/token must sit within a few percent of full precision —
+    the cache-quality measurement the flag exists for."""
+    import os
+    import subprocess
+    import sys
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(config).eval()
+    mdir = tmp_path / "ckpt"
+    hf.save_pretrained(str(mdir))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": os.path.dirname(os.path.dirname(
+               os.path.abspath(__file__)))}
+    ids = "1,2,3,4,5,6"
+
+    def run(*extra):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tony_tpu.cli.score", "--model",
+             str(mdir), "--token-ids", ids, *extra],
+            capture_output=True, text=True, timeout=240, env=env)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = [l for l in proc.stdout.splitlines()
+                if l.startswith("TOTAL")][0]
+        return float(line.split("nll/token=")[1].split()[0])
+
+    fp = run()
+    kv8 = run("--kv-int8")
+    assert abs(kv8 - fp) / fp < 0.05, (fp, kv8)

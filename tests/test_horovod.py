@@ -264,3 +264,70 @@ def test_rendezvous_server_not_orphaned_after_job(cluster):
                          capture_output=True, text=True)
     assert res.returncode != 0, \
         f"orphaned processes for {client.job_dir}: {res.stdout}"
+
+
+def test_elastic_driver_replans_on_discovery_change(tmp_path):
+    """--elastic: membership change via the discovery command republishes
+    the slot plan under a bumped generation (the reference's
+    elastic_driver_fn is a stub — reference horovod_driver.py:28-29)."""
+    import glob
+    import subprocess
+    import sys
+    import time
+
+    # discovery flips from 2 hosts to 3 after the flag file appears
+    flag = tmp_path / "grow"
+    disc = tmp_path / "discover.py"
+    disc.write_text(
+        "import os, sys\n"
+        "print('h1:2')\nprint('h2:2')\n"
+        f"if os.path.exists({str(flag)!r}):\n"
+        "    print('h3:2')\n")
+    workdir = tmp_path / "wd"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tony_tpu.runtime.horovod_driver",
+         "-w", "h1:2,h2:2", "-d", str(workdir), "--elastic",
+         "--discover", f"{sys.executable} {disc}",
+         "--discover-interval", "0.2"])
+    try:
+        def read_port_file(deadline=20.0):
+            end = time.time() + deadline
+            while time.time() < end:
+                files = glob.glob(str(workdir / "*HOROVOD_RENDEZVOUS*"))
+                if files:
+                    try:
+                        with open(files[0]) as f:
+                            return json.load(f)
+                    except (ValueError, OSError):
+                        pass
+                time.sleep(0.1)
+            raise AssertionError("port file never appeared")
+
+        body = read_port_file()
+        assert body["generation"] == 0
+        assert len(body["slots"]) == 4
+        flag.write_text("x")
+        end = time.time() + 20
+        while time.time() < end:
+            body = read_port_file()
+            if body.get("generation", 0) >= 1:
+                break
+            time.sleep(0.2)
+        assert body["generation"] >= 1
+        assert len(body["slots"]) == 6  # h3:2 joined
+        ranks = sorted(s["rank"] for s in body["slots"])
+        assert ranks == list(range(6))
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_elastic_discovery_failure_keeps_membership(tmp_path):
+    """A failing/garbled discovery probe must NOT dissolve the gang."""
+    from tony_tpu.runtime.horovod_driver import run_discovery
+
+    assert run_discovery("false") is None
+    assert run_discovery("echo not_a_number:xx") is None
+    assert run_discovery("echo ''") is None
+    assert run_discovery("echo h1:2") == [("h1", 2)]
+    assert run_discovery("echo h1") == [("h1", 1)]

@@ -5,7 +5,6 @@ TaskScheduler.java:93-105, util/Utils.java:420-430)."""
 
 import json
 import os
-import time
 
 import pytest
 

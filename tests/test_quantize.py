@@ -6,7 +6,6 @@ beyond quantization itself), across architecture families and the
 KV-cache decode path.
 """
 
-import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -114,9 +113,6 @@ def test_quantized_generate_runs_greedy():
 def test_quantize_rejects_unsupported_configs():
     base = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
                 max_seq_len=16, dtype=jnp.float32)
-    moe = Transformer(TransformerConfig(**base, moe_every=1))
-    with pytest.raises(ValueError, match="MoE"):
-        quantize_for_serving(moe, {})
     scan = Transformer(TransformerConfig(**base, scan_layers=True))
     with pytest.raises(ValueError, match="scan_layers"):
         quantize_for_serving(scan, {})
@@ -156,22 +152,142 @@ def test_q8_matmul_prime_rows_pads_not_degenerates():
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
-def test_quantized_params_replicated_logical_axes():
-    """Quantized leaves get all-None logical axes (replicated) — the fp
-    head/kv rules would shard the flattened kernels wrongly."""
+def test_quantized_params_tp_logical_axes():
+    """int8 leaves shard on the same logical axes as their bf16 kernels
+    (VERDICT r3 next #5): column-parallel q/wi out dims, row-parallel
+    o/wo in dims, GQA k/v on the always-replicated kv_heads."""
     from tony_tpu.models.transformer import logical_axis_rules_tree
 
     cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
-                            n_layers=1, d_ff=64, max_seq_len=16,
-                            dtype=jnp.float32,
+                            n_kv_heads=2, n_layers=1, d_ff=64,
+                            max_seq_len=16, dtype=jnp.float32,
+                            gated_mlp=True,
                             attention_backend="reference")
     model = Transformer(cfg)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 8), jnp.int32))
     _, qparams = quantize_for_serving(model, params)
     axes = logical_axis_rules_tree(qparams)
-    blk = axes["params"]["block_0"]["attn"]["q"]
-    assert blk["kernel_q8"] == (None, None)
-    assert blk["scale"] == (None,)
+    attn = axes["params"]["block_0"]["attn"]
+    assert attn["q"]["kernel_q8"] == ("embed", "heads")
+    assert attn["q"]["scale"] == ("heads",)
+    assert attn["k"]["kernel_q8"] == ("embed", "kv_heads")  # GQA guard
+    assert attn["v"]["scale"] == ("kv_heads",)
+    assert attn["o"]["kernel_q8"] == ("heads", "embed")  # row-parallel
+    assert attn["o"]["scale"] == ("embed",)
+    mlp = axes["params"]["block_0"]["mlp"]
+    assert mlp["wi"]["kernel_q8"] == ("embed", "mlp")
+    assert mlp["wo"]["kernel_q8"] == ("mlp", "embed")
     # fp leaves (embedding) keep their rules
     assert axes["params"]["embedding"] == ("vocab", "embed")
+    # norm scales stay replicated (same leaf NAME as QuantDense's scale)
+    norm_scale = axes["params"]["block_0"]["ln1"]["scale"]
+    assert norm_scale == (None,)
+
+
+def test_quantized_forward_under_tensor_parallel_mesh():
+    """generate --int8 under a tp mesh (custom-partitioned pallas q8
+    matmul): sharded logits and greedy tokens match the replicated run."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tony_tpu.models.transformer import logical_axis_rules_tree
+    from tony_tpu.parallel import MeshSpec, make_mesh
+    from tony_tpu.parallel.mesh import DATA
+    from tony_tpu.parallel.sharding import tree_shardings
+
+    mesh = make_mesh(MeshSpec(data=2, tensor=4))
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_seq_len=24, dtype=jnp.float32,
+                            gated_mlp=True, mesh=mesh,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 12), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    qmodel, qparams = quantize_for_serving(model, params)
+    logits_rep = qmodel.apply(qparams, tokens)
+
+    sh = tree_shardings(mesh, logical_axis_rules_tree(qparams), "tp")
+    placed = jax.device_put(qparams, sh)
+    # q kernels really are tensor-sharded on the device mesh
+    q_leaf = placed["params"]["block_0"]["attn"]["q"]["kernel_q8"]
+    assert q_leaf.sharding.spec[1] == "tensor", q_leaf.sharding.spec
+    tok_sh = jax.device_put(tokens, NamedSharding(mesh, P(DATA)))
+    logits_tp = jax.jit(qmodel.apply)(placed, tok_sh)
+    np.testing.assert_allclose(np.asarray(logits_tp),
+                               np.asarray(logits_rep),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_lora_adapter_logical_axes():
+    """LoRA A/B shard like their host kernel: A carries the input axis,
+    B the output axes; rank stays replicated."""
+    from tony_tpu.models.transformer import logical_axis_rules_tree
+    from tony_tpu.train.lora import lora_init
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_kv_heads=2, n_layers=1, d_ff=64,
+                            max_seq_len=16, dtype=jnp.float32,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))
+    lora = lora_init(jax.random.PRNGKey(1), params, rank=4,
+                     targets=("q", "v", "wi"))
+    axes = logical_axis_rules_tree(lora)
+    qk = axes["params"]["block_0"]["attn"]["q"]["kernel"]
+    assert qk["a"] == ("embed", None)
+    assert qk["b"] == (None, "heads", "kv")
+    vk = axes["params"]["block_0"]["attn"]["v"]["kernel"]
+    assert vk["b"] == (None, "kv_heads", "kv")  # GQA: fewer v heads
+    wik = axes["params"]["block_0"]["mlp"]["wi"]["kernel"]
+    assert wik["a"] == ("embed", None)
+    assert wik["b"] == (None, "mlp")
+
+
+def test_quantized_moe_matches_dequant_reference():
+    """Mixtral-style int8 MoE serving (VERDICT r3 next #5): the quantized
+    expert path (vmapped pallas dequant matmul) matches a full-precision
+    forward over the dequantized expert weights, routed AND dropless."""
+    for dropless in (True, False):
+        cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq_len=16,
+                                dtype=jnp.float32, moe_every=2,
+                                moe_num_experts=4, moe_top_k=2,
+                                moe_gated=True, moe_renormalize=True,
+                                moe_dropless=dropless,
+                                attention_backend="reference")
+        model = Transformer(cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 8), 0, 64)
+        params = model.init(jax.random.PRNGKey(5), tokens)
+        qmodel, qparams = quantize_for_serving(model, params)
+
+        # dequantize every int8 leaf back into the fp tree and compare
+        def dq(node, ref):
+            if isinstance(node, dict) and "wi_q8" in node:
+                out = {"router": node["router"]}
+                for nm in ("wi", "wg", "wo"):
+                    if nm + "_q8" in node:
+                        out[nm] = jnp.asarray(
+                            np.asarray(node[nm + "_q8"], np.float32)
+                            * np.asarray(node[nm + "_scale"])[:, None, :])
+                return out
+            if isinstance(node, dict) and "kernel_q8" in node:
+                w = np.asarray(dequantize_q8(node["kernel_q8"],
+                                             node["scale"]))
+                out = {"kernel": jnp.asarray(
+                    w.reshape(np.asarray(ref["kernel"]).shape),
+                    jnp.float32)}
+                if "bias" in node:
+                    out["bias"] = node["bias"]
+                return out
+            if isinstance(node, dict):
+                return {k: dq(v, ref[k]) for k, v in node.items()}
+            return node
+
+        fp_params = dq(qparams, params)
+        logits_q = qmodel.apply(qparams, tokens)
+        logits_fp = model.apply(fp_params, tokens)
+        np.testing.assert_allclose(np.asarray(logits_q),
+                                   np.asarray(logits_fp),
+                                   atol=2e-4, rtol=2e-4)

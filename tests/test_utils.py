@@ -1,7 +1,6 @@
 """Utils tests (ref: TestUtils.java zip/shell/resource parsing,
 TestLocalizableResource, TestPortAllocation)."""
 
-import os
 import socket
 
 from tony_tpu.utils import (

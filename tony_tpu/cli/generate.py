@@ -44,6 +44,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eos-id", type=int, default=-1,
                    help="stop token (default: model config's eos_token_id)")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="int8 KV cache: quantize-on-write with "
+                        "per-(position, head) scales — halves the decode "
+                        "cache HBM traffic (the dominant decode bytes at "
+                        "long context)")
+    p.add_argument("--flash-decode", action="store_true",
+                   help="use the pallas flash-decode kernel for "
+                        "single-token decode steps (fused online-softmax "
+                        "over the KV cache; int8-aware). Interpreted — "
+                        "slow — off TPU")
     p.add_argument("--int8", action="store_true",
                    help="serve with int8 weight-only quantization "
                         "(pallas dequant-matmul; half the weight bytes "
@@ -114,6 +124,16 @@ def main(argv=None) -> int:
         from tony_tpu.models.quantize import quantize_cli
 
         model, params = quantize_cli(model, params)
+    if args.kv_int8 or args.flash_decode:
+        import dataclasses
+
+        from tony_tpu.models import Transformer
+
+        model = Transformer(dataclasses.replace(
+            model.cfg,
+            kv_cache_quant=args.kv_int8,
+            decode_attention="flash" if args.flash_decode
+            else model.cfg.decode_attention))
 
     tokenizer = None
     if args.prompt:

@@ -7,7 +7,6 @@ fast timings + CPU jax, then a normal submission.
 
 from __future__ import annotations
 
-import argparse
 import logging
 
 from tony_tpu import constants as C

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import argparse
 import logging
-import threading
 
 from tony_tpu import constants as C
 from tony_tpu.client import TonyClient

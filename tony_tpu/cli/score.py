@@ -40,6 +40,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-len", type=int, default=0,
                    help="truncate inputs to this many tokens "
                         "(default: the model's max_seq_len)")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="score THROUGH an int8 KV cache (decode/prefill "
+                        "path): measures the cache quantization's exact "
+                        "nll/token cost for serving")
     p.add_argument("--int8", action="store_true",
                    help="score with int8 weight-only quantization (the "
                         "serving config; measures the quality cost of "
@@ -58,17 +62,31 @@ def bucket_len(n: int, limit: int) -> int:
     return min(b, limit)
 
 
-def make_score_fn(model, params):
+def make_score_fn(model, params, through_cache: bool = False):
     """One jitted scorer reused for every input; jit's shape-keyed cache
     means exactly one compile per bucket length. Returns
-    ``fn(ids) -> (total nll, token count)`` with the padding masked out."""
+    ``fn(ids) -> (total nll, token count)`` with the padding masked out.
+
+    ``through_cache`` scores via the decode/prefill path (the cache is
+    written, then logits read back through it) — with
+    ``cfg.kv_cache_quant`` this measures the int8 KV cache's exact
+    nll/token cost, the serving-quality analog of ``--int8``'s weight
+    cost."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     @jax.jit
     def nll(tokens, tgt_mask):
-        logits = model.apply(params, tokens)
+        if through_cache:
+            cache = model.init(jax.random.PRNGKey(0), tokens,
+                               decode=True)["cache"]
+            logits, _ = model.apply(
+                {"params": params["params"] if "params" in params
+                 else params, "cache": cache},
+                tokens, decode=True, mutable=["cache"])
+        else:
+            logits = model.apply(params, tokens)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         picked = jnp.take_along_axis(
             logp[:, :-1], tokens[:, 1:, None], axis=-1)[0, :, 0]
@@ -105,6 +123,13 @@ def main(argv=None) -> int:
         from tony_tpu.models.quantize import quantize_cli
 
         model, params = quantize_cli(model, params)
+    if args.kv_int8:
+        import dataclasses
+
+        from tony_tpu.models import Transformer
+
+        model = Transformer(dataclasses.replace(model.cfg,
+                                                kv_cache_quant=True))
     if texts:
         import transformers
 
@@ -117,7 +142,7 @@ def main(argv=None) -> int:
 
     limit = min(args.max_len or model.cfg.max_seq_len,
                 model.cfg.max_seq_len)
-    score = make_score_fn(model, params)
+    score = make_score_fn(model, params, through_cache=args.kv_int8)
     total_nll = 0.0
     total_tokens = 0
     for ids in inputs:

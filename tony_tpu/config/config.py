@@ -130,7 +130,8 @@ class TonyConf:
         out: list[str] = []
         for k in self._values:
             m = ROLE_KEY_RE.match(k)
-            if m and m.group("suffix") == "instances" and m.group("role") not in _NON_ROLE_SEGMENTS:
+            if m and m.group("suffix") == "instances" \
+                    and m.group("role") not in _NON_ROLE_SEGMENTS:
                 if m.group("role") not in out:
                     out.append(m.group("role"))
         return out

@@ -137,7 +137,8 @@ KEYS: dict[str, Key] = {
         "(ref: tony.am.command + doPreprocessingJob stdout scrape)"
     ),
     "tony.coordinator.retry-count": Key(
-        0, int, "Times the coordinator rebuilds the session after failure (ref: tony.am.retry-count)"
+        0, int, "Times the coordinator rebuilds the session after "
+                "failure (ref: tony.am.retry-count)"
     ),
     "tony.coordinator.monitor-interval-ms": Key(
         1000, int, "Coordinator monitor loop cadence (ref AM 5s; faster since no YARN)"
@@ -160,7 +161,8 @@ KEYS: dict[str, Key] = {
         0, int, "Per-task user-process timeout; 0 = unlimited (ref: same)"
     ),
     "tony.task.reuse-port": Key(
-        False, bool, "Reserve rendezvous ports with SO_REUSEPORT across exec (ref: TF_GRPC_REUSE_PORT)"
+        False, bool, "Reserve rendezvous ports with SO_REUSEPORT "
+                     "across exec (ref: TF_GRPC_REUSE_PORT)"
     ),
     "tony.elastic.grace-ms": Key(
         15_000, int, "Grace period for tasks to checkpoint-and-exit on an "
@@ -320,6 +322,18 @@ KEYS: dict[str, Key] = {
         "", str,
         "User-supplied command replacing the built-in rendezvous driver "
         "(ref: HorovodDriver debug mode :189-216)"
+    ),
+    "tony.horovod.elastic": Key(
+        False, bool,
+        "Elastic rendezvous: the driver polls the discovery command and "
+        "republishes the slot plan (new generation) on membership change "
+        "(ref: horovod_driver.py elastic_driver_fn stub :28-29 — real here)"
+    ),
+    "tony.horovod.discovery-command": Key(
+        "", str,
+        "Elastic host-discovery command printing host[:slots] lines "
+        "(horovod's discovery-script contract); required with "
+        "tony.horovod.elastic"
     ),
 }
 

@@ -9,8 +9,10 @@ and the LM head stay full precision: they are a small fraction of the
 bytes and dominate quality.
 
 Scope: the dense transformer family (everything models/hf.py imports —
-GPT-2, Llama/Mistral/Qwen2, Gemma, GPT-NeoX). MoE blocks and
-scan-stacked layers are rejected rather than half-converted.
+GPT-2, Llama/Mistral/Qwen2, Gemma, GPT-NeoX, Phi) plus MoE expert
+weights (Mixtral: per-expert, per-output-channel scales, served through
+a vmapped pallas dequant matmul). scan-stacked layers are rejected
+rather than half-converted.
 """
 
 from __future__ import annotations
@@ -20,7 +22,7 @@ from typing import Any
 
 import numpy as np
 
-from tony_tpu.models.transformer import Transformer, TransformerConfig
+from tony_tpu.models.transformer import Transformer
 from tony_tpu.ops.quant import quantize_q8
 
 # parent module names whose "kernel" leaf becomes int8
@@ -45,6 +47,16 @@ def quantize_transformer_params(params: Any) -> Any:
     """params pytree (as from model.init / hf import) -> quantized tree.
     Biases ride along unchanged; every other leaf passes through."""
 
+    def quantize_expert(arr) -> tuple[np.ndarray, np.ndarray]:
+        # [E, in, out]: contraction over axis 1, so the per-output-channel
+        # scale is per (expert, out) — the 3-D analog of quantize_q8
+        a = np.asarray(arr, np.float32)
+        absmax = np.max(np.abs(a), axis=1)
+        scale = np.maximum(absmax, 1e-8) / 127.0
+        q = np.clip(np.round(a / scale[:, None, :]), -127, 127) \
+            .astype(np.int8)
+        return q, scale.astype(np.float32)
+
     def walk(node, name=""):
         if not isinstance(node, dict):
             return node
@@ -55,6 +67,16 @@ def quantize_transformer_params(params: Any) -> Any:
             extra = set(node) - {"kernel", "bias"}
             if extra:
                 raise ValueError(f"unexpected leaves under {name}: {extra}")
+            return out
+        if "router" in node and "wi" in node:  # MoE expert block (Mixtral)
+            out = {"router": node["router"]}
+            for nm in ("wi", "wg", "wo"):
+                if nm in node:
+                    out[nm + "_q8"], out[nm + "_scale"] = \
+                        quantize_expert(node[nm])
+            extra = set(node) - {"router", "wi", "wg", "wo"}
+            if extra:
+                raise ValueError(f"unexpected MoE leaves: {extra}")
             return out
         return {k: walk(v, k) for k, v in node.items()}
 
@@ -67,9 +89,6 @@ def quantize_for_serving(model: Transformer, params: Any
     returned pair drops into generate()/score exactly like the original.
     """
     cfg = model.cfg
-    if cfg.moe_every:
-        raise ValueError("int8 serving conversion does not cover MoE "
-                         "expert weights yet")
     if cfg.scan_layers:
         raise ValueError("int8 serving conversion expects per-block "
                          "params (scan_layers stacks them)")

@@ -13,7 +13,7 @@ No reference analog (TonY has no model code); built TPU-first:
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 import flax.linen as nn
@@ -79,6 +79,18 @@ class TransformerConfig:
     # convert a trained/imported model; training this config is
     # unsupported (int8 weights have no useful gradients)
     quantized: bool = False
+    # SERVING int8 KV cache: cache buffers store int8 with per-(position,
+    # head) fp32 scales, quantized on write after RoPE — HALF the decode
+    # cache HBM traffic (the dominant decode bytes at long context,
+    # docs/PERF.md). Read back through the flash-decode kernel (int8
+    # tiles dequantized in VMEM) or dequantized for the einsum path.
+    kv_cache_quant: bool = False
+    # decode-step attention implementation for single-token steps:
+    # "einsum" = XLA path (default; exact reference), "flash" = pallas
+    # flash-decode kernel (ops/decode.py: fused online-softmax over the
+    # cache, int8-aware). Prefill (multi-token decode) always uses the
+    # einsum path.
+    decode_attention: str = "einsum"
     # multiply token embeddings by sqrt(d_model), in activation dtype
     # (Gemma's normalizer)
     embed_scale: bool = False
@@ -300,7 +312,8 @@ class Attention(nn.Module):
         # matching in logical_axis_rules_tree, not from annotations here
         if cfg.quantized:
             dense = lambda name, feats, bias: QuantDense(  # noqa: E731
-                feats, in_axes=1, use_bias=bias, dtype=cfg.dtype, name=name)
+                feats, in_axes=1, use_bias=bias, dtype=cfg.dtype, name=name,
+                mesh=cfg.mesh, shard_axes=_q8_shard_axes(cfg, name))
         else:
             dense = lambda name, feats, bias: nn.DenseGeneral(  # noqa: E731
                 feats, axis=-1, use_bias=bias, dtype=cfg.dtype,
@@ -334,7 +347,8 @@ class Attention(nn.Module):
         if cfg.quantized:
             out = QuantDense((cfg.d_model,), in_axes=2,
                              use_bias=cfg.use_bias, dtype=cfg.dtype,
-                             name="o")(out)
+                             name="o", mesh=cfg.mesh,
+                             shard_axes=_q8_shard_axes(cfg, "o"))(out)
         else:
             out = nn.DenseGeneral(
                 cfg.d_model, axis=(-2, -1), use_bias=cfg.use_bias,
@@ -358,12 +372,22 @@ class Attention(nn.Module):
         group = h // kvh
         max_len = cfg.max_seq_len
         is_init = self.has_variable("cache", "cached_key")
+        quant = cfg.kv_cache_quant
         # cache holds only kv_heads — the GQA HBM saving that makes long
-        # batched decode fit (cache is the decode-path memory bound)
+        # batched decode fit (cache is the decode-path memory bound).
+        # kv_cache_quant stores int8 + per-(pos, head) scales: half the
+        # bytes again (docs/PERF.md decode roofline next lever).
+        cache_dtype = jnp.int8 if quant else k.dtype
         cached_k = self.variable("cache", "cached_key", jnp.zeros,
-                                 (b, max_len, kvh, dh), k.dtype)
+                                 (b, max_len, kvh, dh), cache_dtype)
         cached_v = self.variable("cache", "cached_value", jnp.zeros,
-                                 (b, max_len, kvh, dh), v.dtype)
+                                 (b, max_len, kvh, dh), cache_dtype)
+        if quant:
+            k_scales = self.variable("cache", "cached_key_scale", jnp.zeros,
+                                     (b, max_len, kvh), jnp.float32)
+            v_scales = self.variable("cache", "cached_value_scale",
+                                     jnp.zeros, (b, max_len, kvh),
+                                     jnp.float32)
         cache_index = self.variable("cache", "cache_index",
                                     lambda: jnp.array(0, jnp.int32))
         if not is_init:  # shape-only init pass
@@ -375,6 +399,15 @@ class Attention(nn.Module):
                                  cfg.rope_scaling, cfg.rotary_dims)
             k = rotary_embedding(k, positions, cfg.rope_theta,
                                  cfg.rope_scaling, cfg.rotary_dims)
+        if quant:
+            from tony_tpu.ops.decode import quantize_kv
+
+            k, k_sc = quantize_kv(k)  # quantize-on-write, after RoPE
+            v, v_sc = quantize_kv(v)
+            k_scales.value = jax.lax.dynamic_update_slice(
+                k_scales.value, k_sc, (0, cur, 0))
+            v_scales.value = jax.lax.dynamic_update_slice(
+                v_scales.value, v_sc, (0, cur, 0))
         keys = jax.lax.dynamic_update_slice(cached_k.value, k, (0, cur, 0, 0))
         values = jax.lax.dynamic_update_slice(cached_v.value, v, (0, cur, 0, 0))
         cached_k.value = keys
@@ -382,6 +415,20 @@ class Attention(nn.Module):
         cache_index.value = cur + l
         q_pos = (cur + jnp.arange(l))[:, None]
         win = cfg.sliding_window
+        if l == 1 and cfg.decode_attention == "flash":
+            # the decode hot loop: fused pallas kernel over the (possibly
+            # int8) FULL cache buffer — online softmax in VMEM, GQA tiles
+            # read once. The kernel masks window/length itself and skips
+            # out-of-range blocks' FLOPs via predication, so the einsum
+            # path's static window slice (whose odd win+1 span has no
+            # legal TPU tile divisor) is neither needed nor wanted here.
+            from tony_tpu.ops.decode import flash_decode
+
+            out = flash_decode(
+                q[:, 0], keys, values, cur + 1, window=win,
+                k_scale=k_scales.value if quant else None,
+                v_scale=v_scales.value if quant else None)
+            return out[:, None].astype(q.dtype)
         if win > 0 and win + l <= max_len:
             # windowed decode: attend over a STATIC (window+l)-sized slice
             # ending at the newest token instead of the whole max_len
@@ -394,10 +441,22 @@ class Attention(nn.Module):
                                              (b, span, kvh, dh))
             values_att = jax.lax.dynamic_slice(values, (0, start, 0, 0),
                                                (b, span, kvh, dh))
+            if quant:
+                ks_att = jax.lax.dynamic_slice(k_scales.value, (0, start, 0),
+                                               (b, span, kvh))
+                vs_att = jax.lax.dynamic_slice(v_scales.value, (0, start, 0),
+                                               (b, span, kvh))
             kv_pos = start + jnp.arange(span)
         else:
             keys_att, values_att = keys, values
+            if quant:
+                ks_att, vs_att = k_scales.value, v_scales.value
             kv_pos = jnp.arange(max_len)
+        if quant:
+            from tony_tpu.ops.decode import dequantize_kv
+
+            keys_att = dequantize_kv(keys_att, ks_att)
+            values_att = dequantize_kv(values_att, vs_att)
         # grouped attention: q [b, l, kvh, group, dh] against kv [b, m, kvh, dh]
         qg = q.astype(jnp.float32).reshape(b, l, kvh, group, dh)
         s = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
@@ -412,18 +471,58 @@ class Attention(nn.Module):
         return out.reshape(b, l, h, dh).astype(q.dtype)
 
 
+def _q8_shard_axes(cfg: TransformerConfig, name: str) -> tuple:
+    """(in_axis, out_axis) mesh axes for a QuantDense, mirroring the
+    'tp' preset's logical rules in logical_axis_rules_tree: q/wi/wg
+    column-parallel on heads/mlp, o/wo row-parallel, GQA k/v replicated
+    (kv_heads must never split over a bigger tensor axis). Falls back to
+    replication when the dim does not divide the axis."""
+    from tony_tpu.parallel.mesh import TENSOR
+
+    mesh = cfg.mesh
+    if mesh is None or mesh.shape.get(TENSOR, 1) <= 1:
+        return (None, None)
+    t = mesh.shape[TENSOR]
+    heads_ok = cfg.n_heads % t == 0
+    ff_ok = cfg.d_ff % t == 0
+    if name == "q":
+        return (None, TENSOR) if heads_ok else (None, None)
+    if name in ("k", "v"):
+        grouped = cfg.kv_heads != cfg.n_heads
+        return (None, TENSOR) if (not grouped and heads_ok) \
+            else (None, None)
+    if name == "o":
+        return (TENSOR, None) if heads_ok else (None, None)
+    if name in ("wi", "wg"):
+        return (None, TENSOR) if ff_ok else (None, None)
+    if name == "wo":
+        return (TENSOR, None) if ff_ok else (None, None)
+    return (None, None)
+
+
 class QuantDense(nn.Module):
     """int8 weight-only dense for SERVING (``cfg.quantized``): parameters
     are the converter's ``{kernel_q8 int8 [in_flat, out_flat], scale
     [out_flat], bias?}`` (see ``models.quantize``); the matmul runs
     through the pallas dequant kernel, so HBM traffic for weights is
     int8 — the decode-path bandwidth win (docs/PERF.md). Multi-dim
-    in/out axes (head projections) flatten around the 2-D kernel."""
+    in/out axes (head projections) flatten around the 2-D kernel.
+
+    Tensor parallelism: GSPMD cannot see inside a pallas call, so a
+    tensor-sharded q8 kernel would be silently all-gathered. When
+    ``mesh`` is set, ``shard_axes=(in_axis, out_axis)`` runs the kernel
+    under shard_map manual ONLY over those mesh axes (everything else —
+    data/fsdp batch sharding — stays under automatic propagation):
+    column-parallel (out_axis) shards are independent; row-parallel
+    (in_axis, the Megatron o/wo layout) psums partial products — the
+    per-output-channel scale distributes over the contraction sum."""
 
     features: tuple
     in_axes: int = 1
     use_bias: bool = False
     dtype: Any = jnp.bfloat16
+    mesh: Any = None
+    shard_axes: tuple = (None, None)
 
     @nn.compact
     def __call__(self, x):
@@ -442,8 +541,36 @@ class QuantDense(nn.Module):
         scale = self.param("scale", nn.initializers.ones, (out_flat,),
                            jnp.float32)
         lead = x.shape[:-self.in_axes]
-        y = q8_matmul(x.reshape(-1, in_flat).astype(self.dtype), w_q,
-                      scale, out_dtype=self.dtype)
+        x2 = x.reshape(-1, in_flat).astype(self.dtype)
+        in_ax, out_ax = self.shard_axes
+        if self.mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            from tony_tpu.parallel.mesh import DATA, FSDP
+
+            # manual over the WHOLE mesh (partial-manual shard_map needs
+            # explicit-type meshes): batch rows ride the data/fsdp axes
+            # when they divide, so dp x tp serving keeps its batch shards
+            import math
+
+            baxes = tuple(a for a in (DATA, FSDP)
+                          if self.mesh.shape.get(a, 1) > 1)
+            bsize = math.prod(self.mesh.shape[a] for a in baxes) \
+                if baxes else 1
+            bspec = baxes if baxes and x2.shape[0] % bsize == 0 else None
+
+            def local(xl, wl, sl):
+                y = q8_matmul(xl, wl, sl)
+                return jax.lax.psum(y, in_ax) if in_ax else y
+
+            y = jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(bspec, in_ax), P(in_ax, out_ax), P(out_ax)),
+                out_specs=P(bspec, out_ax),
+                check_vma=False,
+            )(x2, w_q, scale)
+        else:
+            y = q8_matmul(x2, w_q, scale)
         y = y.reshape(*lead, *feats)
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros, feats,
@@ -460,7 +587,8 @@ class MLP(nn.Module):
         cfg = self.cfg
         if cfg.quantized:
             dense = lambda name, feats: QuantDense(  # noqa: E731
-                (feats,), use_bias=cfg.use_bias, dtype=cfg.dtype, name=name)
+                (feats,), use_bias=cfg.use_bias, dtype=cfg.dtype, name=name,
+                mesh=cfg.mesh, shard_axes=_q8_shard_axes(cfg, name))
         else:
             dense = lambda name, feats: nn.Dense(  # noqa: E731
                 feats, use_bias=cfg.use_bias, dtype=cfg.dtype,
@@ -508,25 +636,30 @@ class MoEMLP(nn.Module):
             dropless=cfg.moe_dropless,
         )
         init = nn.initializers.normal(0.02)
-        params = {
-            "router": self.param("router", init,
-                                 (cfg.d_model, cfg.moe_num_experts),
-                                 jnp.float32),
-            "wi": self.param("wi", init,
-                             (cfg.moe_num_experts, cfg.d_model, d_ff),
-                             jnp.float32),
-            "wo": self.param("wo", init,
-                             (cfg.moe_num_experts, d_ff, cfg.d_model),
-                             jnp.float32),
-        }
-        if cfg.moe_gated:
-            params["wg"] = self.param(
-                "wg", init, (cfg.moe_num_experts, cfg.d_model, d_ff),
-                jnp.float32)
+        e = cfg.moe_num_experts
+        params = {"router": self.param("router", init,
+                                       (cfg.d_model, e), jnp.float32)}
+        names = ("wi", "wg", "wo") if cfg.moe_gated else ("wi", "wo")
+        for nm in names:
+            shp = (e, d_ff, cfg.d_model) if nm == "wo" \
+                else (e, cfg.d_model, d_ff)
+            if cfg.quantized:
+                # int8 expert weights + per-(expert, out-channel) scales
+                # (models/quantize.py Mixtral conversion)
+                params[nm + "_q8"] = self.param(
+                    nm + "_q8", nn.initializers.zeros, shp, jnp.int8)
+                params[nm + "_scale"] = self.param(
+                    nm + "_scale", nn.initializers.ones, (shp[0], shp[2]),
+                    jnp.float32)
+            else:
+                params[nm] = self.param(nm, init, shp, jnp.float32)
         # experts compute in cfg.dtype (bf16 on TPU); the router stays fp32 —
         # bf16 routing logits quantize near-tied gate probabilities and flip
-        # top-k choices step to step, destabilizing load balancing
-        cast = {k: (v if k == "router" else v.astype(cfg.dtype))
+        # top-k choices step to step, destabilizing load balancing. int8
+        # leaves and their fp32 scales pass through untouched (the pallas
+        # dequant matmul owns the cast).
+        cast = {k: (v if k == "router" or v.dtype == jnp.int8
+                    or k.endswith("_scale") else v.astype(cfg.dtype))
                 for k, v in params.items()}
         out, aux = moe_layer(cast, x, moe_cfg)
         if not self.is_initializing():
@@ -678,11 +811,18 @@ def logical_axis_rules_tree(params: Any) -> Any:
         return "/layers/" in joined
 
     head_counts: dict[str, int] = {}
+    q8_out: dict[str, int] = {}  # attn parent -> q kernel_q8 out_flat
     for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
         joined = "/" + "/".join(getattr(p, "key", str(p)) for p in path)
         off = 1 if is_stacked(joined) else 0
         if "/q/" in joined and getattr(leaf, "ndim", 0) == 3 + off:
             head_counts[joined.rsplit("/q/", 1)[0]] = leaf.shape[1 + off]
+        if joined.endswith("/q/kernel/b") and \
+                getattr(leaf, "ndim", 0) == 3 + off:
+            # LoRA trees carry no bare q kernel; B [r, h, dh] has the count
+            head_counts[joined.rsplit("/q/", 1)[0]] = leaf.shape[1 + off]
+        if joined.endswith("/q/kernel_q8"):
+            q8_out[joined.rsplit("/q/", 1)[0]] = leaf.shape[-1]
 
     def bias_axes(joined: str, x, off: int, leaf_dims: int) -> tuple:
         # use_bias=True (GPT-2 family): biases shard like their kernel's
@@ -707,13 +847,65 @@ def logical_axis_rules_tree(params: Any) -> Any:
         off = 1 if is_stacked(joined) else 0
         leaf_dims = x.ndim - off
         base: tuple
-        if "kernel_q8" in joined or joined.endswith("/scale"):
-            # quantized serving leaves: flattened [in_flat, out_flat]
-            # kernels don't match the fp rules' head/kv semantics —
-            # replicate rather than shard them wrongly (int8 serving is
-            # single-chip today; tp sharding of q8 weights is future work)
-            return ("layers",) + (None,) * leaf_dims if off \
-                else (None,) * leaf_dims
+        def _q8_dense_name() -> str | None:
+            # QuantDense leaves: .../<dense>/kernel_q8 and .../<dense>/scale
+            # (norm layers also own a "scale" param — only dense parents
+            # count). Returns the dense module name or None.
+            parts = joined.rsplit("/", 2)
+            if len(parts) == 3 and parts[2] in ("kernel_q8", "scale") \
+                    and parts[1] in ("q", "k", "v", "o", "wi", "wg", "wo"):
+                return parts[1]
+            return None
+
+        q8name = _q8_dense_name()
+        if q8name is not None:
+            # int8 serving leaves shard on the SAME logical axes as their
+            # bf16 kernels, on the flattened dims: out_flat carries the
+            # kernel's leading output axis ("heads"/"mlp"/"embed"), which
+            # QuantDense's shard_map branch runs as shard-local
+            # column-parallel pallas calls; o/wo in_flat carries the
+            # row-parallel axis (psum over partial products).
+            # GQA k/v (smaller out_flat than q) keep the always-replicated
+            # "kv_heads" so a big tensor axis never splits n_kv_heads.
+            parent = joined.rsplit("/", 2)[0]
+            if q8name in ("k", "v"):
+                q_out = q8_out.get(parent)
+                grouped = q_out is not None and x.shape[-1] != q_out
+                out_ax = "kv_heads" if grouped else "heads"
+            else:
+                out_ax = {"q": "heads", "o": "embed", "wi": "mlp",
+                          "wg": "mlp", "wo": "embed"}[q8name]
+            in_ax = {"q": "embed", "k": "embed", "v": "embed",
+                     "o": "heads", "wi": "embed", "wg": "embed",
+                     "wo": "mlp"}[q8name]
+            base = (in_ax, out_ax) if joined.endswith("/kernel_q8") \
+                else (out_ax,)
+            return ("layers",) + base if off else base
+        if joined.endswith(("/kernel/a", "/kernel/b")):
+            # LoRA adapters: A [in, r] shards its input dim like the host
+            # kernel's input; B [r, *out] carries the kernel's output axes
+            # (rank stays replicated — it is tiny)
+            kj = joined[: -2]  # .../kernel
+            if "/q/" in kj:
+                kin, kout = "embed", ("heads", "kv")
+            elif "/k/" in kj or "/v/" in kj:
+                s2 = "/k/" if "/k/" in kj else "/v/"
+                parent = kj.rsplit(s2, 1)[0]
+                grouped = (joined.endswith("/b") and x.ndim >= 2 + off
+                           and x.shape[1 + off] != head_counts.get(
+                               parent, x.shape[1 + off]))
+                kin, kout = "embed", ("kv_heads" if grouped else "heads",
+                                      "kv")
+            elif "/wi/" in kj or "/wg/" in kj:
+                kin, kout = "embed", ("mlp",)
+            elif "/wo/" in kj:
+                kin, kout = "mlp", ("embed",)
+            else:  # o (two contracted input dims) and anything exotic
+                base = (None,) * leaf_dims
+                return ("layers",) + base if off else base
+            base = (kin, None) if joined.endswith("/a") \
+                else ((None,) + kout)[:leaf_dims]
+            return ("layers",) + tuple(base) if off else tuple(base)
         if joined.endswith("/bias"):
             base = bias_axes(joined, x, off, leaf_dims)
         elif "pos_embedding" in joined:

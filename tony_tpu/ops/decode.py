@@ -1,0 +1,217 @@
+"""Flash-decode: single-query KV-cache attention as a pallas TPU kernel.
+
+The decode hot loop is HBM-bound (docs/PERF.md "Decode roofline"): every
+generated token re-reads the whole KV cache once. This kernel is the
+cache-side counterpart of the int8 weight path (ops/quant.py):
+
+- one grid step per (batch x kv_head, kv block): K/V tiles are DMA'd
+  HBM->VMEM once and consumed by an online-softmax accumulation held in
+  VMEM scratch — no [S] score tensor round-trips to HBM, and the
+  softmax/weighted-sum fuse into the tile pass (XLA's decode attention
+  materializes scores + probabilities in HBM at small batch);
+- the cache may be stored **int8 with per-(position, head) scales**
+  (quantize-on-write in models/transformer._decode_attention): tiles
+  cross HBM as int8 — HALF the cache traffic of bf16, the dominant
+  decode bytes at long context — and dequantize in VMEM right before
+  the MXU, exactly the ops/quant.py recipe for weights;
+- GQA: the q-head group [G, D] of each kv head rides one kernel
+  instance, so cache tiles are read ONCE per kv head (never repeated to
+  n_heads), preserving the GQA bandwidth saving end-to-end;
+- cache positions at/after ``length`` (and behind the sliding window)
+  are masked; blocks entirely outside [start, length) skip their FLOPs
+  via ``@pl.when`` predication.
+
+No reference analog (TonY ships no kernels; SURVEY.md section 2.5 —
+the data plane is delegated). Falls back to the pallas interpreter
+off-TPU so CPU tests pin exactness against the jax reference path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from tony_tpu.ops.platform import interpret_mode
+
+NEG_INF = -1e30
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, L, H, D] float -> (int8 values, fp32 scales [B, L, H]).
+    Symmetric absmax per (batch, position, head) — the KV analog of
+    ops/quant.quantize_q8's per-output-channel recipe; dequant is
+    ``q * scale[..., None]``."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def _vmem(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, *rest,
+                   block_k: int, scale: float, window: int,
+                   quant: bool):
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+    ki = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[0, 0]
+    start = jnp.maximum(length - window, 0) if window > 0 else 0
+
+    def _body():
+        q = q_ref[0]  # [Gp, D]
+        k = k_ref[0]  # [block_k, D] (int8 when quant)
+        v = v_ref[0]
+        if quant:
+            kf = k.astype(jnp.float32) * ks_ref[0, 0][:, None]
+            vf = v.astype(jnp.float32) * vs_ref[0, 0][:, None]
+        else:
+            kf, vf = k, v
+        s = jax.lax.dot_general(
+            q, kf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [Gp, block_k]
+        pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        visible = pos < length
+        if window > 0:
+            visible = visible & (pos >= start)
+        s = jnp.where(visible, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[:] = m_new
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(vf.dtype), vf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    # skip FLOPs for blocks wholly past `length` or behind the window
+    # (their DMA is already issued by BlockSpec — static grid — so this
+    # saves compute, not traffic; the traffic win comes from int8 tiles)
+    in_range = ki * block_k < length
+    if window > 0:
+        in_range = in_range & (ki * block_k + block_k > start)
+
+    @pl.when(in_range)
+    def _run():
+        _body()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _pick_block_k(limit: int, s: int) -> int:
+    """Largest multiple-of-8 divisor of ``s`` within ``limit``; a whole-
+    length single block is legal too (mosaic pads a full-dim block). Any
+    other non-8-multiple would be a sublane-misaligned TPU tile that only
+    the CPU interpreter accepts, so it is an error, not a fallback."""
+    if s <= limit:
+        return s
+    b = limit
+    for cand in range(b - b % 8, 7, -8):
+        if s % cand == 0:
+            return cand
+    raise ValueError(
+        f"no usable flash-decode block for cache length {s} (need a "
+        f"divisor <= {limit} that is a multiple of 8, or the whole "
+        f"length; pad max_seq_len to a multiple of 8)")
+
+
+@functools.partial(jax.jit, static_argnames=("window", "block_k",
+                                             "interpret"))
+def flash_decode(q, k, v, length, *, window: int = 0, block_k: int = 512,
+                 k_scale=None, v_scale=None, interpret: bool | None = None):
+    """Single-step decode attention over a static KV cache.
+
+    q: [B, H, D] — the one new query per sequence (head-grouped GQA ok).
+    k/v: [B, S, KVH, D] cache buffers — float, or int8 with
+      ``k_scale``/``v_scale`` [B, S, KVH] fp32 per-(position, head)
+      scales (quantize-on-write; see models/quantize.quantize_kv).
+    length: [B] int32 — valid cache length per sequence (query sits at
+      position ``length - 1``); positions >= length are masked.
+    window: sliding window (key visible iff 0 <= q_pos - k_pos < window).
+    Returns [B, H, D] in q's dtype.
+    """
+    b, h, d = q.shape
+    bs, s, kvh, dk = k.shape
+    if bs != b or dk != d or v.shape != k.shape:
+        raise ValueError(f"shape mismatch: q{q.shape} k{k.shape} v{v.shape}")
+    if h % kvh:
+        raise ValueError(f"q heads {h} not divisible by kv heads {kvh}")
+    quant = k.dtype == jnp.int8
+    if quant != (v.dtype == jnp.int8):
+        raise ValueError("k and v must both be int8 or both float")
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError("int8 cache needs k_scale and v_scale")
+    group = h // kvh
+    gp = -(-group // 8) * 8  # pad query rows to a legal sublane multiple
+    scale = d ** -0.5
+    if interpret is None:
+        interpret = interpret_mode()
+    bk = _pick_block_k(block_k, s)
+
+    # [B, H, D] -> [B*KVH, Gp, D] (group-major per kv head)
+    qr = q.reshape(b, kvh, group, d)
+    if gp != group:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    qr = qr.reshape(b * kvh, gp, d)
+    # [B, S, KVH, D] -> [B*KVH, S, D]
+    kr = k.transpose(0, 2, 1, 3).reshape(b * kvh, s, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * kvh, s, d)
+    len2 = jnp.broadcast_to(jnp.asarray(length, jnp.int32).reshape(-1, 1),
+                            (b, 1))  # scalar length broadcasts per batch
+
+    kernel = functools.partial(_decode_kernel, block_k=bk, scale=scale,
+                               window=window, quant=quant)
+    in_specs = [
+        pl.BlockSpec((1, gp, d), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, 1), lambda bh, ki: (bh // kvh, 0)),
+    ]
+    operands = [qr, kr, vr, len2]
+    if quant:
+        # [B, S, KVH] -> [B*KVH, 1, S]: lane-dim S keeps (1, bk) legal
+        ksr = k_scale.transpose(0, 2, 1).reshape(b * kvh, 1, s)
+        vsr = v_scale.transpose(0, 2, 1).reshape(b * kvh, 1, s)
+        in_specs += [
+            pl.BlockSpec((1, 1, bk), lambda bh, ki: (bh, 0, ki)),
+            pl.BlockSpec((1, 1, bk), lambda bh, ki: (bh, 0, ki)),
+        ]
+        operands += [ksr, vsr]
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * kvh, gp, d), q.dtype),
+        grid=(b * kvh, s // bk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, gp, d), lambda bh, ki: (bh, 0, 0)),
+        scratch_shapes=[_vmem((gp, 1)), _vmem((gp, 1)), _vmem((gp, d))],
+        interpret=interpret,
+    )(*operands)
+    out = out.reshape(b, kvh, gp, d)[:, :, :group]
+    return out.reshape(b, h, d)

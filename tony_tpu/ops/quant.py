@@ -94,3 +94,12 @@ def q8_matmul(x, w_q, scale, *, block_m: int = 128, block_n: int = 256,
         interpret=_interp(),
     )(x_in, w_q, scale)
     return out if m_pad == m else out[:m]
+
+# Tensor parallelism note: GSPMD cannot see inside a pallas_call (an
+# opaque custom call), so a tensor-sharded int8 kernel fed to q8_matmul
+# under bare pjit would be silently ALL-GATHERED before the kernel ran —
+# the opposite of the bandwidth win. The serving path therefore runs the
+# kernel under shard_map with explicit column/row-parallel specs: see
+# models.transformer.QuantDense (a custom_partitioning route was tried
+# and dropped — jax 0.9's Shardy glue hands the callbacks sub-axis
+# shardings it cannot convert mid-model).

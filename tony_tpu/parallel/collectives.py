@@ -9,7 +9,6 @@ for the cross-slice (DCN) helpers.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
 

@@ -20,7 +20,7 @@ Canonical axis names used across the framework:
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 import numpy as np

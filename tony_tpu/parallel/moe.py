@@ -66,8 +66,26 @@ def _expert_ffn(params: dict, x: jnp.ndarray, cfg: "MoEConfig",
                 up_spec: str, down_spec: str) -> jnp.ndarray:
     """Per-expert FFN shared by both paths: 2-matmul act(wi) or SwiGLU
     act(wg)*wi (``cfg.gated``), then wo. The einsum specs carry the
-    layout difference (routed [E,C,D] vs dropless [T,D]-broadcast)."""
+    layout difference (routed [E,C,D] vs dropless [T,D]-broadcast).
+
+    int8 serving (Mixtral --int8): ``wi_q8 [E, D, F] + wi_scale [E, F]``
+    (per-expert, per-output-channel — models/quantize.py) run through the
+    pallas dequant matmul vmapped over the expert dim: expert weights
+    cross HBM as int8, dequantized in VMEM, matching the q8 dense path.
+    The vmapped outputs are exactly the einsums' expert-major layouts
+    ([E, T, F] dropless / [E, C, F] routed)."""
     act = _act(cfg.activation)
+    if "wi_q8" in params:
+        from tony_tpu.ops.quant import q8_matmul
+
+        x_axis = None if x.ndim == 2 else 0  # dropless broadcasts tokens
+        up_mm = jax.vmap(q8_matmul, in_axes=(x_axis, 0, 0))
+        up = up_mm(x, params["wi_q8"], params["wi_scale"])
+        if cfg.gated:
+            h = act(up_mm(x, params["wg_q8"], params["wg_scale"])) * up
+        else:
+            h = act(up)
+        return jax.vmap(q8_matmul)(h, params["wo_q8"], params["wo_scale"])
     up = jnp.einsum(up_spec, x, params["wi"])
     if cfg.gated:
         h = act(jnp.einsum(up_spec, x, params["wg"])) * up

@@ -19,6 +19,18 @@ Test modes mirror the reference (`_build_fake_host_plan` :44-66, fast-fail
 exit :164-167): ``--fake`` writes a fake plan on a fake port with no
 server; ``--fail`` exits 1 immediately.
 
+Elastic mode (``--elastic --discover CMD``): the reference stubs its
+elastic driver entirely (``elastic_driver_fn`` at reference
+horovod_driver.py:28-29 is ``pass``, with the horovod.runner.elastic
+imports at :19-21 unused); here it is real. ``CMD`` is horovod's elastic
+host-discovery contract — a command printing one ``host:slots`` line per
+live host. The driver polls it, and on membership change rebuilds the
+slot plan under a bumped ``generation``, republishes the port file, and
+updates the KV store at ``/rendezvous/plan`` so running workers (and the
+coordinator, via the re-announced file) observe the new world size. This
+composes with the framework's own resize path (tony_tpu.elastic): point
+the discovery command at ``cli.resize``'s host list.
+
 Usage: ``python -m tony_tpu.runtime.horovod_driver -w host1:2,host2:1``
 """
 
@@ -28,6 +40,8 @@ import argparse
 import http.server
 import json
 import os
+import shlex
+import subprocess
 import sys
 import threading
 import time
@@ -144,16 +158,64 @@ def start_rendezvous_server() -> tuple[http.server.ThreadingHTTPServer, int]:
 # Port-file announcement (the TonY driver contract)
 # ---------------------------------------------------------------------------
 
-def create_port_file(directory: str, port: int, plan: list[dict]) -> str:
+def create_port_file(directory: str, port: int, plan: list[dict],
+                     generation: int | None = None) -> str:
     """Atomically write ``{port}____HOROVOD_RENDEZVOUS_SERVER____`` holding
-    the slot-plan JSON (ref: create_port_file :130-136)."""
+    the slot-plan JSON (ref: create_port_file :130-136). Elastic mode adds
+    a ``generation`` counter so consumers can detect replanning."""
     os.makedirs(directory, exist_ok=True)
     final = os.path.join(directory, f"{port}{PORT_FILE_SUFFIX}")
     tmp = final + ".tmp"
+    body = {"port": port, "slots": plan}
+    if generation is not None:
+        body["generation"] = generation
     with open(tmp, "w") as f:
-        json.dump({"port": port, "slots": plan}, f)
+        json.dump(body, f)
     os.replace(tmp, final)
     return final
+
+
+# ---------------------------------------------------------------------------
+# Elastic host discovery (the horovod discovery-script contract)
+# ---------------------------------------------------------------------------
+
+def run_discovery(cmd: str) -> list[tuple[str, int]] | None:
+    """Run the discovery command; parse one ``host[:slots]`` line per live
+    host (slots default 1 — horovod's contract). Returns None on failure
+    or empty output so the caller keeps the previous membership (a flaky
+    discovery probe must not dissolve the gang)."""
+    try:
+        proc = subprocess.run(shlex.split(cmd), capture_output=True,
+                              text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired, ValueError):
+        return None
+    if proc.returncode != 0:
+        return None
+    hosts: list[tuple[str, int]] = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        host, sep, n = line.partition(":")
+        try:
+            hosts.append((host, int(n) if sep else 1))
+        except ValueError:
+            return None
+    return hosts or None
+
+
+def publish_plan(port: int, hosts: list[tuple[str, int]], directory: str,
+                 generation: int) -> list[dict]:
+    """Rebuild + re-announce the slot plan: the port file (coordinator
+    contract) and the in-process KV store at ``/rendezvous/plan`` (running
+    workers poll it to observe resizes without re-reading files)."""
+    plan = build_slot_plan(hosts)
+    body = json.dumps({"port": port, "slots": plan,
+                       "generation": generation}).encode()
+    with _KVHandler.lock:
+        _KVHandler.store["/rendezvous/plan"] = body
+    create_port_file(directory, port, plan, generation=generation)
+    return plan
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -166,6 +228,14 @@ def main(argv: list[str] | None = None) -> int:
                     help="test mode: fake plan + fake port, no server")
     ap.add_argument("--fail", action="store_true",
                     help="test mode: exit 1 immediately (fast-fail)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="poll --discover for membership changes and "
+                         "republish the slot plan under a new generation")
+    ap.add_argument("--discover", default="",
+                    help="host-discovery command printing host[:slots] "
+                         "lines (horovod's elastic contract)")
+    ap.add_argument("--discover-interval", type=float, default=5.0,
+                    help="seconds between discovery polls")
     args = ap.parse_args(argv)
 
     if args.fail:
@@ -179,8 +249,30 @@ def main(argv: list[str] | None = None) -> int:
             time.sleep(3600)
 
     hosts = parse_worker_list(args.worker_list)
-    plan = build_slot_plan(hosts)
     server, port = start_rendezvous_server()
+    if args.elastic:
+        if not args.discover:
+            print("--elastic needs --discover", file=sys.stderr)
+            return 2
+        generation = 0
+        publish_plan(port, hosts, args.dir, generation)
+        try:
+            while True:
+                time.sleep(args.discover_interval)
+                new_hosts = run_discovery(args.discover)
+                # order-insensitive: discovery enumerating the same hosts
+                # in a different order must not reshuffle ranks
+                if new_hosts is not None and \
+                        sorted(new_hosts) != sorted(hosts):
+                    hosts = new_hosts
+                    generation += 1
+                    publish_plan(port, hosts, args.dir, generation)
+                    print(f"elastic replan: generation {generation}, "
+                          f"{sum(n for _, n in hosts)} slots", flush=True)
+        except KeyboardInterrupt:
+            server.shutdown()
+        return 0
+    plan = build_slot_plan(hosts)
     create_port_file(args.dir, port, plan)
     try:
         while True:

@@ -75,9 +75,12 @@ class HorovodDriver:
 
     @classmethod
     def create(cls, worker_list: str, workdir: str, fake: bool = False,
-               fail: bool = False, debug_command: str = "") -> "HorovodDriver":
+               fail: bool = False, debug_command: str = "",
+               discovery_command: str = "") -> "HorovodDriver":
         """Fork the driver script (or a user debug command, ref: debug mode
-        HorovodDriver.java:189-216) and wait for the port file."""
+        HorovodDriver.java:189-216) and wait for the port file.
+        ``discovery_command`` switches the driver to elastic mode (the
+        reference's elastic_driver_fn is a stub; see horovod_driver.py)."""
         os.makedirs(workdir, exist_ok=True)
         for stale in glob.glob(os.path.join(workdir, f"*{PORT_FILE_SUFFIX}")):
             os.remove(stale)
@@ -90,6 +93,8 @@ class HorovodDriver:
                 cmd.append("--fake")
             if fail:
                 cmd.append("--fail")
+            if discovery_command:
+                cmd += ["--elastic", "--discover", discovery_command]
         # the driver runs from the job workdir; make sure the package stays
         # importable there (agents may run from an unpacked staging dir)
         env = dict(os.environ)
@@ -251,10 +256,20 @@ class HorovodTaskAdapter(TaskAdapter):
         fake = ctx.conf.get_bool("tony.horovod.test-mode", False)
         fail = ctx.conf.get_bool("tony.horovod.test-fast-fail", False)
         debug_cmd = str(ctx.conf.get("tony.horovod.driver.debug-command", ""))
+        discover = ""
+        if ctx.conf.get_bool("tony.horovod.elastic", False):
+            discover = str(ctx.conf.get("tony.horovod.discovery-command",
+                                        ""))
+            if not discover:
+                # fail loudly, like the standalone driver's exit 2: a
+                # silently-static "elastic" job is the worst outcome
+                log.error("tony.horovod.elastic=true requires "
+                          "tony.horovod.discovery-command")
+                return C.EXIT_FAIL
         try:
             driver = HorovodDriver.create(
                 worker_list, workdir=ctx.workdir or ".", fake=fake, fail=fail,
-                debug_command=debug_cmd)
+                debug_command=debug_cmd, discovery_command=discover)
         except Exception:
             log.exception("rendezvous driver failed to start")
             return C.EXIT_FAIL

@@ -14,7 +14,6 @@ User scripts call ``tony_tpu.distributed.initialize()`` (reads this env) or
 
 from __future__ import annotations
 
-import json
 
 from tony_tpu import constants as C
 from tony_tpu.config import ConfError, TonyConf

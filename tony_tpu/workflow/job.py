@@ -15,13 +15,11 @@ Luigi config) and call ``run()`` gets a fully-formed tony-tpu submission.
 
 from __future__ import annotations
 
-import json
 import logging
 import os
 import uuid
 from dataclasses import dataclass, field
 
-from tony_tpu import constants as C
 from tony_tpu.config import TonyConf, build_conf
 
 log = logging.getLogger(__name__)

@@ -1,0 +1,183 @@
+#!/usr/bin/env python3
+"""In-tree lint gate (reference parity: build.gradle:113-116 wires
+Checkstyle + FindBugs into every build; this is the Python analog).
+
+The TPU image bakes no linter and installs are forbidden, so the gate is
+a fast AST/text checker covering the high-signal rules; `ruff.toml` at
+the repo root configures the same rules for CI environments that do have
+ruff (.github/workflows/ci.yml runs it when available and falls back to
+this script otherwise).
+
+Checks:
+  - the file parses (syntax gate)
+  - line length <= 99 (repo style is ~79 soft, 99 hard)
+  - no trailing whitespace, no tab indentation
+  - no bare `except:`
+  - no mutable default arguments (list/dict/set displays)
+  - unused module-level imports (skipped in __init__.py re-export files
+    and for names listed in __all__ or marked `# noqa`)
+  - imports positioned after code (E402-lite: only docstring, comments,
+    `from __future__`, and simple assignments may precede imports;
+    function-local imports are exempt — the repo uses them deliberately
+    for lazy heavy deps)
+
+Exit code 0 = clean; 1 = findings (printed one per line, file:line).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+MAX_LEN = 99
+SKIP_DIRS = {".git", "__pycache__", ".claude", "native"}
+
+
+def iter_py(root: Path):
+    for p in sorted(root.rglob("*.py")):
+        if not any(part in SKIP_DIRS for part in p.parts):
+            yield p
+
+
+def check_text(path: Path, src: str, out: list[str]):
+    for i, line in enumerate(src.splitlines(), 1):
+        if len(line) > MAX_LEN:
+            out.append(f"{path}:{i} line too long ({len(line)} > {MAX_LEN})")
+        if line != line.rstrip() and line.strip():
+            out.append(f"{path}:{i} trailing whitespace")
+        stripped = line.lstrip(" ")
+        if stripped.startswith("\t") or line.startswith("\t"):
+            out.append(f"{path}:{i} tab indentation")
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: Path, src_lines: list[str], out: list[str]):
+        self.path, self.lines, self.out = path, src_lines, out
+
+    def _noqa(self, node) -> bool:
+        line = self.lines[node.lineno - 1] if node.lineno <= len(self.lines) \
+            else ""
+        return "# noqa" in line
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None and not self._noqa(node):
+            self.out.append(f"{self.path}:{node.lineno} bare except")
+        self.generic_visit(node)
+
+    def _check_defaults(self, node):
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)) \
+                    and not self._noqa(d):
+                self.out.append(
+                    f"{self.path}:{d.lineno} mutable default argument")
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+
+def _imported_names(node) -> list[tuple[str, int]]:
+    if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+        return []  # compiler directive, not a binding anyone must use
+    names = []
+    for alias in node.names:
+        name = alias.asname or alias.name.split(".")[0]
+        if name != "*":
+            names.append((name, node.lineno))
+    return names
+
+
+def check_unused_imports(path: Path, tree: ast.Module, src: str,
+                         out: list[str]):
+    if path.name == "__init__.py":  # re-export files
+        return
+    lines = src.splitlines()
+    imported: dict[str, int] = {}
+    for node in tree.body:  # module level only
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for name, lineno in _imported_names(node):
+                if "# noqa" not in (lines[lineno - 1]
+                                    if lineno <= len(lines) else ""):
+                    imported[name] = lineno
+    if not imported:
+        return
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # the base Name node is visited separately
+    # names in __all__ strings count as used (re-exports)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__" \
+                        and isinstance(node.value, (ast.List, ast.Tuple)):
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant):
+                            used.add(str(elt.value))
+    for name, lineno in imported.items():
+        if name not in used:
+            out.append(f"{path}:{lineno} unused import '{name}'")
+
+
+def check_import_position(path: Path, tree: ast.Module, src: str,
+                          out: list[str]):
+    lines = src.splitlines()
+    seen_code = False
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            if seen_code:
+                line = lines[node.lineno - 1] \
+                    if node.lineno <= len(lines) else ""
+                if "# noqa" not in line:
+                    out.append(f"{path}:{node.lineno} import after "
+                               f"module-level code (E402)")
+        elif isinstance(node, ast.Expr):
+            # docstrings AND expression-statement calls: the canonical
+            # jax pattern sets os.environ / jax.config BEFORE importing
+            # the heavy modules — that must not force a noqa
+            continue
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue  # simple module constants before lazy imports are ok
+        elif isinstance(node, ast.If):
+            continue  # TYPE_CHECKING / platform guards
+        elif isinstance(node, ast.Try):
+            continue  # optional-dependency guards
+        else:
+            seen_code = True
+
+
+def main(argv=None) -> int:
+    roots = [Path(a) for a in (argv or sys.argv[1:])] or [Path(".")]
+    findings: list[str] = []
+    n = 0
+    for root in roots:
+        files = [root] if root.is_file() else list(iter_py(root))
+        for path in files:
+            n += 1
+            src = path.read_text(encoding="utf-8")
+            try:
+                tree = ast.parse(src, filename=str(path))
+            except SyntaxError as e:
+                findings.append(f"{path}:{e.lineno} syntax error: {e.msg}")
+                continue
+            check_text(path, src, findings)
+            _Visitor(path, src.splitlines(), findings).visit(tree)
+            check_unused_imports(path, tree, src, findings)
+            check_import_position(path, tree, src, findings)
+    for f in findings:
+        print(f)
+    print(f"lint: {n} files, {len(findings)} findings",
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
