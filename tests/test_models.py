@@ -1,5 +1,7 @@
 """Model + trainer smoke tests on CPU (tiny shapes)."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -216,6 +218,30 @@ def test_scan_layers_forward_decode_and_sharding():
     sh = tree_shardings(mesh, axes, "pp")
     assert sh["layers"]["block"]["mlp"]["wi"]["kernel"].spec[0] == "pipe"
     jax.device_put(variables["params"], sh)
+
+
+def test_remat_policy_dots_matches_nothing():
+    """remat_policy='dots' (keep matmul outputs, skip the 2N recompute)
+    is a scheduling choice only: grads must match full remat exactly."""
+    cfg = tiny_cfg(n_layers=2, scan_layers=True, remat=True)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0, 64)
+    params = Transformer(cfg).init(jax.random.PRNGKey(0), tokens)
+
+    def loss(c):
+        def f(p):
+            logits = Transformer(c).apply(p, tokens)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+        return jax.grad(f)(params)
+
+    g_nothing = loss(cfg)
+    for policy in ("dots", "attn_saved"):
+        g_p = loss(dataclasses.replace(cfg, remat_policy=policy))
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            g_nothing, g_p)
+    with pytest.raises(ValueError, match="remat_policy"):
+        Transformer(dataclasses.replace(cfg, remat_policy="bogus")).apply(
+            params, tokens)
 
 
 def test_scan_layers_trains_and_remat():

@@ -165,6 +165,33 @@ def test_chunked_xent_grads_match():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_chunked_xent_bf16_compute_dtype():
+    """compute_dtype=bf16 (the TPU head path: bf16 dot, fp32 accumulate)
+    stays within bf16 rounding of the fp32 loss, values AND grads."""
+    from tony_tpu.ops import chunked_cross_entropy, full_cross_entropy
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+    hidden = jax.random.normal(k1, (4, 16, 32))
+    emb = jax.random.normal(k2, (96, 32))
+    labels = jax.random.randint(jax.random.PRNGKey(8), (4, 16), 0, 96)
+    ref = full_cross_entropy(hidden, emb, labels)
+    got = chunked_cross_entropy(hidden, emb, labels, chunk_size=32,
+                                compute_dtype=jnp.bfloat16)
+    assert got.dtype == jnp.float32  # loss math stays fp32
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+    g_ref = jax.grad(full_cross_entropy, argnums=(0, 1))(
+        hidden.reshape(-1, 32), emb, labels.reshape(-1))
+    g_bf = jax.grad(
+        lambda h, e: chunked_cross_entropy(
+            h, e, labels.reshape(-1), chunk_size=32,
+            compute_dtype=jnp.bfloat16),
+        argnums=(0, 1))(hidden.reshape(-1, 32), emb)
+    for a, b in zip(g_bf, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-2, atol=5e-3)
+
+
 def test_chunked_xent_z_loss_and_jit():
     from tony_tpu.ops import chunked_cross_entropy
 

@@ -41,6 +41,21 @@ class TransformerConfig:
     attention_backend: str = "blockwise"  # reference|blockwise|ring|ulysses|pallas
     attention_block_size: int = 512
     remat: bool = False
+    # what the remat pass may KEEP from the forward instead of
+    # recomputing it for backward:
+    #   "nothing" — full per-block remat: minimum memory, but the whole
+    #     forward (~2N FLOPs) re-executes, capping model-FLOPs MFU at
+    #     6/8 of hardware utilization;
+    #   "dots" — keep matmul outputs, recompute only elementwise ops:
+    #     recompute FLOPs ~0 at O(tokens * (5*d + d_ff)) bytes/layer —
+    #     the right trade whenever it fits HBM (docs/PERF.md). The flash
+    #     attention call is a pallas custom_vjp, NOT a dot: its forward
+    #     still re-executes for backward under this policy;
+    #   "attn_saved" — the attention sublayer runs OUTSIDE the remat
+    #     region (its residuals, ~8 KB/token/layer in bf16, are saved,
+    #     so the flash forward never re-runs) and only the MLP is
+    #     rematted with dots kept. Fastest; costs the most HBM.
+    remat_policy: str = "nothing"  # nothing | dots | attn_saved
     mesh: Any = None  # required for the ring backend
     # architecture family knobs: the defaults are the Llama-style TPU
     # flagship (RMSNorm + RoPE + no biases + gelu); flipping them to
@@ -680,14 +695,40 @@ class Block(nn.Module):
         attn_out = Attention(self.cfg, name="attn")(
             make_norm(self.cfg, "ln1")(x), decode=decode,
             segment_ids=segment_ids)
-        ffn = (MoEMLP(self.cfg, name="moe") if self.use_moe
-               else MLP(self.cfg, name="mlp"))
+        ffn_cls = MoEMLP if self.use_moe else MLP
+        if (self.cfg.remat and not decode
+                and self.cfg.remat_policy == "attn_saved"):
+            # attn_saved: attention (above) stays un-rematted — its
+            # custom-vjp residuals are saved, the flash forward never
+            # re-runs — and only the FFN pays the remat pass, with its
+            # dot outputs kept
+            ffn_cls = nn.remat(
+                ffn_cls, policy=jax.checkpoint_policies.dots_saveable)
+        ffn = ffn_cls(self.cfg, name="moe" if self.use_moe else "mlp")
         if self.cfg.parallel_residual:
             # GPT-NeoX: both sublayers read the block INPUT; one residual
             # add (fuses into a single elementwise epilogue on TPU)
             return x + attn_out + ffn(make_norm(self.cfg, "ln2")(x))
         x = x + attn_out
         return x + ffn(make_norm(self.cfg, "ln2")(x))
+
+
+_STRUCTURAL = "structural"  # attn_saved: remat applied inside Block
+
+
+def _remat_policy(cfg: TransformerConfig):
+    """Map cfg.remat_policy to a jax.checkpoint policy, or _STRUCTURAL
+    for attn_saved (see the TransformerConfig field comment)."""
+    try:
+        return {
+            "nothing": jax.checkpoint_policies.nothing_saveable,
+            "dots": jax.checkpoint_policies.dots_saveable,
+            "attn_saved": _STRUCTURAL,
+        }[cfg.remat_policy]
+    except KeyError:
+        raise ValueError(
+            f"unknown remat_policy {cfg.remat_policy!r}; "
+            "expected 'nothing', 'dots' or 'attn_saved'") from None
 
 
 class _ScanBody(nn.Module):
@@ -729,8 +770,9 @@ class Transformer(nn.Module):
         cfg = self.cfg
         body = _ScanBody
         if cfg.remat and not decode:
-            body = nn.remat(
-                _ScanBody, policy=jax.checkpoint_policies.nothing_saveable)
+            policy = _remat_policy(cfg)
+            if policy is not _STRUCTURAL:  # attn_saved remats inside Block
+                body = nn.remat(_ScanBody, policy=policy)
         scanned = nn.scan(
             body,
             variable_axes={"params": 0, "cache": 0},
@@ -772,7 +814,10 @@ class Transformer(nn.Module):
         else:
             block = Block
             if cfg.remat and not decode:
-                block = nn.remat(Block, static_argnums=(2,))
+                policy = _remat_policy(cfg)
+                if policy is not _STRUCTURAL:
+                    block = nn.remat(Block, static_argnums=(2,),
+                                     policy=policy)
             for i in range(cfg.n_layers):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
                 x = block(cfg, use_moe=use_moe, name=f"block_{i}")(

@@ -50,6 +50,36 @@ def _causal_mask(qi, ki, block_q, block_k, window: int = 0):
     return mask
 
 
+def _block_interior(qi, ki, block_q, block_k, window: int):
+    """Grid predicate: is this tile FULLY visible (every q sees every k)?
+    Interior tiles skip the iota mask build + where entirely — at these
+    head dims the kernels are VPU-bound, and for causal seq/block ratios
+    around 4 most visible tiles are interior, so the saved elementwise
+    passes are a real fraction of kernel time."""
+    pred = qi * block_q >= ki * block_k + block_k - 1
+    if window > 0:
+        pred = pred & (qi * block_q + block_q - 1 - ki * block_k < window)
+    return pred
+
+
+def _dispatch_body(body, causal: bool, has_seg: bool, qi, ki,
+                   block_q: int, block_k: int, window: int):
+    """Shared tile dispatch for the three flash kernels: skip invisible
+    tiles, and run fully-visible (interior) tiles without the mask build.
+    ``body(masked)`` does the tile's work; segment ids are data-dependent
+    so they always mask."""
+    if not causal:
+        body(False)
+        return
+    vis = _block_visible(qi, ki, block_q, block_k, window)
+    if has_seg:
+        pl.when(vis)(lambda: body(True))
+        return
+    interior = _block_interior(qi, ki, block_q, block_k, window)
+    pl.when(vis & interior)(lambda: body(False))
+    pl.when(vis & jnp.logical_not(interior))(lambda: body(True))
+
+
 def _block_visible(qi, ki, block_q, block_k, window: int):
     """Grid predicate: does this (q block, kv block) tile contain ANY
     visible entry? Upper side: the tile's newest query must not precede
@@ -128,16 +158,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def _body():
+    def _body(masked: bool):
         # inputs stay in their native dtype (bf16 in production): the MXU
         # runs bf16 x bf16 -> fp32 accumulation at full rate; casting the
         # operands to fp32 first would halve matmul throughput
         q = q_ref[0]  # [block_q, d]
         k = k_ref[0]  # [block_k, d]
         v = v_ref[0]
+        # RAW scores: the softmax scale is folded into the exp (max
+        # commutes with positive scaling), so no [block_q, block_k]
+        # scaling pass ever runs — at d=64 the kernel is VPU-bound and
+        # every elementwise pass over the scores tile is ~a third of the
+        # matmul time
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
+                                preferred_element_type=jnp.float32)
+        if masked:
             mask = _causal_mask(qi, ki, block_q, block_k, window)
             if has_seg:
                 mask = mask & (qseg_ref[0, 0][:, None]
@@ -145,29 +180,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest,
             s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp((s - m_new) * scale)  # one fused sub-mul-exp pass
+        corr = jnp.exp((m_prev - m_new) * scale)
         l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         m_scr[:] = m_new
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        # skip kv blocks strictly above the diagonal or behind the window
-        @pl.when(_block_visible(qi, ki, block_q, block_k, window))
-        def _run():
-            _body()
-    else:
-        _body()
+    _dispatch_body(_body, causal, has_seg, qi, ki, block_q, block_k,
+                   window)
 
     @pl.when(ki_local == nk - 1)
     def _finalize():
         l_safe = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
         # logsumexp per q row ([block_q, 1], same layout as the scratch),
-        # saved for the backward's softmax recompute
-        lse_ref[0] = m_scr[:] + jnp.log(l_safe)
+        # saved for the backward's softmax recompute. m_scr holds the RAW
+        # running max, so it re-enters scaled space here.
+        lse_ref[0] = m_scr[:] * scale + jnp.log(l_safe)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -196,37 +227,36 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    def _body():
+    def _body(masked: bool):
         q = q_ref[0]  # native dtype: full-rate MXU, fp32 accumulation
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
+        # raw scores; scale folds into the fused exp below, and the dS
+        # scale is applied once to the [block_q, d] accumulator at
+        # finalize instead of per-body on the [block_q, block_k] tile
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
+                                preferred_element_type=jnp.float32)
+        if masked:
             mask = _causal_mask(qi, ki, block_q, block_k, window)
             if has_seg:
                 mask = mask & (qseg_ref[0, 0][:, None]
                                == kseg_ref[0, 0][None, :])
             s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0])  # lse block: [block_q, 1], broadcasts
+        p = jnp.exp(s * scale - lse_ref[0])  # lse: [block_q, 1] broadcast
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0]) * scale
+        ds = p * (dp - delta_ref[0])
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(_block_visible(qi, ki, block_q, block_k, window))
-        def _run():
-            _body()
-    else:
-        _body()
+    _dispatch_body(_body, causal, has_seg, qi, ki, block_q, block_k,
+                   window)
 
     @pl.when(ki_local == nk - 1)
     def _finalize():
-        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+        dq_ref[0] = (dq_scr[:] * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -261,42 +291,38 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    def _body():
+    def _body(masked: bool):
         q = q_ref[0]  # native dtype: full-rate MXU, fp32 accumulation
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
+        # raw scores (see the dQ kernel): scale folds into the exp; the
+        # dS scale lands on the [block_k, d] dK accumulator at finalize
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
+                                preferred_element_type=jnp.float32)
+        if masked:
             mask = _causal_mask(qi, ki, block_q, block_k, window)
             if has_seg:
                 mask = mask & (qseg_ref[0, 0][:, None]
                                == kseg_ref[0, 0][None, :])
             s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0])  # [block_q, block_k]
+        p = jnp.exp(s * scale - lse_ref[0])  # [block_q, block_k]
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0]) * scale
+        ds = p * (dp - delta_ref[0])
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        # q blocks whose last row is above this kv block, or whose first
-        # row is already past the window, see none of it
-        @pl.when(_block_visible(qi, ki, block_q, block_k, window))
-        def _run():
-            _body()
-    else:
-        _body()
+    _dispatch_body(_body, causal, has_seg, qi, ki, block_q, block_k,
+                   window)
 
     @pl.when(s_idx == ns - 1)
     def _finalize():
-        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_scr[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
