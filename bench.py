@@ -370,12 +370,20 @@ def bench_transformer(on_tpu: bool) -> dict:
         # VPU-bound on the softmax passes, and halving the score-element
         # count at equal d_model halves attention kernel time (measured
         # 2.1x on v5e, round 4) at identical parameter count.
+        # scan_layers=False: the scan machinery (residual stacking via
+        # dynamic-update-slice, per-layer param slicing) measured ~45 ms
+        # of a 257 ms device step; unrolled runs 235 ms vs 261 ms. The
+        # one-time unrolled compile (~4 min over the tunnel) amortizes
+        # through the persistent compile cache.
         cfg = TransformerConfig(
             vocab_size=32768, d_model=1024, n_layers=28, n_heads=8,
             d_ff=4096, max_seq_len=2048, attention_backend="pallas",
             attention_block_size=int(
                 os.environ.get("TONY_BENCH_LM_BLOCK", "512")),
-            scan_layers=True, remat=True,
+            attention_block_k=int(
+                os.environ.get("TONY_BENCH_LM_BLOCK_K", "1024")),
+            scan_layers=os.environ.get("TONY_BENCH_LM_SCAN", "0") == "1",
+            remat=True,
             remat_policy=os.environ.get("TONY_BENCH_LM_REMAT",
                                         "attn_saved"))
         # batch 4: the remat policies that keep activations (dots /

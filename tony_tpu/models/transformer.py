@@ -40,6 +40,11 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     attention_backend: str = "blockwise"  # reference|blockwise|ring|ulysses|pallas
     attention_block_size: int = 512
+    # pallas backend only: kv-block size when it should differ from the
+    # q-block size (0 = same). Measured on v5e at (b4, seq 2048, 8x128):
+    # block 512x1024 runs the fwd+bwd kernels 15% faster than 512x512 —
+    # half the kv-loop steps means half the per-body fixed VPU work.
+    attention_block_k: int = 0
     remat: bool = False
     # what the remat pass may KEEP from the forward instead of
     # recomputing it for backward:
@@ -191,7 +196,8 @@ def _attention(cfg: TransformerConfig, q, k, v, segment_ids=None):
 
         return flash_attention(q, k, v, causal=True,
                                block_q=cfg.attention_block_size,
-                               block_k=cfg.attention_block_size,
+                               block_k=(cfg.attention_block_k
+                                        or cfg.attention_block_size),
                                window=cfg.sliding_window,
                                segment_ids=segment_ids)
     raise ValueError(f"unknown attention backend {cfg.attention_backend}")
