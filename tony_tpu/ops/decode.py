@@ -63,7 +63,7 @@ def _vmem(shape):
 
 def _decode_kernel(q_ref, k_ref, v_ref, len_ref, *rest,
                    block_k: int, scale: float, window: int,
-                   quant: bool):
+                   quant: bool, kvh: int):
     if quant:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -77,7 +77,10 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, *rest,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    length = len_ref[0, 0]
+    # lengths live whole in SMEM (scalars don't tile: a (1, 1) VMEM
+    # block of an [B, 1] array fails Mosaic's sublane rule on-chip);
+    # indexed dynamically per grid row instead of via BlockSpec
+    length = len_ref[pl.program_id(0) // kvh, 0]
     start = jnp.maximum(length - window, 0) if window > 0 else 0
 
     def _body():
@@ -187,12 +190,14 @@ def flash_decode(q, k, v, length, *, window: int = 0, block_k: int = 512,
                             (b, 1))  # scalar length broadcasts per batch
 
     kernel = functools.partial(_decode_kernel, block_k=bk, scale=scale,
-                               window=window, quant=quant)
+                               window=window, quant=quant, kvh=kvh)
+    from jax.experimental.pallas import tpu as pltpu
+
     in_specs = [
         pl.BlockSpec((1, gp, d), lambda bh, ki: (bh, 0, 0)),
         pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
         pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
-        pl.BlockSpec((1, 1), lambda bh, ki: (bh // kvh, 0)),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
     ]
     operands = [qr, kr, vr, len2]
     if quant:

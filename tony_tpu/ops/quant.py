@@ -43,8 +43,11 @@ def _q8_matmul_kernel(x_ref, w_ref, s_ref, o_ref):
     x = x_ref[:].astype(jnp.float32)
     w = w_ref[:].astype(jnp.float32)  # int8 tile dequant happens IN VMEM
     acc = jnp.dot(x, w, preferred_element_type=jnp.float32)
-    o_ref[:] = (acc * s_ref[:].astype(jnp.float32)[None, :]) \
-        .astype(o_ref.dtype)
+    # s_ref is deliberately [1, bn] (2-D): Mosaic rejects 1-D blocks
+    # whose lane count disagrees with XLA's vector tiling (seen on-chip:
+    # f32[4096] laid out T(1024) vs a (256,) block); [1, bn] broadcasts
+    # over the [bm, bn] accumulator as-is
+    o_ref[:] = (acc * s_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("block_m", "block_n",
@@ -88,11 +91,11 @@ def q8_matmul(x, w_q, scale, *, block_m: int = 128, block_n: int = 256,
         in_specs=[
             pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
             pl.BlockSpec((k, bn), lambda i, j: (0, j)),
-            pl.BlockSpec((bn,), lambda i, j: (j,)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
         interpret=_interp(),
-    )(x_in, w_q, scale)
+    )(x_in, w_q, scale.reshape(1, n))
     return out if m_pad == m else out[:m]
 
 # Tensor parallelism note: GSPMD cannot see inside a pallas_call (an
