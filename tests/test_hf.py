@@ -5,6 +5,8 @@ positions, biases, tanh-gelu — and the weight mapping are both exact).
 Offline: the HF model is built from a config, no download.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -295,15 +297,25 @@ def test_sliding_window_blockwise_matches_reference():
     assert not np.allclose(np.asarray(full), np.asarray(ref), atol=1e-3)
 
 
-def test_sliding_window_rejected_on_unsupported_backend():
+def test_sliding_window_on_ring_backend():
+    """r4: ring composes with sliding windows (kernel parity is pinned in
+    tests/test_parallel.py); the model-level contract is now only that
+    the ring backend demands a mesh."""
     from tony_tpu.models import Transformer, TransformerConfig
+    from tony_tpu.parallel import MeshSpec, make_mesh
 
     cfg = TransformerConfig(vocab_size=32, d_model=16, n_heads=2, n_layers=1,
                             d_ff=32, max_seq_len=16, dtype=jnp.float32,
                             attention_backend="ring", sliding_window=4)
+    with pytest.raises(ValueError, match="mesh"):
+        Transformer(cfg).init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, 8), jnp.int32))
+    mesh = make_mesh(MeshSpec(data=-1, seq=2))
+    cfg = dataclasses.replace(cfg, mesh=mesh)
     model = Transformer(cfg)
-    with pytest.raises(ValueError, match="sliding_window"):
-        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    out = model.apply(params, jnp.zeros((2, 8), jnp.int32))
+    assert out.shape == (2, 8, 32)
 
 
 @pytest.fixture(scope="module")
