@@ -530,6 +530,74 @@ def bench_transformer(on_tpu: bool) -> dict:
     }
 
 
+def bench_long_seq(on_tpu: bool) -> dict:
+    """Long-context training on ONE chip: the 386M flagship at seq 8192
+    with a 1024-token sliding window through the banded flash kernel
+    (O(L*window) compute and HBM traffic — full causal at 8k would cost
+    4x the attention FLOPs and not fit the remat budget). Single-chip
+    long-seq is the building block under ring/ulysses sp (multi-chip
+    composition is covered by the driver's dryrun)."""
+    if not on_tpu:
+        return {"skipped": "long-seq training bench is TPU-only"}
+    if os.environ.get("TONY_BENCH_LONG_SEQ") == "0":
+        return {"skipped": "TONY_BENCH_LONG_SEQ=0"}
+    from tony_tpu.models import Transformer, TransformerConfig
+    from tony_tpu.ops import chunked_cross_entropy
+    from tony_tpu.parallel import data_parallel_mesh
+    from tony_tpu.parallel.sharding import batch_sharding
+    from tony_tpu.train import Trainer
+
+    seq, window, batch, steps = 8192, 1024, 1, 20
+    cfg = TransformerConfig(
+        vocab_size=32768, d_model=1024, n_layers=28, n_heads=8,
+        d_ff=4096, max_seq_len=seq, attention_backend="pallas",
+        attention_block_size=512, attention_block_k=1024,
+        sliding_window=window, scan_layers=False, remat=True,
+        remat_policy="attn_saved")
+    model = Transformer(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size, jnp.int32)
+    params = jax.device_get(model.init(jax.random.PRNGKey(0),
+                                       jnp.zeros((1, seq), jnp.int32)))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+
+    def apply_fn(p, train_batch):
+        hidden = model.apply(p, train_batch["tokens"], return_hidden=True)
+        return chunked_cross_entropy(
+            hidden[:, :-1], p["params"]["embedding"],
+            train_batch["tokens"][:, 1:], chunk_size=2048,
+            compute_dtype=jnp.bfloat16)
+
+    mesh = data_parallel_mesh()
+    trainer = Trainer(mesh=mesh, apply_fn=apply_fn,
+                      optimizer=optax.adamw(3e-4), donate=True,
+                      compute_dtype=jnp.bfloat16)
+    state = trainer.init_state(fresh(params))
+    step_fn, placed = trainer.build_step(state)
+    train_batch = {"tokens": jax.device_put(tokens, batch_sharding(mesh))}
+
+    def fw_step(carry):
+        new_state, metrics = step_fn(carry, train_batch)
+        return new_state, metrics["loss"]
+
+    _, placed = timed_round(fw_step, placed, 2)
+    rounds = []
+    for _ in range(3):
+        t_round, placed = timed_round(fw_step, placed, steps)
+        rounds.append(t_round)
+    t_step = sorted(rounds)[1] / steps
+    # windowed attention model FLOPs: each query sees <= window keys
+    flops_model = 6.0 * n_params * batch * seq \
+        + 6.0 * batch * seq * window * cfg.d_model * cfg.n_layers
+    peak = peak_flops_per_chip()
+    return {
+        "tokens_per_sec_per_chip": round(batch * seq / t_step, 1),
+        "seq_len": seq, "window": window, "batch": batch,
+        "step_ms": round(t_step * 1e3, 1),
+        "mfu": round(flops_model / t_step / peak, 4) if peak else 0.0,
+    }
+
+
 # --------------------------------------------------------------- decode
 
 
@@ -915,6 +983,10 @@ def main() -> None:
         extras["attention"] = bench_attention(on_tpu)
     except Exception as e:
         extras["attention"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        extras["long_seq"] = bench_long_seq(on_tpu)
+    except Exception as e:
+        extras["long_seq"] = {"error": f"{type(e).__name__}: {e}"}
     try:
         extras["decode"] = bench_decode(on_tpu)
     except Exception as e:
