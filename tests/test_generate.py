@@ -423,6 +423,38 @@ def test_beam_search_scan_layers_model():
         np.asarray(generate(model, params, prompt, max_new_tokens=4)))
 
 
+def test_generate_cli_bf16_serving(tmp_path):
+    """--dtype bf16 (the serving precision: half the decode parameter
+    traffic) runs the same checkpoint end-to-end; token COUNT contract
+    holds (bit-parity is an fp32 guarantee, not a bf16 one)."""
+    import os
+    import subprocess
+    import sys
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(config).eval()
+    mdir = tmp_path / "ckpt"
+    hf.save_pretrained(str(mdir))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tony_tpu.cli.generate", "--model", str(mdir),
+         "--token-ids", "1,2,3", "--max-new-tokens", "4",
+         "--dtype", "bf16", "--eos-id", "63"],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__)))})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    ids = [int(x) for x in proc.stdout.strip().split(",")]
+    assert ids[:3] == [1, 2, 3] and len(ids) == 7
+    assert all(0 <= i < 64 for i in ids)
+
+
 def test_score_cli_on_local_checkpoint(tmp_path):
     """tony-tpu score: perplexity must match a torch teacher-forced NLL."""
     import subprocess

@@ -58,6 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve with int8 weight-only quantization "
                         "(pallas dequant-matmul; half the weight bytes "
                         "per decode step)")
+    p.add_argument("--dtype", choices=("fp32", "bf16"), default="fp32",
+                   help="parameter storage dtype. Default fp32 keeps "
+                        "bit-exact greedy parity with the torch "
+                        "reference; pass bf16 for serving — decode is "
+                        "bandwidth-bound on parameter bytes, so bf16 "
+                        "storage halves per-token traffic (the standard "
+                        "accelerator serving precision)")
     p.add_argument("--compile-cache",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "tony_tpu", "compile-cache"),
@@ -120,6 +127,14 @@ def main(argv=None) -> int:
     from tony_tpu.models import generate
 
     model, params, config = load_model(args.model)
+    if args.dtype == "bf16" and not args.int8:
+        # cast ONCE at load: flax would otherwise re-read fp32 kernels
+        # from HBM every decode step and cast per-use (int8 mode has its
+        # own storage format; norm scales etc. it keeps follow here too)
+        params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if np.issubdtype(np.asarray(x).dtype, np.floating) else x,
+            params)
     if args.int8:
         from tony_tpu.models.quantize import quantize_cli
 

@@ -48,6 +48,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="score with int8 weight-only quantization (the "
                         "serving config; measures the quality cost of "
                         "--int8 generation)")
+    p.add_argument("--dtype", choices=("fp32", "bf16"), default="fp32",
+                   help="parameter storage dtype: score with bf16 to "
+                        "measure the quality cost of bf16 serving "
+                        "(generate --dtype bf16); default fp32")
     return p
 
 
@@ -119,6 +123,15 @@ def main(argv=None) -> int:
         with open(path, encoding="utf-8") as f:
             texts.append(f.read())
     model, params, config = load_model(args.model)
+    if args.dtype == "bf16" and not args.int8:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if np.issubdtype(np.asarray(x).dtype, np.floating) else x,
+            params)
     if args.int8:
         from tony_tpu.models.quantize import quantize_cli
 
