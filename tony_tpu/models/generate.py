@@ -13,6 +13,11 @@ flagship transformer is usable end-to-end. TPU-first design:
 - sampling (greedy / temperature / top-k) is branchless inside the scan
 - under a Mesh the cache shards like activations (batch on "data", heads
   on "tensor"), so tensor-parallel decode works unchanged via jit+sharding
+- serve with ``scan_layers=False`` (the checkpoint-import default):
+  scanned layers stack the caches [n_layers, ...] and every token then
+  pays a full per-layer-cache dynamic-slice/update-slice round trip —
+  measured 2.1x slower decode at d768x12L (docs/PERF.md). scan_layers
+  is a TRAINING compile-time optimization, not a serving one.
 """
 
 from __future__ import annotations
