@@ -66,3 +66,66 @@ def test_coordinator_command_queue():
         finally:
             coord.rpc.stop()
             coord.metrics_rpc.stop()
+
+
+# ------------------------------------------------------- xplane parsing
+
+
+def test_xplane_parse_cpu_trace(tmp_path):
+    """On the CPU backend the trace has host planes but no /device: plane
+    — the parser must say 'no device data' (None), not crash, so bench
+    callers can fall back to wall-clock."""
+    import jax
+
+    from tony_tpu.profiler import device_busy_ms, op_totals_ms, xplane
+
+    logdir = str(tmp_path / "trace")
+    f = jax.jit(lambda a: a @ a)
+    x = jnp.ones((16, 16))
+    f(x).block_until_ready()
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    f(x).block_until_ready()
+    jax.profiler.stop_trace()
+
+    files = xplane.xplane_files(logdir)
+    assert files, "trace wrote no xplane dump"
+    space = xplane.load_xspace(files[-1])
+    if space is None:  # proto stubs unavailable in this env: degraded mode
+        assert op_totals_ms(logdir) is None
+        assert device_busy_ms(logdir) is None
+        return
+    assert [p.name for p in space.planes]  # parsed something real
+    # CPU backend -> no TPU device plane -> None (graceful degradation)
+    if not xplane.device_planes(space):
+        assert device_busy_ms(logdir) is None
+
+
+def test_trace_device_ms_cpu_returns_none_or_positive():
+    import jax
+
+    from tony_tpu.profiler import trace_device_ms
+
+    f = jax.jit(lambda a: (a @ a).sum())
+    x = jnp.ones((16, 16))
+    f(x).block_until_ready()
+    out = trace_device_ms(f, (x,), steps=2)
+    assert out is None or out > 0
+
+
+def test_hbm_estimate_bytes():
+    import jax
+
+    from tony_tpu.profiler import hbm_estimate_bytes
+
+    f = jax.jit(lambda a: a @ a)
+    x = jnp.ones((64, 64), jnp.float32)
+    est = hbm_estimate_bytes(f, x)
+    # args (16 KB) + out (16 KB); CPU backends may report nothing (0)
+    assert est == 0 or est >= 2 * 64 * 64 * 4
+
+
+def test_hbm_estimate_bytes_bad_input_is_zero():
+    from tony_tpu.profiler import hbm_estimate_bytes
+
+    assert hbm_estimate_bytes(object()) == 0

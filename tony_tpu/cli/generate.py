@@ -127,13 +127,16 @@ def main(argv=None) -> int:
     from tony_tpu.models import generate
 
     model, params, config = load_model(args.model)
+    if args.dtype == "bf16" and args.int8:
+        print("note: --int8 supplies its own storage format; "
+              "--dtype bf16 is ignored", file=sys.stderr)
     if args.dtype == "bf16" and not args.int8:
         # cast ONCE at load: flax would otherwise re-read fp32 kernels
-        # from HBM every decode step and cast per-use (int8 mode has its
-        # own storage format; norm scales etc. it keeps follow here too)
+        # from HBM every decode step and cast per-use. Inspect x.dtype
+        # directly — np.asarray would pull every leaf to host first.
         params = jax.tree.map(
             lambda x: x.astype(jnp.bfloat16)
-            if np.issubdtype(np.asarray(x).dtype, np.floating) else x,
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
             params)
     if args.int8:
         from tony_tpu.models.quantize import quantize_cli

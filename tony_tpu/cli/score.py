@@ -123,14 +123,16 @@ def main(argv=None) -> int:
         with open(path, encoding="utf-8") as f:
             texts.append(f.read())
     model, params, config = load_model(args.model)
+    if args.dtype == "bf16" and args.int8:
+        print("note: --int8 supplies its own storage format; "
+              "--dtype bf16 is ignored", file=sys.stderr)
     if args.dtype == "bf16" and not args.int8:
         import jax
         import jax.numpy as jnp
-        import numpy as np
 
         params = jax.tree.map(
             lambda x: x.astype(jnp.bfloat16)
-            if np.issubdtype(np.asarray(x).dtype, np.floating) else x,
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
             params)
     if args.int8:
         from tony_tpu.models.quantize import quantize_cli

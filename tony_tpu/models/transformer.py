@@ -152,6 +152,10 @@ class TransformerConfig:
         if self.scan_layers and self.moe_every:
             raise ValueError("scan_layers needs uniform layers "
                              "(moe_every alternates block types)")
+        if self.remat_policy not in ("nothing", "dots", "attn_saved"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; "
+                "expected one of: nothing, dots, attn_saved")
 
     @property
     def head_dim(self) -> int:

@@ -5,11 +5,21 @@ from tony_tpu.profiler.profiler import (
     trigger_path,
     write_trigger,
 )
+from tony_tpu.profiler.xplane import (
+    device_busy_ms,
+    hbm_estimate_bytes,
+    op_totals_ms,
+    trace_device_ms,
+)
 
 __all__ = [
     "StepProfiler",
+    "device_busy_ms",
+    "hbm_estimate_bytes",
     "maybe_start_server",
+    "op_totals_ms",
     "trace",
+    "trace_device_ms",
     "trigger_path",
     "write_trigger",
 ]

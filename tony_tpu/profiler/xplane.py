@@ -1,0 +1,173 @@
+"""Direct xplane trace parsing — device-busy time without TensorBoard.
+
+The reference has no profiling subsystem at all (SURVEY.md §5.1); this
+is the analysis half of tony-tpu's greenfield tracing design
+(``profiler.py`` is the capture half). Motivation, measured on the
+tunneled TPU backend: wall-clock microbenches of small kernels are
+dominated by ~4.5 ms/launch of dispatch overhead — a 0.9 ms kernel
+"measures" 5.4 ms, and kernel A/B ratios swing 40% between identical
+runs. Device-busy time from the profiler's xplane trace has no launch
+overhead in it, so ratios derived from it are stable run-to-run.
+
+Parsing is done directly from the ``*.xplane.pb`` protos that
+``jax.profiler.start_trace`` writes:
+
+- ``tensorboard_plugin_profile``'s converter is broken in this image
+  (protobuf/pywrap mismatch), so we read the proto ourselves via
+  ``tensorflow.tsl.profiler.protobuf.xplane_pb2``.
+- ``PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python`` must be exported
+  before the first ``google.protobuf`` import or the C++ descriptor
+  pool rejects the generated code; this module sets it on import.
+- The device plane is named ``/device:TPU:N``; its ``XLA Ops`` line
+  carries one event per executed HLO op with ``duration_ps``. Summing
+  durations is safe: ops on one TPU core's line are serialized.
+
+Everything degrades to ``None``/empty off-TPU or when tensorflow is
+absent, so callers can fall back to wall-clock.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+_PS_PER_MS = 1e9
+
+
+def xplane_files(logdir: str) -> list[str]:
+    """All xplane dumps under a trace logdir, oldest -> newest."""
+    files = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                      recursive=True)
+    return sorted(files, key=os.path.getmtime)
+
+
+def load_xspace(path: str):
+    """Parse one ``*.xplane.pb`` into an XSpace proto. None if the
+    tensorflow proto stubs are unavailable OR the file is truncated/
+    corrupt (e.g. a killed earlier trace session) — degrade, don't
+    abort a caller's whole bench run."""
+    # the env var must be exported before the FIRST google.protobuf
+    # import or the C++ descriptor pool rejects the generated code; set
+    # it here (not at module import) so merely importing tony_tpu
+    # .profiler does not force the slower python protobuf impl on
+    # processes that never parse xplanes
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION",
+                          "python")
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+        space = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            space.ParseFromString(f.read())
+        return space
+    except Exception:
+        return None
+
+
+def device_planes(space) -> list:
+    """TPU (or GPU) device planes of an XSpace, excluding host planes."""
+    return [p for p in space.planes
+            if p.name.startswith("/device:") and "CUSTOM" not in p.name]
+
+
+def op_totals_ms(logdir: str, line_name: str = "XLA Ops") \
+        -> dict[str, float] | None:
+    """Total device-busy ms per op name, summed over every device plane
+    and xplane file under ``logdir``. None when nothing parseable."""
+    totals: dict[str, float] = {}
+    found = False
+    for path in xplane_files(logdir):
+        space = load_xspace(path)
+        if space is None:
+            continue  # unparseable dump: skip it, keep what parses
+        for plane in device_planes(space):
+            meta = {m.id: m.name for m in plane.event_metadata.values()}
+            for line in plane.lines:
+                if line.name != line_name:
+                    continue
+                found = True
+                for ev in line.events:
+                    name = meta.get(ev.metadata_id, str(ev.metadata_id))
+                    totals[name] = totals.get(name, 0.0) \
+                        + ev.duration_ps / _PS_PER_MS
+    return totals if found else None
+
+
+def device_busy_ms(logdir: str, line_name: str = "XLA Ops") -> float | None:
+    """Total device-busy ms across the trace (sum of the per-op line —
+    serialized per core, so the sum IS busy time). None when the trace
+    has no device plane (e.g. CPU backend) or protos are unavailable."""
+    totals = op_totals_ms(logdir, line_name)
+    if totals is None:
+        return None
+    return sum(totals.values())
+
+
+def trace_device_ms(fn, args=(), steps: int = 10,
+                    logdir: str | None = None) -> float | None:
+    """Device-busy ms per call of ``fn(*args)`` over ``steps`` traced
+    dispatches. The caller must have already compiled/warmed ``fn`` —
+    tracing starts immediately. Returns None off-TPU (no device plane).
+
+    The closing barrier is a scalar host fetch (the un-fakeable barrier:
+    on the tunneled backend block_until_ready can resolve before queued
+    work runs); its tiny convert program lands in the trace too, but at
+    nanoseconds it is noise against any kernel worth tracing.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    owned = logdir is None
+    logdir = logdir or tempfile.mkdtemp(prefix="tony_xplane_")
+    try:
+        jax.profiler.start_trace(logdir)
+        try:
+            out = None
+            for _ in range(steps):
+                out = fn(*args)
+            float(jnp.asarray(out).reshape(-1)[0].astype(jnp.float32))
+        finally:
+            jax.profiler.stop_trace()
+        busy = device_busy_ms(logdir)
+        return busy / steps if busy is not None else None
+    finally:
+        if owned:
+            shutil.rmtree(logdir, ignore_errors=True)
+
+
+def hbm_estimate_bytes(jitted, *args) -> int:
+    """Compile-time HBM footprint of a jitted step: argument + output +
+    temp bytes from XLA's memory analysis. On the tunneled backend
+    ``device.memory_stats()`` returns nothing (peak reads 0), but the
+    compile-time analysis is exact about what the executable will
+    reserve — it correctly predicted this repo's OOM boundaries.
+    Returns 0 when the backend offers no analysis."""
+    try:
+        return memory_bytes_of_compiled(jitted.lower(*args).compile())
+    except Exception:
+        return 0
+
+
+def memory_bytes_of_compiled(compiled) -> int:
+    """HBM bytes from an already-compiled executable's memory analysis
+    (callers that also need cost_analysis should lower+compile ONCE and
+    feed the result here — a flagship-sized re-trace costs minutes over
+    the tunnel). 0 when the backend offers no analysis."""
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return 0
+        peak = int(getattr(ma, "peak_memory_in_bytes", 0) or 0)
+        if peak > 0:
+            # measured >= argument+output+temp-alias on this backend:
+            # the compiler's own peak covers live buffers and temps
+            return peak
+        return int(getattr(ma, "argument_size_in_bytes", 0)
+                   + getattr(ma, "output_size_in_bytes", 0)
+                   + getattr(ma, "temp_size_in_bytes", 0)
+                   - getattr(ma, "alias_size_in_bytes", 0))
+    except Exception:
+        return 0
