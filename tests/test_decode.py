@@ -154,3 +154,55 @@ def test_int8_cache_vars_allocated(tiny_lm):
     assert keys and scales
     assert all(flat[k_].dtype == jnp.int8 for k_ in keys)
     assert all(flat[k_].dtype == jnp.float32 for k_ in scales)
+
+
+@pytest.fixture(scope="module")
+def qkv_mha():
+    # h == kvh and b*kvh % 8 == 0 -> the batched-rows MHA kernel
+    b, s, h, d = 2, 64, 8, 16
+    q = jax.random.normal(jax.random.PRNGKey(7), (b, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(8), (b, s, h, d))
+    v = jax.random.normal(jax.random.PRNGKey(9), (b, s, h, d))
+    return q, k, v
+
+
+@pytest.mark.parametrize("window", [0, 10])
+def test_flash_decode_mha_mixed_lengths(qkv_mha, window):
+    """The batched-rows MHA kernel assembles per-row lengths from SMEM
+    (rows of one 8-row block span batches with DIFFERENT lengths) and
+    gates blocks on the max/min over rows — exactness against the numpy
+    reference across mixed lengths and a sliding window."""
+    q, k, v = qkv_mha
+    length = jnp.asarray([37, 64], jnp.int32)
+    out = flash_decode(q, k, v, length, window=window, block_k=16)
+    ref = _ref_decode(q, k, v, length, window=window)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_decode_mha_int8_cache(qkv_mha):
+    """int8 cache through the MHA kernel's scale-tile dequant path."""
+    q, k, v = qkv_mha
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    length = jnp.asarray([29, 55], jnp.int32)
+    out = flash_decode(q, kq, vq, length, block_k=16, k_scale=ks,
+                       v_scale=vs)
+    ref_q = _ref_decode(q, dequantize_kv(kq, ks).astype(jnp.float32),
+                        dequantize_kv(vq, vs).astype(jnp.float32), length)
+    np.testing.assert_allclose(np.asarray(out), ref_q, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_decode_mha_windowed_int8(qkv_mha):
+    """Window + int8 + mixed lengths together on the MHA kernel (the
+    conservative in_range gate must not skip a block any row's window
+    still reaches)."""
+    q, k, v = qkv_mha
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    length = jnp.asarray([18, 62], jnp.int32)
+    out = flash_decode(q, kq, vq, length, window=12, block_k=16,
+                       k_scale=ks, v_scale=vs)
+    ref_q = _ref_decode(q, dequantize_kv(kq, ks).astype(jnp.float32),
+                        dequantize_kv(vq, vs).astype(jnp.float32),
+                        length, window=12)
+    np.testing.assert_allclose(np.asarray(out), ref_q, atol=2e-5, rtol=2e-5)

@@ -9,7 +9,10 @@ from tony_tpu.models.resnet import (
 from tony_tpu.models.generate import (beam_search, generate, init_cache,
                                       sample_logits)
 from tony_tpu.models.pipeline import pipelined_forward
-from tony_tpu.models.quantize import quantize_for_serving
+from tony_tpu.models.quantize import (
+    quantize_for_serving,
+    shard_expert_qparams,
+)
 from tony_tpu.models.hf import (
     convert_gpt2_state_dict,
     convert_llama_state_dict,
@@ -49,6 +52,7 @@ __all__ = [
     "generate",
     "pipelined_forward",
     "quantize_for_serving",
+    "shard_expert_qparams",
     "init_cache",
     "sample_logits",
     "ResNet",

@@ -29,39 +29,53 @@ from tony_tpu.ops.quant import quantize_q8
 _DENSE_NAMES = ("q", "k", "v", "o", "wi", "wg", "wo")
 
 
-def _quantize_kernel(kernel, is_o: bool):
+def _quantize_kernel(kernel, is_o: bool, on_device: bool = False):
     """kernel [in, *out] (q/k/v/wi/wg/wo) or [*in, out] (o) -> 2-D
-    int8 + per-output-channel scale, matching QuantDense's flatten."""
-    arr = np.asarray(kernel)
+    int8 + per-output-channel scale, matching QuantDense's flatten.
+
+    ``on_device``: keep the leaf a jax array so multi-GB checkpoints
+    already living in HBM never round-trip to host (the tunneled
+    backend's transfer path would dominate the conversion)."""
+    if on_device:
+        import jax.numpy as xp
+    else:
+        xp = np
+    arr = kernel if on_device else np.asarray(kernel)
     if is_o:  # o: [heads, dh, d] — leading axes are the INPUT
         in_flat = arr.shape[0] * arr.shape[1] if arr.ndim == 3 \
             else arr.shape[0]
-        w2 = arr.reshape(in_flat, arr.shape[-1])
+        w2 = xp.reshape(arr, (in_flat, arr.shape[-1]))
     else:  # [in, *out]
-        w2 = arr.reshape(arr.shape[0], -1)
+        w2 = xp.reshape(arr, (arr.shape[0], -1))
     w_q, scale = quantize_q8(w2)
     return {"kernel_q8": w_q, "scale": scale}
 
 
-def quantize_transformer_params(params: Any) -> Any:
+def quantize_transformer_params(params: Any, on_device: bool = False) -> Any:
     """params pytree (as from model.init / hf import) -> quantized tree.
-    Biases ride along unchanged; every other leaf passes through."""
+    Biases ride along unchanged; every other leaf passes through.
+    ``on_device``: quantize with jnp, for params already in HBM."""
 
-    def quantize_expert(arr) -> tuple[np.ndarray, np.ndarray]:
+    xp = np
+    if on_device:
+        import jax.numpy as xp  # noqa: F811
+
+    def quantize_expert(arr):
         # [E, in, out]: contraction over axis 1, so the per-output-channel
         # scale is per (expert, out) — the 3-D analog of quantize_q8
-        a = np.asarray(arr, np.float32)
-        absmax = np.max(np.abs(a), axis=1)
-        scale = np.maximum(absmax, 1e-8) / 127.0
-        q = np.clip(np.round(a / scale[:, None, :]), -127, 127) \
-            .astype(np.int8)
-        return q, scale.astype(np.float32)
+        a = xp.asarray(arr, xp.float32)
+        absmax = xp.max(xp.abs(a), axis=1)
+        scale = xp.maximum(absmax, 1e-8) / 127.0
+        q = xp.clip(xp.round(a / scale[:, None, :]), -127, 127) \
+            .astype(xp.int8)
+        return q, scale.astype(xp.float32)
 
     def walk(node, name=""):
         if not isinstance(node, dict):
             return node
         if "kernel" in node and name in _DENSE_NAMES:
-            out = _quantize_kernel(node["kernel"], is_o=(name == "o"))
+            out = _quantize_kernel(node["kernel"], is_o=(name == "o"),
+                                   on_device=on_device)
             if "bias" in node:
                 out["bias"] = node["bias"]
             extra = set(node) - {"kernel", "bias"}
@@ -83,17 +97,46 @@ def quantize_transformer_params(params: Any) -> Any:
     return walk(params)
 
 
-def quantize_for_serving(model: Transformer, params: Any
+def quantize_for_serving(model: Transformer, params: Any,
+                         on_device: bool = False
                          ) -> tuple[Transformer, Any]:
     """(model, params) -> (quantized model, quantized params): the
     returned pair drops into generate()/score exactly like the original.
+    ``on_device``: convert with jnp so a multi-GB tree already in HBM
+    never round-trips through host memory.
     """
     cfg = model.cfg
     if cfg.scan_layers:
         raise ValueError("int8 serving conversion expects per-block "
                          "params (scan_layers stacks them)")
     qcfg = dataclasses.replace(cfg, quantized=True)
-    return Transformer(qcfg), quantize_transformer_params(params)
+    return Transformer(qcfg), quantize_transformer_params(
+        params, on_device=on_device)
+
+
+def shard_expert_qparams(mesh, qparams: Any, axis: str = "expert") -> Any:
+    """Place a quantized tree's MoE expert weights SHARDED on ``axis``
+    (wi/wg/wo_q8 on dim 0, their scales likewise) and leave everything
+    else where it is. This is the placement the shard_mapped q8 expert
+    FFN consumes (parallel/moe.py): per-device HBM holds only E/ways
+    experts — how a 47B-class Mixtral fits a slice. Pair with a
+    TransformerConfig whose ``mesh`` carries the same axis."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def place(node, name=""):
+        if isinstance(node, dict):
+            return {k: place(v, k) for k, v in node.items()}
+        if name in ("wi_q8", "wg_q8", "wo_q8"):
+            return jax.device_put(jnp.asarray(node),
+                                  NamedSharding(mesh, P(axis, None, None)))
+        if name in ("wi_scale", "wg_scale", "wo_scale"):
+            return jax.device_put(jnp.asarray(node),
+                                  NamedSharding(mesh, P(axis, None)))
+        return node
+
+    return place(qparams)
 
 
 def quantize_cli(model, params):

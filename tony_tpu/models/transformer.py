@@ -659,6 +659,11 @@ class MoEMLP(nn.Module):
             activation=cfg.moe_activation,
             renormalize_top_k=cfg.moe_renormalize,
             dropless=cfg.moe_dropless,
+            # int8 + EP serving: with cfg.mesh carrying an expert axis,
+            # the q8 expert FFN runs shard-mapped over it so quantized
+            # expert weights SHARD instead of replicating (the 47B-
+            # Mixtral-on-a-slice requirement; see parallel/moe.py)
+            mesh=cfg.mesh,
         )
         init = nn.initializers.normal(0.02)
         e = cfg.moe_num_experts

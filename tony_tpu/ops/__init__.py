@@ -1,8 +1,14 @@
+from tony_tpu.ops.adamw import (
+    FusedAdamW,
+    FusedAdamWState,
+    fused_adamw_update,
+)
 from tony_tpu.ops.attention import flash_attention
 from tony_tpu.ops.fused import add_rmsnorm, rmsnorm
 from tony_tpu.ops.quant import dequantize_q8, q8_matmul, quantize_q8
 from tony_tpu.ops.xent import chunked_cross_entropy, full_cross_entropy
 
-__all__ = ["flash_attention", "rmsnorm", "add_rmsnorm",
+__all__ = ["FusedAdamW", "FusedAdamWState", "fused_adamw_update",
+           "flash_attention", "rmsnorm", "add_rmsnorm",
            "chunked_cross_entropy", "full_cross_entropy",
            "quantize_q8", "dequantize_q8", "q8_matmul"]

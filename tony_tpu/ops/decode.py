@@ -128,6 +128,87 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, *rest,
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
 
 
+def _decode_kernel_mha(q_ref, k_ref, v_ref, len_ref, *rest,
+                       block_k: int, scale: float, window: int,
+                       quant: bool, kvh: int, bh_blk: int):
+    """Batched-rows variant for MHA decode (group == 1).
+
+    The GQA kernel pads each kv head's single query row to 8 sublanes
+    and runs one grid instance per (batch x head) — at short cache that
+    is b*h tiny instances whose fixed cost (DMA setup, grid step) beats
+    the useful work, exactly where the XLA einsum used to win
+    (VERDICT r4 #1/#4: 0.89x at cache 512). Here ``bh_blk`` (batch x
+    head) rows ride ONE instance: 8 real query rows fill the sublanes
+    that padding wasted, DMA tiles are 8x larger, and the instance count
+    drops 8x. The score/value contractions become VPU
+    multiply-reductions (each row has its own K/V — there is no shared
+    matmul), which decode can afford: it is bandwidth-bound, and the VPU
+    work is microseconds against the cache-read time.
+    """
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+    ki = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # per-row cache lengths: rows of this block may span batches; SMEM
+    # scalar reads (unrolled: bh_blk is static) assemble the column
+    row0 = pl.program_id(0) * bh_blk
+    lens = jnp.stack([len_ref[(row0 + i) // kvh, 0]
+                      for i in range(bh_blk)]).reshape(bh_blk, 1)
+    maxlen = jnp.max(lens)
+
+    def _body():
+        q = q_ref[:].astype(jnp.float32)          # [bh, D]
+        k = k_ref[:]                              # [bh, block_k, D]
+        v = v_ref[:]
+        if quant:
+            kf = k.astype(jnp.float32) * ks_ref[:, 0, :][:, :, None]
+            vf = v.astype(jnp.float32) * vs_ref[:, 0, :][:, :, None]
+        else:
+            kf = k.astype(jnp.float32)
+            vf = v.astype(jnp.float32)
+        # each row contracts against its own K tile: VPU mul-reduce over
+        # D (lane dim), not a matmul
+        s = jnp.sum(q[:, None, :] * kf, axis=2) * scale  # [bh, block_k]
+        pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        visible = pos < lens
+        if window > 0:
+            visible = visible & (pos >= jnp.maximum(lens - window, 0))
+        s = jnp.where(visible, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[:] = m_new
+        acc_scr[:] = acc_scr[:] * corr + jnp.sum(
+            p[:, :, None] * vf, axis=1)  # [bh, D]
+
+    in_range = ki * block_k < maxlen
+    if window > 0:
+        # conservative: any row's window may reach into this block
+        in_range = in_range & (ki * block_k + block_k
+                               > jnp.min(jnp.maximum(lens - window, 0)))
+
+    @pl.when(in_range)
+    def _run():
+        _body()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[:] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
 def _pick_block_k(limit: int, s: int) -> int:
     """Largest multiple-of-8 divisor of ``s`` within ``limit``; a whole-
     length single block is legal too (mosaic pads a full-dim block). Any
@@ -178,21 +259,60 @@ def flash_decode(q, k, v, length, *, window: int = 0, block_k: int = 512,
         interpret = interpret_mode()
     bk = _pick_block_k(block_k, s)
 
-    # [B, H, D] -> [B*KVH, Gp, D] (group-major per kv head)
-    qr = q.reshape(b, kvh, group, d)
-    if gp != group:
-        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
-    qr = qr.reshape(b * kvh, gp, d)
+    from jax.experimental.pallas import tpu as pltpu
+
     # [B, S, KVH, D] -> [B*KVH, S, D]
     kr = k.transpose(0, 2, 1, 3).reshape(b * kvh, s, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * kvh, s, d)
     len2 = jnp.broadcast_to(jnp.asarray(length, jnp.int32).reshape(-1, 1),
                             (b, 1))  # scalar length broadcasts per batch
+    if quant:
+        # [B, S, KVH] -> [B*KVH, 1, S]: lane-dim S keeps (1, bk) legal
+        ksr = k_scale.transpose(0, 2, 1).reshape(b * kvh, 1, s)
+        vsr = v_scale.transpose(0, 2, 1).reshape(b * kvh, 1, s)
+
+    bh_blk = 8
+    if group == 1 and (b * kvh) % bh_blk == 0:
+        # MHA: 8 (batch x head) rows per instance — fills the sublanes
+        # the GQA kernel padded, 8x fewer instances, 8x larger DMA tiles
+        # (the short-cache regime where per-instance cost dominated)
+        qr = q.reshape(b * kvh, d)
+        kernel = functools.partial(
+            _decode_kernel_mha, block_k=bk, scale=scale, window=window,
+            quant=quant, kvh=kvh, bh_blk=bh_blk)
+        in_specs = [
+            pl.BlockSpec((bh_blk, d), lambda bh, ki: (bh, 0)),
+            pl.BlockSpec((bh_blk, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((bh_blk, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ]
+        operands = [qr, kr, vr, len2]
+        if quant:
+            in_specs += [
+                pl.BlockSpec((bh_blk, 1, bk), lambda bh, ki: (bh, 0, ki)),
+                pl.BlockSpec((bh_blk, 1, bk), lambda bh, ki: (bh, 0, ki)),
+            ]
+            operands += [ksr, vsr]
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((b * kvh, d), q.dtype),
+            grid=(b * kvh // bh_blk, s // bk),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((bh_blk, d), lambda bh, ki: (bh, 0)),
+            scratch_shapes=[_vmem((bh_blk, 1)), _vmem((bh_blk, 1)),
+                            _vmem((bh_blk, d))],
+            interpret=interpret,
+        )(*operands)
+        return out.reshape(b, h, d)
+
+    # [B, H, D] -> [B*KVH, Gp, D] (group-major per kv head)
+    qr = q.reshape(b, kvh, group, d)
+    if gp != group:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    qr = qr.reshape(b * kvh, gp, d)
 
     kernel = functools.partial(_decode_kernel, block_k=bk, scale=scale,
                                window=window, quant=quant, kvh=kvh)
-    from jax.experimental.pallas import tpu as pltpu
-
     in_specs = [
         pl.BlockSpec((1, gp, d), lambda bh, ki: (bh, 0, 0)),
         pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
@@ -201,9 +321,6 @@ def flash_decode(q, k, v, length, *, window: int = 0, block_k: int = 512,
     ]
     operands = [qr, kr, vr, len2]
     if quant:
-        # [B, S, KVH] -> [B*KVH, 1, S]: lane-dim S keeps (1, bk) legal
-        ksr = k_scale.transpose(0, 2, 1).reshape(b * kvh, 1, s)
-        vsr = v_scale.transpose(0, 2, 1).reshape(b * kvh, 1, s)
         in_specs += [
             pl.BlockSpec((1, 1, bk), lambda bh, ki: (bh, 0, ki)),
             pl.BlockSpec((1, 1, bk), lambda bh, ki: (bh, 0, ki)),

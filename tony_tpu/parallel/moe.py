@@ -10,6 +10,7 @@ exchange to all-to-all over ICI — no hand-written comms.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +34,14 @@ class MoEConfig:
     # eval/checkpoint-parity path. False = capacity-limited dispatch
     # einsums (all-to-all under pjit), the training path.
     dropless: bool = False
+    # int8 expert serving over expert parallelism: a vmapped pallas call
+    # is opaque to GSPMD, so expert-sharded q8 weights fed to the vmapped
+    # dequant matmul under bare pjit would be ALL-GATHERED (defeating the
+    # only way a 47B Mixtral fits a slice). With ``mesh`` set and the
+    # ``expert_axis`` present, the q8 expert FFN runs under shard_map over
+    # that axis: each device dequant-matmuls its LOCAL experts only.
+    mesh: Any = None
+    expert_axis: str = "expert"
 
 
 def _act(name: str):
@@ -76,22 +85,66 @@ def _expert_ffn(params: dict, x: jnp.ndarray, cfg: "MoEConfig",
     ([E, T, F] dropless / [E, C, F] routed)."""
     act = _act(cfg.activation)
     if "wi_q8" in params:
-        from tony_tpu.ops.quant import q8_matmul
-
         x_axis = None if x.ndim == 2 else 0  # dropless broadcasts tokens
-        up_mm = jax.vmap(q8_matmul, in_axes=(x_axis, 0, 0))
-        up = up_mm(x, params["wi_q8"], params["wi_scale"])
-        if cfg.gated:
-            h = act(up_mm(x, params["wg_q8"], params["wg_scale"])) * up
-        else:
-            h = act(up)
-        return jax.vmap(q8_matmul)(h, params["wo_q8"], params["wo_scale"])
+        ep = _expert_shards(cfg)
+        if ep > 1:
+            # expert-sharded int8 serving: shard_map over the expert axis
+            # so each device's pallas dequant matmul sees only its local
+            # expert shard (vmapped pallas is opaque to GSPMD — bare pjit
+            # would all-gather the very weights EP exists to split)
+            from jax.sharding import PartitionSpec as P
+
+            ax = cfg.expert_axis
+            w3, w2 = P(ax, None, None), P(ax, None)
+            xspec = P(None, None) if x_axis is None else P(ax, None, None)
+            names = [nm for nm in ("wi", "wg", "wo")
+                     if nm + "_q8" in params]
+            weights = [params[nm + sfx] for nm in names
+                       for sfx in ("_q8", "_scale")]
+            w_specs = [sp for _ in names for sp in (w3, w2)]
+
+            def local_ffn(x_l, *flat):
+                local = {nm + sfx: flat[2 * i + j]
+                         for i, nm in enumerate(names)
+                         for j, sfx in enumerate(("_q8", "_scale"))}
+                return _q8_expert_ffn(local, x_l, x_axis, act, cfg.gated)
+
+            return jax.shard_map(
+                local_ffn, mesh=cfg.mesh,
+                in_specs=(xspec, *w_specs),
+                out_specs=P(ax, None, None),
+                check_vma=False,
+            )(x, *weights)
+        return _q8_expert_ffn(params, x, x_axis, act, cfg.gated)
     up = jnp.einsum(up_spec, x, params["wi"])
     if cfg.gated:
         h = act(jnp.einsum(up_spec, x, params["wg"])) * up
     else:
         h = act(up)
     return jnp.einsum(down_spec, h, params["wo"])
+
+
+def _expert_shards(cfg: MoEConfig) -> int:
+    """Way size of the expert axis when the q8 shard_map path applies
+    (mesh set, axis present, experts divisible); 1 = run unsharded."""
+    if cfg.mesh is None or cfg.expert_axis not in cfg.mesh.shape:
+        return 1
+    ways = cfg.mesh.shape[cfg.expert_axis]
+    return ways if ways > 1 and cfg.num_experts % ways == 0 else 1
+
+
+def _q8_expert_ffn(params: dict, x, x_axis, act, gated: bool):
+    """The vmapped int8 expert FFN body (shard-local or global): expert
+    weights cross HBM as int8 tiles and dequantize in VMEM (ops/quant)."""
+    from tony_tpu.ops.quant import q8_matmul
+
+    up_mm = jax.vmap(q8_matmul, in_axes=(x_axis, 0, 0))
+    up = up_mm(x, params["wi_q8"], params["wi_scale"])
+    if gated:
+        h = act(up_mm(x, params["wg_q8"], params["wg_scale"])) * up
+    else:
+        h = act(up)
+    return jax.vmap(q8_matmul)(h, params["wo_q8"], params["wo_scale"])
 
 
 def init_moe_params(key, cfg: MoEConfig, dtype=jnp.float32) -> dict:

@@ -128,7 +128,9 @@ def trace_device_ms(fn, args=(), steps: int = 10,
             out = None
             for _ in range(steps):
                 out = fn(*args)
-            float(jnp.asarray(out).reshape(-1)[0].astype(jnp.float32))
+            # first leaf: fn may return a pytree, not a bare array
+            leaf = jax.tree.leaves(out)[0]
+            float(jnp.asarray(leaf).reshape(-1)[0].astype(jnp.float32))
         finally:
             jax.profiler.stop_trace()
         busy = device_busy_ms(logdir)

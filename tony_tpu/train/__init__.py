@@ -13,6 +13,7 @@ from tony_tpu.train.lora import (
     merge_lora,
     wrap_apply_fn,
 )
+from tony_tpu.ops.adamw import FusedAdamW, FusedAdamWState
 from tony_tpu.train.trainer import (
     Trainer,
     TrainState,
@@ -21,6 +22,8 @@ from tony_tpu.train.trainer import (
 )
 
 __all__ = [
+    "FusedAdamW",
+    "FusedAdamWState",
     "lora_init",
     "lora_param_count",
     "materialize_lora",

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tony_tpu.ops.adamw import FusedAdamW, fused_adamw_update
 from tony_tpu.parallel.sharding import batch_sharding, shard_params_by_size
 
 
@@ -85,10 +86,18 @@ class Trainer:
     batch_shardings: Any = None
 
     def init_state(self, params) -> TrainState:
+        if isinstance(self.optimizer, FusedAdamW):
+            # compute-dtype carry (accum path keeps fp32 grads — bf16
+            # microbatch accumulation would compound rounding)
+            opt_state = self.optimizer.init(
+                params, compute_dtype=self.compute_dtype
+                if max(self.accum_steps, 1) == 1 else None)
+        else:
+            opt_state = self.optimizer.init(params)
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
-            opt_state=self.optimizer.init(params),
+            opt_state=opt_state,
         )
 
     def state_shardings(self, state: TrainState):
@@ -176,14 +185,52 @@ class Trainer:
             return loss_sum * scale, jax.tree.map(
                 lambda g: g * scale, grad_sum)
 
+        fused = isinstance(self.optimizer, FusedAdamW)
+        # compute-dtype carry: the fused update emits the bf16 copy of
+        # the new params from the SAME pass that writes the fp32 master;
+        # the next step forwards/backwards through that copy. The
+        # separate master->bf16 cast pass disappears, the backward
+        # writes bf16 grad leaves, and the update reads them as bf16 —
+        # ~3 GB/step less HBM traffic at the 386M flagship.
+        carry_compute = (fused and self.compute_dtype is not None
+                         and accum == 1)
+        if fused:
+            # the fused path needs each param's PartitionSpec so sharded
+            # leaves run their pallas update under shard_map (a pallas
+            # call is opaque to GSPMD — bare pjit would all-gather)
+            param_specs = jax.tree.map(lambda s: s.spec, shardings.params)
+
         def step_fn(state: TrainState, batch):
-            loss, grads = grads_of(state.params, batch)
-            updates, opt_state = self.optimizer.update(
-                grads, state.opt_state, state.params)
-            params = optax.apply_updates(state.params, updates)
+            if carry_compute:
+                # grads arrive in compute dtype (the one numerics change
+                # of the carry: one rounding of each grad leaf — the
+                # products were bf16 with f32 accumulation either way).
+                # loss_fn is reused as-is: its to_compute on the carried
+                # bf16 params is an identity cast XLA elides.
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    state.opt_state.compute_params, batch)
+            else:
+                loss, grads = grads_of(state.params, batch)
+            if fused:
+                # single fused read+write pass over g/p/mu/nu — no
+                # materialized updates tree between transforms
+                params, opt_state = fused_adamw_update(
+                    self.optimizer, grads, state.opt_state, state.params,
+                    mesh=self.mesh, param_specs=param_specs,
+                    compute_dtype=self.compute_dtype
+                    if carry_compute else None)
+            else:
+                updates, opt_state = self.optimizer.update(
+                    grads, state.opt_state, state.params)
+                params = optax.apply_updates(state.params, updates)
             metrics = {"loss": loss}
             if self.log_grad_norm:
-                metrics["grad_norm"] = optax.global_norm(grads)
+                # fp32 accumulation even when the carry delivers bf16
+                # grads: the metric must stay comparable across the
+                # optimizer flag (squares at 8-bit mantissa drift)
+                metrics["grad_norm"] = optax.global_norm(
+                    jax.tree.map(lambda g_: g_.astype(jnp.float32),
+                                 grads))
             new_state = TrainState(step=state.step + 1, params=params,
                                    opt_state=opt_state)
             return new_state, metrics
@@ -219,13 +266,17 @@ def _opt_shardings_like(mesh, opt_state, param_shardings, params):
     param's sharding (momentum/adam moments); everything else replicated."""
     flat_params, _ = jax.tree_util.tree_flatten(params)
     flat_shard, _ = jax.tree_util.tree_flatten(param_shardings)
-    by_shape = {}
+    by_shape, by_shape_only = {}, {}
     for p, s in zip(flat_params, flat_shard):
         by_shape.setdefault((p.shape, p.dtype), s)
+        # dtype-blind fallback: FusedAdamW's compute_params mirror the
+        # params at compute dtype and must shard identically
+        by_shape_only.setdefault(p.shape, s)
 
     def pick(leaf):
         if hasattr(leaf, "shape"):
-            s = by_shape.get((leaf.shape, leaf.dtype))
+            s = by_shape.get((leaf.shape, leaf.dtype)) \
+                or by_shape_only.get(leaf.shape)
             if s is not None:
                 return s
         return NamedSharding(mesh, P())
