@@ -1,0 +1,71 @@
+"""Multislice gang e2e payload (VERDICT r4 stretch #10): each worker
+asserts the MEGASCALE_*/per-slice libtpu env the jax runtime injected
+for ITS (role, index) — slice id, intra-slice worker id, per-slice
+hostname partition, shared DCN coordinator — and then the whole gang
+proves it actually runs together: global jax.distributed rendezvous +
+allgather across all slices (coordination is global even when libtpu
+bring-up is per-slice). Exit codes mark which leg failed."""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# one local device per process (see check_jax_psum.py: pinning the
+# multi-process contract, not virtual-device fan-out, on a 1-core box)
+os.environ["XLA_FLAGS"] = " ".join(
+    [f for f in os.environ.get("XLA_FLAGS", "").split()
+     if "xla_force_host_platform_device_count" not in f]
+    + ["--xla_force_host_platform_device_count=1"])
+
+spec = json.loads(os.environ["CLUSTER_SPEC"])
+workers = spec["worker"]
+idx = int(os.environ["TONY_TASK_INDEX"])
+n_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "0"))
+if n_slices != 2:
+    print("expected MEGASCALE_NUM_SLICES=2, got", n_slices)
+    sys.exit(3)
+per_slice = len(workers) // n_slices
+if os.environ.get("MEGASCALE_SLICE_ID") != str(idx // per_slice):
+    print("bad MEGASCALE_SLICE_ID", os.environ.get("MEGASCALE_SLICE_ID"))
+    sys.exit(4)
+if os.environ.get("TPU_WORKER_ID") != str(idx % per_slice):
+    print("bad TPU_WORKER_ID", os.environ.get("TPU_WORKER_ID"))
+    sys.exit(5)
+slice_hosts = [w.rsplit(":", 1)[0]
+               for w in workers[(idx // per_slice) * per_slice:
+                                (idx // per_slice + 1) * per_slice]]
+if os.environ.get("TPU_WORKER_HOSTNAMES") != ",".join(slice_hosts):
+    print("bad TPU_WORKER_HOSTNAMES",
+          os.environ.get("TPU_WORKER_HOSTNAMES"), slice_hosts)
+    sys.exit(6)
+coord = os.environ.get("MEGASCALE_COORDINATOR_ADDRESS", "")
+if coord.rsplit(":", 1)[0] != workers[0].rsplit(":", 1)[0] \
+        or ":" not in coord:
+    print("bad MEGASCALE_COORDINATOR_ADDRESS", coord)
+    sys.exit(7)
+
+# the gang leg: global rendezvous + collective across BOTH slices
+from tony_tpu import distributed  # noqa: E402
+
+dspec = distributed.initialize(timeout_s=180)
+if dspec is None:
+    print("not in a gang")
+    sys.exit(8)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+
+if jax.process_count() != len(workers):
+    print("coordination must stay GLOBAL across slices:",
+          jax.process_count(), "!=", len(workers))
+    sys.exit(9)
+val = jnp.asarray([float(idx + 1)])
+total = float(multihost_utils.process_allgather(val).sum())
+n = len(workers)
+if abs(total - n * (n + 1) / 2) > 1e-6:
+    print("bad global sum", total)
+    sys.exit(10)
+print("multislice gang ok: slice", os.environ["MEGASCALE_SLICE_ID"],
+      "worker", os.environ["TPU_WORKER_ID"], "sum", total)

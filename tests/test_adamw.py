@@ -207,3 +207,41 @@ def test_fused_adamw_schedule_matches_optax():
         upd, rstate = ref.update(grads, rstate, p_r)
         p_r = optax.apply_updates(p_r, upd)
         _tree_close(p_f, p_r)
+
+
+def test_trainer_fused_adamw_carry_with_accum():
+    """The carry composes with gradient accumulation: per-micro grads
+    arrive bf16, the accumulator stays fp32, and the trajectory tracks
+    the optax accum path."""
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 256))
+    y = jax.random.normal(jax.random.PRNGKey(2), (16, 4))
+    params = {"big": jax.random.normal(jax.random.PRNGKey(3), (256, 1024))
+              * 0.05,
+              "head": jax.random.normal(jax.random.PRNGKey(4), (1024, 4))
+              * 0.05}
+
+    def apply_fn(p, batch):
+        h = jnp.tanh(batch["x"] @ p["big"])
+        return jnp.mean((h @ p["head"] - batch["y"]) ** 2)
+
+    losses = {}
+    for name, optimizer in (("fused", FusedAdamW(2e-3)),
+                            ("optax", optax.adamw(2e-3))):
+        trainer = Trainer(mesh=mesh, apply_fn=apply_fn,
+                          optimizer=optimizer, donate=False,
+                          compute_dtype=jnp.bfloat16, accum_steps=4)
+        state = trainer.init_state(jax.tree.map(jnp.copy, params))
+        step_fn, placed = trainer.build_step(state)
+        batch = {"x": x, "y": y}
+        traj = []
+        for _ in range(8):
+            placed, metrics = step_fn(placed, batch)
+            traj.append(float(metrics["loss"]))
+        losses[name] = traj
+        if name == "fused":
+            cp = placed.opt_state.compute_params
+            assert cp is not None and cp["big"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(losses["fused"], losses["optax"],
+                               rtol=0.05)
+    assert losses["fused"][-1] < losses["fused"][0]

@@ -206,3 +206,16 @@ def test_flash_decode_mha_windowed_int8(qkv_mha):
                         dequantize_kv(vq, vs).astype(jnp.float32),
                         length, window=12)
     np.testing.assert_allclose(np.asarray(out), ref_q, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_decode_mha_zero_length_row():
+    """A zero-length row sharing an 8-row MHA block with live rows (an
+    empty continuous-batching slot) must emit 0, exactly like the GQA
+    kernel whose per-row gate never runs such rows."""
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), s) for i, s in
+               enumerate([(2, 8, 16), (2, 64, 8, 16), (2, 64, 8, 16)]))
+    length = jnp.asarray([0, 40], jnp.int32)
+    out = np.asarray(flash_decode(q, k, v, length, block_k=16))
+    np.testing.assert_array_equal(out[0], np.zeros_like(out[0]))
+    ref = _ref_decode(q, k, v, np.asarray([64, 40]))  # row1 vs its ref
+    np.testing.assert_allclose(out[1], ref[1], atol=2e-5, rtol=2e-5)

@@ -446,6 +446,19 @@ def test_jax_distributed_psum_e2e(cluster):
     assert ok
 
 
+def test_multislice_gang_e2e(cluster):
+    """Multislice driven through the REAL submit->agents path (VERDICT
+    r4 stretch #10): 4 workers as 2 virtual slices — every worker
+    asserts its injected MEGASCALE_*/per-slice libtpu env, then the
+    whole gang rendezvouses globally and allgathers across both slices
+    (the contract was previously unit-tested + dryrun-validated only)."""
+    conf = script_conf(cluster, script("check_multislice_env.py"),
+                       {"worker": 4})
+    conf.set("tony.tpu.num-slices", 2)
+    ok, _ = run_job(cluster, conf)
+    assert ok
+
+
 def test_fcfs_mode_e2e(cluster):
     """FCFS scheduling through the full cluster (ref: TestTonyE2E FCFS
     cases over MLGenericRuntime.java:79-99): tasks start without waiting

@@ -300,7 +300,7 @@ def test_quantized_moe_expert_sharded_matches_unsharded():
     over it — quantized expert weights SHARD instead of replicating —
     and the result must equal the unsharded q8 forward, routed AND
     dropless, with the weights actually placed expert-sharded."""
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh
 
     mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "expert"))
     for dropless in (True, False):

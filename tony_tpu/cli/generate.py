@@ -48,12 +48,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="int8 KV cache: quantize-on-write with "
                         "per-(position, head) scales — halves the decode "
                         "cache HBM traffic (the dominant decode bytes at "
-                        "long context)")
+                        "long context). RECOMMENDED at any context: XLA "
+                        "fuses the dequant into the attention einsum "
+                        "(measured 1.5x at cache 512, docs/PERF.md r5)")
     p.add_argument("--flash-decode", action="store_true",
                    help="use the pallas flash-decode kernel for "
                         "single-token decode steps (fused online-softmax "
-                        "over the KV cache; int8-aware). Interpreted — "
-                        "slow — off TPU")
+                        "over the KV cache; int8-aware). NOT recommended "
+                        "on this backend — XLA's fused decode einsum "
+                        "runs at the HBM roofline and wins at every "
+                        "measured cache length (docs/PERF.md r5); kept "
+                        "for VMEM-spill regimes (100k+ caches). "
+                        "Interpreted — slow — off TPU")
     p.add_argument("--int8", action="store_true",
                    help="serve with int8 weight-only quantization "
                         "(pallas dequant-matmul; half the weight bytes "

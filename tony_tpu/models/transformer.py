@@ -477,22 +477,32 @@ class Attention(nn.Module):
             if quant:
                 ks_att, vs_att = k_scales.value, v_scales.value
             kv_pos = jnp.arange(max_len)
-        if quant:
-            from tony_tpu.ops.decode import dequantize_kv
-
-            keys_att = dequantize_kv(keys_att, ks_att)
-            values_att = dequantize_kv(values_att, vs_att)
         # grouped attention: q [b, l, kvh, group, dh] against kv [b, m, kvh, dh]
         qg = q.astype(jnp.float32).reshape(b, l, kvh, group, dh)
-        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
-                       keys_att.astype(jnp.float32)) / jnp.sqrt(dh)
+        # int8 cache: convert to bf16, not fp32 — int8 magnitudes
+        # (<=127) are exact in bf16, the MXU eats bf16 natively, and a
+        # convert the scan fails to fuse then materializes HALF the
+        # bytes; accumulation stays fp32 via the fp32 q operand
+        k_op = keys_att.astype(jnp.bfloat16 if quant else jnp.float32)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_op) / jnp.sqrt(dh)
+        if quant:
+            # int8 cache: the per-(pos, head) scale distributes over the
+            # d-contraction, so apply it to the SMALL score tensor
+            # instead of dequantizing the cache — a materialized fp32
+            # dequant of the whole cache inside the token scan measured
+            # 2.5x per-token slowdown at cache 3584 (the einsum reads
+            # the int8 buffer through a fused convert instead)
+            s = s * ks_att.transpose(0, 2, 1)[:, :, None, None, :]
         visible = kv_pos[None, :] <= q_pos  # [l, span]
         if win > 0:
             visible = visible & (q_pos - kv_pos[None, :] < win)
         s = jnp.where(visible[None, None, None, :, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhgqk,bkhd->bqhgd", p,
-                         values_att.astype(jnp.float32))
+        if quant:
+            # likewise fold the value scale into the probabilities
+            p = p * vs_att.transpose(0, 2, 1)[:, :, None, None, :]
+        v_op = values_att.astype(jnp.bfloat16 if quant else jnp.float32)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v_op)
         return out.reshape(b, l, h, dh).astype(q.dtype)
 
 

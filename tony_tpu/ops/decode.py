@@ -206,7 +206,17 @@ def _decode_kernel_mha(q_ref, k_ref, v_ref, len_ref, *rest,
     @pl.when(ki == nk - 1)
     def _finalize():
         l_safe = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[:] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # rows with NO visible position ever (length 0, or a window
+        # past every block — e.g. an empty continuous-batching slot
+        # sharing this 8-row block with live rows) never raise m above
+        # NEG_INF: their p = exp(s - m) degenerated to 1 and acc holds
+        # a sum of V tiles — mask them to the 0 the GQA kernel (whose
+        # per-row gate never runs such rows) and the reference emit.
+        # Rows whose first visible block comes late self-heal: the
+        # correction factor exp(NEG_INF - m_new) wipes the pollution.
+        valid = m_scr[:] > NEG_INF * 0.5
+        o_ref[:] = jnp.where(valid, acc_scr[:] / l_safe,
+                             0.0).astype(o_ref.dtype)
 
 
 def _pick_block_k(limit: int, s: int) -> int:

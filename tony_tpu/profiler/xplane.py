@@ -70,10 +70,43 @@ def device_planes(space) -> list:
             if p.name.startswith("/device:") and "CUSTOM" not in p.name]
 
 
-def op_totals_ms(logdir: str, line_name: str = "XLA Ops") \
+_OPKIND_RE = None
+
+
+def hlo_op_kind(name: str) -> str:
+    """HLO op KIND from an xplane op-metadata name. The name is the
+    whole HLO statement ('%step.85 = (f32[...]) custom-call(%a, %b)'):
+    the op name left of '=' is arbitrary (custom calls inherit jax fn
+    names — a function named ``while_scanner`` yields
+    '%while_scanner.3'), and the operand list mentions other ops'
+    names, so the only reliable token is the kind between the result
+    type and '('. Falls back to the name stem when the type expression
+    defeats the regex (nested layout parens)."""
+    global _OPKIND_RE
+    if _OPKIND_RE is None:
+        import re
+
+        _OPKIND_RE = re.compile(
+            r"=\s*(?:\([^)]*\)|[^\s(]+)\s+([a-z][a-z0-9_-]*)\(")
+    m = _OPKIND_RE.search(name)
+    if m:
+        return m.group(1)
+    return name.split("=", 1)[0].strip().lstrip("%").split(".")[0]
+
+
+def op_totals_ms(logdir: str, line_name: str = "XLA Ops",
+                 drop_control_flow: bool = True) \
         -> dict[str, float] | None:
     """Total device-busy ms per op name, summed over every device plane
-    and xplane file under ``logdir``. None when nothing parseable."""
+    and xplane file under ``logdir``. None when nothing parseable.
+
+    ``drop_control_flow`` (default): skip while/conditional events —
+    their duration INCLUDES the nested body ops, which the XLA Ops line
+    logs separately per dynamic execution, so keeping both would count
+    every loop body twice (measured: a scan-heavy step summed to ~2x
+    its wall time before this filter). Filtering is by parsed HLO op
+    KIND, not name prefix — a custom call from a jax fn named
+    ``while_*`` must not vanish from the totals."""
     totals: dict[str, float] = {}
     found = False
     for path in xplane_files(logdir):
@@ -88,6 +121,18 @@ def op_totals_ms(logdir: str, line_name: str = "XLA Ops") \
                 found = True
                 for ev in line.events:
                     name = meta.get(ev.metadata_id, str(ev.metadata_id))
+                    # ' while(' / ' conditional(' can only be the HLO op
+                    # kind (op names contain no spaces; operand refs are
+                    # not followed by '('), so this cannot swallow a
+                    # custom call from a jax fn NAMED while_*; the
+                    # prefix check covers dumps whose metadata carries
+                    # only the op name — 'while.3' never collides with
+                    # 'while_scanner.3' (dot vs underscore)
+                    if drop_control_flow and (
+                            " while(" in name or " conditional(" in name
+                            or name.lstrip("%").startswith(
+                                ("while.", "conditional."))):
+                        continue
                     totals[name] = totals.get(name, 0.0) \
                         + ev.duration_ps / _PS_PER_MS
     return totals if found else None

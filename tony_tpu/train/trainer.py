@@ -87,11 +87,10 @@ class Trainer:
 
     def init_state(self, params) -> TrainState:
         if isinstance(self.optimizer, FusedAdamW):
-            # compute-dtype carry (accum path keeps fp32 grads — bf16
-            # microbatch accumulation would compound rounding)
+            # compute-dtype carry (under accum the per-micro grads are
+            # bf16 but the accumulator stays fp32 — see compile_step)
             opt_state = self.optimizer.init(
-                params, compute_dtype=self.compute_dtype
-                if max(self.accum_steps, 1) == 1 else None)
+                params, compute_dtype=self.compute_dtype)
         else:
             opt_state = self.optimizer.init(params)
         return TrainState(
@@ -178,7 +177,13 @@ class Trainer:
                 return (loss_sum + loss,
                         jax.tree.map(jnp.add, grad_sum, grads)), None
 
-            zeros = jax.tree.map(jnp.zeros_like, params)
+            # fp32 accumulator even when the compute carry delivers bf16
+            # per-micro grads (jnp.add promotes): bf16 accumulation
+            # across micros would compound rounding
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32)
+                if jnp.issubdtype(p.dtype, jnp.floating)
+                else jnp.zeros_like(p), params)
             (loss_sum, grad_sum), _ = jax.lax.scan(
                 body, (jnp.zeros((), jnp.float32), zeros), micros)
             scale = 1.0 / accum
@@ -192,8 +197,7 @@ class Trainer:
         # separate master->bf16 cast pass disappears, the backward
         # writes bf16 grad leaves, and the update reads them as bf16 —
         # ~3 GB/step less HBM traffic at the 386M flagship.
-        carry_compute = (fused and self.compute_dtype is not None
-                         and accum == 1)
+        carry_compute = fused and self.compute_dtype is not None
         if fused:
             # the fused path needs each param's PartitionSpec so sharded
             # leaves run their pallas update under shard_map (a pallas
@@ -201,16 +205,17 @@ class Trainer:
             param_specs = jax.tree.map(lambda s: s.spec, shardings.params)
 
         def step_fn(state: TrainState, batch):
-            if carry_compute:
-                # grads arrive in compute dtype (the one numerics change
-                # of the carry: one rounding of each grad leaf — the
-                # products were bf16 with f32 accumulation either way).
-                # loss_fn is reused as-is: its to_compute on the carried
-                # bf16 params is an identity cast XLA elides.
-                loss, grads = jax.value_and_grad(loss_fn)(
-                    state.opt_state.compute_params, batch)
-            else:
-                loss, grads = grads_of(state.params, batch)
+            # under the carry, forward/backward run through the bf16
+            # copy the previous update emitted: per-(micro)batch grads
+            # arrive in compute dtype (the one numerics change — one
+            # rounding per grad leaf; the products were bf16 with f32
+            # accumulation either way) and no master->bf16 cast pass
+            # ever materializes. loss_fn is reused as-is: its
+            # to_compute on the carried bf16 params is an identity
+            # cast XLA elides.
+            diff_params = state.opt_state.compute_params \
+                if carry_compute else state.params
+            loss, grads = grads_of(diff_params, batch)
             if fused:
                 # single fused read+write pass over g/p/mu/nu — no
                 # materialized updates tree between transforms
