@@ -501,6 +501,10 @@ def bench_transformer(on_tpu: bool) -> dict:
     # memory_stats — VERDICT r4 #5: the batch-4-vs-8 decision now
     # carries a measured number, not a hand estimate)
     flops_ca, hbm_est = compiled_analyses(step_fn, placed, train_batch)
+    # XLA's cost analysis counts a while-loop body ONCE; the microbatch
+    # scan executes it `accum` times per step — scale so mfu_hw stays a
+    # comparable (if still pallas-blind) diagnostic across accum configs
+    flops_ca *= max(accum, 1)
 
     # MODEL FLOPs (PaLM-style MFU accounting): 6·N per token fwd+bwd for
     # the dense stack + causal attention matmuls (fwd 4·b·s²·d, bwd 2x,
@@ -684,12 +688,18 @@ def bench_long_seq(on_tpu: bool) -> dict:
         # attn_saved sat at 15.96/15.75 GB at seq 8k in r5 — compiler
         # layout drift tips a borderline fit either way between rounds,
         # so fall back to the heavier-remat dots policy (~1 MFU point
-        # slower, fits comfortably) rather than lose the data point
+        # slower, fits comfortably) rather than lose the data point.
+        # The retry runs OUTSIDE the handler: the caught exception's
+        # traceback frames pin the failed attempt's device state (GBs)
+        # until the except block exits.
+        import gc
+
         try:
             return one_point(seq, window, batch, steps)
         except Exception:
-            return one_point(seq, window, batch, steps,
-                             remat_policy="dots")
+            pass
+        gc.collect()
+        return one_point(seq, window, batch, steps, remat_policy="dots")
 
     out = point_with_fallback(8192, 1024, 1, 20)
     if os.environ.get("TONY_BENCH_LONG_SEQ_16K", "1") == "1":
@@ -1247,6 +1257,8 @@ def main() -> None:
         os.environ.get("TONY_COMPILE_CACHE_DIR")
         or os.path.join(REPO_DIR, ".jax_compile_cache"))
 
+    import gc
+
     platform = _platform()  # ONCE: a re-probe after the parent holds the
     # TPU would fail in the child and falsely demote the run to cpu
     on_tpu = platform in ("tpu", "axon")
@@ -1258,26 +1270,32 @@ def main() -> None:
         extras["transformer"] = bench_transformer(on_tpu)
     except Exception as e:  # the headline line must survive a sub-bench
         extras["transformer"] = {"error": f"{type(e).__name__}: {e}"}
+    gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
     try:
         extras["attention"] = bench_attention(on_tpu)
     except Exception as e:
         extras["attention"] = {"error": f"{type(e).__name__}: {e}"}
+    gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
     try:
         extras["long_seq"] = bench_long_seq(on_tpu)
     except Exception as e:
         extras["long_seq"] = {"error": f"{type(e).__name__}: {e}"}
+    gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
     try:
         extras["decode"] = bench_decode(on_tpu)
     except Exception as e:
         extras["decode"] = {"error": f"{type(e).__name__}: {e}"}
+    gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
     try:
         extras["decode_1b"] = bench_decode_1b(on_tpu)
     except Exception as e:
         extras["decode_1b"] = {"error": f"{type(e).__name__}: {e}"}
+    gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
     try:
         extras["quant"] = bench_quant(on_tpu)
     except Exception as e:
         extras["quant"] = {"error": f"{type(e).__name__}: {e}"}
+    gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
     try:
         extras["launch"] = bench_launch()
     except Exception as e:
