@@ -34,6 +34,16 @@ def main() -> int:
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--vocab", type=int, default=512)
     p.add_argument("--moe", action="store_true", help="MoE FFN every 2nd block")
+    p.add_argument("--accum", type=int, default=1,
+                   help="gradient-accumulation microbatches: activation "
+                        "footprint of ONE micro, optimizer amortized over "
+                        "the global batch (the r5 flagship recipe trains "
+                        "at micro 4 x accum 16 = batch 64)")
+    p.add_argument("--fused-adamw", action="store_true",
+                   help="FusedAdamW + compute-dtype carry: the update "
+                        "emits the next step's bf16 params (no separate "
+                        "cast pass, bf16 grads) — the bench flagship "
+                        "optimizer (docs/PERF.md r5)")
     p.add_argument("--text", nargs="+", default=None, metavar="FILE",
                    help="pretrain on these text files (byte-tokenized into "
                         "a packed .bin) instead of synthetic tokens")
@@ -46,7 +56,12 @@ def main() -> int:
     from tony_tpu.ops import chunked_cross_entropy
     from tony_tpu.parallel import data_parallel_mesh
     from tony_tpu.parallel.sharding import batch_sharding
-    from tony_tpu.train import JsonlMetricsLogger, Trainer, fit
+    from tony_tpu.train import (
+        FusedAdamW,
+        JsonlMetricsLogger,
+        Trainer,
+        fit,
+    )
 
     distributed.initialize()  # no-op outside a gang
     mesh = data_parallel_mesh()
@@ -71,10 +86,14 @@ def main() -> int:
                                     eos_id=tok.eos_id)
         print(f"tokenized {len(args.text)} file(s) -> {n_tok} tokens")
 
+    # --fused-adamw is the bf16 recipe end to end: the MODEL computes in
+    # bf16 too (compute_dtype alone would be undone by fp32 layer dtypes)
+    model_dtype = jnp.bfloat16 if args.fused_adamw else jnp.float32
+    lr = 3e-3
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=64, n_heads=4, n_kv_heads=2,
         n_layers=2, d_ff=128, max_seq_len=args.seq_len,
-        dtype=jnp.float32, attention_backend="blockwise",
+        dtype=model_dtype, attention_backend="blockwise",
         attention_block_size=64,
         moe_every=2 if args.moe else 0, moe_num_experts=4, moe_top_k=2)
     model = Transformer(cfg)
@@ -112,8 +131,14 @@ def main() -> int:
     loader = DataLoader(source, global_batch_size=args.global_batch,
                         num_epochs=None, sharding=batch_sharding(mesh))
 
+    if args.fused_adamw:
+        optimizer, compute_dtype = FusedAdamW(lr), jnp.bfloat16
+    else:
+        optimizer, compute_dtype = optax.adamw(lr), None
     trainer = Trainer(mesh=mesh, apply_fn=apply_fn,
-                      optimizer=optax.adamw(3e-3), donate=False)
+                      optimizer=optimizer, donate=False,
+                      compute_dtype=compute_dtype,
+                      accum_steps=args.accum)
     sinks = []
     # one writer per job: the job dir is shared by the whole gang
     if os.environ.get("TONY_JOB_DIR") and jax.process_index() == 0:
