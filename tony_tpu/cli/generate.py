@@ -48,17 +48,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="int8 KV cache: quantize-on-write with "
                         "per-(position, head) scales — halves the decode "
                         "cache HBM traffic (the dominant decode bytes at "
-                        "long context). RECOMMENDED at any context: XLA "
-                        "fuses the dequant into the attention einsum "
-                        "(measured 1.5x at cache 512, docs/PERF.md r5)")
+                        "long context). Recommended below ~2k live "
+                        "cache tokens per sequence (1.27x e2e measured); "
+                        "above that the in-scan VPU lowering favors the "
+                        "bf16 cache (docs/PERF.md r5 context rule)")
     p.add_argument("--flash-decode", action="store_true",
                    help="use the pallas flash-decode kernel for "
                         "single-token decode steps (fused online-softmax "
-                        "over the KV cache; int8-aware). NOT recommended "
-                        "on this backend — XLA's fused decode einsum "
-                        "runs at the HBM roofline and wins at every "
-                        "measured cache length (docs/PERF.md r5); kept "
-                        "for VMEM-spill regimes (100k+ caches). "
+                        "over the KV cache; int8-aware). Measured ~par "
+                        "with the default einsum e2e (1.06x at cache "
+                        "512, 0.95x with int8 at 3584 — docs/PERF.md "
+                        "r5); the clear win case is VMEM-spill regimes "
+                        "(very long caches x batch x heads). "
                         "Interpreted — slow — off TPU")
     p.add_argument("--int8", action="store_true",
                    help="serve with int8 weight-only quantization "

@@ -490,8 +490,13 @@ class Attention(nn.Module):
             # d-contraction, so apply it to the SMALL score tensor
             # instead of dequantizing the cache — a materialized fp32
             # dequant of the whole cache inside the token scan measured
-            # 2.5x per-token slowdown at cache 3584 (the einsum reads
-            # the int8 buffer through a fused convert instead)
+            # 2.5x per-token slowdown at cache 3584; with this fold the
+            # einsum reads the int8 buffer through a FUSED convert
+            # (trace-verified: s8 operands feed the score fusion
+            # directly). Residual cost at long cache: XLA lowers the
+            # single-query contraction as a VPU multiply-reduce (never
+            # MXU), and the inline convert slows that VPU loop — see
+            # docs/PERF.md's context-dependent --kv-int8 guidance.
             s = s * ks_att.transpose(0, 2, 1)[:, :, None, None, :]
         visible = kv_pos[None, :] <= q_pos  # [l, span]
         if win > 0:
