@@ -961,6 +961,106 @@ def bench_decode_1b(on_tpu: bool) -> dict:
     return out
 
 
+def bench_serving(on_tpu: bool) -> dict:
+    """Continuous batching vs fixed-batch generate() on a mixed-length
+    workload (the ISSUE-1 acceptance datum): requests share a prompt
+    length but draw exponential-ish OUTPUT budgets, the regime where
+    request-level batching idles most slots behind the batch straggler.
+
+    Fixed-batch baseline: requests grouped in arrival order into
+    batches of ``batch``; each batch decodes max(budgets in batch)
+    tokens through the one-dispatch generate() scan (its strongest
+    form — no eos, so every step is useful for SOME row). Continuous:
+    serve.Server retires each slot at exactly its budget and refills it
+    the same iteration. Both sides run the identical jitted model;
+    tok/s counts only REQUESTED tokens (the straggler padding fixed
+    batching decodes past a row's budget is waste, not throughput).
+    Programs are warmed (one untimed pass each) so the datum compares
+    steady-state serving, not compile time. ``*_steps`` record the
+    decode-step counts — the launch-overhead-free form of the same
+    claim (the tunneled backend charges the host-driven continuous
+    loop ~4.5 ms per step that the scan amortizes away, so wall ratios
+    on the tunnel understate the algorithmic win the step counts pin)."""
+    import numpy as np
+
+    from tony_tpu.models import Transformer, TransformerConfig, generate
+    from tony_tpu.serve import Request, Server
+
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=32768, d_model=768, n_layers=12, n_heads=12,
+            d_ff=3072, max_seq_len=512, scan_layers=False)
+        batch, n_req, prompt_len = 8, 32, 64
+        lo, hi = 8, 192
+    else:
+        # big enough that a decode step's compute clears the per-dispatch
+        # host floor (~1.5 ms on the CI box) — at smaller toy sizes the
+        # datum measures dispatch overhead, not scheduling
+        cfg = TransformerConfig(
+            vocab_size=512, d_model=128, n_layers=3, n_heads=4, d_ff=256,
+            max_seq_len=256)
+        batch, n_req, prompt_len = 4, 16, 16
+        lo, hi = 8, 224
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, prompt_len), jnp.int32))["params"]
+    if on_tpu:
+        params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+    rng = np.random.default_rng(0)
+    budgets = rng.exponential(scale=(hi - lo) / 3.0, size=n_req)
+    budgets = (budgets.astype(int) + lo).clip(lo, hi)
+    prompts = rng.integers(0, cfg.vocab_size, size=(n_req, prompt_len))
+
+    def run_fixed() -> int:
+        steps = out = 0
+        for start in range(0, n_req, batch):
+            grp = slice(start, start + batch)
+            nt = int(budgets[grp].max())
+            out = generate(model, params, jnp.asarray(prompts[grp],
+                                                      jnp.int32),
+                           max_new_tokens=nt)
+            steps += nt
+        float(jnp.asarray(out).reshape(-1)[0])
+        return steps
+
+    def run_continuous() -> Server:
+        # chunk 16: throughput mode — amortizes the per-dispatch host
+        # floor to ~0.1 ms/token (a streaming deployment would trade
+        # some of this back for first-token latency)
+        server = Server(model, params, batch_size=batch, eos_id=-1,
+                        min_bucket=prompt_len, chunk_steps=16)
+        n_done = sum(1 for _ in server.run(
+            Request(prompts[i].tolist(), int(budgets[i]), id=i)
+            for i in range(n_req)))
+        assert n_done == n_req
+        return server
+
+    run_fixed()  # warm: compiles every (batch, nt) program
+    run_continuous()  # warm: prefill bucket + resident step + admit
+    t0 = time.perf_counter()
+    fixed_steps = run_fixed()
+    t_fixed = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    server = run_continuous()
+    t_cont = time.perf_counter() - t0
+    useful = int(budgets.sum())
+    return {
+        "n_requests": n_req,
+        "batch_slots": batch,
+        "prompt_len": prompt_len,
+        "output_budget_lo_hi": [int(lo), int(hi)],
+        "useful_tokens": useful,
+        "continuous_tok_s": round(useful / t_cont, 1),
+        "fixed_batch_tok_s": round(useful / t_fixed, 1),
+        "continuous_vs_fixed": round(t_fixed / t_cont, 3),
+        "continuous_steps": server.steps,
+        "fixed_steps": fixed_steps,
+        "steps_saved_ratio": round(fixed_steps / max(server.steps, 1), 3),
+    }
+
+
 # ------------------------------------------------------ attention kernels
 
 
@@ -1247,7 +1347,34 @@ def _maybe_reexec_on_tpu(line: dict) -> dict:
     return line
 
 
+class _StdoutToStderr:
+    """FD-level stdout->stderr redirect around the bench body: every
+    incidental print — sub-benches, jax/absl noise, the mini cluster's
+    children (they inherit fd 1) — lands on stderr, so the artifact JSON
+    printed AFTER restore is guaranteed to be the final (and only)
+    stdout line and the round driver's ``parsed`` field is non-null
+    (VERDICT item 7)."""
+
+    def __enter__(self):
+        sys.stdout.flush()
+        self._saved = os.dup(1)
+        os.dup2(2, 1)
+        return self
+
+    def __exit__(self, *exc):
+        sys.stdout.flush()
+        os.dup2(self._saved, 1)
+        os.close(self._saved)
+        return False
+
+
 def main() -> None:
+    with _StdoutToStderr():
+        line = _collect_line()
+    print(json.dumps(line))
+
+
+def _collect_line() -> dict:
     from tony_tpu.utils import compilecache
 
     # persistent XLA compile cache, repo-scoped: bench reruns (and the
@@ -1292,6 +1419,11 @@ def main() -> None:
         extras["decode_1b"] = {"error": f"{type(e).__name__}: {e}"}
     gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
     try:
+        extras["serving"] = bench_serving(on_tpu)
+    except Exception as e:
+        extras["serving"] = {"error": f"{type(e).__name__}: {e}"}
+    gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
+    try:
         extras["quant"] = bench_quant(on_tpu)
     except Exception as e:
         extras["quant"] = {"error": f"{type(e).__name__}: {e}"}
@@ -1319,7 +1451,7 @@ def main() -> None:
         if lkg:
             extras["last_known_good_tpu"] = lkg
         line = _maybe_reexec_on_tpu(line)
-    print(json.dumps(line))
+    return line
 
 
 if __name__ == "__main__":

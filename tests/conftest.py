@@ -25,3 +25,10 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight tests excluded from the tier-1 budget "
+        "(ROADMAP.md runs -m 'not slow'); run explicitly with -m slow")

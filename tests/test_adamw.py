@@ -245,3 +245,50 @@ def test_trainer_fused_adamw_carry_with_accum():
     np.testing.assert_allclose(losses["fused"], losses["optax"],
                                rtol=0.05)
     assert losses["fused"][-1] < losses["fused"][0]
+
+
+def test_fused_adamw_tuple_axis_partition_spec(monkeypatch):
+    """A PartitionSpec entry that is a TUPLE of axis names
+    (P(('data','fsdp')) — what batch_sharding emits on multi-axis
+    meshes) must divide the local element count by EVERY named axis,
+    not raise KeyError (ADVICE r5)."""
+    monkeypatch.setenv("TONY_FUSED_ADAMW_MIN_ELEMS", "1024")
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "fsdp"))
+    opt = FusedAdamW(learning_rate=3e-4, weight_decay=1e-2)
+    params = {"big": jax.random.normal(jax.random.PRNGKey(0),
+                                       (256, 1024), jnp.float32)}
+    grads = jax.tree.map(lambda p: jnp.sin(p) * 0.1, params)
+    state = opt.init(params)
+    specs = {"big": P(("data", "fsdp"), None)}
+    p_sharded, _ = fused_adamw_update(opt, grads, state, params,
+                                      mesh=mesh, param_specs=specs)
+    # same math as the unsharded update
+    p_plain, _ = fused_adamw_update(opt, grads, opt.init(params), params)
+    _tree_close(p_sharded, p_plain)
+
+
+def test_fused_adamw_compute_params_nonfloat_leaf_tracks_params():
+    """With compute_dtype set, a NON-floating leaf must carry the same
+    value in params and compute_params after the update — a stale
+    pre-update copy in compute_params would make the tree the next step
+    differentiates diverge from the master (ADVICE r5)."""
+    opt = FusedAdamW(learning_rate=0.5, weight_decay=0.0)
+    params = {"w": jnp.ones((8, 16), jnp.float32),
+              "steps": jnp.asarray([10, 20], jnp.int32)}
+    state = opt.init(params, compute_dtype=jnp.bfloat16)
+    grads = {"w": jnp.ones((8, 16), jnp.float32),
+             "steps": jnp.asarray([100, 100], jnp.int32)}
+    new_p, new_state = fused_adamw_update(opt, grads, state, params,
+                                          compute_dtype=jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(new_p["steps"]),
+                                  np.asarray(
+                                      new_state.compute_params["steps"]))
+    # float leaves carry the bf16 copy of the updated master
+    assert new_state.compute_params["w"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(new_state.compute_params["w"], np.float32),
+        np.asarray(new_p["w"]), rtol=1e-2)

@@ -131,3 +131,32 @@ def test_reexec_guard_blocks_recursion(bench, monkeypatch):
     line = {"metric": "x"}
     assert bench._maybe_reexec_on_tpu(line) is line
     assert probes == []
+
+
+def test_stdout_guard_artifact_is_final_line():
+    """VERDICT item 7: everything printed inside the guard (python- or
+    fd-level, as sub-benches and their children do) lands on stderr;
+    the artifact JSON printed after it is the one and only stdout
+    line, so the round driver's `parsed` field is non-null."""
+    import subprocess
+    import sys
+
+    code = (
+        "import importlib.util, json, os, sys\n"
+        f"spec = importlib.util.spec_from_file_location('b', {os.path.join(REPO, 'bench.py')!r})\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(m)\n"
+        "with m._StdoutToStderr():\n"
+        "    print('python-level noise')\n"
+        "    os.write(1, b'fd-level noise\\n')\n"
+        "print(json.dumps({'metric': 'x', 'value': 1}))\n")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = proc.stdout.strip().splitlines()
+    assert lines == ['{"metric": "x", "value": 1}']
+    assert json.loads(lines[-1])["metric"] == "x"
+    assert "python-level noise" in proc.stderr
+    assert "fd-level noise" in proc.stderr

@@ -162,6 +162,7 @@ def test_device_prefetch_wrapper():
                                   np.full((8, 2), 2, np.float32))
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_loader_trains_resnet_batch():
     """End-to-end: loader feeds the Trainer for 2 steps."""
     import jax.numpy as jnp

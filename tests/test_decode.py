@@ -77,6 +77,27 @@ def test_flash_decode_int8_cache(qkv):
     np.testing.assert_allclose(np.asarray(out), ref_fp, atol=0.05, rtol=0.05)
 
 
+def test_flash_decode_zero_length_slot_rows(qkv):
+    """Per-slot lengths (serve/): a length-0 row — an EMPTY continuous-
+    batching slot — must emit EXACT zeros (never NaN, never a uniform
+    average of junk V tiles) while live rows stay exact. Covers the GQA
+    kernel's never-ran accumulator and the MHA kernel's `valid` mask
+    (decode.py _finalize)."""
+    q, k, v = qkv
+    length = jnp.asarray([0, 37], jnp.int32)
+    out = np.asarray(flash_decode(q, k, v, length, block_k=16))
+    assert (out[0] == 0).all()
+    ref = _ref_decode(q, k, v, np.asarray([37, 37]))
+    np.testing.assert_allclose(out[1], ref[1], atol=2e-5, rtol=2e-5)
+    # MHA batched-rows kernel (bh_blk path needs (b*kvh) % 8 == 0)
+    kf = jnp.repeat(k, 4, axis=2)
+    vf = jnp.repeat(v, 4, axis=2)
+    out = np.asarray(flash_decode(q, kf, vf, length, block_k=16))
+    assert (out[0] == 0).all()
+    reff = _ref_decode(q, kf, vf, np.asarray([37, 37]))
+    np.testing.assert_allclose(out[1], reff[1], atol=2e-5, rtol=2e-5)
+
+
 def test_quantize_kv_roundtrip_error_bound():
     x = jax.random.normal(jax.random.PRNGKey(3), (2, 8, 4, 32))
     q, s = quantize_kv(x)

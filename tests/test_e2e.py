@@ -134,6 +134,7 @@ def test_untracked_failure_fails_fast(cluster):
     assert not ok
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_sidecar_tb_builtin_launcher(cluster):
     """A tensorboard role with no command gets the built-in sidecar
     launcher shipped into the job dir, and its URL reaches the client
@@ -245,6 +246,7 @@ def test_coordinator_exception_retry(cluster, monkeypatch):
     assert ok, client.final_status
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_resume_from_checkpoint_on_retry(cluster):
     """Restart-with-resume (no reference analog, SURVEY 5.4): attempt 0
     checkpoints then fails; the retry attempt must see TONY_RESUME_STEP and
@@ -257,6 +259,7 @@ def test_resume_from_checkpoint_on_retry(cluster):
     assert ok, client.final_status
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_preemption_grace_checkpoint_and_resume(cluster):
     """TPU-preemption path (SURVEY 7.9b: the heartbeat-expiry analog):
     SIGTERM to the agent forwards to the user process with a grace window;
@@ -402,6 +405,7 @@ def test_history_written(cluster):
     assert types[-1] == "APPLICATION_FINISHED"
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_coordinator_hard_crash_respawned(cluster, monkeypatch):
     """Ref: TEST_AM_CRASH + YARN AM restart (testAMCrash :241): the
     coordinator process hard-exits; the client respawns it (the AM-attempt
@@ -446,6 +450,7 @@ def test_jax_distributed_psum_e2e(cluster):
     assert ok
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_multislice_gang_e2e(cluster):
     """Multislice driven through the REAL submit->agents path (VERDICT
     r4 stretch #10): 4 workers as 2 virtual slices — every worker

@@ -10,6 +10,7 @@ import time
 
 from tony_tpu import elastic
 from tony_tpu.mini import MiniTonyCluster, script_conf
+import pytest
 
 SCRIPTS = os.path.join(os.path.dirname(__file__), "scripts")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -46,6 +47,7 @@ def test_resize_validation():
             coord.metrics_rpc.stop()
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_elastic_resize_e2e():
     """Submit 2 elastic workers, grow to 3 mid-run: job must SUCCEED, the
     new epoch must see TASK_NUM=3, progress must resume (not restart), and
@@ -161,6 +163,7 @@ def _request_resize_when_running(client, role, n):
     return t
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_elastic_shrink_e2e():
     """Shrink 3 -> 1: the new epoch runs a single worker, the removed
     indices never reappear, progress resumes (ref semantics:
@@ -192,6 +195,7 @@ def test_elastic_shrink_e2e():
         assert "resumed at step" in open(log0).read()
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_resize_while_task_failing_with_retry_e2e():
     """Resize racing a task failure (+ the resulting retry epoch): in
     every interleaving the job must converge — the pending resize

@@ -37,6 +37,7 @@ def test_linear_regression_example(cluster):
     assert client.final_status["status"] == "SUCCEEDED", client.final_status
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_mnist_jax_example(cluster):
     conf = example_conf(
         cluster, "mnist-jax",
@@ -45,6 +46,7 @@ def test_mnist_jax_example(cluster):
     assert client.final_status["status"] == "SUCCEEDED", client.final_status
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_mnist_pytorch_example(cluster):
     conf = example_conf(
         cluster, "mnist-pytorch",
@@ -53,6 +55,7 @@ def test_mnist_pytorch_example(cluster):
     assert client.final_status["status"] == "SUCCEEDED", client.final_status
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_lm_pretrain_example(cluster):
     """Full-stack flagship: loader + GQA/chunked-CE + fit with checkpoints,
     2-worker gang."""
@@ -84,6 +87,7 @@ def test_horovod_example(cluster):
     assert client.final_status["status"] == "SUCCEEDED", client.final_status
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_examples_run_standalone():
     """The documented degrade-gracefully contract: every example script
     exits 0 outside a gang."""
@@ -140,6 +144,7 @@ def test_tpu_pod_conf_selects_ssh_launcher():
             coord.metrics_rpc.stop()
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_lm_pretrain_on_raw_text(tmp_path):
     """--text: raw files -> byte-tokenized packed corpus -> fit, standalone
     (no cluster; the data-prep path is what's under test)."""
@@ -158,6 +163,7 @@ def test_lm_pretrain_on_raw_text(tmp_path):
     assert "tokenized 1 file(s)" in proc.stdout
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_sft_lora_example(cluster):
     """Post-training flagship: InstructionSource masked loss + frozen base
     + LoRA adapters; the script's own greedy-decode check is the exit

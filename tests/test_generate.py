@@ -233,6 +233,7 @@ def test_sliding_window_decode_full_cache():
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_generate_cli_on_local_checkpoint(tmp_path):
     """tony-tpu generate: local HF dir -> framework decode loop, offline."""
     import subprocess
@@ -401,6 +402,7 @@ def test_repetition_penalty_blocks_repeats(tiny):
     assert 7 not in toks
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_beam_search_scan_layers_model():
     """scan_layers caches carry a leading n_layers axis: the beam widen and
     parent-gather must hit the batch axis, not the layers axis."""
@@ -423,6 +425,7 @@ def test_beam_search_scan_layers_model():
         np.asarray(generate(model, params, prompt, max_new_tokens=4)))
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_generate_cli_bf16_serving(tmp_path):
     """--dtype bf16 (the serving precision: half the decode parameter
     traffic) runs the same checkpoint end-to-end; token COUNT contract
@@ -455,6 +458,7 @@ def test_generate_cli_bf16_serving(tmp_path):
     assert all(0 <= i < 64 for i in ids)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_score_cli_on_local_checkpoint(tmp_path):
     """tony-tpu score: perplexity must match a torch teacher-forced NLL."""
     import subprocess
@@ -487,6 +491,7 @@ def test_score_cli_on_local_checkpoint(tmp_path):
     np.testing.assert_allclose(got_nll, float(out.loss), rtol=1e-3)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_score_buckets_one_compile_per_bucket(tiny):
     """VERDICT r2 #10: scoring varied lengths compiles O(#buckets)
     programs (jit's shape-keyed cache), and bucket padding never changes
@@ -529,6 +534,7 @@ def test_score_bucket_len():
     assert bucket_len(5000, 2048) == 2048  # capped (caller truncates ids)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_generate_cli_batches_same_length_prompts(tmp_path):
     """Multiple --token-ids of equal length decode as ONE batch; outputs
     print in input order and match per-prompt greedy decodes exactly
@@ -570,6 +576,7 @@ def test_generate_cli_batches_same_length_prompts(tmp_path):
         assert got == ref[0].tolist(), (line, p)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_score_cli_int8_close_to_fp(tmp_path):
     """--int8 scoring runs the quantized serving config; its perplexity
     must sit within a few percent of full precision (the quality-cost
@@ -608,6 +615,7 @@ def test_score_cli_int8_close_to_fp(tmp_path):
     assert abs(q8 - fp) / fp < 0.05, (fp, q8)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_score_cli_kv_int8_close_to_fp(tmp_path):
     """--kv-int8 scores THROUGH the quantized KV cache (decode/prefill
     path): nll/token must sit within a few percent of full precision —

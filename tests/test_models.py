@@ -152,6 +152,7 @@ def test_moe_param_tree_logical_axes_and_ep_sharding():
     jax.device_put(params, sh)  # placement must succeed
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_transformer_moe_trains_on_expert_mesh():
     from tony_tpu.models import moe_aux_loss
 
@@ -220,6 +221,7 @@ def test_scan_layers_forward_decode_and_sharding():
     jax.device_put(variables["params"], sh)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_remat_policy_dots_matches_nothing():
     """remat_policy='dots' (keep matmul outputs, skip the 2N recompute)
     is a scheduling choice only: grads must match full remat exactly."""
@@ -353,6 +355,7 @@ def test_gated_mlp_rejected_with_moe():
             gated_mlp=True, moe_every=2)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_pipelined_forward_matches_plain_apply():
     """PP on the flagship model: identical logits to model.apply with the
     same scan_layers params, GPipe and interleaved schedules."""
@@ -384,6 +387,7 @@ def test_pipelined_forward_matches_plain_apply():
     np.testing.assert_allclose(np.asarray(out4), ref4, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_pipelined_forward_trains():
     """Loss + grads through the pipelined model decrease under adam."""
     from tony_tpu.models import Transformer, TransformerConfig, pipelined_forward
@@ -469,6 +473,7 @@ def test_segment_ids_isolate_packed_documents():
                                    err_msg=backend)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_segment_ids_scan_layers_and_rejections():
     cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
                             d_ff=64, max_seq_len=32, dtype=jnp.float32,

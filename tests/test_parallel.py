@@ -203,6 +203,7 @@ def test_ulysses_attention_matches_reference():
                                    atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_ulysses_attention_differentiable():
     from tony_tpu.parallel import ulysses_attention
 
@@ -318,6 +319,7 @@ def test_multislice_mesh_virtual_slices_executes():
     assert first_ids == {d.id for d in jax.devices()[:4]}
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_pipeline_remat_matches_and_differentiates():
     n_stages = 4
     mesh = make_mesh(MeshSpec(data=2, pipe=n_stages))
@@ -352,6 +354,7 @@ def test_pipeline_remat_matches_and_differentiates():
                                np.asarray(g_remat["w"]), atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_circular_pipeline_matches_sequential():
     """Interleaved schedule (R=2, 8 virtual stages on 4 devices) must equal
     running all 8 stages sequentially."""
@@ -380,6 +383,7 @@ def test_circular_pipeline_matches_sequential():
                                    err_msg=f"n_micro={n_micro}")
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_circular_pipeline_differentiable():
     n_stages, R = 4, 2
     mesh = make_mesh(MeshSpec(data=2, pipe=n_stages))
@@ -505,6 +509,7 @@ def test_ring_attention_segments_match_reference():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_ring_attention_window_and_segments_gradients():
     mesh = make_mesh(MeshSpec(data=-1, seq=4))
     rng = jax.random.PRNGKey(33)
@@ -544,6 +549,7 @@ def test_ulysses_segments_and_window_match_reference():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_transformer_train_step_ring_window_segments():
     """The FULL transformer forward/backward under sp: ring backend with
     sliding_window + packed segment_ids must match the reference backend
@@ -600,6 +606,7 @@ def _stage_params(n_stages, d, f, seed):
         for i in range(n_stages)])
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_pipeline_composes_with_data_and_tensor_axes():
     """pp=2 x tp=2 x dp=2 on 8 devices: forward AND one full optimizer
     step match the sequential single-axis run."""
@@ -652,6 +659,7 @@ def test_pipeline_composes_with_data_and_tensor_axes():
                                    atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_moe_transformer_train_step_ep_tp_dp():
     """Full MoE transformer optimizer step on a data=2 x tensor=2 x
     expert=2 mesh (ep_tp preset): loss matches the replicated

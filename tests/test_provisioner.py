@@ -83,6 +83,7 @@ def test_chips_in_accelerator_type():
 # -- TpuVmProvisioner over fake gcloud ----------------------------------
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_provision_creates_awaits_ready_then_deletes(gdir):
     prov = make_prov(gdir)
     hosts = prov.provision()

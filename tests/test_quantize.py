@@ -52,7 +52,11 @@ CONFIGS = {
 }
 
 
-@pytest.mark.parametrize("family", sorted(CONFIGS))
+@pytest.mark.parametrize("family", [
+    # gpt2 (learned positions + biases) is the heavyweight variant
+    # (~15 s of compiles); tier-1 keeps the others, -m slow runs it
+    pytest.param(f, marks=pytest.mark.slow) if f == "gpt2" else f
+    for f in sorted(CONFIGS)])
 def test_quantized_forward_matches_dequant_reference(family):
     cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
                             n_layers=2, d_ff=64, max_seq_len=16,
@@ -185,6 +189,7 @@ def test_quantized_params_tp_logical_axes():
     assert norm_scale == (None,)
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_quantized_forward_under_tensor_parallel_mesh():
     """generate --int8 under a tp mesh (custom-partitioned pallas q8
     matmul): sharded logits and greedy tokens match the replicated run."""

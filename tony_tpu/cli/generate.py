@@ -11,6 +11,21 @@ the checkpoint and tokenizer are read from disk, nothing is downloaded.
         --temperature 0.8 --top-p 0.95
 
 Raw-token mode (no tokenizer needed): ``--token-ids 1,2,3``.
+
+Serving mode (``--serve``): a continuous-batching request loop
+(``tony_tpu.serve``) reading one JSON request per stdin line and
+writing one JSON response per finished request — drivable without a
+TPU (JAX_PLATFORMS=cpu) and without a tokenizer (token_ids requests):
+
+    printf '%s\n' '{"id": "a", "token_ids": [1, 2, 3]}' \
+                  '{"id": "b", "prompt": "Hello", "max_new_tokens": 8}' \
+        | python -m tony_tpu.cli.generate --model ./my-llama --serve
+
+Request fields: ``token_ids`` or ``prompt``; optional ``id``,
+``max_new_tokens``, ``temperature``, ``top_k``, ``seed`` (defaulting to
+the CLI flags). Responses stream in FINISH order (short requests do not
+wait on long ones — that is the point): ``{"id", "token_ids",
+"finish_reason", "text"?}``.
 """
 
 from __future__ import annotations
@@ -72,6 +87,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "bandwidth-bound on parameter bytes, so bf16 "
                         "storage halves per-token traffic (the standard "
                         "accelerator serving precision)")
+    p.add_argument("--serve", action="store_true",
+                   help="continuous-batching serving loop: JSONL "
+                        "requests on stdin -> JSONL responses on stdout "
+                        "(see module docstring). Requests multiplex onto "
+                        "one resident KV cache; finished slots are "
+                        "refilled the same iteration, so mixed-length "
+                        "traffic never idles behind the longest sequence")
+    p.add_argument("--serve-batch", type=int, default=4,
+                   help="cache slots (resident batch size) in --serve "
+                        "mode; bounds the KV-cache footprint")
     p.add_argument("--compile-cache",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "tony_tpu", "compile-cache"),
@@ -116,9 +141,68 @@ def load_model(model_dir: str):
     return model, params, config
 
 
+def _serve_loop(model, params, args, eos) -> int:
+    """``--serve``: read JSONL requests from stdin until EOF, stream one
+    JSONL response per finished request (finish order, not submit
+    order). Token-id requests need no tokenizer; the first ``prompt``
+    request lazy-loads one from the model dir."""
+    import json
+
+    from tony_tpu.serve import Request, Server
+
+    server = Server(model, params["params"], batch_size=args.serve_batch,
+                    eos_id=eos)
+    tokenizer = None
+    n_bad = 0
+    for lineno, raw in enumerate(sys.stdin, 1):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            d = json.loads(raw)
+            if not isinstance(d, dict):
+                raise ValueError("request must be a JSON object")
+            if "token_ids" in d:
+                ids = [int(x) for x in d["token_ids"]]
+            elif "prompt" in d:
+                if tokenizer is None:
+                    import transformers
+
+                    tokenizer = transformers.AutoTokenizer.from_pretrained(
+                        args.model)
+                ids = tokenizer.encode(d["prompt"])
+            else:
+                raise ValueError("request needs token_ids or prompt")
+            server.submit(Request(
+                ids,
+                int(d.get("max_new_tokens", args.max_new_tokens)),
+                temperature=float(d.get("temperature", args.temperature)),
+                top_k=int(d.get("top_k", args.top_k)),
+                seed=int(d.get("seed", args.seed)),
+                id=d.get("id")))
+        except Exception as e:  # noqa: BLE001 — a malformed line (bad
+            # JSON, wrong shapes, a prompt with no tokenizer in the
+            # model dir, an oversized prompt) must not kill the stream
+            # and strand every queued request: report, skip
+            print(f"request line {lineno} rejected: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            n_bad += 1
+    for res in server.run():
+        new_ids = res.tokens
+        stops = [i for i, t in enumerate(new_ids) if t in eos]
+        if stops:  # mirror the batch CLI: trim at the first stop token
+            new_ids = new_ids[:stops[0]]
+        out = {"id": res.id, "token_ids": list(res.prompt) + new_ids,
+               "finish_reason": res.finish_reason}
+        if tokenizer is not None:
+            out["text"] = tokenizer.decode(out["token_ids"])
+        print(json.dumps(out), flush=True)
+    return 0 if n_bad == 0 else 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    if not args.prompt and not args.token_ids:
+    if not args.serve and not args.prompt and not args.token_ids:
         print("need --prompt or --token-ids", file=sys.stderr)
         return 2
 
@@ -174,6 +258,17 @@ def main(argv=None) -> int:
     # [128001, 128009]); the decode loops stop on ANY of them
     eos = normalize_eos_ids(args.eos_id) or \
         normalize_eos_ids(getattr(config, "eos_token_id", None))
+
+    if args.serve:
+        if args.int8:
+            print("--serve does not support --int8 weight quantization "
+                  "yet", file=sys.stderr)
+            return 2
+        if args.top_p < 1.0:
+            print("warning: --top-p is not applied in --serve mode "
+                  "(per-slot sampling supports temperature/top-k); "
+                  "ignoring", file=sys.stderr)
+        return _serve_loop(model, params, args, eos)
 
     from tony_tpu.models import beam_search
 

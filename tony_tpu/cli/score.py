@@ -59,11 +59,12 @@ _MIN_BUCKET = 32
 
 
 def bucket_len(n: int, limit: int) -> int:
-    """Smallest power-of-two >= n (floor 32), capped at ``limit``."""
-    b = _MIN_BUCKET
-    while b < n:
-        b *= 2
-    return min(b, limit)
+    """Smallest power-of-two >= n (floor 32), capped at ``limit``.
+    One shared bucketing algorithm (serve's prefill uses the same
+    helper with a smaller floor)."""
+    from tony_tpu.serve import bucket_len as _bucket
+
+    return _bucket(n, limit, minimum=_MIN_BUCKET)
 
 
 def make_score_fn(model, params, through_cache: bool = False):

@@ -15,7 +15,11 @@ from __future__ import annotations
 import json
 import os
 import re
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # python < 3.11: tomli IS tomllib upstream
+    import tomli as tomllib
 from typing import Any, Iterable
 
 from tony_tpu.config import keys as K

@@ -55,6 +55,21 @@ def initialize(timeout_s: int | None = None) -> dict | None:
         return spec
     import jax
 
+    # CPU gangs (CI, the mini cluster, local smoke runs): the CPU
+    # backend's cross-process collectives need the gloo implementation
+    # selected BEFORE backend init, or every psum/allgather dies with
+    # "Multiprocess computations aren't implemented on the CPU
+    # backend". Newer jax defaults to gloo and may drop the knob — the
+    # update is best-effort. Read the platform from config/env, not
+    # jax.default_backend(), which would initialize the backend early.
+    platforms = str(jax.config.jax_platforms
+                    or os.environ.get("JAX_PLATFORMS", ""))
+    if platforms.split(",")[0] == "cpu":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:
+            pass
     kwargs = {}
     if timeout_s is not None:
         kwargs["initialization_timeout"] = timeout_s

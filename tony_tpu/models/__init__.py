@@ -7,7 +7,7 @@ from tony_tpu.models.resnet import (
     ResNet152,
 )
 from tony_tpu.models.generate import (beam_search, generate, init_cache,
-                                      sample_logits)
+                                      sample_logits, single_decode_step)
 from tony_tpu.models.pipeline import pipelined_forward
 from tony_tpu.models.quantize import (
     quantize_for_serving,
@@ -55,6 +55,7 @@ __all__ = [
     "shard_expert_qparams",
     "init_cache",
     "sample_logits",
+    "single_decode_step",
     "ResNet",
     "ResNet18",
     "ResNet34",

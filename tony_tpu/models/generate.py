@@ -103,6 +103,23 @@ def _is_eos(tok, eos_ids):
     return hit
 
 
+def single_decode_step(model, params, cache, tok, positions=None):
+    """ONE token step through the KV cache: feed ``tok`` [b] at the
+    current position(s), return ``(new_cache, last_logits [b, V])``.
+
+    The shared decode body of ``_generate``'s scan and the serving
+    loop's resident step (serve/engine.py): the scalar-index path
+    (``positions=None``, all rows in lockstep) and the per-slot path
+    (``positions`` [b], every row at its own cache position — negative
+    marks an empty slot) run the same model.apply; only the position
+    bookkeeping differs (Attention._decode_attention)."""
+    kwargs = {} if positions is None else {"positions": positions}
+    logits, vars_ = model.apply({"params": params, "cache": cache},
+                                tok[:, None], decode=True,
+                                mutable=["cache"], **kwargs)
+    return vars_["cache"], logits[:, -1]
+
+
 def generate(model, params, prompt, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
              rng: jax.Array | None = None, eos_id=-1,
@@ -156,16 +173,14 @@ def _generate(model, params, prompt, *, max_new_tokens: int,
 
     def step(carry, _):
         cache, tok, rng, done, seen = carry
-        logits, vars_ = model.apply({"params": params, "cache": cache},
-                                    tok[:, None], decode=True,
-                                    mutable=["cache"])
+        cache, logits_last = single_decode_step(model, params, cache, tok)
         rng, sub = jax.random.split(rng)
-        last = _penalize_repeats(logits[:, -1], seen, repetition_penalty)
+        last = _penalize_repeats(logits_last, seen, repetition_penalty)
         nxt = sample_logits(last, sub, temperature, top_k, top_p)
         nxt = jnp.where(done, freeze, nxt)
         seen = seen.at[jnp.arange(b), nxt].set(True)
         done = done | _is_eos(nxt, eos_ids)
-        return (vars_["cache"], nxt, rng, done, seen), nxt
+        return (cache, nxt, rng, done, seen), nxt
 
     carry = (vars_["cache"], next_tok, rng, done, seen)
     if max_new_tokens > 1:

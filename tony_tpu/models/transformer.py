@@ -308,7 +308,9 @@ def rotary_embedding(x, positions, theta: float = 10_000.0,
     """RoPE over head_dim (TPU-friendly: pure elementwise, fuses away).
     Half-split rotation convention (matches HF Llama's rotate_half).
     ``rotary_dims`` < head_dim rotates only the leading slice and passes
-    the rest through (GPT-NeoX/Pythia rotary_pct)."""
+    the rest through (GPT-NeoX/Pythia rotary_pct). ``positions`` is [L]
+    (shared across the batch) or [B, L] (per-row — the continuous-batching
+    decode step, where every cache slot sits at its own position)."""
     d = x.shape[-1]
     if rotary_dims and rotary_dims < d:
         rotated = rotary_embedding(x[..., :rotary_dims], positions, theta,
@@ -318,9 +320,13 @@ def rotary_embedding(x, positions, theta: float = 10_000.0,
     freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     if scaling is not None:
         freq = scaling.apply(freq)
-    angles = positions[:, None].astype(jnp.float32) * freq[None, :]  # [L, half]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions[..., None].astype(jnp.float32) * freq  # [..., half]
+    if angles.ndim == 3:  # per-row positions [B, L, half]
+        cos = jnp.cos(angles)[:, :, None, :]
+        sin = jnp.sin(angles)[:, :, None, :]
+    else:  # shared positions [L, half]
+        cos = jnp.cos(angles)[None, :, None, :]
+        sin = jnp.sin(angles)[None, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
@@ -330,7 +336,8 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, decode: bool = False, segment_ids=None):
+    def __call__(self, x, decode: bool = False, segment_ids=None,
+                 positions=None):
         cfg = self.cfg
         b, l, _ = x.shape
         # logical sharding axes for these kernels come from path-name
@@ -349,7 +356,7 @@ class Attention(nn.Module):
         k = dense("k", (cfg.kv_heads, cfg.head_dim), qkv_bias)(x)
         v = dense("v", (cfg.kv_heads, cfg.head_dim), qkv_bias)(x)
         if decode:
-            out = self._decode_attention(q, k, v)
+            out = self._decode_attention(q, k, v, positions)
         else:
             if cfg.positional == "rope":
                 positions = jnp.arange(l)
@@ -381,7 +388,7 @@ class Attention(nn.Module):
                 kernel_init=nn.initializers.normal(0.02))(out)
         return out
 
-    def _decode_attention(self, q, k, v):
+    def _decode_attention(self, q, k, v, positions=None):
         """Incremental attention over a fixed-size KV cache.
 
         Flax "cache" collection, the standard jittable decode shape: the
@@ -390,6 +397,16 @@ class Attention(nn.Module):
         lax.dynamic_update_slice at the current index, so every decode
         step compiles to the same static-shape program (no growing
         tensors, no recompiles — the XLA-friendly way to autoregress).
+
+        ``positions`` [b] int32 switches to PER-SLOT decode (the
+        continuous-batching serving step, serve/): every batch row is an
+        independent cache slot sitting at its own position — the new
+        token is scatter-written at ``positions[i]`` and row i attends
+        over ``[0, positions[i]]`` only. The shared ``cache_index``
+        scalar is meaningless across mixed-length slots and is neither
+        read nor advanced; a row with ``positions[i] < 0`` is an EMPTY
+        slot (no visible keys — its output is garbage by construction
+        and the serving scheduler ignores it). Single-token steps only.
         """
         cfg = self.cfg
         b, l, h, dh = q.shape
@@ -417,28 +434,61 @@ class Attention(nn.Module):
                                     lambda: jnp.array(0, jnp.int32))
         if not is_init:  # shape-only init pass
             return jnp.zeros((b, l, h, dh), q.dtype)
+        if positions is not None and l != 1:
+            raise ValueError("per-slot decode (positions=...) is a "
+                             "single-token step; got l=%d" % l)
+        per_slot = positions is not None
         cur = cache_index.value
         if cfg.positional == "rope":
-            positions = cur + jnp.arange(l)
-            q = rotary_embedding(q, positions, cfg.rope_theta,
+            # per-slot mode rotates row i at its own position (2-D
+            # positions ride a per-row cos/sin in rotary_embedding)
+            rope_pos = positions[:, None] if per_slot \
+                else cur + jnp.arange(l)
+            q = rotary_embedding(q, rope_pos, cfg.rope_theta,
                                  cfg.rope_scaling, cfg.rotary_dims)
-            k = rotary_embedding(k, positions, cfg.rope_theta,
+            k = rotary_embedding(k, rope_pos, cfg.rope_theta,
                                  cfg.rope_scaling, cfg.rotary_dims)
         if quant:
             from tony_tpu.ops.decode import quantize_kv
 
             k, k_sc = quantize_kv(k)  # quantize-on-write, after RoPE
             v, v_sc = quantize_kv(v)
-            k_scales.value = jax.lax.dynamic_update_slice(
-                k_scales.value, k_sc, (0, cur, 0))
-            v_scales.value = jax.lax.dynamic_update_slice(
-                v_scales.value, v_sc, (0, cur, 0))
-        keys = jax.lax.dynamic_update_slice(cached_k.value, k, (0, cur, 0, 0))
-        values = jax.lax.dynamic_update_slice(cached_v.value, v, (0, cur, 0, 0))
-        cached_k.value = keys
-        cached_v.value = values
-        cache_index.value = cur + l
-        q_pos = (cur + jnp.arange(l))[:, None]
+        if per_slot:
+            # scatter each row's token at that row's own cache position
+            # (one batched scatter — no per-slot dispatch). Empty slots
+            # (positions < 0) park their junk write at slot position 0:
+            # admit() overwrites the whole row before it ever goes live.
+            rows = jnp.arange(b)
+            write = jnp.clip(positions, 0, max_len - 1)
+            if quant:
+                k_scales.value = k_scales.value.at[rows, write].set(
+                    k_sc[:, 0])
+                v_scales.value = v_scales.value.at[rows, write].set(
+                    v_sc[:, 0])
+            keys = cached_k.value.at[rows, write].set(k[:, 0])
+            values = cached_v.value.at[rows, write].set(v[:, 0])
+            cached_k.value = keys
+            cached_v.value = values
+            # cache_index stays untouched: per-slot lengths live with the
+            # caller (serve.SlotCache), not in the shared scalar
+        else:
+            if quant:
+                k_scales.value = jax.lax.dynamic_update_slice(
+                    k_scales.value, k_sc, (0, cur, 0))
+                v_scales.value = jax.lax.dynamic_update_slice(
+                    v_scales.value, v_sc, (0, cur, 0))
+            keys = jax.lax.dynamic_update_slice(
+                cached_k.value, k, (0, cur, 0, 0))
+            values = jax.lax.dynamic_update_slice(
+                cached_v.value, v, (0, cur, 0, 0))
+            cached_k.value = keys
+            cached_v.value = values
+            cache_index.value = cur + l
+        # query positions, [rows, l]: one broadcast row in scalar mode,
+        # one row per slot in per-slot mode — the visibility mask below
+        # is written once against this shape
+        q_pos = positions[:, None] if per_slot \
+            else (cur + jnp.arange(l))[None, :]
         win = cfg.sliding_window
         if l == 1 and cfg.decode_attention == "flash":
             # the decode hot loop: fused pallas kernel over the (possibly
@@ -447,14 +497,17 @@ class Attention(nn.Module):
             # out-of-range blocks' FLOPs via predication, so the einsum
             # path's static window slice (whose odd win+1 span has no
             # legal TPU tile divisor) is neither needed nor wanted here.
+            # Per-slot lengths feed straight through: flash_decode takes
+            # a [B] length vector and zero-length rows emit exact zeros.
             from tony_tpu.ops.decode import flash_decode
 
+            length = jnp.maximum(positions + 1, 0) if per_slot else cur + 1
             out = flash_decode(
-                q[:, 0], keys, values, cur + 1, window=win,
+                q[:, 0], keys, values, length, window=win,
                 k_scale=k_scales.value if quant else None,
                 v_scale=v_scales.value if quant else None)
             return out[:, None].astype(q.dtype)
-        if win > 0 and win + l <= max_len:
+        if not per_slot and win > 0 and win + l <= max_len:
             # windowed decode: attend over a STATIC (window+l)-sized slice
             # ending at the newest token instead of the whole max_len
             # buffer — per-step attention work drops from O(max_len) to
@@ -498,10 +551,13 @@ class Attention(nn.Module):
             # MXU), and the inline convert slows that VPU loop — see
             # docs/PERF.md's context-dependent --kv-int8 guidance.
             s = s * ks_att.transpose(0, 2, 1)[:, :, None, None, :]
-        visible = kv_pos[None, :] <= q_pos  # [l, span]
+        # [rows, l, span]: rows == 1 (shared positions) broadcasts over
+        # the batch; rows == b is the per-slot mask
+        visible = kv_pos[None, None, :] <= q_pos[:, :, None]
         if win > 0:
-            visible = visible & (q_pos - kv_pos[None, :] < win)
-        s = jnp.where(visible[None, None, None, :, :], s, -1e30)
+            visible = visible & (q_pos[:, :, None] - kv_pos[None, None, :]
+                                 < win)
+        s = jnp.where(visible[:, None, None, :, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         if quant:
             # likewise fold the value scale into the probabilities
@@ -587,6 +643,7 @@ class QuantDense(nn.Module):
             from jax.sharding import PartitionSpec as P
 
             from tony_tpu.parallel.mesh import DATA, FSDP
+            from tony_tpu.utils.compat import shard_map
 
             # manual over the WHOLE mesh (partial-manual shard_map needs
             # explicit-type meshes): batch rows ride the data/fsdp axes
@@ -603,7 +660,7 @@ class QuantDense(nn.Module):
                 y = q8_matmul(xl, wl, sl)
                 return jax.lax.psum(y, in_ax) if in_ax else y
 
-            y = jax.shard_map(
+            y = shard_map(
                 local, mesh=self.mesh,
                 in_specs=(P(bspec, in_ax), P(in_ax, out_ax), P(out_ax)),
                 out_specs=P(bspec, out_ax),
@@ -721,10 +778,11 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, decode: bool = False, segment_ids=None):
+    def __call__(self, x, decode: bool = False, segment_ids=None,
+                 positions=None):
         attn_out = Attention(self.cfg, name="attn")(
             make_norm(self.cfg, "ln1")(x), decode=decode,
-            segment_ids=segment_ids)
+            segment_ids=segment_ids, positions=positions)
         ffn_cls = MoEMLP if self.use_moe else MLP
         if (self.cfg.remat and not decode
                 and self.cfg.remat_policy == "attn_saved"):
@@ -768,18 +826,21 @@ class _ScanBody(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, segment_ids):
+    def __call__(self, x, segment_ids, positions):
         return Block(self.cfg, name="block")(
-            x, self.decode, segment_ids=segment_ids), None
+            x, self.decode, segment_ids=segment_ids,
+            positions=positions), None
 
 
 class Transformer(nn.Module):
     cfg: TransformerConfig
 
-    def _learned_positions(self, l: int, decode: bool):
+    def _learned_positions(self, l: int, decode: bool, positions=None):
         """GPT-2-style absolute position embeddings. In decode mode a
         top-level cache counter tracks the current offset (the per-layer
-        attention cache keeps its own; they advance in lockstep)."""
+        attention cache keeps its own; they advance in lockstep). Per-slot
+        decode (``positions`` [b]) reads each row's own offset and leaves
+        the shared counter untouched — slot lengths live with the caller."""
         cfg = self.cfg
         pos_emb = self.param("pos_embedding", nn.initializers.normal(0.02),
                              (cfg.max_seq_len, cfg.d_model), jnp.float32)
@@ -787,16 +848,22 @@ class Transformer(nn.Module):
             is_init = self.has_variable("cache", "pos_index")
             pos_index = self.variable("cache", "pos_index",
                                       lambda: jnp.array(0, jnp.int32))
+            if positions is not None:
+                # declared-but-unchanged pos_index keeps the mutated cache
+                # tree congruent with the carried one across serve steps
+                rows = jnp.clip(positions, 0, cfg.max_seq_len - 1)
+                return pos_emb[rows][:, None].astype(cfg.dtype)  # [b, 1, d]
             if is_init:
-                positions = pos_index.value + jnp.arange(l)
+                pos = pos_index.value + jnp.arange(l)
                 pos_index.value = pos_index.value + l
             else:
-                positions = jnp.arange(l)
+                pos = jnp.arange(l)
         else:
-            positions = jnp.arange(l)
-        return pos_emb[positions][None].astype(cfg.dtype)
+            pos = jnp.arange(l)
+        return pos_emb[pos][None].astype(cfg.dtype)
 
-    def _scan_blocks(self, x, decode: bool, segment_ids=None):
+    def _scan_blocks(self, x, decode: bool, segment_ids=None,
+                     positions=None):
         cfg = self.cfg
         body = _ScanBody
         if cfg.remat and not decode:
@@ -807,16 +874,17 @@ class Transformer(nn.Module):
             body,
             variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True},
-            in_axes=nn.broadcast,  # segment_ids: same array every layer
+            in_axes=nn.broadcast,  # segment_ids/positions: same every layer
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        x, _ = scanned(cfg, decode, name="layers")(x, segment_ids)
+        x, _ = scanned(cfg, decode, name="layers")(x, segment_ids, positions)
         return x
 
     @nn.compact
     def __call__(self, tokens, decode: bool = False,
-                 return_hidden: bool = False, segment_ids=None):
+                 return_hidden: bool = False, segment_ids=None,
+                 positions=None):
         """return_hidden=True yields the final [B, L, D] activations
         (post ln_f) instead of logits, for the chunked large-vocab loss
         (ops.xent.chunked_cross_entropy with params["embedding"]) — the
@@ -826,10 +894,18 @@ class Transformer(nn.Module):
         restricted to same-segment keys, so documents packed into one
         window never leak into each other. Training-path only (decode
         caches have no segment notion); reference/blockwise/pallas
-        backends (the pallas kernels stream the ids as blocked operands)."""
+        backends (the pallas kernels stream the ids as blocked operands).
+
+        positions [B] int32 (decode-only): PER-SLOT decode for the
+        continuous-batching server (serve/) — each batch row is an
+        independent cache slot at its own position; negative = empty
+        slot. See Attention._decode_attention."""
         if segment_ids is not None and decode:
             raise ValueError("segment_ids are a training-path feature; "
                              "decode has no segment notion")
+        if positions is not None and not decode:
+            raise ValueError("positions (per-slot decode) requires "
+                             "decode=True")
         cfg = self.cfg
         embed = self.param("embedding", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.d_model), jnp.float32)
@@ -838,9 +914,10 @@ class Transformer(nn.Module):
             # in activation dtype, matching HF Gemma's normalizer cast
             x = x * jnp.asarray(cfg.d_model ** 0.5, cfg.dtype)
         if cfg.positional == "learned":
-            x = x + self._learned_positions(tokens.shape[1], decode)
+            x = x + self._learned_positions(tokens.shape[1], decode,
+                                            positions)
         if cfg.scan_layers:
-            x = self._scan_blocks(x, decode, segment_ids)
+            x = self._scan_blocks(x, decode, segment_ids, positions)
         else:
             block = Block
             if cfg.remat and not decode:
@@ -851,7 +928,7 @@ class Transformer(nn.Module):
             for i in range(cfg.n_layers):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
                 x = block(cfg, use_moe=use_moe, name=f"block_{i}")(
-                    x, decode, segment_ids=segment_ids)
+                    x, decode, segment_ids=segment_ids, positions=positions)
         x = make_norm(cfg, "ln_f")(x)
         if not cfg.tied_embeddings:
             head = self.param("lm_head", nn.initializers.normal(0.02),

@@ -41,7 +41,7 @@ from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from tony_tpu.utils.compat import shard_map
 from jax.experimental import pallas as pl
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -228,12 +228,17 @@ def fused_adamw_update(opt: FusedAdamW, grads, state: FusedAdamWState,
             and jnp.issubdtype(p.dtype, jnp.floating)) else None
         sharded = (mesh is not None and spec is not None
                    and any(ax is not None for ax in spec))
-        # local (per-shard) element count decides the kernel/jnp split
+        # local (per-shard) element count decides the kernel/jnp split.
+        # A spec entry may be a TUPLE of axis names (P(('data','fsdp'))
+        # — legal, and what batch_sharding emits on multi-axis meshes):
+        # the dim splits over every named axis, so divide by each.
         n_local = p.size
         if sharded:
             for ax in spec:
-                if ax is not None:
-                    n_local //= mesh.shape[ax]
+                if ax is None:
+                    continue
+                for a in (ax if isinstance(ax, tuple) else (ax,)):
+                    n_local //= mesh.shape[a]
         if n_local < _min_kernel_elems() or n_local % _LANES:
             new = _leaf_update_jnp(g, p, mu, nu, lr, c1, c2,
                                    compute_dtype=cdt, **static)
@@ -256,7 +261,10 @@ def fused_adamw_update(opt: FusedAdamW, grads, state: FusedAdamWState,
         for i, leaf in enumerate(new):
             out[i].append(leaf)
         if cdt is None and compute_dtype is not None:
-            out[3].append(p)  # non-float leaf rides along unchanged
+            # non-float leaf: carry the UPDATED value (new[0]), not the
+            # stale input — params and compute_params must never diverge
+            # (the train step differentiates through compute_params)
+            out[3].append(new[0])
 
     unflatten = treedef.unflatten
     return unflatten(out[0]), FusedAdamWState(

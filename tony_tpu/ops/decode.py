@@ -247,7 +247,11 @@ def flash_decode(q, k, v, length, *, window: int = 0, block_k: int = 512,
       ``k_scale``/``v_scale`` [B, S, KVH] fp32 per-(position, head)
       scales (quantize-on-write; see models/quantize.quantize_kv).
     length: [B] int32 — valid cache length per sequence (query sits at
-      position ``length - 1``); positions >= length are masked.
+      position ``length - 1``); positions >= length are masked. Lengths
+      are PER-SLOT state: a serving batch may mix any lengths, and a
+      length of 0 marks an EMPTY continuous-batching slot — its output
+      row is exact zeros (both kernels; see _finalize), never NaN, so
+      empty slots ride a live batch for free.
     window: sliding window (key visible iff 0 <= q_pos - k_pos < window).
     Returns [B, H, D] in q's dtype.
     """

@@ -94,6 +94,8 @@ def _expert_ffn(params: dict, x: jnp.ndarray, cfg: "MoEConfig",
             # would all-gather the very weights EP exists to split)
             from jax.sharding import PartitionSpec as P
 
+            from tony_tpu.utils.compat import shard_map
+
             ax = cfg.expert_axis
             w3, w2 = P(ax, None, None), P(ax, None)
             xspec = P(None, None) if x_axis is None else P(ax, None, None)
@@ -109,7 +111,7 @@ def _expert_ffn(params: dict, x: jnp.ndarray, cfg: "MoEConfig",
                          for j, sfx in enumerate(("_q8", "_scale"))}
                 return _q8_expert_ffn(local, x_l, x_axis, act, cfg.gated)
 
-            return jax.shard_map(
+            return shard_map(
                 local_ffn, mesh=cfg.mesh,
                 in_specs=(xspec, *w_specs),
                 out_specs=P(ax, None, None),

@@ -160,18 +160,22 @@ class PortalHandler(BaseHTTPRequestHandler):
         jobs = all_jobs[(page - 1) * per:page * per]
         if api:
             return self._send(200, json.dumps(jobs), "application/json")
-        rows = "".join(
-            f"<tr><td><a href='{self._href(f'/job/{j['app_id']}/config')}'>"
-            f"{j['app_id']}</a></td>"
-            f"<td class='{j['status']}'>{j['status']}</td>"
-            f"<td>{j['user'] or '-'}</td>"
-            f"<td>{_ts(j['started'])}</td><td>{_ts(j['completed'])}</td>"
-            f"<td><a href='{self._href(f'/job/{j['app_id']}/events')}'>events</a> "
-            f"<a href='{self._href(f'/job/{j['app_id']}/logs')}'>logs</a> "
-            f"<a href='{self._href(f'/job/{j['app_id']}/metrics')}'>metrics</a>"
-            f"</td></tr>"
-            for j in jobs
-        )
+        def _row(j):
+            # aid hoisted out of the nested f-strings: quoting a dict key
+            # inside a same-quoted inner f-string needs python >= 3.12
+            aid = j["app_id"]
+            return (
+                f"<tr><td><a href='{self._href(f'/job/{aid}/config')}'>"
+                f"{aid}</a></td>"
+                f"<td class='{j['status']}'>{j['status']}</td>"
+                f"<td>{j['user'] or '-'}</td>"
+                f"<td>{_ts(j['started'])}</td><td>{_ts(j['completed'])}</td>"
+                f"<td><a href='{self._href(f'/job/{aid}/events')}'>events</a> "
+                f"<a href='{self._href(f'/job/{aid}/logs')}'>logs</a> "
+                f"<a href='{self._href(f'/job/{aid}/metrics')}'>metrics</a>"
+                f"</td></tr>")
+
+        rows = "".join(_row(j) for j in jobs)
         nav = []
         if page > 1:
             nav.append(f"<a href='{self._href('/', f'page={page - 1}', f'per={per}')}'"

@@ -17,7 +17,9 @@ Parsing is done directly from the ``*.xplane.pb`` protos that
   ``tensorflow.tsl.profiler.protobuf.xplane_pb2``.
 - ``PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python`` must be exported
   before the first ``google.protobuf`` import or the C++ descriptor
-  pool rejects the generated code; this module sets it on import.
+  pool rejects the generated code; it is set LAZILY in ``load_xspace``
+  (not at module import, so merely importing the profiler never forces
+  the slower python protobuf impl on processes that parse no xplanes).
 - The device plane is named ``/device:TPU:N``; its ``XLA Ops`` line
   carries one event per executed HLO op with ``duration_ps``. Summing
   durations is safe: ops on one TPU core's line are serialized.
@@ -32,6 +34,25 @@ import glob
 import os
 
 _PS_PER_MS = 1e9
+
+_warned_degraded = False
+
+
+def _warn_degraded(reason: str) -> None:
+    """One-time (per process) warning when xplane parsing degrades to
+    None: callers fall back to wall-clock ratios, which on the tunneled
+    backend carry ~4.5 ms/launch of dispatch noise — that silent
+    downgrade must be visible in the bench log."""
+    global _warned_degraded
+    if _warned_degraded:
+        return
+    _warned_degraded = True
+    import warnings
+
+    warnings.warn(
+        f"xplane trace parsing degraded to None ({reason}); timing "
+        "ratios fall back to wall-clock, which includes dispatch/launch "
+        "overhead", RuntimeWarning, stacklevel=3)
 
 
 def xplane_files(logdir: str) -> list[str]:
@@ -135,7 +156,10 @@ def op_totals_ms(logdir: str, line_name: str = "XLA Ops",
                         continue
                     totals[name] = totals.get(name, 0.0) \
                         + ev.duration_ps / _PS_PER_MS
-    return totals if found else None
+    if not found:
+        _warn_degraded("no parseable device plane under " + logdir)
+        return None
+    return totals
 
 
 def device_busy_ms(logdir: str, line_name: str = "XLA Ops") -> float | None:

@@ -1,0 +1,24 @@
+"""Continuous-batching serving over the slot-tolerant decode path.
+
+The TPU-serving analog of TonY's job multiplexing (``TonySession`` /
+``TaskScheduler`` packing many jobs onto one container pool): many
+REQUESTS multiplex onto one resident KV cache. One jitted decode step
+of fixed shape [batch_size, max_seq_len] runs forever; requests stream
+through its slots — admitted into free slots at their own positions,
+evicted the moment they hit EOS or their token budget, replaced the
+same iteration (Orca/vLLM-style iteration-level scheduling). Static
+shapes mean the step compiles ONCE; mixed-length traffic never waits
+on the longest sequence in a batch.
+"""
+
+from tony_tpu.serve.engine import Request, Result, Server, bucket_len
+from tony_tpu.serve.slots import SlotCache, cache_batch_axis
+
+__all__ = [
+    "Request",
+    "Result",
+    "Server",
+    "SlotCache",
+    "bucket_len",
+    "cache_batch_axis",
+]

@@ -1,0 +1,135 @@
+"""SlotCache: batch_size resident KV-cache slots + per-slot decode state.
+
+The device side is ONE fixed-shape cache pytree (``init_cache`` at
+``batch_size``) that the resident decode step updates in place; the
+host side is a handful of small per-slot arrays (length, last token,
+sampling knobs, rng) the scheduler reads and writes between steps.
+Admit copies a freshly prefilled single-row cache into a free slot with
+one jitted dynamic-update-slice per leaf (slot index traced — one
+compile total); evict is pure host bookkeeping (the row's stale K/V is
+masked by the slot's length going inactive and fully overwritten by the
+next admit, so no device work is ever spent clearing it).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tony_tpu.models.generate import init_cache
+
+
+def cache_batch_axis(path, leaf) -> int | None:
+    """Batch (slot) axis of a cache leaf, or None for non-batched leaves.
+
+    KV buffers are [..., b, max_len, kvh, dh] — batch is 4th-from-last;
+    their quant scales are [..., b, max_len, kvh] — 3rd-from-last.
+    scan_layers models prepend an n_layers axis, which this arithmetic
+    skips (keying on axis 0 would slice the LAYERS axis). Index counters
+    (cache_index/pos_index) carry no batch dim: per-slot decode neither
+    reads nor advances them (positions live host-side)."""
+    name = str(path[-1].key if hasattr(path[-1], "key") else path[-1])
+    if name in ("cached_key", "cached_value"):
+        return leaf.ndim - 4
+    if name in ("cached_key_scale", "cached_value_scale"):
+        return leaf.ndim - 3
+    return None
+
+
+def write_slot_row(cache: Any, row: Any, slot) -> Any:
+    """Copy a batch-1 cache ``row`` into slot ``slot`` of ``cache``
+    (pure tree transform, traceable — the ONE place that knows how to
+    place a row; the engine's fused prefill-admit and the standalone
+    jitted copy below both call it)."""
+    def write(path, leaf, rleaf):
+        ax = cache_batch_axis(path, leaf)
+        if ax is None:
+            return leaf  # shared counters: per-slot mode ignores them
+        start = [jnp.int32(0)] * leaf.ndim
+        start[ax] = jnp.asarray(slot, jnp.int32)
+        return jax.lax.dynamic_update_slice(leaf, rleaf.astype(leaf.dtype),
+                                            tuple(start))
+
+    return jax.tree_util.tree_map_with_path(write, cache, row)
+
+
+@jax.jit
+def _write_slot(cache: Any, row: Any, slot) -> Any:
+    """Jitted ``write_slot_row``; ``slot`` is traced — every admit
+    reuses one compiled program."""
+    return write_slot_row(cache, row, slot)
+
+
+class SlotCache:
+    """``batch_size`` cache slots + per-slot length/rng/EOS-side state.
+
+    Host arrays are numpy (the scheduler mutates them every iteration);
+    the cache pytree stays on device across the whole serve session.
+    """
+
+    def __init__(self, model, params, batch_size: int):
+        self.batch_size = batch_size
+        self.max_seq_len = model.cfg.max_seq_len
+        self.cache = init_cache(model, params, batch_size)
+        self.lengths = np.zeros(batch_size, np.int32)
+        self.active = np.zeros(batch_size, bool)
+        self.last_token = np.zeros(batch_size, np.int32)
+        self.temperature = np.zeros(batch_size, np.float32)
+        self.top_k = np.zeros(batch_size, np.int32)
+        self.rng = np.zeros((batch_size, 2), np.uint32)
+
+    def free_slots(self) -> list[int]:
+        return [i for i in range(self.batch_size) if not self.active[i]]
+
+    @property
+    def n_active(self) -> int:
+        return int(self.active.sum())
+
+    def positions(self) -> np.ndarray:
+        """Per-slot decode positions for the next step: the slot's
+        current length (where the next token is written and up to which
+        attention looks), -1 for empty slots (no visible keys)."""
+        return np.where(self.active, self.lengths, -1).astype(np.int32)
+
+    def admit(self, slot: int, length: int, last_token: int,
+              temperature: float, top_k: int, rng_key,
+              row_cache: Any = None) -> None:
+        """Arm ``slot``'s per-slot state; with ``row_cache`` also copy
+        that prefilled batch-1 cache row into the slot (the serving
+        engine fuses the copy into its prefill dispatch instead and
+        passes None). ``length`` = real prompt length (bucket padding
+        beyond it is invisible: masked now, overwritten as the slot
+        advances). ``last_token`` is the first sampled continuation —
+        the next step feeds it at position ``length``."""
+        if self.active[slot]:
+            raise ValueError(f"slot {slot} is occupied")
+        if not 0 < length <= self.max_seq_len:
+            raise ValueError(f"bad prompt length {length}")
+        if row_cache is not None:
+            self.cache = _write_slot(self.cache, row_cache,
+                                     jnp.int32(slot))
+        self.lengths[slot] = length
+        self.last_token[slot] = last_token
+        self.temperature[slot] = temperature
+        self.top_k[slot] = top_k
+        self.rng[slot] = np.asarray(rng_key, np.uint32).reshape(2)
+        self.active[slot] = True
+
+    def evict(self, slot: int) -> None:
+        """Free a slot (EOS / budget exhausted). Device state is left in
+        place — an inactive slot's position is -1, so nothing reads it,
+        and the next admit overwrites the whole row."""
+        self.active[slot] = False
+        self.lengths[slot] = 0
+        self.last_token[slot] = 0
+        self.temperature[slot] = 0.0
+        self.top_k[slot] = 0
+
+    def reset(self) -> None:
+        """Evict everything (a fresh serving session on the same cache
+        allocation — no reallocation, no recompile)."""
+        for i in range(self.batch_size):
+            self.evict(i)

@@ -1,0 +1,27 @@
+"""Version-bridging shims for the jax surface this repo targets.
+
+The codebase is written against the modern API (``jax.shard_map`` with
+its ``check_vma`` flag); older jax releases ship the same primitive as
+``jax.experimental.shard_map.shard_map`` with the flag spelled
+``check_rep``. Every ``shard_map`` import in the repo goes through this
+module so exactly one place owns the difference — a missing top-level
+``jax.shard_map`` must degrade to the experimental spelling, not take
+the whole test suite down at collection time.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+
+else:  # jax < 0.6: experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
