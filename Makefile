@@ -10,6 +10,9 @@
 #   make check  - lint + smoke (the pre-commit gate)
 #   make test   - the full suite (~15-20 min on a 1-core box)
 #   make bench  - the driver-contract benchmark (one JSON line)
+#   make serve-smoke - boot a tiny-model gateway, concurrent curl
+#                 clients (unary + streaming), SIGTERM drain; every
+#                 phase `timeout`-bounded so a hang exits nonzero
 
 PY ?= python
 
@@ -21,7 +24,7 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 	tests/test_workflow.py tests/test_tpu_info.py \
 	tests/test_compilecache.py tests/test_proxy.py tests/test_profiler.py
 
-.PHONY: lint smoke check test bench
+.PHONY: lint smoke check test bench serve-smoke
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -41,3 +44,6 @@ test:
 
 bench:
 	$(PY) bench.py
+
+serve-smoke:
+	PY=$(PY) sh tools/serve_smoke.sh

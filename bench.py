@@ -20,7 +20,10 @@ Measurements (BASELINE.md rows 2-3 + VERDICT next-steps, r1-r3):
    weight-only dequant-matmul vs bf16 at decode shapes.
 
 4. KV-cache decode throughput + HBM-bandwidth utilization (prefill
-   subtracted) — the serving-path roofline.
+   subtracted) — the serving-path roofline. Plus the serving-layer
+   data: continuous-vs-fixed batching (extras.serving) and the
+   gateway front door's concurrent-client throughput + p50/p99 TTFT
+   at 1 vs 2 replicas (extras.gateway).
 
 5. Launch -> first-step latency through the REAL submit path
    (TonyClient -> coordinator -> agent -> payload jit step) on the mini
@@ -1061,6 +1064,109 @@ def bench_serving(on_tpu: bool) -> dict:
     }
 
 
+def bench_gateway(on_tpu: bool) -> dict:
+    """The front-door datum (ISSUE-2 acceptance): concurrent clients
+    through ``tony_tpu.gateway`` vs the same requests issued serially
+    by one client. Serial leaves every slot but one idle; concurrent
+    clients fill the continuous-batching slots, so concurrent tok/s
+    must be >= the serial baseline (the asserted bound) and in practice
+    well above it. Also records p50/p99 TTFT at 1 vs 2 replicas — the
+    latency price of queueing under load that /stats exposes in
+    production. Host-scheduling-bound by design, so the CPU-sized model
+    is the right probe on either backend (the chip-side decode numbers
+    live in extras.serving/decode)."""
+    import threading
+
+    import numpy as np
+
+    from tony_tpu.gateway import Gateway, GenRequest
+    from tony_tpu.models import Transformer, TransformerConfig
+    from tony_tpu.serve import Server
+
+    cfg = TransformerConfig(
+        vocab_size=512, d_model=128, n_layers=3, n_heads=4, d_ff=256,
+        max_seq_len=128)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    rng = np.random.default_rng(0)
+    n_req, prompt_len, batch = 16, 16, 4
+    budgets = (rng.exponential(scale=12.0, size=n_req).astype(int)
+               + 8).clip(8, 48)
+    prompts = rng.integers(0, cfg.vocab_size, size=(n_req, prompt_len))
+    useful = int(budgets.sum())
+
+    def make_gateway(n_replicas):
+        return Gateway(
+            [Server(model, params, batch_size=batch, eos_id=-1,
+                    min_bucket=prompt_len, chunk_steps=8)
+             for _ in range(n_replicas)],
+            max_queue=2 * n_req).start()
+
+    def run_serial() -> float:
+        gw = make_gateway(1)
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            gw.submit(GenRequest(prompts[i].tolist(), int(budgets[i]),
+                                 id=i)).result(timeout=600)
+        dt = time.perf_counter() - t0
+        gw.drain(timeout=60)
+        return dt
+
+    def run_concurrent(n_replicas, n_clients=8):
+        gw = make_gateway(n_replicas)
+        errors = []
+
+        def client(c):
+            try:
+                for i in range(c, n_req, n_clients):
+                    gw.submit(GenRequest(prompts[i].tolist(),
+                                         int(budgets[i]), id=i)) \
+                        .result(timeout=600)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        snap = gw.snapshot()
+        gw.drain(timeout=60)
+        if errors:
+            raise errors[0]
+        return dt, snap
+
+    run_concurrent(1)  # warm: compiles prefill bucket + chunk ladder
+    t_serial = run_serial()
+    t_c1, snap1 = run_concurrent(1)
+    t_c2, snap2 = run_concurrent(2)
+    serial_tok_s = useful / t_serial
+    c1_tok_s = useful / t_c1
+    c2_tok_s = useful / t_c2
+    return {
+        "n_requests": n_req,
+        "useful_tokens": useful,
+        "batch_slots": batch,
+        "serial_tok_s": round(serial_tok_s, 1),
+        "concurrent_tok_s_1r": round(c1_tok_s, 1),
+        "concurrent_tok_s_2r": round(c2_tok_s, 1),
+        # the acceptance bound: concurrent clients must not be SLOWER
+        # than one serial client (continuous batching fills the slots)
+        "concurrent_vs_serial": round(c1_tok_s / serial_tok_s, 3),
+        "concurrent_beats_serial": bool(c1_tok_s >= serial_tok_s),
+        "ttft_ms_1r": {"p50": snap1["ttft_ms"]["p50"],
+                       "p99": snap1["ttft_ms"]["p99"]},
+        "ttft_ms_2r": {"p50": snap2["ttft_ms"]["p50"],
+                       "p99": snap2["ttft_ms"]["p99"]},
+        "queue_wait_ms_1r_p99": snap1["queue_wait_ms"]["p99"],
+        "queue_wait_ms_2r_p99": snap2["queue_wait_ms"]["p99"],
+    }
+
+
 # ------------------------------------------------------ attention kernels
 
 
@@ -1422,6 +1528,11 @@ def _collect_line() -> dict:
         extras["serving"] = bench_serving(on_tpu)
     except Exception as e:
         extras["serving"] = {"error": f"{type(e).__name__}: {e}"}
+    gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
+    try:
+        extras["gateway"] = bench_gateway(on_tpu)
+    except Exception as e:
+        extras["gateway"] = {"error": f"{type(e).__name__}: {e}"}
     gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
     try:
         extras["quant"] = bench_quant(on_tpu)

@@ -133,6 +133,18 @@ def test_reexec_guard_blocks_recursion(bench, monkeypatch):
     assert probes == []
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
+def test_bench_gateway_concurrent_beats_serial(bench):
+    """The extras.gateway acceptance bound: concurrent clients through
+    the front door must reach at least the single-client serial
+    throughput (continuous batching fills the slots serial leaves
+    idle; measured ~2.8x on the CI box)."""
+    out = bench.bench_gateway(False)
+    assert out["concurrent_beats_serial"], out
+    assert out["concurrent_tok_s_1r"] >= out["serial_tok_s"], out
+    assert out["ttft_ms_1r"]["p99"] >= out["ttft_ms_1r"]["p50"] >= 0
+
+
 def test_stdout_guard_artifact_is_final_line():
     """VERDICT item 7: everything printed inside the guard (python- or
     fd-level, as sub-benches and their children do) lands on stderr;

@@ -72,9 +72,27 @@ def _build_native() -> bool:
     return r.returncode == 0 and os.path.exists(_NATIVE_BIN)
 
 
+def _native_libc_error() -> str:
+    """The dynamic-link error of the checked-in binary, or '' when it
+    loads. The binary ships built against glibc 2.34; on older images
+    (this container: 2.31) the loader rejects it before main, so probe
+    the binary itself rather than doing version arithmetic."""
+    try:
+        probe = subprocess.run([_NATIVE_BIN], capture_output=True,
+                               text=True, timeout=10)
+    except OSError as e:
+        return str(e)
+    err = probe.stderr.strip()
+    return err if "GLIBC" in err else ""
+
+
 def test_native_proxy_roundtrip(echo_server):
     if not _build_native():
         pytest.skip("native proxy not built and no toolchain")
+    libc_err = _native_libc_error()
+    if libc_err:
+        pytest.skip("prebuilt native proxy needs a newer glibc than this "
+                    f"image ships (typically GLIBC >= 2.34): {libc_err}")
     proxy = ProxyServer("127.0.0.1", echo_server, prefer_native=True).start()
     try:
         assert proxy.prefer_native, "native binary exists but was not chosen"

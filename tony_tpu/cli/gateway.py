@@ -1,0 +1,191 @@
+"""``tony-tpu gateway`` — the HTTP serving front door.
+
+Boots N data-parallel ``serve.Server`` replicas (one scheduler thread
+each, weights shared, KV caches private) behind ``tony_tpu.gateway``:
+bounded admission with per-request deadlines, least-outstanding-tokens
+routing, graceful drain on SIGTERM, per-request metrics on ``/stats``
+(and in the portal via ``--history``).
+
+    python -m tony_tpu.cli.gateway --model ./my-llama \
+        --replicas 2 --serve-batch 4 --port 8000
+
+    curl -s localhost:8000/v1/generate -d \
+        '{"prompt": "Once upon a time", "max_new_tokens": 32}'
+
+``--demo-model`` serves a tiny randomly initialized decoder instead of
+a checkpoint — token_ids-only, but boots in seconds on CPU: the smoke
+target (``make serve-smoke``) and quick integration checks use it.
+
+Shutdown: SIGTERM/SIGINT stops admission (``/readyz`` -> 503 so a load
+balancer pulls the replica), finishes every queued + in-flight request,
+then exits 0. A second signal force-exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tony-tpu gateway",
+        description="HTTP serving front door over N continuous-batching "
+                    "replicas")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--model", help="local checkpoint directory (HF format)")
+    src.add_argument("--demo-model", action="store_true",
+                     help="serve a tiny random decoder (no checkpoint, "
+                          "token_ids requests only) — for smoke tests")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="data-parallel serve.Server replicas (each with "
+                        "its own KV cache and scheduler thread)")
+    p.add_argument("--serve-batch", type=int, default=4,
+                   help="cache slots per replica")
+    p.add_argument("--chunk-steps", type=int, default=1,
+                   help="decode micro-steps fused per dispatch; 1 = "
+                        "lowest per-token streaming latency, larger = "
+                        "higher throughput")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000,
+                   help="0 picks an ephemeral port")
+    p.add_argument("--max-queue", type=int, default=128,
+                   help="admission queue bound; past it requests shed "
+                        "with 429")
+    p.add_argument("--max-pending", type=int, default=1024,
+                   help="per-replica engine queue bound (serve.QueueFull)")
+    p.add_argument("--default-ttl", type=float, default=None,
+                   help="default per-request deadline in seconds "
+                        "(requests may override with ttl_s); expired "
+                        "requests shed with 504 before taking a slot")
+    p.add_argument("--eos-id", type=int, default=-1,
+                   help="stop token (default: model config's "
+                        "eos_token_id)")
+    p.add_argument("--dtype", choices=("fp32", "bf16"), default="fp32",
+                   help="parameter storage dtype (bf16 halves decode "
+                        "HBM traffic — the serving default on TPU)")
+    p.add_argument("--history", default="",
+                   help="job-history root: record the gateway as a "
+                        "portal-browsable job with per-request metrics")
+    p.add_argument("--drain-timeout", type=float, default=120.0,
+                   help="max seconds to wait for in-flight requests on "
+                        "shutdown")
+    p.add_argument("--compile-cache",
+                   default=os.path.join(os.path.expanduser("~"), ".cache",
+                                        "tony_tpu", "compile-cache"),
+                   help="persistent XLA compile-cache dir ('' disables)")
+    return p
+
+
+def demo_model():
+    """A tiny random decoder: boots in seconds on CPU, exercises the
+    whole serving stack (prefill buckets, per-slot decode, EOS evict)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models import Transformer, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=64,
+                            dtype=jnp.float32,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def build_gateway(args, model, params, eos, *, metrics_store=None):
+    """Servers + Gateway from parsed args (shared with tests/bench)."""
+    from tony_tpu.gateway import Gateway, GatewayHistory
+    from tony_tpu.serve import Server
+
+    servers = [Server(model, params, batch_size=args.serve_batch,
+                      eos_id=eos, chunk_steps=args.chunk_steps,
+                      max_pending=args.max_pending)
+               for _ in range(max(1, args.replicas))]
+    history = None
+    if args.history:
+        history = GatewayHistory(args.history,
+                                 n_replicas=len(servers))
+    return Gateway(servers, max_queue=args.max_queue,
+                   default_ttl_s=args.default_ttl,
+                   metrics_store=metrics_store, history=history)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    if args.compile_cache:
+        from tony_tpu.utils import compilecache
+
+        compilecache.enable(args.compile_cache)
+
+    encode = decode = None
+    if args.demo_model:
+        model, params, eos = *demo_model(), \
+            ([args.eos_id] if args.eos_id >= 0 else [])
+    else:
+        from tony_tpu.cli.generate import load_model
+        from tony_tpu.models.generate import normalize_eos_ids
+
+        model, wrapped, config = load_model(args.model)
+        params = wrapped["params"]
+        if args.dtype == "bf16":
+            import jax
+            import jax.numpy as jnp
+
+            params = jax.tree.map(
+                lambda x: x.astype(jnp.bfloat16)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        eos = normalize_eos_ids(args.eos_id) or \
+            normalize_eos_ids(getattr(config, "eos_token_id", None))
+        try:
+            import transformers
+
+            tok = transformers.AutoTokenizer.from_pretrained(args.model)
+            encode, decode = tok.encode, tok.decode
+        except Exception:  # noqa: BLE001 — a checkpoint without a
+            # tokenizer still serves token_ids requests
+            print("note: no tokenizer in model dir; token_ids "
+                  "requests only", file=sys.stderr)
+
+    from tony_tpu.gateway import GatewayHTTP
+    from tony_tpu.metrics import MetricsStore
+
+    gateway = build_gateway(args, model, params, eos,
+                            metrics_store=MetricsStore()).start()
+    http = GatewayHTTP(gateway, host=args.host, port=args.port,
+                       encode=encode, decode=decode).start()
+    print(f"tony-tpu gateway at http://{http.host}:{http.port} "
+          f"({max(1, args.replicas)} replica(s) x {args.serve_batch} "
+          f"slots)", flush=True)
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        if stop.is_set():  # second signal: force exit
+            os._exit(1)
+        print(f"signal {signum}: draining (readyz -> 503, finishing "
+              f"in-flight)...", file=sys.stderr, flush=True)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    stop.wait()
+    ok = gateway.drain(timeout=args.drain_timeout)
+    http.stop()
+    if not ok:
+        print("drain timed out with requests still in flight",
+              file=sys.stderr)
+        return 1
+    print("drained clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,10 +12,13 @@ the checkpoint and tokenizer are read from disk, nothing is downloaded.
 
 Raw-token mode (no tokenizer needed): ``--token-ids 1,2,3``.
 
-Serving mode (``--serve``): a continuous-batching request loop
-(``tony_tpu.serve``) reading one JSON request per stdin line and
-writing one JSON response per finished request — drivable without a
-TPU (JAX_PLATFORMS=cpu) and without a tokenizer (token_ids requests):
+Serving mode (``--serve``): the gateway core (``tony_tpu.gateway``
+over ``tony_tpu.serve`` replicas) driven as a JSONL loop — one JSON
+request per stdin line, one JSON response per finished request,
+printed the moment it finishes while stdin is still being read.
+Drivable without a TPU (JAX_PLATFORMS=cpu) and without a tokenizer
+(token_ids requests). The network front door over the same core is
+``python -m tony_tpu.cli.gateway``:
 
     printf '%s\n' '{"id": "a", "token_ids": [1, 2, 3]}' \
                   '{"id": "b", "prompt": "Hello", "max_new_tokens": 8}' \
@@ -97,6 +100,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-batch", type=int, default=4,
                    help="cache slots (resident batch size) in --serve "
                         "mode; bounds the KV-cache footprint")
+    p.add_argument("--serve-replicas", type=int, default=1,
+                   help="data-parallel engine replicas in --serve mode "
+                        "(the gateway core drives one scheduler thread "
+                        "per replica; the HTTP front door is "
+                        "``tony-tpu gateway``)")
     p.add_argument("--compile-cache",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "tony_tpu", "compile-cache"),
@@ -145,15 +153,50 @@ def _serve_loop(model, params, args, eos) -> int:
     """``--serve``: read JSONL requests from stdin until EOF, stream one
     JSONL response per finished request (finish order, not submit
     order). Token-id requests need no tokenizer; the first ``prompt``
-    request lazy-loads one from the model dir."""
+    request lazy-loads one from the model dir.
+
+    Runs over the gateway core (``tony_tpu.gateway``): requests decode
+    on ``--serve-replicas`` worker threads WHILE stdin is still being
+    read, responses print the moment they finish, and a full admission
+    queue blocks the stdin reader (natural pipe backpressure) instead
+    of growing without bound."""
     import json
+    import threading
+    import time
 
-    from tony_tpu.serve import Request, Server
+    from tony_tpu.gateway import Gateway, GatewayQueueFull, GenRequest
+    from tony_tpu.serve import Server
 
-    server = Server(model, params["params"], batch_size=args.serve_batch,
-                    eos_id=eos)
+    n_replicas = max(1, getattr(args, "serve_replicas", 1))
+    servers = [Server(model, params["params"],
+                      batch_size=args.serve_batch, eos_id=eos)
+               for _ in range(n_replicas)]
+    gateway = Gateway(servers,
+                      max_queue=max(64, 32 * n_replicas)).start()
     tokenizer = None
     n_bad = 0
+    n_shed = 0
+    out_lock = threading.Lock()
+
+    def on_event(ticket, event):
+        nonlocal n_shed
+        if event[0] == "done":
+            res = event[1]
+            new_ids = res.tokens
+            stops = [i for i, t in enumerate(new_ids) if t in eos]
+            if stops:  # mirror the batch CLI: trim at the first stop
+                new_ids = new_ids[:stops[0]]
+            out = {"id": res.id, "token_ids": list(res.prompt) + new_ids,
+                   "finish_reason": res.finish_reason}
+            if tokenizer is not None:
+                out["text"] = tokenizer.decode(out["token_ids"])
+            with out_lock:
+                print(json.dumps(out), flush=True)
+        elif event[0] == "shed":
+            n_shed += 1
+            print(f"request {ticket.request.id} shed: {event[2]}",
+                  file=sys.stderr)
+
     for lineno, raw in enumerate(sys.stdin, 1):
         raw = raw.strip()
         if not raw:
@@ -173,31 +216,28 @@ def _serve_loop(model, params, args, eos) -> int:
                 ids = tokenizer.encode(d["prompt"])
             else:
                 raise ValueError("request needs token_ids or prompt")
-            server.submit(Request(
+            req = GenRequest(
                 ids,
                 int(d.get("max_new_tokens", args.max_new_tokens)),
                 temperature=float(d.get("temperature", args.temperature)),
                 top_k=int(d.get("top_k", args.top_k)),
                 seed=int(d.get("seed", args.seed)),
-                id=d.get("id")))
-        except Exception as e:  # noqa: BLE001 — a malformed line (bad
-            # JSON, wrong shapes, a prompt with no tokenizer in the
-            # model dir, an oversized prompt) must not kill the stream
-            # and strand every queued request: report, skip
+                id=d.get("id"))
+            while True:
+                try:
+                    gateway.submit(req, on_event=on_event)
+                    break
+                except GatewayQueueFull:
+                    time.sleep(0.01)  # pipe backpressure, not rejection
+        except Exception as e:  # noqa: BLE001 — a malformed
+            # line (bad JSON, wrong shapes, a prompt with no tokenizer
+            # in the model dir, an oversized prompt) must not kill the
+            # stream and strand every queued request: report, skip
             print(f"request line {lineno} rejected: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             n_bad += 1
-    for res in server.run():
-        new_ids = res.tokens
-        stops = [i for i, t in enumerate(new_ids) if t in eos]
-        if stops:  # mirror the batch CLI: trim at the first stop token
-            new_ids = new_ids[:stops[0]]
-        out = {"id": res.id, "token_ids": list(res.prompt) + new_ids,
-               "finish_reason": res.finish_reason}
-        if tokenizer is not None:
-            out["text"] = tokenizer.decode(out["token_ids"])
-        print(json.dumps(out), flush=True)
-    return 0 if n_bad == 0 else 1
+    gateway.drain()
+    return 0 if n_bad == 0 and n_shed == 0 else 1
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,26 @@
+"""Serving front door over N ``serve.Server`` replicas.
+
+``core`` is the admission/routing/drain machinery (pure Python, no
+sockets — unit-testable); ``http`` is the stdlib network face. The CLI
+entrypoint is ``python -m tony_tpu.cli.gateway``; ``tony-tpu generate
+--serve`` drives the same core over stdin/stdout JSONL.
+"""
+
+from tony_tpu.gateway.core import (BadRequest, DeadlineExceeded, Gateway,
+                                   GatewayClosed, GatewayHistory,
+                                   GatewayQueueFull, GenRequest, Shed,
+                                   Ticket)
+from tony_tpu.gateway.http import GatewayHTTP
+
+__all__ = [
+    "BadRequest",
+    "DeadlineExceeded",
+    "Gateway",
+    "GatewayClosed",
+    "GatewayHTTP",
+    "GatewayHistory",
+    "GatewayQueueFull",
+    "GenRequest",
+    "Shed",
+    "Ticket",
+]

@@ -1,0 +1,644 @@
+"""Multi-replica serving front door: admission, deadlines, routing, drain.
+
+The layer above ``tony_tpu.serve``: PR 1's ``Server`` multiplexes many
+requests onto ONE resident KV cache; this module multiplexes many
+CLIENTS onto N such servers (data-parallel replicas, one scheduler
+thread each — the serving analog of TonY's coordinator packing a fleet
+of role tasks onto a container pool). The pieces, front to back:
+
+- ``Gateway.submit()`` is the ADMISSION gate: a bounded queue (past
+  ``max_queue`` waiting requests it sheds with ``GatewayQueueFull`` ->
+  HTTP 429) with a per-request deadline (``ttl_s``); requests whose
+  deadline passes while they wait are shed with ``DeadlineExceeded``
+  (-> 504) BEFORE they ever occupy a cache slot — a dead client's
+  request must not spend decode steps nobody will read.
+- Routing picks the replica with the LEAST OUTSTANDING TOKENS
+  (queued + in-flight prompt+budget estimate — queue-length routing
+  would park a burst of 512-token requests behind one another while a
+  replica full of 8-token requests sits idle). A ``session`` key opts
+  into affinity (hash -> replica), keeping a conversation's requests
+  on one replica.
+- Each ``_Replica`` owns a ``serve.Server`` and drives it on its own
+  thread: admit from its queue (deadline-checked at the moment a slot
+  is actually free), ``step()``, stream per-token deltas to tickets,
+  deliver results. The engine's lock-protected ``submit()`` plus this
+  single-owner step loop is the whole concurrency story — no lock is
+  ever held across a device dispatch.
+- ``drain()`` is the SIGTERM story: close the front door (new submits
+  shed with ``GatewayClosed`` -> 503), let every replica finish its
+  queue and in-flight slots, then join the threads — zero accepted
+  requests lost.
+- Every finished request records queue-wait / TTFT / TPOT / tokens
+  in+out: into the rolling ``/stats`` window (p50/p99), into a
+  ``metrics.MetricsStore`` under ``gateway:replica-<i>`` (the
+  coordinator-side sink TaskMetricsMonitor pushes to), and optionally
+  into a portal-browsable history job (``GatewayHistory``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+import time
+import zlib
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from tony_tpu.serve import QueueFull, Request, Server
+
+log = logging.getLogger(__name__)
+
+
+class Shed(Exception):
+    """A request the gateway refused or gave up on; ``http_status`` is
+    the status the front door maps it to."""
+
+    http_status = 500
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class BadRequest(Shed):
+    http_status = 400
+
+
+class GatewayQueueFull(Shed):
+    http_status = 429
+
+
+class GatewayClosed(Shed):
+    http_status = 503
+
+
+class DeadlineExceeded(Shed):
+    http_status = 504
+
+
+@dataclass
+class GenRequest:
+    """One client request. ``ttl_s`` bounds its whole life (queue wait
+    included): ``None`` = no deadline. ``session`` opts into replica
+    affinity. Sampling knobs mirror ``serve.Request``."""
+
+    prompt: list
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+    id: Any = None
+    ttl_s: float | None = None
+    session: str | None = None
+
+
+# ticket lifecycle states
+QUEUED, RUNNING, DONE, SHED = "QUEUED", "RUNNING", "DONE", "SHED"
+
+
+class Ticket:
+    """The caller's handle on a submitted request: an event stream plus
+    a blocking ``result()``.
+
+    Events (also forwarded to ``on_event`` from the replica thread):
+      ("tokens", [ids])          newly generated tokens (streaming)
+      ("done", Result, metrics)  finished; metrics = the per-request
+                                 observability record (queue_wait_ms,
+                                 ttft_ms, tpot_ms, tokens_in/out, ...)
+      ("shed", status, reason)   refused after admission (deadline hit
+                                 in queue, replica failure)
+    """
+
+    def __init__(self, request: GenRequest, deadline: float | None,
+                 on_event: Callable | None = None):
+        self.request = request
+        self.deadline = deadline
+        self.t_submit = time.monotonic()
+        self.t_admit: float | None = None
+        self.t_first: float | None = None
+        self.replica: int | None = None
+        self.state = QUEUED
+        self.metrics: dict | None = None  # the done-event record
+        self.events: queue.Queue = queue.Queue()
+        self._on_event = on_event
+        self._n_emitted = 0  # tokens already streamed out
+
+    # estimate used by least-outstanding-tokens routing: the work a
+    # replica signs up for when it accepts this ticket
+    @property
+    def cost(self) -> int:
+        return len(self.request.prompt) + self.request.max_new_tokens
+
+    def _emit(self, event: tuple) -> None:
+        self.events.put(event)
+        if self._on_event is not None:
+            try:
+                self._on_event(self, event)
+            except Exception:
+                log.exception("ticket on_event callback failed")
+
+    def result(self, timeout: float | None = None):
+        """Block until the request finishes; returns the
+        ``serve.Result``. Raises the mapped ``Shed`` subclass if the
+        gateway gave up on it. Token events are drained silently (use
+        ``on_event`` or read ``events`` yourself to stream)."""
+        t_end = None if timeout is None else time.monotonic() + timeout
+        while True:
+            left = None if t_end is None else max(0.0, t_end - time.monotonic())
+            try:
+                kind, *rest = self.events.get(timeout=left)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"request {self.request.id!r} not finished after "
+                    f"{timeout}s (state {self.state})") from None
+            if kind == "done":
+                return rest[0]
+            if kind == "shed":
+                status, reason = rest
+                exc = {429: GatewayQueueFull, 503: GatewayClosed,
+                       504: DeadlineExceeded}.get(status, Shed)(reason)
+                exc.http_status = status
+                raise exc
+
+
+class _Replica:
+    """One ``serve.Server`` + the thread that drives it."""
+
+    def __init__(self, index: int, server: Server, gateway: "Gateway"):
+        self.index = index
+        self.server = server
+        self.gateway = gateway
+        self.queue: deque[Ticket] = deque()
+        self.cv = threading.Condition()
+        self.outstanding = 0  # token-cost estimate: queued + in-flight
+        self.completed = 0
+        self.shed = 0
+        self._stop = False
+        self._tickets: dict[int, Ticket] = {}  # engine id -> ticket
+        self._next_id = 0
+        self._thread = threading.Thread(target=self._loop,
+                                        name=f"gateway-replica-{index}",
+                                        daemon=True)
+
+    # ---------------------------------------------------------- intake
+
+    def enqueue(self, ticket: Ticket) -> None:
+        with self.cv:
+            if self._stop:
+                # closes the submit-vs-drain race: a ticket landing
+                # after the stop signal could otherwise strand forever
+                # on a thread that already exited
+                raise GatewayClosed("gateway is draining")
+            ticket.replica = self.index
+            self.queue.append(ticket)
+            self.outstanding += ticket.cost
+            self.cv.notify()
+
+    @property
+    def n_queued(self) -> int:
+        return len(self.queue)
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.server.slots.n_active or self.server.n_pending
+                    or self.queue)
+
+    # ------------------------------------------------------------ loop
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def signal_stop(self) -> None:
+        with self.cv:
+            self._stop = True
+            self.cv.notify()
+
+    def join(self, timeout: float | None = None) -> None:
+        if self._thread.ident is not None:  # join pre-start is an error
+            self._thread.join(timeout)
+
+    def _loop(self) -> None:
+        while True:
+            with self.cv:
+                while not self.queue and not self._server_busy() \
+                        and not self._stop:
+                    self.cv.wait()
+                if self._stop and not self.queue \
+                        and not self._server_busy():
+                    return
+            try:
+                self._admit_from_queue()
+                if self._server_busy():
+                    finished = self.server.step()
+                    now = time.monotonic()
+                    self._stream_deltas(now)
+                    self._deliver(finished, now)
+            except Exception as e:  # a wedged replica must not strand
+                # its tickets with no terminal event: shed everything
+                # this replica holds, then keep consuming (each later
+                # ticket sheds fast rather than hanging its client)
+                log.exception("replica %d step failed", self.index)
+                self._abort(f"replica {self.index} failure: "
+                            f"{type(e).__name__}: {e}")
+
+    def _server_busy(self) -> bool:
+        return bool(self.server.slots.n_active or self.server.n_pending)
+
+    def _admit_from_queue(self) -> None:
+        """Move tickets into the engine, AT MOST as many as there are
+        free slots — the deadline check runs at the moment a slot is
+        genuinely available, so an expired request is shed having never
+        occupied one (and never cost a prefill dispatch)."""
+        free = len(self.server.slots.free_slots()) - self.server.n_pending
+        while free > 0:
+            with self.cv:
+                if not self.queue:
+                    return
+                ticket = self.queue.popleft()
+            now = time.monotonic()
+            if ticket.deadline is not None and now >= ticket.deadline:
+                self._shed(ticket, 504,
+                           f"deadline exceeded after "
+                           f"{now - ticket.t_submit:.3f}s in queue")
+                continue
+            req = ticket.request
+            engine_id = self._next_id
+            self._next_id += 1
+            try:
+                self.server.submit(Request(
+                    list(req.prompt), req.max_new_tokens,
+                    temperature=req.temperature, top_k=req.top_k,
+                    seed=req.seed, id=engine_id))
+            except QueueFull:
+                # engine bound hit (shouldn't happen: we feed at most
+                # free-slot many) — put it back and stop admitting
+                with self.cv:
+                    self.queue.appendleft(ticket)
+                return
+            except ValueError as e:
+                self._shed(ticket, 400, str(e))
+                continue
+            ticket.t_admit = now
+            ticket.state = RUNNING
+            self._tickets[engine_id] = ticket
+            free -= 1
+
+    def _stream_deltas(self, now: float) -> None:
+        emitted = {eid: t._n_emitted for eid, t in self._tickets.items()}
+        for engine_id, new in self.server.live_progress(emitted).items():
+            ticket = self._tickets.get(engine_id)
+            if ticket is None or not new:
+                continue
+            if ticket.t_first is None:
+                ticket.t_first = now
+            ticket._n_emitted += len(new)
+            ticket._emit(("tokens", new))
+
+    def _deliver(self, finished, now: float) -> None:
+        for res in finished:
+            ticket = self._tickets.pop(res.id, None)
+            if ticket is None:
+                continue
+            if ticket.t_first is None:
+                ticket.t_first = now
+            tail = res.tokens[ticket._n_emitted:]
+            if tail:
+                ticket._emit(("tokens", tail))
+            ticket.state = DONE
+            self.completed += 1
+            with self.cv:
+                self.outstanding -= ticket.cost
+            metrics = self._request_metrics(ticket, res, now)
+            ticket.metrics = metrics  # unary responders read it after
+            # result(); same record the stream's final line carries
+            res = type(res)(ticket.request.id, res.prompt, res.tokens,
+                            res.finish_reason)
+            self.gateway._record_done(self, metrics)
+            ticket._emit(("done", res, metrics))
+
+    def _request_metrics(self, ticket: Ticket, res, now: float) -> dict:
+        n_out = len(res.tokens)
+        ttft = (ticket.t_first - ticket.t_submit) if ticket.t_first else 0.0
+        tpot = ((now - ticket.t_first) / (n_out - 1)
+                if n_out > 1 and ticket.t_first else 0.0)
+        return {
+            "id": ticket.request.id,
+            "replica": self.index,
+            "queue_wait_ms": round(
+                (ticket.t_admit - ticket.t_submit) * 1e3, 3),
+            "ttft_ms": round(ttft * 1e3, 3),
+            "tpot_ms": round(tpot * 1e3, 3),
+            "e2e_ms": round((now - ticket.t_submit) * 1e3, 3),
+            "tokens_in": len(res.prompt),
+            "tokens_out": n_out,
+            "finish_reason": res.finish_reason,
+        }
+
+    def _shed(self, ticket: Ticket, status: int, reason: str) -> None:
+        ticket.state = SHED
+        self.shed += 1
+        with self.cv:
+            self.outstanding -= ticket.cost
+        self.gateway._record_shed(self, status)
+        ticket._emit(("shed", status, reason))
+
+    def _abort(self, reason: str) -> None:
+        """Terminal-event every ticket this replica holds (engine-
+        admitted AND queued) after an unrecoverable step failure."""
+        for ticket in list(self._tickets.values()):
+            self._shed(ticket, 500, reason)
+        self._tickets.clear()
+        self.server.reset()  # pending + _live + slots together: slots
+        # alone would leave engine ghosts decoding phantom results
+        while True:
+            with self.cv:
+                if not self.queue:
+                    return
+                ticket = self.queue.popleft()
+            self._shed(ticket, 500, reason)
+
+    def stats(self) -> dict:
+        return {
+            "queued": self.n_queued,
+            "active_slots": self.server.slots.n_active,
+            "batch_size": self.server.slots.batch_size,
+            "outstanding_tokens": self.outstanding,
+            "completed": self.completed,
+            "shed": self.shed,
+            "prefills": self.server.prefills,
+            "decode_steps": self.server.steps,
+            "dispatches": self.server.dispatches,
+        }
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+class _Stats:
+    """Rolling per-request window + monotonic counters behind /stats."""
+
+    def __init__(self, window: int = 1024):
+        self.lock = threading.Lock()
+        self.window: deque[dict] = deque(maxlen=window)
+        self.accepted = 0
+        self.completed = 0
+        self.shed_by_status: dict[int, int] = {}
+        self.tokens_in = 0
+        self.tokens_out = 0
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            recent = list(self.window)
+            out = {
+                "accepted": self.accepted,
+                "completed": self.completed,
+                "shed": dict(self.shed_by_status),
+                "tokens_in": self.tokens_in,
+                "tokens_out": self.tokens_out,
+            }
+        for key in ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
+            vals = sorted(r[key] for r in recent)
+            out[key] = {"p50": _percentile(vals, 0.50),
+                        "p95": _percentile(vals, 0.95),
+                        "p99": _percentile(vals, 0.99)}
+        out["window"] = len(recent)
+        return out
+
+
+class GatewayHistory:
+    """Portal hookup: the gateway as a browsable history job.
+
+    Writes the coordinator's on-disk layout (``events/history.py``)
+    under ``<history>/intermediate/<app_id>/``: an in-progress
+    ``.jhist.jsonl`` event log (inited/finished) plus per-request
+    metric rows in ``metrics/requests.jsonl`` — the portal's existing
+    /job/<id>/metrics page renders them with zero portal changes, and
+    the history mover/purger manage the directory like any other job's.
+    """
+
+    def __init__(self, history_root: str, app_id: str = "",
+                 n_replicas: int = 1):
+        from tony_tpu.events import history
+        from tony_tpu.events.event import application_inited
+
+        self._lock = threading.Lock()
+        started = int(time.time() * 1000)
+        self.app_id = app_id or f"application_gateway_{started}"
+        self.started = started
+        self.job_dir = history.intermediate_dir(history_root, self.app_id)
+        os.makedirs(os.path.join(self.job_dir, "metrics"), exist_ok=True)
+        self.jhist = os.path.join(
+            self.job_dir, history.inprogress_name(self.app_id, started))
+        self._append_event(application_inited(
+            self.app_id, n_replicas, os.uname().nodename))
+        self._metrics_path = os.path.join(self.job_dir, "metrics",
+                                          "requests.jsonl")
+
+    def _append_event(self, event) -> None:
+        with self._lock, open(self.jhist, "a") as f:
+            f.write(json.dumps(event.to_dict()) + "\n")
+
+    def record(self, row: dict) -> None:
+        with self._lock, open(self._metrics_path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    def close(self, status: str = "SUCCEEDED",
+              metrics: dict | None = None) -> None:
+        from tony_tpu.events import history
+        from tony_tpu.events.event import application_finished
+
+        self._append_event(application_finished(
+            self.app_id, status, 0, metrics or {}))
+        completed = int(time.time() * 1000)
+        final = os.path.join(self.job_dir, history.finished_name(
+            self.app_id, self.started, completed,
+            os.environ.get("USER", "unknown"), status))
+        with self._lock:
+            os.replace(self.jhist, final)
+
+
+class Gateway:
+    """The front door over N replica servers. See the module docstring
+    for the full story; the API surface:
+
+    - ``submit(req, on_event=None) -> Ticket`` (raises ``Shed``)
+    - ``drain()`` then ``stop()`` — or just ``stop()`` (drains)
+    - ``snapshot()`` — the /stats payload
+    - ``ready`` / ``draining`` — the /readyz signal
+    """
+
+    def __init__(self, servers: list[Server], *, max_queue: int = 128,
+                 default_ttl_s: float | None = None,
+                 metrics_store=None, history: GatewayHistory | None = None):
+        if not servers:
+            raise ValueError("gateway needs at least one replica server")
+        self.replicas = [_Replica(i, s, self) for i, s in enumerate(servers)]
+        self.max_queue = max(1, max_queue)
+        self.default_ttl_s = default_ttl_s
+        self.metrics_store = metrics_store
+        self.history = history
+        self.stats = _Stats()
+        self._lock = threading.Lock()
+        self._drain_lock = threading.Lock()
+        self._drain_done: bool | None = None
+        self._ids = iter(range(1 << 62))
+        self._started = False
+        self._closed = False
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> "Gateway":
+        for r in self.replicas:
+            r.start()
+        self._started = True
+        return self
+
+    @property
+    def ready(self) -> bool:
+        return self._started and not self._closed
+
+    @property
+    def draining(self) -> bool:
+        return self._closed
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Graceful shutdown: stop admitting (submit -> 503), let every
+        replica finish its queue and in-flight slots, join the threads.
+        Returns True when everything drained inside ``timeout``.
+        Idempotent — a second call (stop() after drain()) returns the
+        first outcome instead of re-finalizing the history job."""
+        with self._drain_lock:
+            if self._drain_done is not None:
+                return self._drain_done
+            self._closed = True
+            for r in self.replicas:
+                r.signal_stop()
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
+            ok = True
+            for r in self.replicas:
+                left = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                r.join(left)
+                ok = ok and not r._thread.is_alive()
+            if self.history is not None:
+                self.history.close("SUCCEEDED" if ok else "KILLED",
+                                   self.stats.snapshot())
+            self._drain_done = ok
+            return ok
+
+    def stop(self, timeout: float | None = None) -> bool:
+        return self.drain(timeout)
+
+    # --------------------------------------------------------- admission
+
+    def submit(self, request: GenRequest,
+               on_event: Callable | None = None) -> Ticket:
+        """Admission gate + router. Raises ``GatewayClosed`` (503) when
+        draining, ``BadRequest`` (400) on invalid shapes,
+        ``GatewayQueueFull`` (429) past ``max_queue`` waiting requests,
+        ``DeadlineExceeded`` (504) for an already-dead ttl."""
+        if self._closed:
+            self.stats_shed(503)
+            raise GatewayClosed("gateway is draining")
+        prompt = list(request.prompt)
+        max_len = self.replicas[0].server.model.cfg.max_seq_len
+        if not prompt:
+            self.stats_shed(400)
+            raise BadRequest("empty prompt")
+        if len(prompt) >= max_len:
+            self.stats_shed(400)
+            raise BadRequest(f"prompt ({len(prompt)}) leaves no room for "
+                             f"generation in max_seq_len ({max_len})")
+        if request.max_new_tokens < 1:
+            self.stats_shed(400)
+            raise BadRequest("max_new_tokens must be >= 1")
+        ttl = request.ttl_s if request.ttl_s is not None \
+            else self.default_ttl_s
+        if ttl is not None and ttl <= 0:
+            self.stats_shed(504)
+            raise DeadlineExceeded("ttl_s already expired at submit")
+        if request.id is None:
+            request.id = next(self._ids)
+        with self._lock:
+            if sum(r.n_queued for r in self.replicas) >= self.max_queue:
+                self.stats_shed(429)
+                raise GatewayQueueFull(
+                    f"admission queue at max_queue={self.max_queue}")
+            replica = self._route(request)
+            ticket = Ticket(request,
+                            None if ttl is None
+                            else time.monotonic() + ttl, on_event)
+            try:
+                # enqueue INSIDE the gateway lock: the bound check and
+                # the depth increment must be atomic or two concurrent
+                # submits both pass at max_queue - 1 and overshoot.
+                # Lock order gateway._lock -> replica.cv is safe: no
+                # replica-thread path takes the gateway lock.
+                replica.enqueue(ticket)
+            except GatewayClosed:  # the drain race
+                self.stats_shed(503)
+                raise
+        with self.stats.lock:
+            self.stats.accepted += 1
+        return ticket
+
+    def _route(self, request: GenRequest) -> _Replica:
+        """Session affinity when asked; least outstanding tokens
+        otherwise (ties -> lowest index, deterministic)."""
+        if request.session is not None:
+            key = zlib.crc32(str(request.session).encode())
+            return self.replicas[key % len(self.replicas)]
+        return min(self.replicas, key=lambda r: (r.outstanding, r.index))
+
+    # -------------------------------------------------------- accounting
+
+    def stats_shed(self, status: int) -> None:
+        with self.stats.lock:
+            self.stats.shed_by_status[status] = \
+                self.stats.shed_by_status.get(status, 0) + 1
+
+    def _record_shed(self, replica: _Replica, status: int) -> None:
+        self.stats_shed(status)
+        self._push_replica_metrics(replica)
+
+    def _record_done(self, replica: _Replica, metrics: dict) -> None:
+        with self.stats.lock:
+            self.stats.completed += 1
+            self.stats.tokens_in += metrics["tokens_in"]
+            self.stats.tokens_out += metrics["tokens_out"]
+            self.stats.window.append(metrics)
+        if self.history is not None:
+            try:
+                self.history.record(metrics)
+            except OSError:
+                log.exception("history metrics write failed")
+        self._push_replica_metrics(replica)
+
+    def _push_replica_metrics(self, replica: _Replica) -> None:
+        if self.metrics_store is None:
+            return
+        try:
+            self.metrics_store.update_metrics(
+                f"gateway:replica-{replica.index}",
+                {k: v for k, v in replica.stats().items()
+                 if isinstance(v, (int, float))})
+        except Exception:
+            log.exception("metrics store push failed")
+
+    def snapshot(self) -> dict:
+        out = self.stats.snapshot()
+        out["ready"] = self.ready
+        out["draining"] = self.draining
+        out["replicas"] = [r.stats() for r in self.replicas]
+        out["queued"] = sum(r.n_queued for r in self.replicas)
+        out["max_queue"] = self.max_queue
+        return out

@@ -1,0 +1,229 @@
+"""The gateway's network face: a stdlib-only concurrent HTTP server.
+
+One handler thread per connection (``ThreadingHTTPServer``, the same
+transport the portal uses) — a slow reader stalls only its own thread,
+never the decode loops, which live on the replica threads behind the
+admission queue. Endpoints:
+
+  POST /v1/generate   submit one request; JSON body (see _parse_body)
+                      {"stream": true} -> chunked NDJSON: one
+                      {"id", "token_ids": [delta...]} line per step,
+                      then a final line with finish_reason/metrics.
+                      Otherwise one JSON object when done.
+  GET  /healthz       liveness: 200 while the process serves at all
+  GET  /readyz        admission: 200 accepting / 503 draining (the
+                      load-balancer signal during graceful shutdown)
+  GET  /stats         the Gateway.snapshot() JSON (counters, queue
+                      depths, p50/p95/p99 queue-wait/TTFT/TPOT)
+
+Shed mapping (core.Shed.http_status): 400 bad request, 429 admission
+queue full, 503 draining, 504 deadline exceeded. In streaming mode the
+status line is only committed at the FIRST event, so a request shed
+while queued still gets its real status code, not a 200 with an error
+trailer.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from tony_tpu.gateway.core import Gateway, GenRequest, Shed
+
+log = logging.getLogger(__name__)
+
+
+class GatewayHandler(BaseHTTPRequestHandler):
+    # bound by GatewayHTTP: the shared Gateway plus optional tokenizer
+    # hooks (encode: str -> [ids]; decode: [ids] -> str)
+    gateway: Gateway
+    encode: Callable | None = None
+    decode: Callable | None = None
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet: requests are metrics,
+        log.debug(fmt, *args)  # not stderr noise
+
+    # ------------------------------------------------------------- GET
+
+    def do_GET(self):
+        path = self.path.partition("?")[0]
+        if path == "/healthz":
+            return self._send(200, {"status": "ok"})
+        if path == "/readyz":
+            if self.gateway.ready:
+                return self._send(200, {"status": "ready"})
+            return self._send(503, {"status": "draining"
+                                    if self.gateway.draining
+                                    else "starting"})
+        if path == "/stats":
+            return self._send(200, self.gateway.snapshot())
+        return self._send(404, {"error": "not found"})
+
+    # ------------------------------------------------------------ POST
+
+    def do_POST(self):
+        if self.path.partition("?")[0] != "/v1/generate":
+            return self._send(404, {"error": "not found"})
+        try:
+            body = self._read_body()
+            req, stream = self._parse_body(body)
+        except (TypeError, ValueError) as e:
+            # TypeError too: int()/float()/iteration over wrong-typed
+            # JSON values ({"token_ids": 123}, {"temperature": null})
+            # must be a 400, not a handler-thread crash + reset socket
+            return self._send(400, {"error": str(e)})
+        try:
+            ticket = self.gateway.submit(req)
+        except Shed as e:
+            return self._send(e.http_status, {"error": e.reason})
+        try:
+            if stream:
+                self._respond_stream(ticket)
+            else:
+                self._respond_unary(ticket)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; the request finishes server-side
+            # and its deadline/shed path handles abandoned successors
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            raise ValueError("missing request body")
+        if length > 8 << 20:
+            raise ValueError("request body too large")
+        raw = self.rfile.read(length)
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"invalid JSON: {e}") from None
+        if not isinstance(body, dict):
+            raise ValueError("request must be a JSON object")
+        return body
+
+    def _parse_body(self, d: dict) -> tuple[GenRequest, bool]:
+        if "token_ids" in d:
+            ids = [int(x) for x in d["token_ids"]]
+        elif "prompt" in d:
+            if self.encode is None:
+                raise ValueError(
+                    "text prompt needs a tokenizer in the model dir; "
+                    "send token_ids instead")
+            ids = self.encode(str(d["prompt"]))
+        else:
+            raise ValueError("request needs token_ids or prompt")
+        ttl = d.get("ttl_s", d.get("timeout_s"))
+        return GenRequest(
+            ids,
+            max_new_tokens=int(d.get("max_new_tokens", 64)),
+            temperature=float(d.get("temperature", 0.0)),
+            top_k=int(d.get("top_k", 0)),
+            seed=int(d.get("seed", 0)),
+            id=d.get("id"),
+            ttl_s=float(ttl) if ttl is not None else None,
+            session=d.get("session"),
+        ), bool(d.get("stream", False))
+
+    # -------------------------------------------------------- responses
+
+    def _finish_doc(self, res, metrics: dict) -> dict:
+        out = {"id": res.id, "token_ids": list(res.prompt) + list(res.tokens),
+               "finish_reason": res.finish_reason, "metrics": metrics}
+        if self.decode is not None:
+            out["text"] = self.decode(out["token_ids"])
+        return out
+
+    def _respond_unary(self, ticket) -> None:
+        try:
+            res = ticket.result()
+        except Shed as e:
+            return self._send(e.http_status, {"error": e.reason})
+        # ticket.metrics is the replica's canonical per-request record
+        # (same dict the stream's final line and /stats window carry)
+        self._send(200, self._finish_doc(res, ticket.metrics or {}))
+
+    def _respond_stream(self, ticket) -> None:
+        """Chunked NDJSON. Headers are sent lazily at the first event
+        so sheds keep their real status code."""
+        headers_sent = False
+        while True:
+            kind, *rest = ticket.events.get()
+            if kind == "tokens":
+                if not headers_sent:
+                    self._start_stream()
+                    headers_sent = True
+                self._chunk({"id": ticket.request.id, "token_ids": rest[0]})
+            elif kind == "done":
+                res, metrics = rest
+                if not headers_sent:
+                    self._start_stream()
+                    headers_sent = True
+                self._chunk(self._finish_doc(res, metrics))
+                self.wfile.write(b"0\r\n\r\n")
+                return
+            elif kind == "shed":
+                status, reason = rest
+                if headers_sent:  # mid-stream shed: error line + close
+                    self._chunk({"id": ticket.request.id, "error": reason,
+                                 "status": status})
+                    self.wfile.write(b"0\r\n\r\n")
+                else:
+                    self._send(status, {"error": reason})
+                return
+
+    def _start_stream(self) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Cache-Control", "no-store")
+        self.end_headers()
+
+    def _chunk(self, doc: dict) -> None:
+        data = (json.dumps(doc) + "\n").encode()
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _send(self, code: int, doc: dict) -> None:
+        data = json.dumps(doc).encode()
+        if code >= 400:
+            # error replies may leave a POST body unread; under
+            # HTTP/1.1 keep-alive those bytes would be parsed as the
+            # NEXT request line — close instead of desyncing
+            self.close_connection = True
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        if code >= 400:
+            self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class GatewayHTTP:
+    """Binds a Gateway to a ThreadingHTTPServer; start()/stop()."""
+
+    def __init__(self, gateway: Gateway, host: str = "127.0.0.1",
+                 port: int = 0, encode: Callable | None = None,
+                 decode: Callable | None = None):
+        handler = type("BoundGatewayHandler", (GatewayHandler,),
+                       {"gateway": gateway, "encode": staticmethod(encode)
+                        if encode else None,
+                        "decode": staticmethod(decode) if decode else None})
+        self.server = ThreadingHTTPServer((host, port), handler)
+        self.server.daemon_threads = True
+        self.host, self.port = self.server.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "GatewayHTTP":
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        name="gateway-http", daemon=True)
+        self._thread.start()
+        log.info("gateway http at http://%s:%d", self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

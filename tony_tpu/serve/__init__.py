@@ -11,10 +11,12 @@ shapes mean the step compiles ONCE; mixed-length traffic never waits
 on the longest sequence in a batch.
 """
 
-from tony_tpu.serve.engine import Request, Result, Server, bucket_len
+from tony_tpu.serve.engine import (QueueFull, Request, Result, Server,
+                                   bucket_len)
 from tony_tpu.serve.slots import SlotCache, cache_batch_axis
 
 __all__ = [
+    "QueueFull",
     "Request",
     "Result",
     "Server",

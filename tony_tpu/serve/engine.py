@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import functools
 import itertools
+import threading
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Iterator
@@ -160,6 +161,14 @@ def _decode_chunk(model, params, cache, tok, positions, temps, top_ks,
     return cache, toks, rngs
 
 
+class QueueFull(RuntimeError):
+    """``submit()`` refused: the pending queue is at ``max_pending``.
+
+    The typed backpressure signal — callers (the gateway's admission
+    layer, the JSONL loop) translate it into 429/shedding instead of
+    letting the queue grow without bound and OOMing the host."""
+
+
 @dataclass
 class Request:
     """One generation request. ``prompt`` is token ids; sampling knobs
@@ -202,10 +211,19 @@ class Server:
 
     eos_id follows generate(): an int (-1 = none) or a list/tuple
     (stop on any).
+
+    Threading contract: ONE thread owns the decode loop (``step()`` /
+    ``drain()`` / ``run()`` — the device cache and per-slot host arrays
+    are single-writer), while ``submit()`` may be called from any
+    thread: the pending queue is lock-protected, so a network front
+    door can feed requests while the owner thread keeps stepping.
+    ``max_pending`` bounds the queue; past it ``submit()`` raises
+    ``QueueFull`` instead of growing without bound.
     """
 
     def __init__(self, model, params, *, batch_size: int = 4, eos_id=-1,
-                 min_bucket: int = 16, chunk_steps: int = 8):
+                 min_bucket: int = 16, chunk_steps: int = 8,
+                 max_pending: int = 1024):
         if model.cfg.quantized:
             # nothing structural in the way — the q8 apply is the same
             # model.apply — but untested here; fail loud, not wrong
@@ -219,8 +237,10 @@ class Server:
         # 1 = token-at-a-time (lowest latency to each token, highest
         # per-token dispatch cost — the right setting for streaming)
         self.chunk_steps = max(1, chunk_steps)
+        self.max_pending = max(1, max_pending)
         self.slots = SlotCache(model, params, batch_size)
         self.pending: deque[Request] = deque()
+        self._pending_lock = threading.Lock()
         self._live: list[_Live | None] = [None] * batch_size
         self._ids = itertools.count()
         self.steps = 0       # decode micro-steps executed (chunk sum)
@@ -232,7 +252,9 @@ class Server:
     def submit(self, request: Request):
         """Enqueue a request; returns its id. Rejects prompts the cache
         cannot hold; clamps max_new_tokens to the remaining capacity
-        (the generate() overflow contract, per slot)."""
+        (the generate() overflow contract, per slot). Raises
+        ``QueueFull`` past ``max_pending`` queued requests — the
+        caller's backpressure signal. Safe to call from any thread."""
         p = list(request.prompt)
         max_len = self.model.cfg.max_seq_len
         if not p:
@@ -247,7 +269,11 @@ class Server:
             request.id = next(self._ids)
         request.max_new_tokens = min(request.max_new_tokens,
                                      max_len - len(p))
-        self.pending.append(request)
+        with self._pending_lock:
+            if len(self.pending) >= self.max_pending:
+                raise QueueFull(
+                    f"pending queue at max_pending={self.max_pending}")
+            self.pending.append(request)
         return request.id
 
     @property
@@ -313,11 +339,21 @@ class Server:
     def step(self) -> list[Result]:
         """One scheduler iteration; returns requests that finished."""
         finished: list[Result] = []
-        while self.pending and self.slots.free_slots():
-            self._admit_one(self.pending.popleft(), finished)
+        while self.slots.free_slots():
+            with self._pending_lock:
+                if not self.pending:
+                    break
+                req = self.pending.popleft()
+            self._admit_one(req, finished)
         if self.slots.n_active == 0:
             return finished
+        finished.extend(self._decode_round())
+        return finished
 
+    def _decode_round(self) -> list[Result]:
+        """One batched decode chunk over the live slots + EOS/evict —
+        ``step()`` minus admission (``drain()`` runs it alone)."""
+        finished: list[Result] = []
         s = self.slots
         k = self._chunk_size()
         cache, toks, rng = _decode_chunk(
@@ -362,6 +398,45 @@ class Server:
             self._live[slot] = None
             s.evict(slot)
         return finished
+
+    def drain(self) -> list[Result]:
+        """Finish every IN-FLIGHT slot (no new admissions) and return
+        their results. Pending requests stay queued — the caller
+        decides whether to reject them, hand them to another replica,
+        or resume stepping. The graceful-shutdown hook: a front door
+        stops feeding, calls drain(), and every request that already
+        holds a slot completes instead of being dropped mid-decode."""
+        finished: list[Result] = []
+        while self.slots.n_active:
+            finished.extend(self._decode_round())
+        return finished
+
+    def live_progress(self, since: dict | None = None) -> dict:
+        """{request_id: tokens generated so far} for every in-flight
+        request — the streaming hook: the loop owner snapshots it after
+        each ``step()`` and emits the delta per request. ``since``
+        (request_id -> count already seen) returns only each request's
+        TAIL, keeping a long generation's repeated snapshots O(new
+        tokens) instead of O(length^2). Copies, so the caller can hold
+        them across the next step."""
+        out = {}
+        for live in self._live:
+            if live is not None:
+                start = since.get(live.request.id, 0) if since else 0
+                out[live.request.id] = live.generated[start:]
+        return out
+
+    def reset(self) -> None:
+        """Hard reset after a failed ``step()``: drop pending and
+        in-flight bookkeeping and free every slot (pure host work — the
+        next admit overwrites device rows). Dropped requests never get
+        a Result; the caller sheds them. ``slots.reset()`` alone leaves
+        the engine inconsistent (``_live`` ghosts would decode garbage
+        and emit phantom results), so external callers use this."""
+        with self._pending_lock:
+            self.pending.clear()
+        self._live = [None] * self.slots.batch_size
+        self.slots.reset()
 
     def run(self, requests: Iterable[Request] = ()) -> Iterator[Result]:
         """Submit ``requests`` and drive the loop until everything

@@ -1,0 +1,102 @@
+#!/bin/sh
+# serve-smoke: boot a tiny-model gateway, fire concurrent curl clients
+# (unary + streaming), assert 200s and a well-formed NDJSON stream, and
+# exercise the SIGTERM graceful drain. Every phase is bounded by
+# `timeout`, so a hang exits nonzero instead of wedging CI.
+#
+# Usage: tools/serve_smoke.sh  (from the repo root; `make serve-smoke`)
+set -u
+
+PY=${PY:-python}
+BOUND=${SERVE_SMOKE_TIMEOUT:-300}   # whole-run ceiling, seconds
+WORK=$(mktemp -d /tmp/serve_smoke.XXXXXX)
+trap 'kill $GW_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
+
+fail() { echo "serve-smoke: FAIL: $1" >&2; exit 1; }
+
+# ---- boot the gateway on an ephemeral port ---------------------------
+JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+    --replicas 2 --port 0 --compile-cache '' \
+    >"$WORK/boot.log" 2>"$WORK/stderr.log" &
+GW_PID=$!
+
+# the boot line prints the bound URL; wait for it (bounded)
+URL=''
+i=0
+while [ $i -lt $BOUND ]; do
+    URL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/boot.log")
+    [ -n "$URL" ] && break
+    kill -0 $GW_PID 2>/dev/null || fail "gateway died at boot: $(cat "$WORK/stderr.log")"
+    sleep 1; i=$((i + 1))
+done
+[ -n "$URL" ] || fail "gateway did not print its URL within ${BOUND}s"
+echo "serve-smoke: gateway at $URL"
+
+curl_s() { timeout -k 5 "$BOUND" curl -sS -o "$1" -w '%{http_code}' "$2" ${3:+-d "$3"}; }
+
+# ---- health ----------------------------------------------------------
+code=$(curl_s "$WORK/healthz" "$URL/healthz") || fail "healthz curl"
+[ "$code" = 200 ] || fail "healthz -> $code"
+code=$(curl_s "$WORK/readyz" "$URL/readyz") || fail "readyz curl"
+[ "$code" = 200 ] || fail "readyz -> $code"
+
+# ---- concurrent generate: 4 unary + 2 streaming ----------------------
+# PIDs collected explicitly: $(jobs -p) runs in a subshell under dash
+# and comes back empty, turning `wait` into wait-for-the-gateway
+CURL_PIDS=''
+n=0
+while [ $n -lt 4 ]; do
+    curl_s "$WORK/unary_$n" "$URL/v1/generate" \
+        "{\"token_ids\": [$((1 + n)), 2, 3], \"max_new_tokens\": 4, \"id\": $n}" \
+        >"$WORK/unary_${n}.code" &
+    CURL_PIDS="$CURL_PIDS $!"
+    n=$((n + 1))
+done
+n=0
+while [ $n -lt 2 ]; do
+    curl_s "$WORK/stream_$n" "$URL/v1/generate" \
+        "{\"token_ids\": [$((9 + n)), 8], \"max_new_tokens\": 5, \"stream\": true}" \
+        >"$WORK/stream_${n}.code" &
+    CURL_PIDS="$CURL_PIDS $!"
+    n=$((n + 1))
+done
+wait $CURL_PIDS
+
+n=0
+while [ $n -lt 4 ]; do
+    [ "$(cat "$WORK/unary_${n}.code")" = 200 ] || fail "unary $n -> $(cat "$WORK/unary_${n}.code")"
+    grep -q '"finish_reason"' "$WORK/unary_$n" || fail "unary $n: no finish_reason"
+    n=$((n + 1))
+done
+n=0
+while [ $n -lt 2 ]; do
+    [ "$(cat "$WORK/stream_${n}.code")" = 200 ] || fail "stream $n -> $(cat "$WORK/stream_${n}.code")"
+    # well-formed stream: >= 2 NDJSON lines, each valid JSON, last has
+    # finish_reason (the $PY check parses every line)
+    $PY - "$WORK/stream_$n" <<'EOF' || fail "stream $n: malformed NDJSON"
+import json, sys
+lines = [ln for ln in open(sys.argv[1]) if ln.strip()]
+assert len(lines) >= 2, f"only {len(lines)} lines"
+docs = [json.loads(ln) for ln in lines]
+assert docs[-1]["finish_reason"] in ("eos", "length"), docs[-1]
+deltas = [t for d in docs[:-1] for t in d["token_ids"]]
+assert docs[-1]["token_ids"][-len(deltas):] == deltas, "delta mismatch"
+EOF
+    n=$((n + 1))
+done
+
+# ---- stats + graceful drain -----------------------------------------
+code=$(curl_s "$WORK/stats" "$URL/stats") || fail "stats curl"
+[ "$code" = 200 ] || fail "stats -> $code"
+grep -q '"completed": 6' "$WORK/stats" || fail "stats: expected 6 completed: $(cat "$WORK/stats")"
+
+kill -TERM $GW_PID
+i=0
+while kill -0 $GW_PID 2>/dev/null; do
+    [ $i -ge $BOUND ] && fail "gateway did not drain within ${BOUND}s of SIGTERM"
+    sleep 1; i=$((i + 1))
+done
+wait $GW_PID
+rc=$?
+[ $rc = 0 ] || fail "gateway exited $rc after SIGTERM"
+echo "serve-smoke: OK (6 requests, clean drain)"
