@@ -21,9 +21,11 @@ Measurements (BASELINE.md rows 2-3 + VERDICT next-steps, r1-r3):
 
 4. KV-cache decode throughput + HBM-bandwidth utilization (prefill
    subtracted) — the serving-path roofline. Plus the serving-layer
-   data: continuous-vs-fixed batching (extras.serving) and the
-   gateway front door's concurrent-client throughput + p50/p99 TTFT
-   at 1 vs 2 replicas (extras.gateway).
+   data: continuous-vs-fixed batching (extras.serving), the gateway
+   front door's concurrent-client throughput + p50/p99 TTFT at 1 vs 2
+   replicas (extras.gateway), and the prefix KV-cache store's prefill
+   dispatches / TTFT on a shared-system-prompt workload, on vs off
+   (extras.prefix).
 
 5. Launch -> first-step latency through the REAL submit path
    (TonyClient -> coordinator -> agent -> payload jit step) on the mini
@@ -1167,6 +1169,83 @@ def bench_gateway(on_tpu: bool) -> dict:
     }
 
 
+def bench_prefix(on_tpu: bool) -> dict:
+    """The prefix-store datum (ISSUE-3 acceptance): a shared-system-
+    prompt workload — every request carries the same long preamble plus
+    a short distinct tail, and half the prompts repeat exactly (the
+    agents-hitting-one-endpoint traffic shape) — served with the radix
+    PrefixStore on vs off. Off, every request prefills its full bucket;
+    on, exact repeats skip prefill entirely (zero dispatches) and
+    fresh tails prefill only their small suffix bucket at an offset.
+    Requests are submitted serially through a 1-replica gateway so TTFT
+    isolates prefill latency (no queueing). The deterministic form of
+    the claim is the prefill dispatch/token counts; wall TTFT rides
+    along (the tunneled backend's per-dispatch launch floor damps the
+    CPU ratio). Greedy outputs are asserted identical on vs off —
+    the exactness contract, re-checked at bench scale."""
+    import numpy as np
+
+    from tony_tpu.gateway import Gateway, GenRequest
+    from tony_tpu.models import Transformer, TransformerConfig
+    from tony_tpu.serve import Server, bucket_len
+
+    cfg = TransformerConfig(
+        vocab_size=512, d_model=128, n_layers=3, n_heads=4, d_ff=256,
+        max_seq_len=256)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    rng = np.random.default_rng(0)
+    system_len, tail_len, n_distinct, budget = 96, 8, 6, 4
+    system = rng.integers(0, cfg.vocab_size, size=system_len)
+    prompts = [np.concatenate(
+        [system, rng.integers(0, cfg.vocab_size, size=tail_len)]).tolist()
+        for _ in range(n_distinct)]
+    workload = prompts + prompts  # second half: exact repeats
+    n_req = len(workload)
+
+    def run(prefix_mb):
+        server = Server(model, params, batch_size=4, min_bucket=16,
+                        chunk_steps=4, prefix_cache_mb=prefix_mb)
+        gw = Gateway([server], max_queue=2 * n_req).start()
+        outs, t0 = [], time.perf_counter()
+        for i, p in enumerate(workload):
+            res = gw.submit(GenRequest(p, budget, id=i)) \
+                .result(timeout=600)
+            outs.append(res.tokens)
+        dt = time.perf_counter() - t0
+        snap = gw.snapshot()
+        gw.drain(timeout=60)
+        return outs, dt, snap, server
+
+    run(0)   # warm: full-prefill bucket + chunk ladder
+    run(64)  # warm: suffix bucket, hit-admit, donation read
+    outs_off, t_off, snap_off, srv_off = run(0)
+    outs_on, t_on, snap_on, srv_on = run(64)
+    assert outs_on == outs_off, "prefix store changed greedy outputs"
+    full_bucket = bucket_len(system_len + tail_len, cfg.max_seq_len, 16)
+    return {
+        "n_requests": n_req,
+        "system_prompt_len": system_len,
+        "full_prefill_bucket": full_bucket,
+        "prefill_dispatches_off": srv_off.prefills,
+        "prefill_dispatches_on": srv_on.prefills,
+        "prefill_dispatch_ratio": round(
+            srv_off.prefills / max(srv_on.prefills, 1), 3),
+        "prefill_tokens_off": srv_off.prefills * full_bucket,
+        "prefill_tokens_saved": srv_on.prefill_tokens_saved,
+        "prefix_hit_rate": snap_on["engine"]["prefix"]["hit_rate"],
+        "ttft_ms_off": {"p50": snap_off["ttft_ms"]["p50"],
+                        "p99": snap_off["ttft_ms"]["p99"]},
+        "ttft_ms_on": {"p50": snap_on["ttft_ms"]["p50"],
+                       "p99": snap_on["ttft_ms"]["p99"]},
+        "ttft_p50_speedup": round(
+            snap_off["ttft_ms"]["p50"] /
+            max(snap_on["ttft_ms"]["p50"], 1e-9), 3),
+        "wall_speedup": round(t_off / t_on, 3),
+    }
+
+
 # ------------------------------------------------------ attention kernels
 
 
@@ -1533,6 +1612,11 @@ def _collect_line() -> dict:
         extras["gateway"] = bench_gateway(on_tpu)
     except Exception as e:
         extras["gateway"] = {"error": f"{type(e).__name__}: {e}"}
+    gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
+    try:
+        extras["prefix"] = bench_prefix(on_tpu)
+    except Exception as e:
+        extras["prefix"] = {"error": f"{type(e).__name__}: {e}"}
     gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
     try:
         extras["quant"] = bench_quant(on_tpu)

@@ -1,0 +1,393 @@
+"""Prefix KV-cache reuse (tony_tpu.serve.prefix + engine integration).
+
+The exactness anchor: greedy outputs with the prefix store enabled are
+token-for-token identical to store-off serving and to a solo
+``generate()`` — across the exact-hit (prefill skipped entirely),
+partial-hit (suffix prefilled at a position offset over a seeded row),
+and miss paths. Store invariants (radix longest-prefix lookup, LRU
+eviction under the byte budget, refcounts pinning in-use rows) and the
+``write_slot_row``/``read_slot_row`` round trip ride along. CPU-only.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.models import Transformer, TransformerConfig, generate
+from tony_tpu.serve import (PrefixStore, Request, Server, SlotCache,
+                            read_slot_row, tree_nbytes, write_slot_row)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=32,
+                            dtype=jnp.float32,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _solo(model, params, prompt, n):
+    out = generate(model, params, jnp.asarray([prompt], jnp.int32),
+                   max_new_tokens=n)
+    return np.asarray(out)[0].tolist()
+
+
+def _serve_one(server, prompt, n, **kw):
+    (res,) = list(server.run([Request(list(prompt), n, **kw)]))
+    return res
+
+
+def _fake_row(nbytes: int):
+    return {"x": np.zeros(nbytes // 4, np.float32)}
+
+
+# --------------------------------------------------------- store unit
+
+
+def test_radix_longest_prefix_lookup():
+    st = PrefixStore(1 << 30)
+    assert st.insert([1, 2, 3, 4, 5, 6], _fake_row(64))
+    m, e = st.acquire([1, 2, 3, 9, 9])
+    assert m == 3 and e is not None
+    st.release(e)
+    m, e = st.acquire([1, 2, 3, 4, 5, 6])
+    assert m == 6 and np.array_equal(e.tokens, [1, 2, 3, 4, 5, 6])
+    st.release(e)
+    assert st.acquire([7, 8]) == (0, None)
+    # a prompt that is a PREFIX of a stored entry matches fully (the
+    # donated-conversation case: entry longer than the new prompt)
+    m, e = st.acquire([1, 2, 3])
+    assert m == 3 and len(e.tokens) == 6
+    st.release(e)
+
+
+def test_radix_nested_entries_and_edge_split():
+    st = PrefixStore(1 << 30)
+    st.insert([1, 2], _fake_row(64))
+    st.insert([1, 2, 3, 4], _fake_row(64))
+    st.insert([1, 2, 3, 7], _fake_row(64))  # splits the [3, 4] edge
+    m, e = st.acquire([1, 2, 3, 4])
+    assert m == 4 and len(e.tokens) == 4
+    st.release(e)
+    m, e = st.acquire([1, 2, 3, 9])  # diverges below the split point
+    assert m == 3 and len(e.tokens) == 4
+    st.release(e)
+    m, e = st.acquire([1, 2, 9])  # falls back to the short ancestor
+    assert m == 2 and len(e.tokens) == 2
+    st.release(e)
+    # three sequences sharing a preamble, inserted in any order, are
+    # all reachable (the shared-system-prompt shape)
+    st2 = PrefixStore(1 << 30)
+    pre = list(range(10, 20))
+    for i in range(3):
+        m, e = st2.acquire(pre + [40 + i])
+        assert (m == 10) == (i > 0), (i, m)
+        if e is not None:
+            st2.release(e)
+        assert st2.insert(pre + [40 + i], _fake_row(64))
+
+
+def test_lru_eviction_under_budget():
+    row_bytes = tree_nbytes(_fake_row(256))
+    st = PrefixStore(2 * row_bytes)  # fits exactly two entries
+    assert st.insert([1, 1], _fake_row(256))
+    assert st.insert([2, 2], _fake_row(256))
+    # touch [1, 1] so [2, 2] is the LRU victim
+    m, e = st.acquire([1, 1])
+    st.release(e)
+    assert st.insert([3, 3], _fake_row(256))
+    assert len(st) == 2 and st.evictions == 1
+    assert st.acquire([2, 2]) == (0, None)
+    m, _e = st.acquire([1, 1])
+    assert m == 2
+    st.release(_e)
+    # an entry bigger than the whole budget is refused outright
+    assert not st.insert([9, 9], _fake_row(4096))
+    assert st.rejected == 1
+
+
+def test_refcount_protects_in_use_rows():
+    row_bytes = tree_nbytes(_fake_row(256))
+    st = PrefixStore(2 * row_bytes)
+    st.insert([1, 1], _fake_row(256))
+    st.insert([2, 2], _fake_row(256))
+    m, pinned = st.acquire([2, 2])
+    # [2, 2] is in use; budget pressure may only evict [1, 1], and a
+    # second insert that would need BOTH slots is refused, not stolen
+    assert st.insert([3, 3], _fake_row(256))
+    assert st.acquire([1, 1]) == (0, None)
+    assert not st.insert([4, 4], _fake_row(2 * 256))
+    m, again = st.acquire([2, 2])
+    assert m == 2 and again is pinned
+    st.release(again)
+    st.release(pinned)
+    # released: now evictable under pressure
+    assert st.insert([4, 4], _fake_row(2 * 256))
+    assert st.acquire([2, 2]) == (0, None)
+    with pytest.raises(ValueError, match="release"):
+        st.release(pinned)
+
+
+def test_insert_dedup_refreshes_lru():
+    row_bytes = tree_nbytes(_fake_row(256))
+    st = PrefixStore(2 * row_bytes)
+    st.insert([1, 1], _fake_row(256))
+    st.insert([2, 2], _fake_row(256))
+    assert st.insert([1, 1], _fake_row(256))  # refresh, not duplicate
+    assert len(st) == 2 and st.bytes_used == 2 * row_bytes
+    st.insert([3, 3], _fake_row(256))  # evicts [2, 2], not [1, 1]
+    assert st.acquire([2, 2]) == (0, None)
+    m, e = st.acquire([1, 1])
+    assert m == 2
+    st.release(e)
+
+
+# ------------------------------------------------- slot row round trip
+
+
+def test_write_read_slot_row_round_trip(tiny):
+    """read_slot_row is the exact inverse of write_slot_row on every
+    batched leaf (the donation path extracts exactly what admit
+    wrote)."""
+    from tony_tpu.models import init_cache
+    from tony_tpu.serve import cache_batch_axis
+
+    model, params = tiny
+    slots = SlotCache(model, params, 3)
+    row = init_cache(model, params, 1)
+    row = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, 5) if x.ndim >= 3 else x, row)
+    cache = write_slot_row(slots.cache, row, jnp.int32(2))
+    back = read_slot_row(cache, jnp.int32(2))
+    flat_row = jax.tree_util.tree_flatten_with_path(row)[0]
+    flat_back = jax.tree_util.tree_leaves(back)
+    for (path, want), got in zip(flat_row, flat_back):
+        if cache_batch_axis(path, want) is not None:
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want))
+
+
+def test_evict_zeroes_rng_row(tiny):
+    model, params = tiny
+    slots = SlotCache(model, params, 2)
+    slots.admit(0, length=3, last_token=1, temperature=0.7, top_k=4,
+                rng_key=jax.random.PRNGKey(9))
+    assert np.asarray(slots.rng[0]).any()
+    slots.evict(0)
+    assert not slots.rng[0].any()
+
+
+# ------------------------------------------------------ engine parity
+
+
+def test_exact_hit_skips_prefill_and_matches_solo(tiny):
+    model, params = tiny
+    prompt = [17, 46, 10, 20, 62, 26]
+    solo = _solo(model, params, prompt, 6)
+    server = Server(model, params, batch_size=1, min_bucket=8,
+                    prefix_cache_mb=32)
+    first = _serve_one(server, prompt, 6)
+    assert first.tokens == solo
+    assert server.prefills == 1 and first.prefix_hit_tokens == 0
+    second = _serve_one(server, prompt, 6)
+    assert second.tokens == solo
+    assert server.prefills == 1  # no new prefill dispatch
+    assert second.prefix_hit_tokens == len(prompt)
+    assert second.prefill_tokens_saved == 8  # the skipped bucket
+    assert server.prefix_hits == 1 and server.prefix_lookups == 2
+
+
+def test_partial_hit_and_miss_match_store_off(tiny):
+    """Shared-preamble prompts: every request on the store-on server
+    must produce exactly the store-off (and solo) tokens, while the
+    sharers register hit tokens. (All prompts share one length so the
+    whole test reuses a single solo-generate program.)"""
+    model, params = tiny
+    pre = [3, 1, 4, 1]
+    prompts = [pre + [11, 12], pre + [21, 22], pre + [31, 32],
+               [40, 41, 30, 31, 20, 21]]
+    on = Server(model, params, batch_size=2, min_bucket=8,
+                prefix_cache_mb=32)
+    off = Server(model, params, batch_size=2, min_bucket=8)
+    for i, p in enumerate(prompts):
+        want = _solo(model, params, p, 6)
+        assert _serve_one(off, p, 6).tokens == want, p
+        got = _serve_one(on, p, 6)
+        assert got.tokens == want, p
+        sharer = i in (1, 2)  # first fills the store; last is disjoint
+        assert (got.prefix_hit_tokens >= len(pre)) == sharer, (i, got)
+    assert on.prefix_hits == 2
+    assert on.prefix_hit_tokens >= 2 * len(pre)
+    assert on.prefix_lookups == 4  # the disjoint prompt missed clean
+
+
+def test_donated_generation_seeds_next_turn(tiny):
+    """Multi-turn shape: turn 2's prompt extends turn 1's prompt +
+    response; the donated row covers past the original prompt, so the
+    hit is DEEPER than what prefill alone ever stored."""
+    model, params = tiny
+    p1 = [17, 46, 10, 20, 62, 26]
+    gen = _solo(model, params, p1, 6)
+    # extend by one generated token plus a fresh one (!= gen[1], so the
+    # match ends inside the donated region, strictly past the prompt)
+    p2 = p1 + [gen[0], (gen[1] + 1) % 64]
+    server = Server(model, params, batch_size=1, min_bucket=8,
+                    prefix_cache_mb=32)
+    _serve_one(server, p1, 6)
+    res = _serve_one(server, p2, 6)
+    assert res.tokens == _solo(model, params, p2, 6)
+    assert res.prefix_hit_tokens == len(p1) + 1
+
+
+def test_prompt_that_prefixes_a_longer_entry_stays_exact(tiny):
+    """A prompt that is a strict PREFIX of a previously prefilled
+    prompt fully matches the longer entry — whose stored logits sit at
+    the wrong position. It must take the partial path (suffix prefill
+    for its own last token), not the exact-hit fast path."""
+    model, params = tiny
+    long = [17, 46, 10, 20, 62, 26, 9, 5]
+    short = long[:6]
+    server = Server(model, params, batch_size=1, min_bucket=8,
+                    prefix_cache_mb=32)
+    _serve_one(server, long, 4)
+    res = _serve_one(server, short, 6)
+    assert res.tokens == _solo(model, params, short, 6)
+    assert res.prefix_hit_tokens == len(short) - 1  # seeded, not skipped
+    assert server.prefills == 2  # the short prompt still prefilled
+
+
+def test_no_donation_when_disabled(tiny):
+    model, params = tiny
+    p1 = [17, 46, 10, 20, 62, 26]
+    server = Server(model, params, batch_size=1, min_bucket=8,
+                    prefix_cache_mb=32, prefix_donate=False)
+    _serve_one(server, p1, 5)
+    # only the prefill-time insert of the prompt itself
+    assert server.prefix.stats()["inserts"] == 1
+
+
+def test_sampled_requests_identical_through_store(tiny):
+    """The exact-hit path samples from the STORED logits with the
+    request's own knobs: a sampled request repeated behind a hit must
+    reproduce the store-off draws bit-for-bit."""
+    model, params = tiny
+    prompt = [1, 2, 3, 4]
+    kw = dict(temperature=0.9, top_k=8, seed=7)
+    off = _serve_one(Server(model, params, batch_size=1, min_bucket=8),
+                     prompt, 5, **kw)
+    on = Server(model, params, batch_size=1, min_bucket=8,
+                prefix_cache_mb=32)
+    first = _serve_one(on, prompt, 5, **kw)
+    second = _serve_one(on, prompt, 5, **kw)  # exact hit
+    assert first.tokens == second.tokens == off.tokens
+    assert second.prefix_hit_tokens == len(prompt)
+
+
+def test_eviction_under_budget_pressure_keeps_parity(tiny):
+    """A budget that holds ~2 rows churns hard under 6 distinct
+    prompts: entries evict mid-serving and outputs must stay exact;
+    the store never exceeds its byte budget."""
+    model, params = tiny
+    server = Server(model, params, batch_size=2, min_bucket=8,
+                    prefix_cache_mb=2.1 * server_row_mb(tiny))
+    prompts = [[i + 1, 2, 3, i + 4, 5, 6] for i in range(6)]
+    for p in prompts + prompts[:2]:
+        assert _serve_one(server, p, 6).tokens == \
+            _solo(model, params, p, 6), p
+    st = server.prefix.stats()
+    assert st["evictions"] > 0
+    assert st["bytes"] <= st["budget_bytes"]
+    assert len(server.prefix) >= 1
+
+
+def server_row_mb(tiny) -> float:
+    from tony_tpu.serve.engine import _row_nbytes
+
+    model, params = tiny
+    return _row_nbytes(SlotCache(model, params, 1).cache) / (1 << 20)
+
+
+@pytest.mark.slow  # its own model config: ~12 s of compiles
+def test_learned_positions_parity_through_store(tiny):
+    """GPT-2-family config (learned positions + LayerNorm): suffix
+    prefill must seed pos_index as well as cache_index."""
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=32,
+                            dtype=jnp.float32, norm="layer",
+                            positional="learned", use_bias=True,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    pre = [3, 1, 4, 1]
+    server = Server(model, params, batch_size=1, min_bucket=8,
+                    prefix_cache_mb=32)
+    for tail in ([11, 12], [21, 22]):
+        p = pre + tail
+        assert _serve_one(server, p, 4).tokens == \
+            _solo(model, params, p, 4), tail
+    assert server.prefix_hits == 1
+
+
+def test_store_disabled_when_budget_below_one_row(tiny):
+    """A budget that cannot hold one cache row would reject every
+    insert while paying the row-returning prefill variant per admit —
+    the engine turns the store off instead."""
+    model, params = tiny
+    server = Server(model, params, batch_size=1, min_bucket=8,
+                    prefix_cache_mb=0.001)
+    assert server.prefix is None
+    res = _serve_one(server, [1, 2, 3], 6)
+    assert res.tokens == _solo(model, params, [1, 2, 3], 6)
+    assert server.prefix_lookups == 0
+
+
+def test_store_rejects_sliding_window_models(tiny):
+    import dataclasses
+
+    model, params = tiny
+    wmodel = Transformer(dataclasses.replace(model.cfg, sliding_window=8))
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        Server(wmodel, params, batch_size=1, prefix_cache_mb=32)
+    Server(wmodel, params, batch_size=1)  # store off is fine
+
+
+# ------------------------------------------------------- gateway plumb
+
+
+def test_gateway_surfaces_prefix_stats(tiny):
+    """The hit shows up everywhere the ISSUE plumbs it: per-request
+    metrics (-> history rows), the /stats rollup, and the replica's
+    flat counter dict (-> MetricsStore)."""
+    from tony_tpu.gateway import Gateway, GenRequest
+
+    model, params = tiny
+    gw = Gateway([Server(model, params, batch_size=2, min_bucket=8,
+                         prefix_cache_mb=32)]).start()
+    try:
+        prompt = [17, 46, 10, 20, 62, 26]
+        gw.submit(GenRequest(prompt, 4, id="a")).result(timeout=120)
+        t2 = gw.submit(GenRequest(prompt, 4, id="b"))
+        t2.result(timeout=120)
+        assert t2.metrics["prefix_hit_tokens"] == len(prompt)
+        assert t2.metrics["prefill_tokens_saved"] == 8
+        snap = gw.snapshot()
+        assert snap["prefix_hit_tokens"] == len(prompt)
+        assert snap["prefill_tokens_saved"] == 8
+        eng = snap["engine"]
+        assert eng["prefills"] == 1  # the hit skipped its prefill
+        assert eng["prefix"]["enabled"]
+        assert eng["prefix"]["hits"] == 1
+        assert eng["prefix"]["hit_rate"] == 0.5
+        assert eng["prefix"]["entries"] >= 1
+        assert 0 < eng["prefix"]["bytes"] <= eng["prefix"]["budget_bytes"]
+        rep = snap["replicas"][0]
+        assert rep["prefix_hits"] == 1
+        assert rep["prefix_hit_tokens"] == len(prompt)
+    finally:
+        gw.drain(timeout=60)

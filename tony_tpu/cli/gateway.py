@@ -50,6 +50,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="decode micro-steps fused per dispatch; 1 = "
                         "lowest per-token streaming latency, larger = "
                         "higher throughput")
+    p.add_argument("--prefix-cache-mb", type=float, default=64.0,
+                   help="per-replica byte budget for the prefix "
+                        "KV-cache store (radix reuse of shared prompt "
+                        "prefixes: exact repeats skip prefill, shared "
+                        "system prompts prefill only their suffix). "
+                        "0 disables; hit rates show on /stats under "
+                        "engine.prefix")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000,
                    help="0 picks an ephemeral port")
@@ -101,12 +108,15 @@ def demo_model():
 
 def build_gateway(args, model, params, eos, *, metrics_store=None):
     """Servers + Gateway from parsed args (shared with tests/bench)."""
+    from tony_tpu.cli.generate import resolve_prefix_cache_mb
     from tony_tpu.gateway import Gateway, GatewayHistory
     from tony_tpu.serve import Server
 
+    prefix_mb = resolve_prefix_cache_mb(args, model)
     servers = [Server(model, params, batch_size=args.serve_batch,
                       eos_id=eos, chunk_steps=args.chunk_steps,
-                      max_pending=args.max_pending)
+                      max_pending=args.max_pending,
+                      prefix_cache_mb=prefix_mb)
                for _ in range(max(1, args.replicas))]
     history = None
     if args.history:

@@ -105,6 +105,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(the gateway core drives one scheduler thread "
                         "per replica; the HTTP front door is "
                         "``tony-tpu gateway``)")
+    p.add_argument("--prefix-cache-mb", type=float, default=64.0,
+                   help="--serve mode: per-replica byte budget for the "
+                        "prefix KV-cache store (shared prompt prefixes "
+                        "skip the matched part of prefill; exact "
+                        "repeats skip it entirely). 0 disables")
     p.add_argument("--compile-cache",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "tony_tpu", "compile-cache"),
@@ -149,6 +154,19 @@ def load_model(model_dir: str):
     return model, params, config
 
 
+def resolve_prefix_cache_mb(args, model) -> float:
+    """``--prefix-cache-mb``, downgraded to 0 (with a stderr note) for
+    model configs the prefix store refuses — the flag defaults ON, so
+    the CLIs must degrade instead of crashing on e.g. Mistral's
+    sliding-window attention. Shared with ``cli.gateway``."""
+    mb = getattr(args, "prefix_cache_mb", 0.0)
+    if mb > 0 and model.cfg.sliding_window:
+        print("note: prefix cache disabled (untested over "
+              "sliding-window attention)", file=sys.stderr)
+        return 0.0
+    return mb
+
+
 def _serve_loop(model, params, args, eos) -> int:
     """``--serve``: read JSONL requests from stdin until EOF, stream one
     JSONL response per finished request (finish order, not submit
@@ -168,8 +186,10 @@ def _serve_loop(model, params, args, eos) -> int:
     from tony_tpu.serve import Server
 
     n_replicas = max(1, getattr(args, "serve_replicas", 1))
+    prefix_mb = resolve_prefix_cache_mb(args, model)
     servers = [Server(model, params["params"],
-                      batch_size=args.serve_batch, eos_id=eos)
+                      batch_size=args.serve_batch, eos_id=eos,
+                      prefix_cache_mb=prefix_mb)
                for _ in range(n_replicas)]
     gateway = Gateway(servers,
                       max_queue=max(64, 32 * n_replicas)).start()

@@ -316,7 +316,8 @@ class _Replica:
             ticket.metrics = metrics  # unary responders read it after
             # result(); same record the stream's final line carries
             res = type(res)(ticket.request.id, res.prompt, res.tokens,
-                            res.finish_reason)
+                            res.finish_reason, res.prefix_hit_tokens,
+                            res.prefill_tokens_saved)
             self.gateway._record_done(self, metrics)
             ticket._emit(("done", res, metrics))
 
@@ -335,6 +336,8 @@ class _Replica:
             "e2e_ms": round((now - ticket.t_submit) * 1e3, 3),
             "tokens_in": len(res.prompt),
             "tokens_out": n_out,
+            "prefix_hit_tokens": res.prefix_hit_tokens,
+            "prefill_tokens_saved": res.prefill_tokens_saved,
             "finish_reason": res.finish_reason,
         }
 
@@ -362,17 +365,19 @@ class _Replica:
             self._shed(ticket, 500, reason)
 
     def stats(self) -> dict:
-        return {
+        out = {
             "queued": self.n_queued,
             "active_slots": self.server.slots.n_active,
             "batch_size": self.server.slots.batch_size,
             "outstanding_tokens": self.outstanding,
             "completed": self.completed,
             "shed": self.shed,
-            "prefills": self.server.prefills,
-            "decode_steps": self.server.steps,
-            "dispatches": self.server.dispatches,
         }
+        # engine counters (prefills, decode_steps, dispatches, the
+        # prefix_* family) flat, so the MetricsStore numeric filter and
+        # /stats both carry them per replica
+        out.update(self.server.counters())
+        return out
 
 
 def _percentile(sorted_vals: list, q: float) -> float:
@@ -393,6 +398,8 @@ class _Stats:
         self.shed_by_status: dict[int, int] = {}
         self.tokens_in = 0
         self.tokens_out = 0
+        self.prefix_hit_tokens = 0
+        self.prefill_tokens_saved = 0
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -403,6 +410,8 @@ class _Stats:
                 "shed": dict(self.shed_by_status),
                 "tokens_in": self.tokens_in,
                 "tokens_out": self.tokens_out,
+                "prefix_hit_tokens": self.prefix_hit_tokens,
+                "prefill_tokens_saved": self.prefill_tokens_saved,
             }
         for key in ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
             vals = sorted(r[key] for r in recent)
@@ -615,6 +624,10 @@ class Gateway:
             self.stats.completed += 1
             self.stats.tokens_in += metrics["tokens_in"]
             self.stats.tokens_out += metrics["tokens_out"]
+            self.stats.prefix_hit_tokens += \
+                metrics.get("prefix_hit_tokens", 0)
+            self.stats.prefill_tokens_saved += \
+                metrics.get("prefill_tokens_saved", 0)
             self.stats.window.append(metrics)
         if self.history is not None:
             try:
@@ -641,4 +654,36 @@ class Gateway:
         out["replicas"] = [r.stats() for r in self.replicas]
         out["queued"] = sum(r.n_queued for r in self.replicas)
         out["max_queue"] = self.max_queue
+        out["engine"] = self._engine_summary()
         return out
+
+    def _engine_summary(self) -> dict:
+        """Fleet-level engine counters: the device work behind the
+        request percentiles (prefills run, decode rounds, occupancy)
+        plus the prefix-cache effectiveness block, summed across
+        replicas — so /stats shows savings NEXT TO the work they
+        avoided."""
+        servers = [r.server for r in self.replicas]
+        counts = [s.counters() for s in servers]
+        total = lambda key: sum(c.get(key, 0) for c in counts)  # noqa: E731
+        lookups = total("prefix_lookups")
+        return {
+            "prefills": total("prefills"),
+            "decode_steps": total("decode_steps"),
+            "dispatches": total("dispatches"),
+            "active_slots": sum(s.slots.n_active for s in servers),
+            "slots": sum(s.slots.batch_size for s in servers),
+            "prefix": {
+                "enabled": any(s.prefix is not None for s in servers),
+                "lookups": lookups,
+                "hits": total("prefix_hits"),
+                "hit_rate": round(total("prefix_hits") / lookups, 4)
+                if lookups else 0.0,
+                "hit_tokens": total("prefix_hit_tokens"),
+                "prefill_tokens_saved": total("prefill_tokens_saved"),
+                "entries": total("prefix_entries"),
+                "bytes": total("prefix_bytes"),
+                "budget_bytes": total("prefix_budget_bytes"),
+                "evictions": total("prefix_evictions"),
+            },
+        }

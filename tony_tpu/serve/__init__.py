@@ -8,14 +8,19 @@ through its slots — admitted into free slots at their own positions,
 evicted the moment they hit EOS or their token budget, replaced the
 same iteration (Orca/vLLM-style iteration-level scheduling). Static
 shapes mean the step compiles ONCE; mixed-length traffic never waits
-on the longest sequence in a batch.
+on the longest sequence in a batch. Shared-prefix traffic (system
+prompts, few-shot preambles, multi-turn) additionally skips prefill
+work through the radix ``PrefixStore`` (serve/prefix.py).
 """
 
 from tony_tpu.serve.engine import (QueueFull, Request, Result, Server,
                                    bucket_len)
-from tony_tpu.serve.slots import SlotCache, cache_batch_axis
+from tony_tpu.serve.prefix import PrefixStore, tree_nbytes
+from tony_tpu.serve.slots import (SlotCache, cache_batch_axis,
+                                  read_slot_row, write_slot_row)
 
 __all__ = [
+    "PrefixStore",
     "QueueFull",
     "Request",
     "Result",
@@ -23,4 +28,7 @@ __all__ = [
     "SlotCache",
     "bucket_len",
     "cache_batch_axis",
+    "read_slot_row",
+    "tree_nbytes",
+    "write_slot_row",
 ]

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import functools
 import itertools
+import logging
 import threading
 from collections import deque
 from dataclasses import dataclass, field
@@ -51,7 +52,10 @@ import numpy as np
 
 from tony_tpu.models.generate import (init_cache, normalize_eos_ids,
                                       single_decode_step)
-from tony_tpu.serve.slots import SlotCache
+from tony_tpu.serve.prefix import PrefixStore
+from tony_tpu.serve.slots import SlotCache, _read_slot, cache_batch_axis
+
+log = logging.getLogger(__name__)
 
 
 def bucket_len(n: int, max_len: int, minimum: int = 16) -> int:
@@ -63,35 +67,113 @@ def bucket_len(n: int, max_len: int, minimum: int = 16) -> int:
     return min(b, max_len)
 
 
+def _seed_offset(cache, offset):
+    """Set a cache pytree's shared position counters (per-layer
+    ``cache_index``, learned-positional ``pos_index``) to ``offset`` —
+    the scalar decode path then WRITES the next tokens at ``offset``,
+    rotates them there (RoPE reads ``cache_index``), and lets their
+    queries see everything at-or-before them: exactly the offset
+    attention a suffix prefill over a seeded prefix row needs.
+    ``offset`` is traced; scan_layers models carry stacked [n_layers]
+    counters, which full_like broadcasts over."""
+    def seed(path, leaf):
+        name = str(path[-1].key if hasattr(path[-1], "key") else path[-1])
+        if name in ("cache_index", "pos_index"):
+            return jnp.full_like(leaf, offset)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(seed, cache)
+
+
 @functools.partial(jax.jit, static_argnames=("model",))
-def _prefill(model, params, prompt, length):
-    """Prefill ONE request's prompt [1, Lb] (right-padded to its bucket)
-    into a fresh batch-1 cache. Returns (row_cache, logits [1, V] at the
-    REAL last prompt position ``length - 1`` — the padded tail's logits
-    are junk and never sampled)."""
-    cache = init_cache(model, params, 1)
+def _prefill(model, params, prompt, length, offset=None, row=None):
+    """Prefill ONE request's token window [1, Lb] (right-padded to its
+    bucket) into a batch-1 cache. Returns (row_cache, logits [1, V] at
+    the REAL last position ``length - 1`` of the window — the padded
+    tail's logits are junk and never sampled).
+
+    ``offset``/``row`` generalize this to SUFFIX prefill for the prefix
+    store: ``row`` is a carried batch-1 cache whose positions
+    ``[0, offset)`` already hold the shared prefix's K/V, and the
+    window holds only the remaining prompt tokens, written/rotated/
+    attended from position ``offset`` (counters seeded via
+    ``_seed_offset``). With both None this is the classic full prefill
+    of a fresh cache from position 0."""
+    cache = init_cache(model, params, 1) if row is None else row
+    if offset is not None:
+        cache = _seed_offset(cache, offset)
     logits, vars_ = model.apply({"params": params, "cache": cache},
                                 prompt, decode=True, mutable=["cache"])
     last = jax.lax.dynamic_slice_in_dim(logits, length - 1, 1, axis=1)
     return vars_["cache"], last[:, 0]
 
 
-@functools.partial(jax.jit, static_argnames=("model",))
+@functools.partial(jax.jit, static_argnames=("model", "with_row"))
 def _prefill_admit(model, params, cache, prompt, length, slot, temp,
-                   top_k, key):
-    """The fused admit: prefill [1, Lb], copy the row into ``slot`` of
-    the resident cache, sample the first continuation token — ONE
-    dispatch per admitted request (three separate dispatches measured
-    ~3x the whole per-request host cost at CPU proxy sizes). Compiles
-    once per prefill bucket; slot / length / sampling knobs are traced."""
+                   top_k, key, offset=None, row=None, *, with_row=False):
+    """The fused admit: prefill [1, Lb] (optionally a suffix seeded
+    from a prefix-store ``row`` at ``offset``), copy the row into
+    ``slot`` of the resident cache, sample the first continuation
+    token — ONE dispatch per admitted request (three separate
+    dispatches measured ~3x the whole per-request host cost at CPU
+    proxy sizes). Compiles once per prefill bucket; slot / length /
+    offset / sampling knobs are traced. ``with_row=True`` additionally
+    returns the prefilled row and its last-position logits so the
+    engine can donate them to the prefix store."""
     from tony_tpu.serve.slots import write_slot_row
 
-    row, last = _prefill(model, params, prompt, length)
-    cache = write_slot_row(cache, row, slot)
+    new_row, last = _prefill(model, params, prompt, length, offset, row)
+    cache = write_slot_row(cache, new_row, slot)
     tok, key = _sample_rows(last, key[None],
                             jnp.asarray(temp, jnp.float32)[None],
                             jnp.asarray(top_k, jnp.int32)[None])
+    if with_row:
+        return cache, tok[0].astype(jnp.int32), key[0], new_row, last
     return cache, tok[0].astype(jnp.int32), key[0]
+
+
+@jax.jit
+def _hit_admit(cache, row, slot, logits, temp, top_k, key):
+    """Exact-prompt prefix hit: NO prefill at all — copy the stored row
+    into ``slot`` and sample the first continuation from the stored
+    last-position logits with THIS request's sampling knobs (so a hit
+    behaves identically across greedy/temperature/seed mixes). One
+    dispatch, everything traced."""
+    from tony_tpu.serve.slots import write_slot_row
+
+    cache = write_slot_row(cache, row, slot)
+    tok, key = _sample_rows(logits, key[None],
+                            jnp.asarray(temp, jnp.float32)[None],
+                            jnp.asarray(top_k, jnp.int32)[None])
+    return cache, tok[0].astype(jnp.int32), key[0]
+
+
+def _row_nbytes(cache) -> int:
+    """Bytes one slot's row costs in the prefix store: batched leaves
+    contribute one slot's share, shared counters their whole (tiny)
+    size — what ``read_slot_row`` of this cache would occupy."""
+    total = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        nbytes = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        ax = cache_batch_axis(path, leaf)
+        total += nbytes // leaf.shape[ax] if ax is not None else nbytes
+    return total
+
+
+def _usable_prefix(off: int, n: int, max_len: int, minimum: int) -> int:
+    """Largest usable seed length <= ``off`` for an ``n``-token prompt:
+    the suffix's power-of-two bucket must still fit the cache
+    (``off + bucket <= max_len`` — dynamic_update_slice would otherwise
+    clamp the write start and corrupt earlier positions). Shrinking
+    ``off`` grows the suffix (and possibly its bucket), so iterate;
+    terminates because ``off`` strictly decreases, and 0 (full prefill)
+    always fits."""
+    while off > 0:
+        lb = bucket_len(n - off, max_len, minimum)
+        if off + lb <= max_len:
+            return off
+        off = max(0, max_len - lb)
+    return 0
 
 
 def _sample_rows(logits, rngs, temps, top_ks):
@@ -187,18 +269,24 @@ class Request:
 class Result:
     """A finished request: ``tokens`` = generated ids (the EOS token,
     when hit, included as the last element); ``finish_reason`` is
-    "eos" or "length"."""
+    "eos" or "length". ``prefix_hit_tokens`` = prompt tokens seeded
+    from the prefix store instead of prefilled; ``prefill_tokens_saved``
+    = bucketed prefill work skipped (both 0 with the store off)."""
 
     id: Any
     prompt: list
     tokens: list
     finish_reason: str
+    prefix_hit_tokens: int = 0
+    prefill_tokens_saved: int = 0
 
 
 @dataclass
 class _Live:
     request: Request
     generated: list = field(default_factory=list)
+    prefix_hit_tokens: int = 0
+    prefill_tokens_saved: int = 0
 
 
 class Server:
@@ -223,12 +311,20 @@ class Server:
 
     def __init__(self, model, params, *, batch_size: int = 4, eos_id=-1,
                  min_bucket: int = 16, chunk_steps: int = 8,
-                 max_pending: int = 1024):
+                 max_pending: int = 1024, prefix_cache_mb: float = 0.0,
+                 prefix_donate: bool = True):
         if model.cfg.quantized:
             # nothing structural in the way — the q8 apply is the same
             # model.apply — but untested here; fail loud, not wrong
             raise NotImplementedError(
                 "serve over int8 weight-only models is untested")
+        if prefix_cache_mb > 0 and model.cfg.sliding_window:
+            # correctness is fine (causal K/V reuse holds under a
+            # window) but the windowed prefill slices differently-sized
+            # spans for full vs suffix prefill, so bitwise greedy
+            # parity — the store's contract — is unpinned; fail loud
+            raise NotImplementedError(
+                "prefix cache over sliding-window models is untested")
         self.model = model
         self.params = params
         self.eos_ids = normalize_eos_ids(eos_id)
@@ -245,7 +341,28 @@ class Server:
         self._ids = itertools.count()
         self.steps = 0       # decode micro-steps executed (chunk sum)
         self.dispatches = 0  # chunk dispatches
-        self.prefills = 0    # prefill dispatches (== admits attempted)
+        self.prefills = 0    # prefill dispatches (exact hits skip one)
+        # prefix KV reuse (serve/prefix.py); 0 MB = off, zero overhead
+        self.prefix = PrefixStore(int(prefix_cache_mb * (1 << 20))) \
+            if prefix_cache_mb > 0 else None
+        self.prefix_donate = prefix_donate
+        self.prefix_lookups = 0       # admits that consulted the store
+        self.prefix_hits = 0          # admits seeded >= 1 cached token
+        self.prefix_hit_tokens = 0    # prompt tokens seeded, total
+        self.prefill_tokens_saved = 0  # bucketed prefill work skipped
+        self._row_nbytes = _row_nbytes(self.slots.cache)
+        # a prefill-path entry = one cache row + its [1, V] fp32 logits
+        entry_nbytes = self._row_nbytes + 4 * model.cfg.vocab_size
+        if self.prefix is not None \
+                and entry_nbytes > self.prefix.budget_bytes:
+            # a budget that cannot hold even ONE entry would reject
+            # every insert while still paying the row-returning prefill
+            # variant per admit — pure overhead, so turn it off loudly
+            log.warning(
+                "prefix cache disabled: one cached entry needs %.1f MB, "
+                "budget is %.1f MB (raise --prefix-cache-mb)",
+                entry_nbytes / (1 << 20), prefix_cache_mb)
+            self.prefix = None
 
     # ------------------------------------------------------------ intake
 
@@ -294,32 +411,88 @@ class Server:
         """Prefill ``req`` into a free slot (prefill + slot copy +
         first-token sample fused into one dispatch) — or finish it on
         the spot when the FIRST token already ends it (EOS, or a budget
-        of one): no slot is burned on a request with nothing to decode."""
+        of one): no slot is burned on a request with nothing to decode.
+
+        With the prefix store on, the prompt's longest cached prefix is
+        looked up first: an exact-prompt hit (stored logits available)
+        skips prefill entirely — one row-copy + first-token-sample
+        dispatch; a partial hit seeds the slot from the stored row and
+        prefills only the bucketed SUFFIX at a position offset. Either
+        way the freshly covered prompt is (re)inserted so the next
+        sharer hits."""
         s = self.slots
         p = np.asarray(req.prompt, np.int32)
-        lb = bucket_len(len(p), self.model.cfg.max_seq_len,
-                        self.min_bucket)
-        padded = np.zeros((1, lb), np.int32)
-        padded[0, :len(p)] = p
+        max_len = self.model.cfg.max_seq_len
         slot = s.free_slots()[0]
-        cache, tok, key = _prefill_admit(
-            self.model, self.params, s.cache, jnp.asarray(padded),
-            jnp.int32(len(p)), jnp.int32(slot),
-            jnp.float32(req.temperature), jnp.int32(req.top_k),
-            jax.random.PRNGKey(req.seed))
-        self.prefills += 1
+        off, entry = 0, None
+        if self.prefix is not None:
+            self.prefix_lookups += 1
+            off, entry = self.prefix.acquire(p)
+        full_bucket = bucket_len(len(p), max_len, self.min_bucket)
+        hit_tokens = saved = 0
+        try:
+            if entry is not None and off == len(p) \
+                    and len(entry.tokens) == len(p) \
+                    and entry.logits is not None:
+                # exact hit: the entry covers EXACTLY this prompt, with
+                # its last-position logits — zero prefill work. (A
+                # LONGER entry can also match the full prompt, but its
+                # logits sit at the wrong position — partial path.)
+                cache, tok, key = _hit_admit(
+                    s.cache, entry.row, jnp.int32(slot), entry.logits,
+                    jnp.float32(req.temperature), jnp.int32(req.top_k),
+                    jax.random.PRNGKey(req.seed))
+                hit_tokens, saved = len(p), full_bucket
+            else:
+                if entry is not None:
+                    # partial hit (or full-prompt match against a
+                    # longer/logits-less entry): seed at most len(p)-1
+                    # tokens so >= 1 real token remains to prefill the
+                    # first-continuation logits from
+                    off = _usable_prefix(min(off, len(p) - 1), len(p),
+                                         max_len, self.min_bucket)
+                    if off <= 0:
+                        self.prefix.release(entry)
+                        entry = None
+                suffix = p[off:]
+                lb = bucket_len(len(suffix), max_len, self.min_bucket)
+                padded = np.zeros((1, lb), np.int32)
+                padded[0, :len(suffix)] = suffix
+                out = _prefill_admit(
+                    self.model, self.params, s.cache,
+                    jnp.asarray(padded), jnp.int32(len(suffix)),
+                    jnp.int32(slot), jnp.float32(req.temperature),
+                    jnp.int32(req.top_k), jax.random.PRNGKey(req.seed),
+                    jnp.int32(off) if self.prefix is not None else None,
+                    entry.row if entry is not None else None,
+                    with_row=self.prefix is not None)
+                self.prefills += 1
+                if self.prefix is not None:
+                    cache, tok, key, row, last = out
+                    self.prefix.insert(p, row, last)
+                else:
+                    cache, tok, key = out
+                if entry is not None:
+                    hit_tokens, saved = off, full_bucket - lb
+        finally:
+            if entry is not None:
+                self.prefix.release(entry)
+        if hit_tokens:
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += hit_tokens
+            self.prefill_tokens_saved += saved
         tok = int(tok)
         if tok in self.eos_ids or req.max_new_tokens == 1:
             # the slot row was written but never armed — the next admit
             # simply overwrites it
             reason = "eos" if tok in self.eos_ids else "length"
             finished.append(Result(req.id, list(req.prompt), [tok],
-                                   reason))
+                                   reason, hit_tokens, saved))
             s.cache = cache
             return
         s.cache = cache
         s.admit(slot, len(p), tok, req.temperature, req.top_k, key)
-        self._live[slot] = _Live(req, [tok])
+        self._live[slot] = _Live(req, [tok], hit_tokens, saved)
 
     def _chunk_size(self) -> int:
         """Decode micro-steps for this iteration: enough for the
@@ -394,10 +567,30 @@ class Server:
                 s.last_token[slot] = int(toks[slot, k - 1])
                 continue
             finished.append(Result(req.id, list(req.prompt),
-                                   live.generated, reason))
+                                   live.generated, reason,
+                                   live.prefix_hit_tokens,
+                                   live.prefill_tokens_saved))
+            if self.prefix is not None and self.prefix_donate:
+                self._donate(live, slot)
             self._live[slot] = None
             s.evict(slot)
         return finished
+
+    def _donate(self, live: _Live, slot: int) -> None:
+        """Give a finished slot's sequence back to the prefix store:
+        its cache row is position-exact over prompt + generated[:-1]
+        (the final token was sampled but never fed, so its K/V was
+        never written). The multi-turn win — the next turn's prompt
+        extends this sequence and seeds from it instead of
+        re-prefilling the whole conversation. ``wants()`` gates the
+        row-extraction dispatch: already-stored or won't-fit sequences
+        cost zero device work."""
+        seq = np.asarray(list(live.request.prompt)
+                         + live.generated[:-1], np.int32)
+        if seq.size == 0 or not self.prefix.wants(seq, self._row_nbytes):
+            return
+        row = _read_slot(self.slots.cache, jnp.int32(slot))
+        self.prefix.insert(seq, row)
 
     def drain(self) -> list[Result]:
         """Finish every IN-FLIGHT slot (no new admissions) and return
@@ -424,6 +617,27 @@ class Server:
             if live is not None:
                 start = since.get(live.request.id, 0) if since else 0
                 out[live.request.id] = live.generated[start:]
+        return out
+
+    def counters(self) -> dict:
+        """Engine-level counters for observability surfaces (gateway
+        /stats, MetricsStore, bench): flat numeric dict. Prefix-store
+        state rides along when the store is on."""
+        out = {
+            "prefills": self.prefills,
+            "decode_steps": self.steps,
+            "dispatches": self.dispatches,
+            "prefix_lookups": self.prefix_lookups,
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+        }
+        if self.prefix is not None:
+            st = self.prefix.stats()
+            out["prefix_entries"] = st["entries"]
+            out["prefix_bytes"] = st["bytes"]
+            out["prefix_budget_bytes"] = st["budget_bytes"]
+            out["prefix_evictions"] = st["evictions"]
         return out
 
     def reset(self) -> None:

@@ -63,6 +63,31 @@ def _write_slot(cache: Any, row: Any, slot) -> Any:
     return write_slot_row(cache, row, slot)
 
 
+def read_slot_row(cache: Any, slot) -> Any:
+    """Extract slot ``slot`` of ``cache`` as a batch-1 row — the exact
+    inverse of ``write_slot_row`` (write then read round-trips every
+    batched leaf). Non-batched leaves (the shared counters per-slot
+    decode neither reads nor advances) pass through unchanged; a
+    consumer seeding a prefill from the row re-seeds them anyway. The
+    prefix store (serve/prefix.py) uses this to donate a finished
+    slot's sequence back to the cache."""
+    def read(path, leaf):
+        ax = cache_batch_axis(path, leaf)
+        if ax is None:
+            return leaf
+        return jax.lax.dynamic_slice_in_dim(
+            leaf, jnp.asarray(slot, jnp.int32), 1, axis=ax)
+
+    return jax.tree_util.tree_map_with_path(read, cache)
+
+
+@jax.jit
+def _read_slot(cache: Any, slot) -> Any:
+    """Jitted ``read_slot_row``; ``slot`` is traced — every donation
+    reuses one compiled program."""
+    return read_slot_row(cache, slot)
+
+
 class SlotCache:
     """``batch_size`` cache slots + per-slot length/rng/EOS-side state.
 
@@ -127,6 +152,7 @@ class SlotCache:
         self.last_token[slot] = 0
         self.temperature[slot] = 0.0
         self.top_k[slot] = 0
+        self.rng[slot] = 0
 
     def reset(self) -> None:
         """Evict everything (a fresh serving session on the same cache

@@ -1,8 +1,10 @@
 #!/bin/sh
 # serve-smoke: boot a tiny-model gateway, fire concurrent curl clients
-# (unary + streaming), assert 200s and a well-formed NDJSON stream, and
-# exercise the SIGTERM graceful drain. Every phase is bounded by
-# `timeout`, so a hang exits nonzero instead of wedging CI.
+# (unary + streaming), assert 200s and a well-formed NDJSON stream, run
+# a shared-prefix round (same preamble, different tails) and assert the
+# prefix KV cache registered hits on /stats, then exercise the SIGTERM
+# graceful drain. Every phase is bounded by `timeout`, so a hang exits
+# nonzero instead of wedging CI.
 #
 # Usage: tools/serve_smoke.sh  (from the repo root; `make serve-smoke`)
 set -u
@@ -85,10 +87,30 @@ EOF
     n=$((n + 1))
 done
 
+# ---- shared-prefix round: the prefix KV cache must register hits -----
+# same 12-token preamble, different tails, one exact repeat; sequential
+# + session-pinned so all three land on ONE replica's store
+PREFIX='1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12'
+n=0
+for TAIL in '21, 22' '23, 24' '21, 22'; do
+    code=$(curl_s "$WORK/prefix_$n" "$URL/v1/generate" \
+        "{\"token_ids\": [$PREFIX, $TAIL], \"max_new_tokens\": 3, \"session\": \"warm\"}") \
+        || fail "prefix round $n curl"
+    [ "$code" = 200 ] || fail "prefix round $n -> $code"
+    n=$((n + 1))
+done
+
 # ---- stats + graceful drain -----------------------------------------
 code=$(curl_s "$WORK/stats" "$URL/stats") || fail "stats curl"
 [ "$code" = 200 ] || fail "stats -> $code"
-grep -q '"completed": 6' "$WORK/stats" || fail "stats: expected 6 completed: $(cat "$WORK/stats")"
+grep -q '"completed": 9' "$WORK/stats" || fail "stats: expected 9 completed: $(cat "$WORK/stats")"
+$PY - "$WORK/stats" <<'EOF' || fail "stats: no prefix-cache hits"
+import json, sys
+prefix = json.load(open(sys.argv[1]))["engine"]["prefix"]
+assert prefix["enabled"], prefix
+assert prefix["hits"] > 0 and prefix["hit_tokens"] > 0, prefix
+assert 0 < prefix["hit_rate"] <= 1, prefix
+EOF
 
 kill -TERM $GW_PID
 i=0
@@ -99,4 +121,4 @@ done
 wait $GW_PID
 rc=$?
 [ $rc = 0 ] || fail "gateway exited $rc after SIGTERM"
-echo "serve-smoke: OK (6 requests, clean drain)"
+echo "serve-smoke: OK (9 requests, prefix hits, clean drain)"
