@@ -23,9 +23,11 @@ Measurements (BASELINE.md rows 2-3 + VERDICT next-steps, r1-r3):
    subtracted) — the serving-path roofline. Plus the serving-layer
    data: continuous-vs-fixed batching (extras.serving), the gateway
    front door's concurrent-client throughput + p50/p99 TTFT at 1 vs 2
-   replicas (extras.gateway), and the prefix KV-cache store's prefill
+   replicas (extras.gateway), the prefix KV-cache store's prefill
    dispatches / TTFT on a shared-system-prompt workload, on vs off
-   (extras.prefix).
+   (extras.prefix), and speculative decoding's decode-dispatch
+   reduction + TPOT on an extractive/repetitive workload, on vs off
+   (extras.spec).
 
 5. Launch -> first-step latency through the REAL submit path
    (TonyClient -> coordinator -> agent -> payload jit step) on the mini
@@ -1246,6 +1248,94 @@ def bench_prefix(on_tpu: bool) -> dict:
     }
 
 
+def bench_spec(on_tpu: bool) -> dict:
+    """The speculative-decoding datum (ISSUE-4 acceptance): an
+    extractive/repetitive workload — prompts built from a short
+    repeated pattern, the traffic shape where prompt-lookup drafting
+    shines (structured output, quote-the-context extraction, template
+    filling) — served greedy with ``speculate_k`` on vs off at
+    chunk_steps=1, the streaming default where every token otherwise
+    costs one whole dispatch. Off, each generated token is one decode
+    dispatch; on, one verify dispatch lands acceptance+1 tokens, so
+    decode dispatches shrink by roughly the acceptance rate. The
+    deterministic form of the claim is the dispatch/step counts
+    (asserted >= 1x in tests/test_spec.py's slow datum test); wall
+    TPOT rides along (the tunneled backend's per-dispatch launch floor
+    makes it the LARGER win there — fewer dispatches is fewer host
+    round trips). Outputs are asserted byte-identical on vs off — the
+    greedy-parity contract, re-checked at bench scale. wasted_steps
+    reports thrown-away PER-SLOT positions before/after (chunk
+    overshoot off; rejected-draft + overshoot positions on) — compare
+    against useful_tokens, not decode_steps (per-dispatch depth)."""
+    import numpy as np
+
+    from tony_tpu.models import Transformer, TransformerConfig
+    from tony_tpu.serve import Request, Server
+
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=32768, d_model=768, n_layers=12, n_heads=12,
+            d_ff=3072, max_seq_len=512, scan_layers=False)
+        n_req, pat_len, prompt_len, budget, batch = 16, 5, 60, 96, 4
+    else:
+        cfg = TransformerConfig(
+            vocab_size=512, d_model=128, n_layers=3, n_heads=4,
+            d_ff=256, max_seq_len=256)
+        n_req, pat_len, prompt_len, budget, batch = 8, 4, 24, 48, 4
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    if on_tpu:
+        params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+    rng = np.random.default_rng(0)
+    prompts = []
+    for _ in range(n_req):
+        pat = rng.integers(1, cfg.vocab_size, size=pat_len).tolist()
+        prompts.append((pat * (prompt_len // pat_len + 1))[:prompt_len])
+
+    def run(k: int):
+        server = Server(model, params, batch_size=batch, eos_id=-1,
+                        min_bucket=16, chunk_steps=1, speculate_k=k)
+        t0 = time.perf_counter()
+        outs = {r.id: r.tokens for r in server.run(
+            Request(list(p), budget, id=i)
+            for i, p in enumerate(prompts))}
+        return outs, time.perf_counter() - t0, server
+
+    run(0)  # warm: prefill bucket + single-step program
+    run(8)  # warm: the verify window ladder
+    outs_off, t_off, srv_off = run(0)
+    outs_on, t_on, srv_on = run(8)
+    identical = outs_on == outs_off
+    assert identical, "speculation changed greedy outputs"
+    useful = n_req * budget
+    return {
+        "n_requests": n_req,
+        "speculate_k": 8,
+        "useful_tokens": useful,
+        "dispatches_off": srv_off.dispatches,
+        "dispatches_on": srv_on.dispatches,
+        "dispatch_ratio": round(
+            srv_off.dispatches / max(srv_on.dispatches, 1), 3),
+        "decode_steps_off": srv_off.steps,
+        "decode_steps_on": srv_on.steps,
+        "wasted_steps_off": srv_off.wasted_steps,
+        "wasted_steps_on": srv_on.wasted_steps,
+        "drafted": srv_on.spec_drafted,
+        "accepted": srv_on.spec_accepted,
+        "acceptance_rate": round(
+            srv_on.spec_accepted / max(srv_on.spec_drafted, 1), 4),
+        "tok_s_off": round(useful / t_off, 1),
+        "tok_s_on": round(useful / t_on, 1),
+        "tpot_ms_off": round(t_off / useful * 1e3, 3),
+        "tpot_ms_on": round(t_on / useful * 1e3, 3),
+        "tpot_speedup": round(t_off / t_on, 3),
+        "outputs_identical": identical,
+    }
+
+
 # ------------------------------------------------------ attention kernels
 
 
@@ -1617,6 +1707,11 @@ def _collect_line() -> dict:
         extras["prefix"] = bench_prefix(on_tpu)
     except Exception as e:
         extras["prefix"] = {"error": f"{type(e).__name__}: {e}"}
+    gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
+    try:
+        extras["spec"] = bench_spec(on_tpu)
+    except Exception as e:
+        extras["spec"] = {"error": f"{type(e).__name__}: {e}"}
     gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
     try:
         extras["quant"] = bench_quant(on_tpu)

@@ -292,6 +292,79 @@ def test_slotcache_row_copy_isolated(tiny):
             assert (np.asarray(new[1]) == 3).all()
 
 
+@pytest.mark.parametrize("scan_layers,kv_int8", [
+    # the satellite case: stacked [n_layers, ...] leaves AND int8
+    # scale leaves together; the plain layout rides the slow tier
+    # (every serve test exercises it implicitly through admit/evict)
+    (True, True),
+    pytest.param(False, False, marks=pytest.mark.slow)])
+def test_slot_row_write_read_roundtrip(scan_layers, kv_int8):
+    """read_slot_row is the EXACT inverse of write_slot_row for every
+    batched leaf — including scan_layers' stacked [n_layers, ...] KV
+    buffers (batch is 4th-from-last, NOT axis 0) and int8-KV scale
+    leaves (batch 3rd-from-last). The prefix store's donation path
+    (engine._donate -> read_slot_row -> later write via
+    _prefill_admit/_hit_admit) depends on this bit-for-bit."""
+    import dataclasses
+
+    from tony_tpu.models import init_cache
+    from tony_tpu.serve import cache_batch_axis, read_slot_row, \
+        write_slot_row
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=16,
+                            dtype=jnp.float32,
+                            attention_backend="reference")
+    cfg = dataclasses.replace(cfg, scan_layers=scan_layers,
+                              kv_cache_quant=kv_int8)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    cache = init_cache(model, params, 3)
+    # fill every leaf with distinct values so a wrong-axis slice would
+    # come back provably different
+    rng = np.random.default_rng(0)
+
+    def randomize(leaf):
+        vals = rng.integers(-100, 100, size=leaf.shape)
+        return jnp.asarray(vals, leaf.dtype)
+
+    cache = jax.tree_util.tree_map(randomize, cache)
+    row = jax.tree_util.tree_map(
+        lambda leaf: randomize(leaf),
+        init_cache(model, params, 1))
+    slot = 1
+    written = write_slot_row(cache, row, slot)
+    back = read_slot_row(written, slot)
+    leaves_c = jax.tree_util.tree_flatten_with_path(cache)[0]
+    leaves_r = jax.tree_util.tree_leaves(row)
+    leaves_w = jax.tree_util.tree_leaves(written)
+    leaves_b = jax.tree_util.tree_leaves(back)
+    saw_scale = saw_stacked = False
+    for (path, old), r, w, b in zip(leaves_c, leaves_r, leaves_w,
+                                    leaves_b):
+        ax = cache_batch_axis(path, old)
+        name = str(path[-1].key if hasattr(path[-1], "key")
+                   else path[-1])
+        if ax is None:
+            # shared counters pass through unchanged in both directions
+            np.testing.assert_array_equal(np.asarray(w), np.asarray(old))
+            np.testing.assert_array_equal(np.asarray(b), np.asarray(old))
+            continue
+        saw_scale |= name.endswith("_scale")
+        saw_stacked |= scan_layers and old.ndim >= 5
+        # write-then-read round-trips the row exactly...
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(r))
+        # ...and the OTHER slots' content is untouched
+        others = [i for i in range(3) if i != slot]
+        np.testing.assert_array_equal(
+            np.asarray(jnp.take(w, np.asarray(others), axis=ax)),
+            np.asarray(jnp.take(old, np.asarray(others), axis=ax)))
+    assert saw_scale == kv_int8
+    if scan_layers:
+        assert saw_stacked
+
+
 def test_bucket_len():
     assert bucket_len(3, 2048) == 16
     assert bucket_len(16, 2048) == 16

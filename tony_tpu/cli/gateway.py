@@ -57,6 +57,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "system prompts prefill only their suffix). "
                         "0 disables; hit rates show on /stats under "
                         "engine.prefix")
+    p.add_argument("--speculate-k", type=int, default=0,
+                   help="speculative decoding: max draft tokens per "
+                        "slot per verify dispatch (prompt-lookup "
+                        "n-gram drafting, batched multi-token "
+                        "verification; greedy outputs unchanged, "
+                        "sampled requests unaffected). 0 disables; "
+                        "acceptance shows on /stats under engine.spec")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000,
                    help="0 picks an ephemeral port")
@@ -116,7 +123,8 @@ def build_gateway(args, model, params, eos, *, metrics_store=None):
     servers = [Server(model, params, batch_size=args.serve_batch,
                       eos_id=eos, chunk_steps=args.chunk_steps,
                       max_pending=args.max_pending,
-                      prefix_cache_mb=prefix_mb)
+                      prefix_cache_mb=prefix_mb,
+                      speculate_k=args.speculate_k)
                for _ in range(max(1, args.replicas))]
     history = None
     if args.history:

@@ -110,6 +110,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "prefix KV-cache store (shared prompt prefixes "
                         "skip the matched part of prefill; exact "
                         "repeats skip it entirely). 0 disables")
+    p.add_argument("--speculate-k", type=int, default=0,
+                   help="--serve mode: speculative decoding — up to K "
+                        "prompt-lookup draft tokens verified per "
+                        "batched dispatch (greedy outputs unchanged; "
+                        "sampled requests decode normally). 0 disables")
     p.add_argument("--compile-cache",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "tony_tpu", "compile-cache"),
@@ -189,7 +194,8 @@ def _serve_loop(model, params, args, eos) -> int:
     prefix_mb = resolve_prefix_cache_mb(args, model)
     servers = [Server(model, params["params"],
                       batch_size=args.serve_batch, eos_id=eos,
-                      prefix_cache_mb=prefix_mb)
+                      prefix_cache_mb=prefix_mb,
+                      speculate_k=args.speculate_k)
                for _ in range(n_replicas)]
     gateway = Gateway(servers,
                       max_queue=max(64, 32 * n_replicas)).start()

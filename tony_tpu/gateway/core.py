@@ -317,7 +317,8 @@ class _Replica:
             # result(); same record the stream's final line carries
             res = type(res)(ticket.request.id, res.prompt, res.tokens,
                             res.finish_reason, res.prefix_hit_tokens,
-                            res.prefill_tokens_saved)
+                            res.prefill_tokens_saved,
+                            res.drafted, res.accepted)
             self.gateway._record_done(self, metrics)
             ticket._emit(("done", res, metrics))
 
@@ -338,6 +339,9 @@ class _Replica:
             "tokens_out": n_out,
             "prefix_hit_tokens": res.prefix_hit_tokens,
             "prefill_tokens_saved": res.prefill_tokens_saved,
+            "drafted": res.drafted,
+            "accepted": res.accepted,
+            "draft_hit_rate": round(res.draft_hit_rate, 4),
             "finish_reason": res.finish_reason,
         }
 
@@ -400,6 +404,8 @@ class _Stats:
         self.tokens_out = 0
         self.prefix_hit_tokens = 0
         self.prefill_tokens_saved = 0
+        self.drafted = 0
+        self.draft_accepted = 0
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -412,6 +418,8 @@ class _Stats:
                 "tokens_out": self.tokens_out,
                 "prefix_hit_tokens": self.prefix_hit_tokens,
                 "prefill_tokens_saved": self.prefill_tokens_saved,
+                "drafted": self.drafted,
+                "draft_accepted": self.draft_accepted,
             }
         for key in ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
             vals = sorted(r[key] for r in recent)
@@ -628,6 +636,8 @@ class Gateway:
                 metrics.get("prefix_hit_tokens", 0)
             self.stats.prefill_tokens_saved += \
                 metrics.get("prefill_tokens_saved", 0)
+            self.stats.drafted += metrics.get("drafted", 0)
+            self.stats.draft_accepted += metrics.get("accepted", 0)
             self.stats.window.append(metrics)
         if self.history is not None:
             try:
@@ -659,20 +669,31 @@ class Gateway:
 
     def _engine_summary(self) -> dict:
         """Fleet-level engine counters: the device work behind the
-        request percentiles (prefills run, decode rounds, occupancy)
-        plus the prefix-cache effectiveness block, summed across
-        replicas — so /stats shows savings NEXT TO the work they
-        avoided."""
+        request percentiles (prefills run, decode rounds, occupancy,
+        overshoot waste) plus the speculative-decoding and prefix-cache
+        effectiveness blocks, summed across replicas — so /stats shows
+        savings NEXT TO the work they avoided."""
         servers = [r.server for r in self.replicas]
         counts = [s.counters() for s in servers]
         total = lambda key: sum(c.get(key, 0) for c in counts)  # noqa: E731
         lookups = total("prefix_lookups")
+        drafted = total("spec_drafted")
         return {
             "prefills": total("prefills"),
             "decode_steps": total("decode_steps"),
             "dispatches": total("dispatches"),
+            "wasted_steps": total("wasted_steps"),
             "active_slots": sum(s.slots.n_active for s in servers),
             "slots": sum(s.slots.batch_size for s in servers),
+            "spec": {
+                "enabled": any(s.speculate_k > 0 for s in servers),
+                "rounds": total("spec_rounds"),
+                "drafted": drafted,
+                "accepted": total("spec_accepted"),
+                "acceptance_rate": round(
+                    total("spec_accepted") / drafted, 4)
+                if drafted else 0.0,
+            },
             "prefix": {
                 "enabled": any(s.prefix is not None for s in servers),
                 "lookups": lookups,

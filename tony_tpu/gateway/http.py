@@ -16,7 +16,9 @@ admission queue. Endpoints:
   GET  /stats         the Gateway.snapshot() JSON (counters, queue
                       depths, p50/p95/p99 queue-wait/TTFT/TPOT, and
                       the engine rollup — prefills/decode steps/
-                      occupancy plus the engine.prefix hit-rate block)
+                      occupancy/wasted_steps plus the engine.spec
+                      speculative-decoding acceptance block and the
+                      engine.prefix hit-rate block)
 
 Shed mapping (core.Shed.http_status): 400 bad request, 429 admission
 queue full, 503 draining, 504 deadline exceeded. In streaming mode the

@@ -120,6 +120,28 @@ def single_decode_step(model, params, cache, tok, positions=None):
     return vars_["cache"], logits[:, -1]
 
 
+def multi_decode_step(model, params, cache, toks, positions):
+    """A ``k``-token per-slot window through the KV cache in ONE apply:
+    feed ``toks`` [b, k] with every row at its own positions [b, k],
+    return ``(new_cache, logits [b, k, V])`` — the logits AFTER each
+    window token, i.e. logits[:, j] scores the token following
+    ``toks[:, j]``.
+
+    The speculative-decoding verify body (serve/engine._verify_chunk):
+    ``single_decode_step`` scores one position per dispatch; this
+    scores the whole draft window in one compute-dense batched pass —
+    the Leviathan et al. trade of sequential memory-bound steps for one
+    parallel verification. Row i's tokens write K/V at positions
+    ``positions[i, :]`` and attend causally by position (intra-window
+    included); entries with ``positions[i, j] < 0`` are padding whose
+    cache writes are dropped and whose logits are garbage
+    (Attention._decode_attention's [b, k] mode)."""
+    logits, vars_ = model.apply({"params": params, "cache": cache},
+                                toks, decode=True, mutable=["cache"],
+                                positions=positions)
+    return vars_["cache"], logits
+
+
 def generate(model, params, prompt, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
              rng: jax.Array | None = None, eos_id=-1,

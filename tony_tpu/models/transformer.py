@@ -406,7 +406,22 @@ class Attention(nn.Module):
         scalar is meaningless across mixed-length slots and is neither
         read nor advanced; a row with ``positions[i] < 0`` is an EMPTY
         slot (no visible keys — its output is garbage by construction
-        and the serving scheduler ignores it). Single-token steps only.
+        and the serving scheduler ignores it).
+
+        ``positions`` [b, l] int32 is the MULTI-TOKEN per-slot window
+        (speculative verify, serve/engine._verify_chunk): row i's token
+        j is written and rotated at ``positions[i, j]`` and attends
+        over everything at-or-before it — which includes the window's
+        own earlier tokens, so the intra-window mask is causal by
+        position arithmetic alone. Entries with ``positions[i, j] < 0``
+        are PADDING (a slot drafting fewer tokens than the batch
+        window): their cache writes are dropped outright (scatter
+        mode="drop" on an out-of-range index) and their logits are
+        garbage the scheduler never reads. Draft tokens past the
+        accepted prefix DO write their K/V — junk beyond a slot's
+        accepted length is invisible under the same per-row visibility
+        mask and overwritten as the slot advances (the prefix-store
+        exactness argument, serve/prefix.py).
         """
         cfg = self.cfg
         b, l, h, dh = q.shape
@@ -434,16 +449,29 @@ class Attention(nn.Module):
                                     lambda: jnp.array(0, jnp.int32))
         if not is_init:  # shape-only init pass
             return jnp.zeros((b, l, h, dh), q.dtype)
-        if positions is not None and l != 1:
-            raise ValueError("per-slot decode (positions=...) is a "
-                             "single-token step; got l=%d" % l)
         per_slot = positions is not None
+        if per_slot:
+            # normalize to the [b, l] window form: [b] is the classic
+            # single-token step, [b, l] the speculative verify window
+            if positions.ndim == 1:
+                if l != 1:
+                    raise ValueError(
+                        "per-slot decode with positions=[b] is a "
+                        "single-token step; got l=%d (pass [b, l] "
+                        "positions for a multi-token window)" % l)
+                pos2d = positions[:, None]
+            elif positions.shape == (b, l):
+                pos2d = positions
+            else:
+                raise ValueError(
+                    f"positions shape {positions.shape} does not match "
+                    f"the token window ({b}, {l})")
         cur = cache_index.value
         if cfg.positional == "rope":
-            # per-slot mode rotates row i at its own position (2-D
-            # positions ride a per-row cos/sin in rotary_embedding)
-            rope_pos = positions[:, None] if per_slot \
-                else cur + jnp.arange(l)
+            # per-slot mode rotates row i's token j at its own position
+            # (2-D positions ride a per-row cos/sin in rotary_embedding;
+            # padding rows rotate at -1 — junk nothing reads)
+            rope_pos = pos2d if per_slot else cur + jnp.arange(l)
             q = rotary_embedding(q, rope_pos, cfg.rope_theta,
                                  cfg.rope_scaling, cfg.rotary_dims)
             k = rotary_embedding(k, rope_pos, cfg.rope_theta,
@@ -454,19 +482,22 @@ class Attention(nn.Module):
             k, k_sc = quantize_kv(k)  # quantize-on-write, after RoPE
             v, v_sc = quantize_kv(v)
         if per_slot:
-            # scatter each row's token at that row's own cache position
-            # (one batched scatter — no per-slot dispatch). Empty slots
-            # (positions < 0) park their junk write at slot position 0:
-            # admit() overwrites the whole row before it ever goes live.
-            rows = jnp.arange(b)
-            write = jnp.clip(positions, 0, max_len - 1)
+            # scatter each row's tokens at that row's own cache
+            # positions (one batched scatter — no per-slot dispatch).
+            # Invalid entries (empty slots, window padding: position
+            # < 0) are redirected to max_len and DROPPED by the scatter
+            # — never clamped: a clamp would overwrite a live position
+            # (negative indices wrap in lax scatter, so the redirect
+            # must be an explicit positive out-of-range index).
+            rows = jnp.arange(b)[:, None]
+            write = jnp.where(pos2d >= 0, pos2d, max_len)
             if quant:
                 k_scales.value = k_scales.value.at[rows, write].set(
-                    k_sc[:, 0])
+                    k_sc, mode="drop")
                 v_scales.value = v_scales.value.at[rows, write].set(
-                    v_sc[:, 0])
-            keys = cached_k.value.at[rows, write].set(k[:, 0])
-            values = cached_v.value.at[rows, write].set(v[:, 0])
+                    v_sc, mode="drop")
+            keys = cached_k.value.at[rows, write].set(k, mode="drop")
+            values = cached_v.value.at[rows, write].set(v, mode="drop")
             cached_k.value = keys
             cached_v.value = values
             # cache_index stays untouched: per-slot lengths live with the
@@ -486,9 +517,11 @@ class Attention(nn.Module):
             cache_index.value = cur + l
         # query positions, [rows, l]: one broadcast row in scalar mode,
         # one row per slot in per-slot mode — the visibility mask below
-        # is written once against this shape
-        q_pos = positions[:, None] if per_slot \
-            else (cur + jnp.arange(l))[None, :]
+        # is written once against this shape. In the multi-token window
+        # this mask IS the intra-window causal mask: window token j's
+        # key sits at pos2d[i, j], visible only to queries at-or-after
+        # it; padding queries (pos -1) see nothing.
+        q_pos = pos2d if per_slot else (cur + jnp.arange(l))[None, :]
         win = cfg.sliding_window
         if l == 1 and cfg.decode_attention == "flash":
             # the decode hot loop: fused pallas kernel over the (possibly
@@ -501,7 +534,8 @@ class Attention(nn.Module):
             # a [B] length vector and zero-length rows emit exact zeros.
             from tony_tpu.ops.decode import flash_decode
 
-            length = jnp.maximum(positions + 1, 0) if per_slot else cur + 1
+            length = jnp.maximum(pos2d[:, 0] + 1, 0) if per_slot \
+                else cur + 1
             out = flash_decode(
                 q[:, 0], keys, values, length, window=win,
                 k_scale=k_scales.value if quant else None,
@@ -850,9 +884,15 @@ class Transformer(nn.Module):
                                       lambda: jnp.array(0, jnp.int32))
             if positions is not None:
                 # declared-but-unchanged pos_index keeps the mutated cache
-                # tree congruent with the carried one across serve steps
+                # tree congruent with the carried one across serve steps.
+                # [b] = single-token step -> [b, 1, d]; [b, l] = multi-
+                # token verify window -> [b, l, d] (clipped padding rows
+                # read a junk embedding nothing consumes)
                 rows = jnp.clip(positions, 0, cfg.max_seq_len - 1)
-                return pos_emb[rows][:, None].astype(cfg.dtype)  # [b, 1, d]
+                emb = pos_emb[rows]
+                if positions.ndim == 1:
+                    emb = emb[:, None]
+                return emb.astype(cfg.dtype)
             if is_init:
                 pos = pos_index.value + jnp.arange(l)
                 pos_index.value = pos_index.value + l
@@ -896,10 +936,12 @@ class Transformer(nn.Module):
         caches have no segment notion); reference/blockwise/pallas
         backends (the pallas kernels stream the ids as blocked operands).
 
-        positions [B] int32 (decode-only): PER-SLOT decode for the
-        continuous-batching server (serve/) — each batch row is an
-        independent cache slot at its own position; negative = empty
-        slot. See Attention._decode_attention."""
+        positions [B] or [B, L] int32 (decode-only): PER-SLOT decode
+        for the continuous-batching server (serve/) — each batch row is
+        an independent cache slot at its own position; negative = empty
+        slot. [B, L] is the multi-token window (speculative verify):
+        row i's token j sits at positions[i, j]; negative entries are
+        dropped padding. See Attention._decode_attention."""
         if segment_ids is not None and decode:
             raise ValueError("segment_ids are a training-path feature; "
                              "decode has no segment notion")

@@ -10,7 +10,11 @@ same iteration (Orca/vLLM-style iteration-level scheduling). Static
 shapes mean the step compiles ONCE; mixed-length traffic never waits
 on the longest sequence in a batch. Shared-prefix traffic (system
 prompts, few-shot preambles, multi-turn) additionally skips prefill
-work through the radix ``PrefixStore`` (serve/prefix.py).
+work through the radix ``PrefixStore`` (serve/prefix.py), and
+predictable continuations (extractive/repetitive/templated output)
+skip sequential decode steps through speculative decoding —
+prompt-lookup drafting + one batched multi-token verify dispatch
+(``Server(speculate_k=...)``), greedy outputs unchanged.
 """
 
 from tony_tpu.serve.engine import (QueueFull, Request, Result, Server,

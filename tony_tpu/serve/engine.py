@@ -50,7 +50,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tony_tpu.models.generate import (init_cache, normalize_eos_ids,
+from tony_tpu.models.generate import (init_cache, multi_decode_step,
+                                      normalize_eos_ids,
                                       single_decode_step)
 from tony_tpu.serve.prefix import PrefixStore
 from tony_tpu.serve.slots import SlotCache, _read_slot, cache_batch_axis
@@ -65,6 +66,42 @@ def bucket_len(n: int, max_len: int, minimum: int = 16) -> int:
     while b < n:
         b *= 2
     return min(b, max_len)
+
+
+def _bucket_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1). Quantizes the verify
+    window's draft width so at most log2(speculate_k)+1 verify programs
+    ever compile — same discipline as the prefill buckets."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def _propose_draft(ctx: np.ndarray, k: int,
+                   max_ngram: int = 3) -> np.ndarray:
+    """Prompt-lookup drafting (the n-gram self-speculation vLLM/HF
+    popularized): find the most RECENT earlier occurrence of the
+    longest suffix n-gram of ``ctx`` (n from ``max_ngram`` down to 1)
+    and propose the up-to-``k`` tokens that followed it. No draft
+    model, no device work — one numpy scan over a <= max_seq_len
+    context per live slot per round, so a miss costs essentially
+    nothing. Extractive / repetitive continuations (quoting the prompt,
+    structured output, greedy loops) hit constantly; free-form text
+    mostly misses and the engine's per-slot EMA stops asking. Returns
+    [0..k] proposed continuation tokens (empty = no match)."""
+    n_ctx = len(ctx)
+    for n in range(min(max_ngram, n_ctx - 1), 0, -1):
+        pat = ctx[n_ctx - n:]
+        # windows over ctx[:-1]: every start with >= 1 token following
+        # the match; the suffix itself (ending at the last token) is
+        # structurally excluded
+        win = np.lib.stride_tricks.sliding_window_view(ctx[:-1], n)
+        hits = np.flatnonzero((win == pat).all(axis=1))
+        if hits.size:
+            start = int(hits[-1]) + n
+            return ctx[start:start + k]
+    return ctx[:0]
 
 
 def _seed_offset(cache, offset):
@@ -188,9 +225,11 @@ def _sample_rows(logits, rngs, temps, top_ks):
     — the serving default — skips the rng splits and both sort passes
     entirely (measured 0.89 -> 0.04 ms per step at CPU proxy sizes,
     most of the micro-step gap to generate()'s scan body); the top-k
-    sorts additionally skip whenever no live row requests a cut. Greedy
-    rows never consume rng, so a request's draws stay reproducible
-    regardless of what it is co-scheduled with."""
+    sorts additionally skip whenever no live SAMPLED row requests a cut
+    — a greedy row's top_k is dead weight (the final where discards its
+    draw), so it must not force the two full-vocab sorts on the whole
+    batch. Greedy rows never consume rng, so a request's draws stay
+    reproducible regardless of what it is co-scheduled with."""
     greedy = jnp.argmax(logits, axis=-1)
 
     def sampled(_):
@@ -202,8 +241,8 @@ def _sample_rows(logits, rngs, temps, top_ks):
             keep = (top_ks[:, None] <= 0) | (ranks < top_ks[:, None])
             return jnp.where(keep, x, -1e30)
 
-        cut = jax.lax.cond(jnp.any(top_ks > 0), topk_cut,
-                           lambda x: x, scaled)
+        cut = jax.lax.cond(jnp.any((temps > 0.0) & (top_ks > 0)),
+                           topk_cut, lambda x: x, scaled)
         pair = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
         drawn = jax.vmap(jax.random.categorical)(pair[:, 1], cut)
         return jnp.where(temps == 0.0, greedy, drawn), pair[:, 0]
@@ -243,6 +282,50 @@ def _decode_chunk(model, params, cache, tok, positions, temps, top_ks,
     return cache, toks, rngs
 
 
+@functools.partial(jax.jit, static_argnames=("model", "window"))
+def _verify_chunk(model, params, cache, toks, positions, draft_len,
+                  temps, top_ks, rngs, *, window: int):
+    """The speculative verify dispatch: score ``window`` positions for
+    EVERY slot in one batched multi-token pass (multi_decode_step) and
+    judge each row's draft against its own greedy verdicts — the
+    Leviathan et al. draft-and-verify step on the resident cache.
+
+    Row layout: ``toks[i] = [last_token, draft_1..draft_d, pad...]``
+    at ``positions[i] = [p, p+1, .., p+d, -1...]`` (``d`` =
+    ``draft_len[i]``; padding writes drop, padding logits are junk).
+    Returns ``(cache, emit [b, window], accepted [b], rngs)``:
+
+    - ``emit[i, 0]`` is the token following ``last_token`` under the
+      row's OWN sampling knobs (_sample_rows: argmax for greedy rows,
+      a real draw advancing the rng once for sampled rows — exactly
+      one advance per emitted token, so a sampled request's draw chain
+      is identical to the chunked path's). Non-speculating rows
+      consume only this.
+    - ``emit[i, 1:]`` are greedy verdicts: ``emit[i, j]`` follows the
+      window prefix through ``draft_j``.
+    - ``accepted[i]`` = length of the leading run of draft tokens
+      equal to the previous position's greedy verdict. The scheduler
+      appends ``emit[i, :accepted[i] + 1]`` — accepted drafts plus the
+      bonus verdict after them — and rewinds nothing: K/V written for
+      rejected drafts sits beyond the slot's advanced length, invisible
+      under per-row masked visibility and overwritten as the slot
+      decodes on.
+
+    ``window`` is static and power-of-two-plus-one bucketed, so at most
+    log2(speculate_k)+1 verify programs ever compile."""
+    cache, logits = multi_decode_step(model, params, cache, toks,
+                                      positions)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [b, w]
+    tok0, rngs = _sample_rows(logits[:, 0], rngs, temps, top_ks)
+    emit = jnp.concatenate([tok0[:, None].astype(jnp.int32),
+                            greedy[:, 1:]], axis=1)
+    j = jnp.arange(window - 1)[None, :]
+    match = (toks[:, 1:] == greedy[:, :-1]) & (j < draft_len[:, None])
+    accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                       axis=1)
+    return cache, emit, accepted, rngs
+
+
 class QueueFull(RuntimeError):
     """``submit()`` refused: the pending queue is at ``max_pending``.
 
@@ -271,7 +354,10 @@ class Result:
     when hit, included as the last element); ``finish_reason`` is
     "eos" or "length". ``prefix_hit_tokens`` = prompt tokens seeded
     from the prefix store instead of prefilled; ``prefill_tokens_saved``
-    = bucketed prefill work skipped (both 0 with the store off)."""
+    = bucketed prefill work skipped (both 0 with the store off).
+    ``drafted``/``accepted`` = speculative-decoding draft tokens this
+    request sent through verify dispatches / had accepted (both 0 with
+    speculation off or for sampled requests)."""
 
     id: Any
     prompt: list
@@ -279,6 +365,14 @@ class Result:
     finish_reason: str
     prefix_hit_tokens: int = 0
     prefill_tokens_saved: int = 0
+    drafted: int = 0
+    accepted: int = 0
+
+    @property
+    def draft_hit_rate(self) -> float:
+        """Fraction of drafted tokens the verify step accepted (0.0
+        when the request never drafted)."""
+        return self.accepted / self.drafted if self.drafted else 0.0
 
 
 @dataclass
@@ -287,6 +381,8 @@ class _Live:
     generated: list = field(default_factory=list)
     prefix_hit_tokens: int = 0
     prefill_tokens_saved: int = 0
+    drafted: int = 0
+    accepted: int = 0
 
 
 class Server:
@@ -307,12 +403,34 @@ class Server:
     door can feed requests while the owner thread keeps stepping.
     ``max_pending`` bounds the queue; past it ``submit()`` raises
     ``QueueFull`` instead of growing without bound.
+
+    ``speculate_k`` > 0 turns on speculative decoding (prompt-lookup
+    drafting + batched verify, module functions ``_propose_draft`` /
+    ``_verify_chunk``): rounds where any greedy slot's n-gram lookup
+    proposes a draft run ONE verify dispatch scoring up to k draft
+    tokens per slot instead of a single micro-step — non-drafting and
+    sampled slots ride the same dispatch at one token per round, so the
+    batch never splits. Greedy outputs are token-for-token unchanged
+    (acceptance compares drafts against the verify pass's own greedy
+    verdicts; rejection is pointer arithmetic — junk K/V beyond the
+    accepted length is invisible and overwritten). A per-slot
+    acceptance EMA (decay ``SPEC_EMA_DECAY``, floor
+    ``SPEC_EMA_DISABLE``) stops drafting for requests whose proposals
+    keep getting rejected, so the worst case is the plain chunked path
+    plus one host-side numpy scan per round.
     """
+
+    # speculative-decoding gate: a slot drafts while its acceptance EMA
+    # (seeded at 1.0 on admit, updated a/d per verify round it drafted
+    # in) stays >= SPEC_EMA_DISABLE; two-ish fully-rejected rounds shut
+    # a hopeless slot up for the rest of its request
+    SPEC_EMA_DECAY = 0.5
+    SPEC_EMA_DISABLE = 0.25
 
     def __init__(self, model, params, *, batch_size: int = 4, eos_id=-1,
                  min_bucket: int = 16, chunk_steps: int = 8,
                  max_pending: int = 1024, prefix_cache_mb: float = 0.0,
-                 prefix_donate: bool = True):
+                 prefix_donate: bool = True, speculate_k: int = 0):
         if model.cfg.quantized:
             # nothing structural in the way — the q8 apply is the same
             # model.apply — but untested here; fail loud, not wrong
@@ -339,9 +457,24 @@ class Server:
         self._pending_lock = threading.Lock()
         self._live: list[_Live | None] = [None] * batch_size
         self._ids = itertools.count()
-        self.steps = 0       # decode micro-steps executed (chunk sum)
-        self.dispatches = 0  # chunk dispatches
+        self.steps = 0       # decode dispatch DEPTH, summed (chunk k /
+        #                      verify window — once per dispatch, not
+        #                      per slot)
+        self.dispatches = 0  # decode dispatches (chunk + verify)
         self.prefills = 0    # prefill dispatches (exact hits skip one)
+        self.wasted_steps = 0  # PER-SLOT token positions decoded and
+        #                       thrown away: chunk overshoot past a
+        #                       finish, verify bonus past EOS/budget,
+        #                       rejected draft positions. Different
+        #                       unit from `steps` — compare against
+        #                       emitted tokens for utilization, the
+        #                       pairing bench.py reports
+        # speculative decoding (0 = off: zero overhead, no new programs)
+        self.speculate_k = max(0, int(speculate_k))
+        self._spec_ema = np.ones(batch_size, np.float64)
+        self.spec_rounds = 0    # verify dispatches run
+        self.spec_drafted = 0   # draft tokens sent through verify
+        self.spec_accepted = 0  # draft tokens accepted
         # prefix KV reuse (serve/prefix.py); 0 MB = off, zero overhead
         self.prefix = PrefixStore(int(prefix_cache_mb * (1 << 20))) \
             if prefix_cache_mb > 0 else None
@@ -492,6 +625,7 @@ class Server:
             return
         s.cache = cache
         s.admit(slot, len(p), tok, req.temperature, req.top_k, key)
+        self._spec_ema[slot] = 1.0  # new tenant: drafting re-enabled
         self._live[slot] = _Live(req, [tok], hit_tokens, saved)
 
     def _chunk_size(self) -> int:
@@ -524,8 +658,14 @@ class Server:
         return finished
 
     def _decode_round(self) -> list[Result]:
-        """One batched decode chunk over the live slots + EOS/evict —
-        ``step()`` minus admission (``drain()`` runs it alone)."""
+        """One batched decode round over the live slots + EOS/evict —
+        ``step()`` minus admission (``drain()`` runs it alone). With
+        speculation on, a round where any slot drafts runs ONE verify
+        dispatch (``_verify_round``); otherwise the plain chunk path."""
+        if self.speculate_k > 0:
+            drafts = self._collect_drafts()
+            if drafts is not None:
+                return self._verify_round(drafts)
         finished: list[Result] = []
         s = self.slots
         k = self._chunk_size()
@@ -566,11 +706,189 @@ class Server:
                 s.lengths[slot] += k
                 s.last_token[slot] = int(toks[slot, k - 1])
                 continue
+            # tokens past the finish are chunk overshoot the host
+            # trimmed: decoded, paid for, never reported
+            self.wasted_steps += k - (j + 1)
             finished.append(Result(req.id, list(req.prompt),
                                    live.generated, reason,
                                    live.prefix_hit_tokens,
-                                   live.prefill_tokens_saved))
+                                   live.prefill_tokens_saved,
+                                   live.drafted, live.accepted))
             if self.prefix is not None and self.prefix_donate:
+                self._donate(live, slot)
+            self._live[slot] = None
+            s.evict(slot)
+        return finished
+
+    # ------------------------------------------------- speculative decode
+
+    def _collect_drafts(self) -> list | None:
+        """Host-side prompt-lookup proposals, one per slot — or None
+        when NOBODY drafts (the round then takes the plain chunk path,
+        so a fleet of lookup misses costs one numpy scan per slot and
+        zero extra device work). A slot drafts only when: greedy (the
+        acceptance rule is argmax equality; sampled requests keep the
+        chunked semantics), its acceptance EMA is above the disable
+        floor, and >= 2 budget tokens remain (a draft of d can land
+        d+1 tokens, so d is clamped to remaining-1 — which also keeps
+        every window write inside max_seq_len).
+
+        A verify round advances every NON-drafting live slot by exactly
+        one token, where a chunk round would advance it ``chunk_steps``
+        — so a lone hot drafter in a mixed batch could drag the rest of
+        the batch to 1 token/dispatch indefinitely. The batch-drag gate
+        refuses the verify round when both hold: (a) some live slot is
+        not drafting, and (b) the round's expected token yield (one per
+        live slot + EMA-weighted draft lengths) is below what the chunk
+        dispatch would land — keeping the worst case at today's cost +
+        the host-side lookups, the speculation contract. A solo drafter
+        (no one to drag) always speculates: its verify is 1 step deep
+        where the chunk is chunk_steps deep. The gate is prechecked on
+        an UPPER bound (full draft caps, before any lookup) so rounds
+        it is provably going to refuse skip the n-gram scans
+        altogether — an ineligible slot can't start drafting and the
+        EMA only moves in verify rounds, so a permanently gated batch
+        pays nothing per round, not one scan per greedy slot."""
+        out: list = [None] * self.slots.batch_size
+        n_live = 0
+        all_eligible = True
+        bound = 0.0  # upper bound on the verify round's token yield
+        eligible: list = []  # (slot, live, d_cap)
+        for slot, live in enumerate(self._live):
+            if live is None:
+                continue
+            n_live += 1
+            bound += 1.0
+            req = live.request
+            if req.temperature != 0.0 \
+                    or self._spec_ema[slot] < self.SPEC_EMA_DISABLE:
+                all_eligible = False
+                continue
+            d_cap = min(self.speculate_k,
+                        req.max_new_tokens - len(live.generated) - 1)
+            if d_cap <= 0:
+                all_eligible = False
+                continue
+            eligible.append((slot, live, d_cap))
+            bound += self._spec_ema[slot] * d_cap
+        if not eligible:
+            return None
+        if not all_eligible and bound < self._chunk_size() * n_live:
+            return None  # gate precheck: refuses before any lookup
+        any_draft = False
+        expected = float(n_live)  # actual-proposal yield estimate
+        for slot, live, d_cap in eligible:
+            req = live.request
+            ctx = np.asarray(list(req.prompt) + live.generated, np.int32)
+            draft = _propose_draft(ctx, d_cap)
+            if draft.size:
+                out[slot] = draft
+                any_draft = True
+                expected += self._spec_ema[slot] * draft.size
+        if not any_draft:
+            return None
+        drafting = sum(d is not None for d in out)
+        if drafting < n_live and \
+                expected < self._chunk_size() * n_live:
+            return None  # batch-drag gate: the chunk dispatch yields more
+        return out
+
+    def _verify_round(self, drafts: list) -> list[Result]:
+        """One speculative verify dispatch + acceptance/evict. Every
+        live slot rides: drafting slots lay out [last_token, draft...]
+        at their own positions, non-drafting slots just [last_token]
+        (their padding writes drop), and acceptance advances each slot
+        by accepted+1 tokens — the rewind for rejected drafts is
+        POINTER ARITHMETIC ONLY: their K/V stays in the cache beyond
+        the slot's length, invisible to every later query and
+        overwritten as the slot decodes on (the prefix-store masked-
+        visibility exactness argument). Mid-window EOS/budget trims
+        exactly like chunk overshoot; donation reads the row whose
+        [0, len) span covers only fed, accepted tokens."""
+        finished: list[Result] = []
+        s = self.slots
+        b = s.batch_size
+        window = _bucket_pow2(max(d.size for d in drafts
+                                  if d is not None)) + 1
+        toks = np.zeros((b, window), np.int32)
+        positions = np.full((b, window), -1, np.int32)
+        draft_len = np.zeros(b, np.int32)
+        for slot, live in enumerate(self._live):
+            if live is None:
+                continue
+            toks[slot, 0] = s.last_token[slot]
+            positions[slot, 0] = s.lengths[slot]
+            d = drafts[slot]
+            if d is not None:
+                toks[slot, 1:1 + d.size] = d
+                positions[slot, 1:1 + d.size] = \
+                    s.lengths[slot] + 1 + np.arange(d.size)
+                draft_len[slot] = d.size
+        cache, emit, accepted, rng = _verify_chunk(
+            self.model, self.params, s.cache, jnp.asarray(toks),
+            jnp.asarray(positions), jnp.asarray(draft_len),
+            jnp.asarray(s.temperature), jnp.asarray(s.top_k),
+            jnp.asarray(s.rng), window=window)
+        self.steps += window
+        self.dispatches += 1
+        self.spec_rounds += 1
+        s.cache = cache
+        emit = np.asarray(emit)
+        accepted = np.asarray(accepted)
+        s.rng = np.array(rng, np.uint32)
+
+        for slot in range(b):
+            live = self._live[slot]
+            if live is None:
+                continue
+            req = live.request
+            d = int(draft_len[slot])
+            a = int(accepted[slot])
+            if d:
+                live.drafted += d
+                live.accepted += a
+                self.spec_drafted += d
+                self.spec_accepted += a
+                # rejected drafts were scored and thrown away — the
+                # speculation-side waste the utilization counter reports
+                # next to chunk overshoot
+                self.wasted_steps += d - a
+                self._spec_ema[slot] = (
+                    self.SPEC_EMA_DECAY * self._spec_ema[slot]
+                    + (1.0 - self.SPEC_EMA_DECAY) * a / d)
+            reason = None
+            consumed = 0
+            # emit[:a] are the accepted drafts, emit[a] the bonus
+            # verdict after them — appended in order with the same
+            # EOS/budget walk as the chunk path
+            for jj in range(a + 1):
+                tok = int(emit[slot, jj])
+                live.generated.append(tok)
+                consumed += 1
+                if tok in self.eos_ids:
+                    reason = "eos"
+                elif len(live.generated) >= req.max_new_tokens:
+                    reason = "length"
+                if reason:
+                    break
+            if reason is None:
+                # fed last_token + a accepted drafts: the slot's
+                # position-exact span grew by accepted + 1
+                s.lengths[slot] += a + 1
+                s.last_token[slot] = int(emit[slot, a])
+                continue
+            self.wasted_steps += (a + 1) - consumed
+            finished.append(Result(req.id, list(req.prompt),
+                                   live.generated, reason,
+                                   live.prefix_hit_tokens,
+                                   live.prefill_tokens_saved,
+                                   live.drafted, live.accepted))
+            if self.prefix is not None and self.prefix_donate:
+                # the donated sequence prompt+generated[:-1] spans
+                # [0, len(prompt) + consumed - 1 + generated_prev)
+                # positions, all of them fed accepted tokens; junk
+                # from rejected drafts sits beyond that span, where
+                # prefix consumers mask or overwrite it
                 self._donate(live, slot)
             self._live[slot] = None
             s.evict(slot)
@@ -627,6 +945,10 @@ class Server:
             "prefills": self.prefills,
             "decode_steps": self.steps,
             "dispatches": self.dispatches,
+            "wasted_steps": self.wasted_steps,
+            "spec_rounds": self.spec_rounds,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
             "prefix_lookups": self.prefix_lookups,
             "prefix_hits": self.prefix_hits,
             "prefix_hit_tokens": self.prefix_hit_tokens,

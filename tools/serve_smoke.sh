@@ -2,9 +2,11 @@
 # serve-smoke: boot a tiny-model gateway, fire concurrent curl clients
 # (unary + streaming), assert 200s and a well-formed NDJSON stream, run
 # a shared-prefix round (same preamble, different tails) and assert the
-# prefix KV cache registered hits on /stats, then exercise the SIGTERM
-# graceful drain. Every phase is bounded by `timeout`, so a hang exits
-# nonzero instead of wedging CI.
+# prefix KV cache registered hits on /stats, run a speculation round
+# (repetitive prompt; /stats engine.spec must show accepted drafts and
+# the output must match a --speculate-k 0 control gateway), then
+# exercise the SIGTERM graceful drain. Every phase is bounded by
+# `timeout`, so a hang exits nonzero instead of wedging CI.
 #
 # Usage: tools/serve_smoke.sh  (from the repo root; `make serve-smoke`)
 set -u
@@ -12,13 +14,14 @@ set -u
 PY=${PY:-python}
 BOUND=${SERVE_SMOKE_TIMEOUT:-300}   # whole-run ceiling, seconds
 WORK=$(mktemp -d /tmp/serve_smoke.XXXXXX)
-trap 'kill $GW_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
+CTRL_PID=''
+trap 'kill $GW_PID $CTRL_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
 
 fail() { echo "serve-smoke: FAIL: $1" >&2; exit 1; }
 
 # ---- boot the gateway on an ephemeral port ---------------------------
 JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
-    --replicas 2 --port 0 --compile-cache '' \
+    --replicas 2 --port 0 --compile-cache '' --speculate-k 4 \
     >"$WORK/boot.log" 2>"$WORK/stderr.log" &
 GW_PID=$!
 
@@ -100,16 +103,58 @@ for TAIL in '21, 22' '23, 24' '21, 22'; do
     n=$((n + 1))
 done
 
+# ---- speculation round: repetitive prompt, drafts must be accepted ---
+# a cyclic prompt is the prompt-lookup sweet spot; same request against
+# a --speculate-k 0 control gateway must produce IDENTICAL token_ids
+SPEC_REQ='{"token_ids": [1, 2, 3, 1, 2, 3, 1, 2, 3, 1, 2, 3], "max_new_tokens": 10, "session": "spec"}'
+code=$(curl_s "$WORK/spec_on" "$URL/v1/generate" "$SPEC_REQ") || fail "spec round curl"
+[ "$code" = 200 ] || fail "spec round -> $code"
+
+JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+    --replicas 1 --port 0 --compile-cache '' --speculate-k 0 \
+    >"$WORK/ctrl_boot.log" 2>"$WORK/ctrl_stderr.log" &
+CTRL_PID=$!
+CTRL_URL=''
+i=0
+while [ $i -lt $BOUND ]; do
+    CTRL_URL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/ctrl_boot.log")
+    [ -n "$CTRL_URL" ] && break
+    kill -0 $CTRL_PID 2>/dev/null || fail "control gateway died at boot: $(cat "$WORK/ctrl_stderr.log")"
+    sleep 1; i=$((i + 1))
+done
+[ -n "$CTRL_URL" ] || fail "control gateway did not print its URL within ${BOUND}s"
+code=$(curl_s "$WORK/spec_off" "$CTRL_URL/v1/generate" "$SPEC_REQ") || fail "spec control curl"
+[ "$code" = 200 ] || fail "spec control -> $code"
+$PY - "$WORK/spec_on" "$WORK/spec_off" <<'EOF' || fail "speculation changed greedy output"
+import json, sys
+on = json.load(open(sys.argv[1]))
+off = json.load(open(sys.argv[2]))
+assert on["token_ids"] == off["token_ids"], (on, off)
+assert on["metrics"]["drafted"] > 0 and on["metrics"]["accepted"] > 0, on["metrics"]
+EOF
+kill -TERM $CTRL_PID
+i=0
+while kill -0 $CTRL_PID 2>/dev/null; do
+    [ $i -ge $BOUND ] && fail "control gateway did not drain"
+    sleep 1; i=$((i + 1))
+done
+CTRL_PID=''
+
 # ---- stats + graceful drain -----------------------------------------
 code=$(curl_s "$WORK/stats" "$URL/stats") || fail "stats curl"
 [ "$code" = 200 ] || fail "stats -> $code"
-grep -q '"completed": 9' "$WORK/stats" || fail "stats: expected 9 completed: $(cat "$WORK/stats")"
-$PY - "$WORK/stats" <<'EOF' || fail "stats: no prefix-cache hits"
+grep -q '"completed": 10' "$WORK/stats" || fail "stats: expected 10 completed: $(cat "$WORK/stats")"
+$PY - "$WORK/stats" <<'EOF' || fail "stats: no prefix-cache hits / no accepted drafts"
 import json, sys
-prefix = json.load(open(sys.argv[1]))["engine"]["prefix"]
+engine = json.load(open(sys.argv[1]))["engine"]
+prefix = engine["prefix"]
 assert prefix["enabled"], prefix
 assert prefix["hits"] > 0 and prefix["hit_tokens"] > 0, prefix
 assert 0 < prefix["hit_rate"] <= 1, prefix
+spec = engine["spec"]
+assert spec["enabled"], spec
+assert spec["drafted"] > 0 and spec["accepted"] > 0, spec
+assert 0 < spec["acceptance_rate"] <= 1, spec
 EOF
 
 kill -TERM $GW_PID
@@ -121,4 +166,4 @@ done
 wait $GW_PID
 rc=$?
 [ $rc = 0 ] || fail "gateway exited $rc after SIGTERM"
-echo "serve-smoke: OK (9 requests, prefix hits, clean drain)"
+echo "serve-smoke: OK (10 requests, prefix hits, accepted drafts, clean drain)"
