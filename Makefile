@@ -13,6 +13,10 @@
 #   make serve-smoke - boot a tiny-model gateway, concurrent curl
 #                 clients (unary + streaming), SIGTERM drain; every
 #                 phase `timeout`-bounded so a hang exits nonzero
+#   make chaos-smoke - just the fault-injection round of serve-smoke:
+#                 a 2-replica gateway with replica 0's dispatches
+#                 killed via TONY_SERVE_FAULTS must keep serving
+#                 (failover, zero 5xx) and rejoin the dead replica
 
 PY ?= python
 
@@ -24,7 +28,7 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 	tests/test_workflow.py tests/test_tpu_info.py \
 	tests/test_compilecache.py tests/test_proxy.py tests/test_profiler.py
 
-.PHONY: lint smoke check test bench serve-smoke
+.PHONY: lint smoke check test bench serve-smoke chaos-smoke
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -47,3 +51,6 @@ bench:
 
 serve-smoke:
 	PY=$(PY) sh tools/serve_smoke.sh
+
+chaos-smoke:
+	PY=$(PY) SERVE_SMOKE_ROUNDS=chaos sh tools/serve_smoke.sh

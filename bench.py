@@ -25,9 +25,11 @@ Measurements (BASELINE.md rows 2-3 + VERDICT next-steps, r1-r3):
    front door's concurrent-client throughput + p50/p99 TTFT at 1 vs 2
    replicas (extras.gateway), the prefix KV-cache store's prefill
    dispatches / TTFT on a shared-system-prompt workload, on vs off
-   (extras.prefix), and speculative decoding's decode-dispatch
+   (extras.prefix), speculative decoding's decode-dispatch
    reduction + TPOT on an extractive/repetitive workload, on vs off
-   (extras.spec).
+   (extras.spec), and the wall-clock cost of a mid-run replica death
+   under the gateway's token-exact failover, faulted vs control
+   (extras.faults).
 
 5. Launch -> first-step latency through the REAL submit path
    (TonyClient -> coordinator -> agent -> payload jit step) on the mini
@@ -1336,6 +1338,106 @@ def bench_spec(on_tpu: bool) -> dict:
     }
 
 
+def bench_faults(on_tpu: bool) -> dict:
+    """The fault-tolerance datum (ISSUE-5 acceptance): the same
+    concurrent workload through a 2-replica gateway twice — fault-free
+    control, then with replica 0 armed (``serve/faults.py``) to die
+    mid-run — and the wall-clock price of a replica failure measured
+    against it. The contract numbers ride along as booleans/counters:
+    zero shed (a retriable failure is failover, never a 5xx), every
+    output token-identical to the control (deterministic greedy re-run
+    + resume-past-emitted), and the dead replica back in the rotation
+    by the end (breaker probe). Host-scheduling-bound like the gateway
+    datum, so the CPU-sized model is the right probe on either
+    backend."""
+    import threading
+
+    import numpy as np
+
+    from tony_tpu.gateway import Gateway, GenRequest
+    from tony_tpu.models import Transformer, TransformerConfig
+    from tony_tpu.serve import FaultPlan, Server
+
+    cfg = TransformerConfig(
+        vocab_size=512, d_model=128, n_layers=3, n_heads=4, d_ff=256,
+        max_seq_len=128)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    rng = np.random.default_rng(0)
+    n_req, prompt_len, budget, batch = 12, 16, 24, 2
+    prompts = rng.integers(0, cfg.vocab_size, size=(n_req, prompt_len))
+    useful = n_req * budget
+
+    def run(inject: bool):
+        gw = Gateway(
+            [Server(model, params, batch_size=batch, eos_id=-1,
+                    min_bucket=prompt_len, chunk_steps=1,
+                    fault_plan=(FaultPlan.fail_at(6) if inject and i == 0
+                                else None))
+             for i in range(2)],
+            max_queue=2 * n_req, breaker_base_s=0.05, breaker_max_s=0.2)
+        gw.start()
+        outs, errors = {}, []
+
+        def client(c, n_clients=6):
+            try:
+                for i in range(c, n_req, n_clients):
+                    outs[i] = gw.submit(
+                        GenRequest(prompts[i].tolist(), budget, id=i)) \
+                        .result(timeout=600).tokens
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(6)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errors:
+            gw.drain(timeout=60)
+            raise errors[0]
+        # the breaker probe is the recovery half of the story: wait
+        # (bounded) for the dead replica to re-earn admission
+        rejoined = True
+        if inject:
+            deadline = time.monotonic() + 60
+            while gw.n_healthy < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            rejoined = gw.n_healthy == 2
+        snap = gw.snapshot()
+        gw.drain(timeout=60)
+        return outs, dt, snap, rejoined
+
+    run(False)  # warm: prefill bucket + decode program
+    outs_ctrl, t_ctrl, snap_ctrl, _ = run(False)
+    outs_chaos, t_chaos, snap_chaos, rejoined = run(True)
+    identical = outs_chaos == outs_ctrl
+    assert identical, "failover changed greedy outputs"
+    sup = snap_chaos["supervision"]
+    return {
+        "n_requests": n_req,
+        "useful_tokens": useful,
+        "completed_control": snap_ctrl["completed"],
+        "completed_faulted": snap_chaos["completed"],
+        "shed_faulted": snap_chaos["shed"],  # the zero-5xx contract
+        "replica_failures": sup["replica_failures"],
+        "failovers": sup["failovers"],
+        "retries": sup["retries"],
+        "failed_replica_rejoined": rejoined,
+        "outputs_identical": identical,
+        "tok_s_control": round(useful / t_ctrl, 1),
+        "tok_s_faulted": round(useful / t_chaos, 1),
+        # the headline: what one mid-run replica death costs the
+        # workload end-to-end (re-run prompts + degraded capacity
+        # until the breaker rejoins the replica)
+        "failover_cost": round(t_chaos / t_ctrl, 3),
+    }
+
+
 # ------------------------------------------------------ attention kernels
 
 
@@ -1712,6 +1814,11 @@ def _collect_line() -> dict:
         extras["spec"] = bench_spec(on_tpu)
     except Exception as e:
         extras["spec"] = {"error": f"{type(e).__name__}: {e}"}
+    gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
+    try:
+        extras["faults"] = bench_faults(on_tpu)
+    except Exception as e:
+        extras["faults"] = {"error": f"{type(e).__name__}: {e}"}
     gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
     try:
         extras["quant"] = bench_quant(on_tpu)

@@ -324,8 +324,11 @@ def _post(url, doc, timeout=120):
 
 def test_http_unary_and_health(tiny, http_gateway):
     gw, url = http_gateway
-    assert json.loads(urllib.request.urlopen(
-        url + "/healthz", timeout=30).read()) == {"status": "ok"}
+    health = json.loads(urllib.request.urlopen(
+        url + "/healthz", timeout=30).read())
+    assert health["status"] == "ok" and health["healthy"] == 1
+    assert health["replicas"][0]["state"] == "healthy"
+    assert health["replicas"][0]["heartbeat_age_s"] < 30
     assert urllib.request.urlopen(url + "/readyz", timeout=30).status == 200
     doc = json.loads(_post(url, {"token_ids": [1, 2, 3],
                                  "max_new_tokens": 5, "id": "u"}).read())

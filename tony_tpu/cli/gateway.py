@@ -19,6 +19,15 @@ target (``make serve-smoke``) and quick integration checks use it.
 Shutdown: SIGTERM/SIGINT stops admission (``/readyz`` -> 503 so a load
 balancer pulls the replica), finishes every queued + in-flight request,
 then exits 0. A second signal force-exits.
+
+Fault tolerance (the TonY supervision story, serving flavor): replica
+threads heartbeat; a watchdog fails a replica whose beats stall past
+``--stall-timeout``, its requests fail over token-exactly to healthy
+replicas (up to ``--max-attempts`` engine runs each), and the failed
+replica re-earns admission through a circuit breaker
+(``--breaker-base``/``--breaker-max`` backoff, ``--quarantine-after``
+strikes). ``TONY_SERVE_FAULTS`` arms deterministic fault injection for
+chaos testing (``make chaos-smoke``; see ``serve/faults.py``).
 """
 
 from __future__ import annotations
@@ -88,6 +97,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-timeout", type=float, default=120.0,
                    help="max seconds to wait for in-flight requests on "
                         "shutdown")
+    p.add_argument("--max-attempts", type=int, default=3,
+                   help="engine runs a request may burn across replica "
+                        "failures before it sheds 503 (the TonY task-"
+                        "retry budget, per request)")
+    p.add_argument("--stall-timeout", type=float, default=30.0,
+                   help="seconds without a replica-thread heartbeat "
+                        "before the watchdog declares it failed and "
+                        "fails its requests over; must comfortably "
+                        "exceed one step's worst dispatch time "
+                        "(first-compile included)")
+    p.add_argument("--breaker-base", type=float, default=0.25,
+                   help="circuit breaker: first backoff before a failed "
+                        "replica is probed (doubles per consecutive "
+                        "failure up to --breaker-max)")
+    p.add_argument("--breaker-max", type=float, default=8.0,
+                   help="circuit breaker: backoff ceiling in seconds")
+    p.add_argument("--quarantine-after", type=int, default=5,
+                   help="consecutive failures (probe failures included) "
+                        "before a replica is quarantined out of the "
+                        "rotation for good")
     p.add_argument("--compile-cache",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "tony_tpu", "compile-cache"),
@@ -117,22 +146,35 @@ def build_gateway(args, model, params, eos, *, metrics_store=None):
     """Servers + Gateway from parsed args (shared with tests/bench)."""
     from tony_tpu.cli.generate import resolve_prefix_cache_mb
     from tony_tpu.gateway import Gateway, GatewayHistory
-    from tony_tpu.serve import Server
+    from tony_tpu.serve import FaultPlan, Server
 
     prefix_mb = resolve_prefix_cache_mb(args, model)
+    # TONY_SERVE_FAULTS arms deterministic fault injection per replica
+    # (serve/faults.py) — the chaos-smoke hook; unset = None = zero cost
     servers = [Server(model, params, batch_size=args.serve_batch,
                       eos_id=eos, chunk_steps=args.chunk_steps,
                       max_pending=args.max_pending,
                       prefix_cache_mb=prefix_mb,
-                      speculate_k=args.speculate_k)
-               for _ in range(max(1, args.replicas))]
+                      speculate_k=args.speculate_k,
+                      fault_plan=FaultPlan.from_env(replica=i))
+               for i in range(max(1, args.replicas))]
+    armed = [i for i, s in enumerate(servers) if s.fault_plan is not None]
+    if armed:
+        logging.getLogger(__name__).warning(
+            "fault injection ARMED on replica(s) %s via TONY_SERVE_FAULTS",
+            armed)
     history = None
     if args.history:
         history = GatewayHistory(args.history,
                                  n_replicas=len(servers))
     return Gateway(servers, max_queue=args.max_queue,
                    default_ttl_s=args.default_ttl,
-                   metrics_store=metrics_store, history=history)
+                   metrics_store=metrics_store, history=history,
+                   max_attempts=args.max_attempts,
+                   stall_timeout_s=args.stall_timeout,
+                   breaker_base_s=args.breaker_base,
+                   breaker_max_s=args.breaker_max,
+                   quarantine_after=args.quarantine_after)
 
 
 def main(argv=None) -> int:

@@ -188,15 +188,24 @@ def _serve_loop(model, params, args, eos) -> int:
     import time
 
     from tony_tpu.gateway import Gateway, GatewayQueueFull, GenRequest
-    from tony_tpu.serve import Server
+    from tony_tpu.serve import FaultPlan, Server
 
     n_replicas = max(1, getattr(args, "serve_replicas", 1))
     prefix_mb = resolve_prefix_cache_mb(args, model)
+    # same chaos hook as the gateway CLI: TONY_SERVE_FAULTS arms
+    # deterministic per-replica fault injection (serve/faults.py)
     servers = [Server(model, params["params"],
                       batch_size=args.serve_batch, eos_id=eos,
                       prefix_cache_mb=prefix_mb,
-                      speculate_k=args.speculate_k)
-               for _ in range(n_replicas)]
+                      speculate_k=args.speculate_k,
+                      fault_plan=FaultPlan.from_env(replica=i))
+               for i in range(n_replicas)]
+    armed = [i for i, s in enumerate(servers) if s.fault_plan is not None]
+    if armed:
+        # loud, like the gateway CLI: a TONY_SERVE_FAULTS leftover from
+        # a chaos run must not silently sabotage a real serve loop
+        print(f"fault injection ARMED on replica(s) {armed} via "
+              "TONY_SERVE_FAULTS", file=sys.stderr)
     gateway = Gateway(servers,
                       max_queue=max(64, 32 * n_replicas)).start()
     tokenizer = None

@@ -8,8 +8,9 @@ entrypoint is ``python -m tony_tpu.cli.gateway``; ``tony-tpu generate
 
 from tony_tpu.gateway.core import (BadRequest, DeadlineExceeded, Gateway,
                                    GatewayClosed, GatewayHistory,
-                                   GatewayQueueFull, GenRequest, Shed,
-                                   Ticket)
+                                   GatewayQueueFull, GenRequest,
+                                   NoHealthyReplicas, RetryBudgetExhausted,
+                                   Shed, Ticket)
 from tony_tpu.gateway.http import GatewayHTTP
 
 __all__ = [
@@ -21,6 +22,8 @@ __all__ = [
     "GatewayHistory",
     "GatewayQueueFull",
     "GenRequest",
+    "NoHealthyReplicas",
+    "RetryBudgetExhausted",
     "Shed",
     "Ticket",
 ]

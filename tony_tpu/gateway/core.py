@@ -33,6 +33,28 @@ of role tasks onto a container pool). The pieces, front to back:
   ``metrics.MetricsStore`` under ``gateway:replica-<i>`` (the
   coordinator-side sink TaskMetricsMonitor pushes to), and optionally
   into a portal-browsable history job (``GatewayHistory``).
+- SUPERVISION (the TonY ApplicationMaster story, ported to serving):
+  every replica thread heartbeats per scheduler iteration; a
+  ``LivenessMonitor`` watchdog declares a replica failed when its
+  beats stop for ``stall_timeout_s`` (a wedged dispatch, not just a
+  raised one). Either failure route — exception or stall — bumps the
+  replica's EPOCH, steals every ticket it holds, and FAILS THEM OVER:
+  queued tickets (which never touched the failed engine) move to a
+  healthy replica untouched; engine-admitted tickets are charged one
+  attempt, exclude the failed replica, and RE-RUN from their prompt —
+  greedy and seeded-sampling decodes are deterministic, so the retry
+  reproduces the exact token sequence and the stream emits only the
+  tokens past what the client already received (the analog of TonY's
+  task retries, token-exact). A ticket out of budget
+  (``max_attempts``) or with no healthy replica left sheds **503**
+  (retriable) — never 500. The failed replica resets its engine and
+  enters the CIRCUIT BREAKER: exponential backoff
+  (``breaker_base_s`` doubling to ``breaker_max_s``), then a probe
+  generation; success rejoins it to the routing set, repeated failures
+  (``quarantine_after`` consecutive) quarantine it. ``/healthz``
+  exposes per-replica heartbeat age + breaker state, ``/readyz`` flips
+  503 when zero replicas are healthy, and every failure / retry /
+  probe / rejoin counts into ``/stats`` ``supervision``.
 """
 
 from __future__ import annotations
@@ -80,6 +102,29 @@ class DeadlineExceeded(Shed):
     http_status = 504
 
 
+class NoHealthyReplicas(Shed):
+    """Every replica's breaker is open (or quarantined): the gateway
+    sheds clean 503s — retriable service-unavailable, the load
+    balancer's signal to back off — until a probe rejoins a replica."""
+
+    http_status = 503
+
+
+class RetryBudgetExhausted(Shed):
+    """The request burned ``max_attempts`` failed engine runs across
+    replica failures: shed 503 — retriable (the request was fine, the
+    fleet was not), and distinct from ``GatewayClosed`` so a client can
+    tell transient fleet trouble from a shutdown in progress."""
+
+    http_status = 503
+
+
+class _ReplicaUnhealthy(Exception):
+    """Internal routing signal: the chosen replica flipped unhealthy
+    between route and enqueue — re-route, never queue onto a broken
+    replica."""
+
+
 @dataclass
 class GenRequest:
     """One client request. ``ttl_s`` bounds its whole life (queue wait
@@ -99,6 +144,12 @@ class GenRequest:
 # ticket lifecycle states
 QUEUED, RUNNING, DONE, SHED = "QUEUED", "RUNNING", "DONE", "SHED"
 
+# replica health states (the circuit-breaker cycle): HEALTHY routable,
+# BROKEN waiting out its breaker backoff, PROBING running the probe
+# generation, QUARANTINED out of the rotation for good
+HEALTHY, BROKEN, PROBING, QUARANTINED = ("healthy", "broken", "probing",
+                                         "quarantined")
+
 
 class Ticket:
     """The caller's handle on a submitted request: an event stream plus
@@ -110,7 +161,17 @@ class Ticket:
                                  observability record (queue_wait_ms,
                                  ttft_ms, tpot_ms, tokens_in/out, ...)
       ("shed", status, reason)   refused after admission (deadline hit
-                                 in queue, replica failure)
+                                 in queue, retry budget / fleet health
+                                 exhausted after replica failures)
+
+    On replica failure the ticket is REQUEUED, not shed (see
+    ``Gateway._failover``): ``attempts`` counts engine runs that
+    failed, ``excluded`` the replicas that failed it. The retry re-runs
+    from the prompt; because greedy and seeded-sampling decodes are
+    deterministic, the regenerated stream is byte-identical, and
+    ``_n_emitted`` makes the replica emit only tokens the client has
+    not already received — a mid-stream failover is invisible apart
+    from latency.
     """
 
     def __init__(self, request: GenRequest, deadline: float | None,
@@ -124,8 +185,14 @@ class Ticket:
         self.state = QUEUED
         self.metrics: dict | None = None  # the done-event record
         self.events: queue.Queue = queue.Queue()
+        self.attempts = 0  # engine runs that FAILED (retry budget)
+        self.excluded: set[int] = set()  # replicas that failed it
         self._on_event = on_event
         self._n_emitted = 0  # tokens already streamed out
+        self._emit_lock = threading.Lock()  # serializes token emission
+        self._shed_exc_cls: type | None = None  # result()'s exception
+        #                                         class, when the status
+        #                                         alone is ambiguous
 
     # estimate used by least-outstanding-tokens routing: the work a
     # replica signs up for when it accepts this ticket
@@ -140,6 +207,30 @@ class Ticket:
                 self._on_event(self, event)
             except Exception:
                 log.exception("ticket on_event callback failed")
+
+    def _emit_tokens(self, start: int, tokens: list, now: float) -> None:
+        """Emit the absolute window ``[start, start + len(tokens))`` of
+        this request's generated sequence, skipping whatever the client
+        already has. Advance-and-emit are atomic under a PER-TICKET
+        lock, so a failed replica's late delta and its failover
+        successor's resumed stream serialize into one exactly-ordered,
+        gap-free, duplicate-free client stream (decoding is
+        deterministic, so overlapping windows carry identical values —
+        whoever wins the lock emits them). A ticket-scoped lock on
+        purpose: no replica lock is held across the ``on_event``
+        callback, so a slow consumer stalls only its own request."""
+        with self._emit_lock:
+            if self.state == SHED:
+                return  # terminal shed already delivered: no tokens
+                #         after the final event
+            cur = self._n_emitted
+            if cur >= start + len(tokens):
+                return
+            new = tokens[cur - start:]
+            self._n_emitted = cur + len(new)
+            if self.t_first is None:
+                self.t_first = now
+            self._emit(("tokens", new))
 
     def result(self, timeout: float | None = None):
         """Block until the request finishes; returns the
@@ -159,14 +250,22 @@ class Ticket:
                 return rest[0]
             if kind == "shed":
                 status, reason = rest
-                exc = {429: GatewayQueueFull, 503: GatewayClosed,
-                       504: DeadlineExceeded}.get(status, Shed)(reason)
+                cls = self._shed_exc_cls or {
+                    429: GatewayQueueFull, 503: GatewayClosed,
+                    504: DeadlineExceeded}.get(status, Shed)
+                exc = cls(reason)
                 exc.http_status = status
                 raise exc
 
 
 class _Replica:
-    """One ``serve.Server`` + the thread that drives it."""
+    """One ``serve.Server`` + the thread that drives it, under
+    supervision: the thread heartbeats (``last_beat``) every scheduler
+    iteration; ``epoch`` is the fencing token — every failure
+    (exception OR watchdog-declared stall) bumps it, and any state the
+    thread computed under the old epoch is discarded, so a wedged step
+    that eventually returns cannot deliver results for tickets that
+    were already failed over to another replica."""
 
     def __init__(self, index: int, server: Server, gateway: "Gateway"):
         self.index = index
@@ -177,7 +276,19 @@ class _Replica:
         self.outstanding = 0  # token-cost estimate: queued + in-flight
         self.completed = 0
         self.shed = 0
+        # supervision / breaker state (all mutated under self.cv except
+        # the plain counters, which only this thread or the gateway's
+        # failure path touch)
+        self.state = HEALTHY
+        self.epoch = 0
+        self.last_beat = time.monotonic()
+        self.failures = 0              # breaker trips, lifetime
+        self.consecutive_failures = 0  # since the last delivered result
+        self.probes = 0
+        self.rejoins = 0
         self._stop = False
+        self._exited = False  # the thread left _loop: nothing enqueued
+        #                       after this is ever processed
         self._tickets: dict[int, Ticket] = {}  # engine id -> ticket
         self._next_id = 0
         self._thread = threading.Thread(target=self._loop,
@@ -186,13 +297,22 @@ class _Replica:
 
     # ---------------------------------------------------------- intake
 
-    def enqueue(self, ticket: Ticket) -> None:
+    def enqueue(self, ticket: Ticket, force: bool = False) -> None:
+        """``force=True`` is the FAILOVER entry: a stolen ticket must be
+        allowed in even mid-drain (the drain promise covers it), as long
+        as this thread is still alive to process it."""
         with self.cv:
-            if self._stop:
+            if (self._stop and not force) or self._exited:
                 # closes the submit-vs-drain race: a ticket landing
                 # after the stop signal could otherwise strand forever
                 # on a thread that already exited
                 raise GatewayClosed("gateway is draining")
+            if self.state != HEALTHY:
+                # closes the route-vs-fail race: the router saw this
+                # replica healthy, the breaker opened before the
+                # enqueue landed — the caller re-routes
+                raise _ReplicaUnhealthy(
+                    f"replica {self.index} is {self.state}")
             ticket.replica = self.index
             self.queue.append(ticket)
             self.outstanding += ticket.cost
@@ -224,31 +344,80 @@ class _Replica:
     def _loop(self) -> None:
         while True:
             with self.cv:
+                epoch = self.epoch
                 while not self.queue and not self._server_busy() \
-                        and not self._stop:
-                    self.cv.wait()
+                        and not self._stop and self.epoch == epoch:
+                    self.cv.wait(timeout=self.gateway._beat_interval_s)
+                    # beat WHILE idle too — an idle replica that only
+                    # beat on work would look stalled to the watchdog
+                    self.gateway._beat(self)
                 if self._stop and not self.queue \
                         and not self._server_busy():
+                    self._exited = True
+                    # stop being watched: the watchdog now outlives the
+                    # join (it must — a step that wedges DURING drain
+                    # still needs its tickets failed over), so a
+                    # cleanly-exited thread going silent must not read
+                    # as a stall
+                    self.gateway._unwatch(self)
                     return
+                stale = self.epoch != epoch
+            self.gateway._beat(self)
+            if stale:
+                # the watchdog (or a probe race) declared us failed
+                # while we were idle — clean up and re-earn admission
+                if not self._recover():
+                    return
+                continue
             try:
-                self._admit_from_queue()
-                if self._server_busy():
-                    finished = self.server.step()
+                self._admit_from_queue(epoch)
+                with self.cv:
+                    stale = self.epoch != epoch
+                # declared failed during admission: the engine holds
+                # only ghosts now — stepping it would burn a full
+                # (multi-dispatch) round whose output is guaranteed to
+                # be discarded. _stream_deltas/_deliver fence
+                # internally, so the stale flag only skips the step.
+                if not stale:
+                    finished = (self.server.step()
+                                if self._server_busy() else [])
                     now = time.monotonic()
-                    self._stream_deltas(now)
-                    self._deliver(finished, now)
-            except Exception as e:  # a wedged replica must not strand
-                # its tickets with no terminal event: shed everything
-                # this replica holds, then keep consuming (each later
-                # ticket sheds fast rather than hanging its client)
+                    # INSIDE the try: an exception in the delivery half
+                    # (a metrics/history consumer, say) must take the
+                    # same failover path as a dead dispatch — outside,
+                    # it would kill this thread with state still
+                    # HEALTHY, a permanently-lost replica no probe can
+                    # ever resurrect
+                    self._stream_deltas(now, epoch)
+                    self._deliver(finished, now, epoch)
+            except Exception as e:
+                # a failed replica must not strand its tickets with no
+                # terminal event — but unlike the old shed-everything
+                # response, failure here means FAILOVER: the gateway
+                # steals every ticket we hold and requeues it on a
+                # healthy replica (token-exact re-run); we reset and
+                # enter the breaker
                 log.exception("replica %d step failed", self.index)
-                self._abort(f"replica {self.index} failure: "
-                            f"{type(e).__name__}: {e}")
+                self.gateway._fail_replica(
+                    self, epoch, f"replica {self.index} step failed: "
+                    f"{type(e).__name__}: {e}")
+                if not self._recover():
+                    return
+                continue
+            with self.cv:
+                stale = self.epoch != epoch
+            if stale:
+                # the step wedged long enough for the watchdog to fire:
+                # our tickets are already re-running elsewhere — any
+                # output was a previous epoch's and was discarded by
+                # the internal fences; re-earn admission
+                if not self._recover():
+                    return
 
     def _server_busy(self) -> bool:
         return bool(self.server.slots.n_active or self.server.n_pending)
 
-    def _admit_from_queue(self) -> None:
+    def _admit_from_queue(self, epoch: int) -> None:
         """Move tickets into the engine, AT MOST as many as there are
         free slots — the deadline check runs at the moment a slot is
         genuinely available, so an expired request is shed having never
@@ -263,7 +432,8 @@ class _Replica:
             if ticket.deadline is not None and now >= ticket.deadline:
                 self._shed(ticket, 504,
                            f"deadline exceeded after "
-                           f"{now - ticket.t_submit:.3f}s in queue")
+                           f"{now - ticket.t_submit:.3f}s in queue",
+                           epoch=epoch)
                 continue
             req = ticket.request
             engine_id = self._next_id
@@ -275,43 +445,81 @@ class _Replica:
                     seed=req.seed, id=engine_id))
             except QueueFull:
                 # engine bound hit (shouldn't happen: we feed at most
-                # free-slot many) — put it back and stop admitting
+                # free-slot many) — put it back and stop admitting.
+                # Epoch-fenced like every other path here: appending to
+                # a replica whose steal already ran would park the
+                # ticket on a BROKEN queue forever
                 with self.cv:
-                    self.queue.appendleft(ticket)
+                    if self.epoch == epoch:
+                        self.queue.appendleft(ticket)
+                        return
+                self.gateway._failover(
+                    self, [], [ticket],
+                    f"replica {self.index} failed during admission")
                 return
             except ValueError as e:
-                self._shed(ticket, 400, str(e))
+                self._shed(ticket, 400, str(e), epoch=epoch)
                 continue
-            ticket.t_admit = now
-            ticket.state = RUNNING
-            self._tickets[engine_id] = ticket
+            with self.cv:
+                if self.epoch != epoch:
+                    # declared failed mid-admission: the ticket we just
+                    # popped was missed by the steal — requeue it
+                    # untouched (the engine ghost dies in the reset)
+                    stray = ticket
+                else:
+                    ticket.t_admit = now
+                    ticket.state = RUNNING
+                    self._tickets[engine_id] = ticket
+                    stray = None
+            if stray is not None:
+                self.gateway._failover(
+                    self, [], [stray],
+                    f"replica {self.index} failed during admission")
+                return
             free -= 1
 
-    def _stream_deltas(self, now: float) -> None:
-        emitted = {eid: t._n_emitted for eid, t in self._tickets.items()}
-        for engine_id, new in self.server.live_progress(emitted).items():
-            ticket = self._tickets.get(engine_id)
-            if ticket is None or not new:
-                continue
-            if ticket.t_first is None:
-                ticket.t_first = now
-            ticket._n_emitted += len(new)
-            ticket._emit(("tokens", new))
+    def _stream_deltas(self, now: float, epoch: int) -> None:
+        with self.cv:
+            if self.epoch != epoch:
+                return
+            tickets = dict(self._tickets)
+            emitted = {eid: t._n_emitted for eid, t in tickets.items()}
+        progress = self.server.live_progress(emitted)
+        # no second epoch fence: emission is offset-based and
+        # per-ticket-serialized (Ticket._emit_tokens), so even a delta
+        # computed just before a steal lands exactly — the failover
+        # replica's resumed stream skips whatever this emit covered,
+        # and vice versa. No replica lock is held across the emits.
+        for engine_id, new in progress.items():
+            ticket = tickets.get(engine_id)
+            if ticket is not None and new:
+                ticket._emit_tokens(emitted[engine_id], new, now)
 
-    def _deliver(self, finished, now: float) -> None:
+    def _deliver(self, finished, now: float, epoch: int) -> None:
         for res in finished:
-            ticket = self._tickets.pop(res.id, None)
+            with self.cv:
+                if self.epoch != epoch:
+                    # failed mid-delivery: remaining tickets were
+                    # stolen and will re-run token-exactly elsewhere
+                    return
+                ticket = self._tickets.pop(res.id, None)
+                if ticket is not None:
+                    self.outstanding = max(0,
+                                           self.outstanding - ticket.cost)
+                    self.consecutive_failures = 0  # real work
+                    # delivered: the breaker's failure streak is over.
+                    # Reset INSIDE the fence: unfenced, it could race a
+                    # concurrent _fail_replica increment and wipe the
+                    # streak a flapping replica needs to reach
+                    # quarantine_after
             if ticket is None:
                 continue
-            if ticket.t_first is None:
-                ticket.t_first = now
-            tail = res.tokens[ticket._n_emitted:]
-            if tail:
-                ticket._emit(("tokens", tail))
+            # the whole sequence as one absolute window: _emit_tokens
+            # dedups past the client's cursor, so this emits exactly
+            # the un-streamed tail (all of it, for unary requests)
+            ticket._emit_tokens(0, res.tokens, now)
             ticket.state = DONE
             self.completed += 1
-            with self.cv:
-                self.outstanding -= ticket.cost
             metrics = self._request_metrics(ticket, res, now)
             ticket.metrics = metrics  # unary responders read it after
             # result(); same record the stream's final line carries
@@ -342,31 +550,114 @@ class _Replica:
             "drafted": res.drafted,
             "accepted": res.accepted,
             "draft_hit_rate": round(res.draft_hit_rate, 4),
+            "attempts": ticket.attempts,  # failed engine runs this
+            # request survived (0 = no failover; latency fields span
+            # the whole life, retries included)
             "finish_reason": res.finish_reason,
         }
 
-    def _shed(self, ticket: Ticket, status: int, reason: str) -> None:
-        ticket.state = SHED
+    def _shed(self, ticket: Ticket, status: int, reason: str,
+              epoch: int | None = None) -> None:
         self.shed += 1
         with self.cv:
-            self.outstanding -= ticket.cost
+            if epoch is None or self.epoch == epoch:
+                # fenced + clamped: a steal that raced the caller's
+                # queue pop already zeroed outstanding wholesale —
+                # subtracting again would drive it negative and skew
+                # least-outstanding routing forever after rejoin
+                self.outstanding = max(0, self.outstanding - ticket.cost)
         self.gateway._record_shed(self, status)
-        ticket._emit(("shed", status, reason))
+        with ticket._emit_lock:
+            # state flip + terminal emit together: a previous owner's
+            # late token delta can't land after the final shed event
+            ticket.state = SHED
+            ticket._emit(("shed", status, reason))
 
-    def _abort(self, reason: str) -> None:
-        """Terminal-event every ticket this replica holds (engine-
-        admitted AND queued) after an unrecoverable step failure."""
-        for ticket in list(self._tickets.values()):
-            self._shed(ticket, 500, reason)
-        self._tickets.clear()
-        self.server.reset()  # pending + _live + slots together: slots
-        # alone would leave engine ghosts decoding phantom results
+    # ------------------------------------------------- breaker recovery
+
+    def _recover(self) -> bool:
+        """The circuit-breaker cycle, on this replica's own thread,
+        entered after a declared failure (exception or watchdog stall;
+        tickets already stolen and failed over by the gateway): reset
+        the engine, wait out the exponential backoff, run a PROBE
+        generation, and either rejoin the routing set (re-earning the
+        watchdog's watch) or go around again. ``quarantine_after``
+        consecutive failures (probe failures included) quarantine the
+        replica — parked out of the rotation until shutdown. Returns
+        False when the gateway is stopping: the thread exits."""
+        gw = self.gateway
         while True:
+            try:
+                self.server.reset()  # pending + _live + slots together:
+                # slots alone would leave engine ghosts decoding phantom
+                # results for tickets now re-running elsewhere
+            except Exception:
+                log.exception("replica %d engine reset failed", self.index)
+            if self.consecutive_failures >= gw.quarantine_after:
+                with self.cv:
+                    if self.state != QUARANTINED:
+                        self.state = QUARANTINED
+                        gw._note_quarantine(self)
+                    while not self._stop:  # out of the rotation for
+                        # good; park so drain() can still join us
+                        self.cv.wait(timeout=gw._beat_interval_s)
+                        # refresh like the backoff loop: the thread is
+                        # alive and parked BY DESIGN — /healthz must
+                        # not show an unboundedly climbing age that
+                        # reads as a dead thread
+                        self.last_beat = time.monotonic()
+                    self._exited = True
+                return False
+            backoff = min(gw.breaker_max_s, gw.breaker_base_s
+                          * (2 ** max(0, self.consecutive_failures - 1)))
+            deadline = time.monotonic() + backoff
             with self.cv:
-                if not self.queue:
-                    return
-                ticket = self.queue.popleft()
-            self._shed(ticket, 500, reason)
+                while not self._stop and time.monotonic() < deadline:
+                    self.cv.wait(timeout=min(gw._beat_interval_s,
+                                             backoff))
+                    self.last_beat = time.monotonic()
+                if self._stop:
+                    self._exited = True
+                    return False
+                self.state = PROBING
+            self.probes += 1
+            gw._note_probe(self)
+            t0 = time.monotonic()
+            try:
+                # a real (tiny) generation through the same engine paths
+                # traffic takes — prefill, decode, evict. The fault
+                # plan's hooks fire here too, so a ``times=-1`` fault
+                # keeps a replica down through every probe.
+                self.server.submit(Request([1], max_new_tokens=2,
+                                           id="__probe__"))
+                for _ in range(64):
+                    self.server.step()
+                    if self.server.done:
+                        break
+                else:
+                    raise RuntimeError("probe did not finish in 64 steps")
+                took = time.monotonic() - t0
+                if took > gw.stall_timeout_s:
+                    # a wedged-but-eventually-returning probe is a
+                    # failed probe: real traffic would have stalled
+                    raise RuntimeError(f"probe wedged for {took:.1f}s")
+                self.server.reset()
+            except Exception as e:  # noqa: BLE001 — ANY probe failure
+                # means another breaker lap, never a crashed supervisor
+                log.warning("replica %d probe failed: %s: %s",
+                            self.index, type(e).__name__, e)
+                self.consecutive_failures += 1
+                with self.cv:
+                    self.state = BROKEN
+                continue
+            with self.cv:
+                self.state = HEALTHY
+                self.last_beat = time.monotonic()
+            self.rejoins += 1
+            gw._note_rejoin(self)
+            log.warning("replica %d probe succeeded: rejoining the "
+                        "routing set", self.index)
+            return True
 
     def stats(self) -> dict:
         out = {
@@ -376,6 +667,15 @@ class _Replica:
             "outstanding_tokens": self.outstanding,
             "completed": self.completed,
             "shed": self.shed,
+            # supervision: state is a string (MetricsStore's numeric
+            # filter drops it; /stats and /healthz carry it)
+            "state": self.state,
+            "epoch": self.epoch,
+            "heartbeat_age_s": round(time.monotonic() - self.last_beat, 3),
+            "failures": self.failures,
+            "consecutive_failures": self.consecutive_failures,
+            "probes": self.probes,
+            "rejoins": self.rejoins,
         }
         # engine counters (prefills, decode_steps, dispatches, the
         # prefix_* family) flat, so the MetricsStore numeric filter and
@@ -406,6 +706,13 @@ class _Stats:
         self.prefill_tokens_saved = 0
         self.drafted = 0
         self.draft_accepted = 0
+        # supervision (the TonY retry-counter analog)
+        self.replica_failures = 0  # HEALTHY -> BROKEN transitions
+        self.failovers = 0         # tickets requeued onto another replica
+        self.retries = 0           # failed engine runs charged to tickets
+        self.probes = 0
+        self.rejoins = 0
+        self.quarantines = 0
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -494,7 +801,10 @@ class Gateway:
 
     def __init__(self, servers: list[Server], *, max_queue: int = 128,
                  default_ttl_s: float | None = None,
-                 metrics_store=None, history: GatewayHistory | None = None):
+                 metrics_store=None, history: GatewayHistory | None = None,
+                 max_attempts: int = 3, stall_timeout_s: float = 30.0,
+                 breaker_base_s: float = 0.25, breaker_max_s: float = 8.0,
+                 quarantine_after: int = 5):
         if not servers:
             raise ValueError("gateway needs at least one replica server")
         self.replicas = [_Replica(i, s, self) for i, s in enumerate(servers)]
@@ -502,6 +812,17 @@ class Gateway:
         self.default_ttl_s = default_ttl_s
         self.metrics_store = metrics_store
         self.history = history
+        # supervision knobs (the TonY AM's heartbeat/retry settings,
+        # serving flavor). stall_timeout_s must comfortably exceed one
+        # step's WORST dispatch time (first-compile included when the
+        # compile cache is cold) or healthy replicas get declared dead.
+        self.max_attempts = max(1, max_attempts)
+        self.stall_timeout_s = stall_timeout_s
+        self.breaker_base_s = breaker_base_s
+        self.breaker_max_s = breaker_max_s
+        self.quarantine_after = max(1, quarantine_after)
+        self._beat_interval_s = max(0.05, stall_timeout_s / 10)
+        self._watchdog = None
         self.stats = _Stats()
         self._lock = threading.Lock()
         self._drain_lock = threading.Lock()
@@ -513,7 +834,17 @@ class Gateway:
     # --------------------------------------------------------- lifecycle
 
     def start(self) -> "Gateway":
+        from tony_tpu.coordinator.liveness import LivenessMonitor
+
+        # the watchdog IS the coordinator's LivenessMonitor (the TonY
+        # AM heartbeat expiry machinery): expiry = stall_timeout_s,
+        # checked at a 1/5 cadence. It catches the failure exceptions
+        # cannot: a dispatch that WEDGES instead of raising.
+        self._watchdog = LivenessMonitor(
+            interval_ms=max(1, int(self.stall_timeout_s * 1000 / 5)),
+            max_missed=5, on_expired=self._on_stall).start()
         for r in self.replicas:
+            self._watchdog.register(str(r.index))
             r.start()
         self._started = True
         return self
@@ -546,6 +877,18 @@ class Gateway:
                     else max(0.0, deadline - time.monotonic())
                 r.join(left)
                 ok = ok and not r._thread.is_alive()
+            # stop the watchdog only AFTER the join: a dispatch that
+            # wedges while its replica drains still gets declared
+            # stalled and its tickets failed over (or terminal-shed
+            # 503 once every other replica has exited) — the
+            # no-stranded-ticket promise holds through shutdown. A
+            # replica that finishes its queue and exits unregisters
+            # itself, so a busy-but-progressing final join is never
+            # misread as a stall.
+            wd = self._watchdog
+            self._watchdog = None
+            if wd is not None:
+                wd.stop()
             if self.history is not None:
                 self.history.close("SUCCEEDED" if ok else "KILLED",
                                    self.stats.snapshot())
@@ -562,7 +905,9 @@ class Gateway:
         """Admission gate + router. Raises ``GatewayClosed`` (503) when
         draining, ``BadRequest`` (400) on invalid shapes,
         ``GatewayQueueFull`` (429) past ``max_queue`` waiting requests,
-        ``DeadlineExceeded`` (504) for an already-dead ttl."""
+        ``DeadlineExceeded`` (504) for an already-dead ttl,
+        ``NoHealthyReplicas`` (503) when every replica's breaker is
+        open."""
         if self._closed:
             self.stats_shed(503)
             raise GatewayClosed("gateway is draining")
@@ -590,31 +935,230 @@ class Gateway:
                 self.stats_shed(429)
                 raise GatewayQueueFull(
                     f"admission queue at max_queue={self.max_queue}")
-            replica = self._route(request)
             ticket = Ticket(request,
                             None if ttl is None
                             else time.monotonic() + ttl, on_event)
-            try:
-                # enqueue INSIDE the gateway lock: the bound check and
-                # the depth increment must be atomic or two concurrent
-                # submits both pass at max_queue - 1 and overshoot.
-                # Lock order gateway._lock -> replica.cv is safe: no
-                # replica-thread path takes the gateway lock.
-                replica.enqueue(ticket)
-            except GatewayClosed:  # the drain race
-                self.stats_shed(503)
-                raise
+            tried: set[int] = set()
+            while True:
+                try:
+                    replica = self._route(request, tried)
+                except NoHealthyReplicas:
+                    self.stats_shed(503)
+                    raise
+                try:
+                    # enqueue INSIDE the gateway lock: the bound check
+                    # and the depth increment must be atomic or two
+                    # concurrent submits both pass at max_queue - 1 and
+                    # overshoot. Lock order gateway._lock -> replica.cv
+                    # is safe: no replica-thread path takes the gateway
+                    # lock.
+                    replica.enqueue(ticket)
+                    break
+                except _ReplicaUnhealthy:
+                    tried.add(replica.index)  # flipped between route
+                    # and enqueue: re-route among the others
+                except GatewayClosed:  # the drain race
+                    self.stats_shed(503)
+                    raise
         with self.stats.lock:
             self.stats.accepted += 1
         return ticket
 
-    def _route(self, request: GenRequest) -> _Replica:
-        """Session affinity when asked; least outstanding tokens
-        otherwise (ties -> lowest index, deterministic)."""
+    def _route(self, request: GenRequest,
+               excluded: set | frozenset = frozenset()) -> _Replica:
+        """Session affinity when asked (degraded to least-outstanding
+        when the pinned replica is down — affinity is a cache
+        preference, not a correctness requirement); least outstanding
+        tokens otherwise (ties -> lowest index, deterministic). Only
+        HEALTHY replicas outside ``excluded`` are candidates; none left
+        raises ``NoHealthyReplicas`` (503, retriable)."""
+        healthy = [r for r in self.replicas
+                   if r.state == HEALTHY and r.index not in excluded]
+        if not healthy:
+            raise NoHealthyReplicas(
+                "no healthy replica (states: "
+                + ", ".join(r.state for r in self.replicas) + ")")
         if request.session is not None:
             key = zlib.crc32(str(request.session).encode())
-            return self.replicas[key % len(self.replicas)]
-        return min(self.replicas, key=lambda r: (r.outstanding, r.index))
+            pinned = self.replicas[key % len(self.replicas)]
+            if pinned in healthy:
+                return pinned
+        return min(healthy, key=lambda r: (r.outstanding, r.index))
+
+    # ------------------------------------------------------- supervision
+
+    def _beat(self, replica: _Replica) -> None:
+        """One heartbeat from a replica's scheduler thread (once per
+        iteration, including idle waits)."""
+        replica.last_beat = time.monotonic()
+        wd = self._watchdog  # snapshot: drain() nulls the attribute
+        # concurrently, and an AttributeError here would kill the
+        # replica thread mid-drain with tickets still queued
+        if wd is not None:
+            wd.ping(str(replica.index))
+
+    def _unwatch(self, replica: _Replica) -> None:
+        """A replica thread exiting cleanly (drain finished its queue)
+        takes itself off the watchdog's list — its silence is not a
+        stall."""
+        wd = self._watchdog  # snapshot (see _beat)
+        if wd is not None:
+            wd.unregister(str(replica.index))
+
+    def _on_stall(self, task_id: str) -> None:
+        """Watchdog expiry: the replica's thread stopped beating —
+        a WEDGED dispatch (the failure exceptions cannot catch). Runs
+        on the monitor thread; the wedged thread finds the bumped epoch
+        whenever its dispatch finally returns and discards the stale
+        output."""
+        replica = self.replicas[int(task_id)]
+        with replica.cv:
+            epoch = replica.epoch
+        self._fail_replica(
+            replica, epoch,
+            f"replica {replica.index} stalled: no heartbeat for "
+            f"{self.stall_timeout_s:.1f}s")
+
+    def _fail_replica(self, replica: _Replica, epoch: int,
+                      reason: str) -> None:
+        """Declare a replica failed (exception route from its own
+        thread, stall route from the watchdog): bump its epoch (the
+        fencing token — stale output from the old epoch is discarded),
+        steal EVERY ticket it holds, and fail them over. Idempotent
+        under the race of both routes firing: the epoch check makes the
+        second caller a no-op."""
+        with replica.cv:
+            if replica.epoch != epoch or replica.state != HEALTHY:
+                return  # already handled (exception-vs-watchdog race)
+            replica.epoch += 1
+            replica.state = BROKEN
+            replica.failures += 1
+            replica.consecutive_failures += 1
+            admitted = list(replica._tickets.values())
+            replica._tickets.clear()
+            queued = list(replica.queue)
+            replica.queue.clear()
+            replica.outstanding = 0
+            replica.cv.notify_all()
+        wd = self._watchdog  # snapshot (see _beat)
+        if wd is not None:
+            wd.unregister(str(replica.index))
+        with self.stats.lock:
+            self.stats.replica_failures += 1
+        log.error("%s: failing over %d admitted + %d queued ticket(s)",
+                  reason, len(admitted), len(queued))
+        self._failover(replica, admitted, queued, reason)
+
+    def _failover(self, replica: _Replica, admitted: list,
+                  queued: list, reason: str) -> None:
+        """The TonY task-retry analog, token-exact: ``admitted``
+        tickets ran on the failed engine — charge one attempt, exclude
+        the replica, re-run from the prompt (deterministic decode +
+        ``_n_emitted`` make the retried stream byte-identical past what
+        the client already has). ``queued`` tickets never touched the
+        engine: moved untouched, no attempt charged, no exclusion.
+        Budget or fleet exhaustion sheds 503 (retriable) — never 500."""
+        for ticket in admitted:
+            ticket.attempts += 1
+            ticket.excluded.add(replica.index)
+        if admitted:
+            with self.stats.lock:
+                self.stats.retries += len(admitted)
+        for ticket in admitted + queued:
+            ticket.state = QUEUED
+            ticket.replica = None
+            if ticket.attempts >= self.max_attempts:
+                self._shed_ticket(
+                    replica, ticket, 503,
+                    f"retry budget exhausted: {ticket.attempts} failed "
+                    f"run(s) on replicas {sorted(ticket.excluded)} "
+                    f"({reason})", exc=RetryBudgetExhausted)
+                continue
+            self._requeue(replica, ticket, reason)
+
+    def _requeue(self, replica: _Replica, ticket: Ticket,
+                 reason: str) -> None:
+        """Land a stolen ticket on a healthy replica (outside its
+        excluded set), or shed it 503. ``force=True`` bypasses the
+        drain gate — the zero-loss drain promise covers stolen tickets
+        too, as long as a live thread can still run them."""
+        tried: set[int] = set()
+        while True:
+            try:
+                target = self._route(ticket.request,
+                                     ticket.excluded | tried)
+            except NoHealthyReplicas:
+                self._shed_ticket(
+                    replica, ticket, 503,
+                    f"no healthy replica left ({reason})",
+                    exc=NoHealthyReplicas)
+                return
+            try:
+                target.enqueue(ticket, force=True)
+            except (GatewayClosed, _ReplicaUnhealthy):
+                tried.add(target.index)  # raced its own failure/exit
+                continue
+            with self.stats.lock:
+                self.stats.failovers += 1
+            return
+
+    def _shed_ticket(self, replica: _Replica, ticket: Ticket,
+                     status: int, reason: str,
+                     exc: type | None = None) -> None:
+        """Terminal-event a stolen ticket the gateway gave up on,
+        charged to the FAILED replica's shed count so per-replica
+        /stats reconciles with ``shed_by_status`` (its ``outstanding``
+        was already zeroed wholesale by the steal, so that is NOT
+        touched). ``exc`` tells ``Ticket.result()`` which Shed subclass
+        to raise when the bare status is ambiguous (the 503 family)."""
+        with ticket._emit_lock:
+            # state flip + terminal emit under the emit lock: a failed
+            # replica's late token delta can't slip in AFTER the shed
+            # event the client treats as final
+            ticket.state = SHED
+            ticket._shed_exc_cls = exc
+            replica.shed += 1
+            self._record_shed(replica, status)
+            ticket._emit(("shed", status, reason))
+
+    def _note_probe(self, replica: _Replica) -> None:
+        with self.stats.lock:
+            self.stats.probes += 1
+
+    def _note_rejoin(self, replica: _Replica) -> None:
+        wd = self._watchdog  # snapshot (see _beat)
+        if wd is not None:
+            wd.register(str(replica.index))
+        with self.stats.lock:
+            self.stats.rejoins += 1
+
+    def _note_quarantine(self, replica: _Replica) -> None:
+        log.error("replica %d quarantined after %d consecutive "
+                  "failures", replica.index, replica.consecutive_failures)
+        with self.stats.lock:
+            self.stats.quarantines += 1
+
+    @property
+    def n_healthy(self) -> int:
+        return sum(1 for r in self.replicas if r.state == HEALTHY)
+
+    def health(self) -> dict:
+        """The /healthz payload: per-replica breaker state + heartbeat
+        age, so a load balancer sees a DEGRADED gateway (one replica
+        down, still serving) before anything 503s."""
+        now = time.monotonic()
+        n = self.n_healthy
+        return {
+            "status": "ok" if n == len(self.replicas)
+            else ("degraded" if n else "down"),
+            "healthy": n,
+            "replicas": [{
+                "replica": r.index,
+                "state": r.state,
+                "heartbeat_age_s": round(now - r.last_beat, 3),
+                "consecutive_failures": r.consecutive_failures,
+            } for r in self.replicas],
+        }
 
     # -------------------------------------------------------- accounting
 
@@ -642,7 +1186,12 @@ class Gateway:
         if self.history is not None:
             try:
                 self.history.record(metrics)
-            except OSError:
+            except Exception:
+                # ANY failure (disk, or a request id json can't take):
+                # a dropped history row must never cost the client its
+                # done event — the ticket was already popped from
+                # _tickets, so it is invisible to the failover steal
+                # and a raise here would strand it terminal-event-less
                 log.exception("history metrics write failed")
         self._push_replica_metrics(replica)
 
@@ -665,6 +1214,19 @@ class Gateway:
         out["queued"] = sum(r.n_queued for r in self.replicas)
         out["max_queue"] = self.max_queue
         out["engine"] = self._engine_summary()
+        with self.stats.lock:
+            out["supervision"] = {
+                "healthy_replicas": self.n_healthy,
+                "replicas": len(self.replicas),
+                "max_attempts": self.max_attempts,
+                "stall_timeout_s": self.stall_timeout_s,
+                "replica_failures": self.stats.replica_failures,
+                "failovers": self.stats.failovers,
+                "retries": self.stats.retries,
+                "probes": self.stats.probes,
+                "rejoins": self.stats.rejoins,
+                "quarantines": self.stats.quarantines,
+            }
         return out
 
     def _engine_summary(self) -> dict:

@@ -10,9 +10,13 @@ admission queue. Endpoints:
                       {"id", "token_ids": [delta...]} line per step,
                       then a final line with finish_reason/metrics.
                       Otherwise one JSON object when done.
-  GET  /healthz       liveness: 200 while the process serves at all
-  GET  /readyz        admission: 200 accepting / 503 draining (the
-                      load-balancer signal during graceful shutdown)
+  GET  /healthz       liveness: 200 while the process serves at all;
+                      body = per-replica breaker state + heartbeat age
+                      ("ok" / "degraded" / "down" — the early-warning
+                      signal before /readyz flips)
+  GET  /readyz        admission: 200 accepting / 503 draining OR zero
+                      healthy replicas (the load-balancer signal
+                      during graceful shutdown and total outage)
   GET  /stats         the Gateway.snapshot() JSON (counters, queue
                       depths, p50/p95/p99 queue-wait/TTFT/TPOT, and
                       the engine rollup — prefills/decode steps/
@@ -56,10 +60,17 @@ class GatewayHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         path = self.path.partition("?")[0]
         if path == "/healthz":
-            return self._send(200, {"status": "ok"})
+            # 200 while the PROCESS serves at all — but the body now
+            # carries per-replica breaker state + heartbeat age, so a
+            # balancer sees "degraded" before anything 503s
+            return self._send(200, self.gateway.health())
         if path == "/readyz":
-            if self.gateway.ready:
+            if self.gateway.ready and self.gateway.n_healthy > 0:
                 return self._send(200, {"status": "ready"})
+            if self.gateway.ready:  # started, zero healthy replicas:
+                # every breaker is open — shed clean 503s until a
+                # probe rejoins one
+                return self._send(503, {"status": "no healthy replicas"})
             return self._send(503, {"status": "draining"
                                     if self.gateway.draining
                                     else "starting"})

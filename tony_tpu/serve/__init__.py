@@ -19,11 +19,15 @@ prompt-lookup drafting + one batched multi-token verify dispatch
 
 from tony_tpu.serve.engine import (QueueFull, Request, Result, Server,
                                    bucket_len)
+from tony_tpu.serve.faults import Fault, FaultPlan, InjectedFault
 from tony_tpu.serve.prefix import PrefixStore, tree_nbytes
 from tony_tpu.serve.slots import (SlotCache, cache_batch_axis,
                                   read_slot_row, write_slot_row)
 
 __all__ = [
+    "Fault",
+    "FaultPlan",
+    "InjectedFault",
     "PrefixStore",
     "QueueFull",
     "Request",

@@ -53,6 +53,7 @@ import numpy as np
 from tony_tpu.models.generate import (init_cache, multi_decode_step,
                                       normalize_eos_ids,
                                       single_decode_step)
+from tony_tpu.serve.faults import FaultPlan
 from tony_tpu.serve.prefix import PrefixStore
 from tony_tpu.serve.slots import SlotCache, _read_slot, cache_batch_axis
 
@@ -430,7 +431,8 @@ class Server:
     def __init__(self, model, params, *, batch_size: int = 4, eos_id=-1,
                  min_bucket: int = 16, chunk_steps: int = 8,
                  max_pending: int = 1024, prefix_cache_mb: float = 0.0,
-                 prefix_donate: bool = True, speculate_k: int = 0):
+                 prefix_donate: bool = True, speculate_k: int = 0,
+                 fault_plan: FaultPlan | None = None):
         if model.cfg.quantized:
             # nothing structural in the way — the q8 apply is the same
             # model.apply — but untested here; fail loud, not wrong
@@ -445,6 +447,10 @@ class Server:
                 "prefix cache over sliding-window models is untested")
         self.model = model
         self.params = params
+        # deterministic fault injection (serve/faults.py); None = off,
+        # zero overhead. Hooked at the top of step() and before each
+        # admission's prefill — the two places device work starts
+        self.fault_plan = fault_plan
         self.eos_ids = normalize_eos_ids(eos_id)
         self.min_bucket = min_bucket
         # upper bound on decode micro-steps fused into one dispatch;
@@ -553,6 +559,8 @@ class Server:
         prefills only the bucketed SUFFIX at a position offset. Either
         way the freshly covered prompt is (re)inserted so the next
         sharer hits."""
+        if self.fault_plan is not None:
+            self.fault_plan.on_admit(req.id)
         s = self.slots
         p = np.asarray(req.prompt, np.int32)
         max_len = self.model.cfg.max_seq_len
@@ -645,6 +653,8 @@ class Server:
 
     def step(self) -> list[Result]:
         """One scheduler iteration; returns requests that finished."""
+        if self.fault_plan is not None:
+            self.fault_plan.on_dispatch()
         finished: list[Result] = []
         while self.slots.free_slots():
             with self._pending_lock:

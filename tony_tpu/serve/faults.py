@@ -1,0 +1,171 @@
+"""Deterministic fault injection for the serving stack.
+
+TonY's defining robustness story — heartbeat the workers, fail the
+silent ones, retry their tasks elsewhere — is only real if the failure
+paths actually run. This module is the switch that runs them: a
+``FaultPlan`` is a list of pre-declared faults hooked into the two
+places a replica does device work (``Server.step()`` and request
+admission), so a test or a smoke script can say "the 3rd dispatch on
+replica 0 dies" or "this request wedges for two seconds" and get the
+SAME failure on every run — the gateway's supervision, failover, and
+circuit-breaker paths are pinned by tests instead of being dead code
+waiting for real hardware to misbehave.
+
+Two delivery routes:
+
+- **constructor**: ``Server(..., fault_plan=FaultPlan.fail_at(3))`` —
+  what the unit tests use.
+- **environment**: ``TONY_SERVE_FAULTS`` holds a JSON fault list; the
+  gateway CLI arms each replica's engine with the faults addressed to
+  it (``FaultPlan.from_env(replica=i)``), so a shell script can chaos-
+  test a real subprocess gateway (``make chaos-smoke``) without any
+  code hook.
+
+Fault spec fields (JSON object or ``Fault`` kwargs):
+
+  op        "fail" (raise ``InjectedFault``) or "wedge" (sleep —
+            simulates a stalled, not crashed, dispatch; the watchdog's
+            case)
+  dispatch  fire on ``step()`` calls numbered >= this (1-based count
+            per engine, probes included)
+  request   fire when this ENGINE request id is admitted (through the
+            gateway, engine ids are the replica's own deterministic
+            0,1,2... sequence; the breaker probe admits id
+            ``"__probe__"``, so a plan can keep probes failing)
+  seconds   wedge duration
+  times     firings before the fault is spent (default 1; -1 = every
+            match — a permanently broken replica)
+  replica   restrict an env fault to one replica index (None = all)
+
+A fired fault is logged loudly; ``InjectedFault`` subclasses
+``RuntimeError`` so nothing upstream special-cases it — it takes the
+exact path a real dispatch failure would.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+ENV_VAR = "TONY_SERVE_FAULTS"
+
+
+class InjectedFault(RuntimeError):
+    """The deterministic stand-in for a dead dispatch. Deliberately a
+    plain ``RuntimeError`` subclass: supervision must treat it exactly
+    like a real failure, or the tests prove nothing."""
+
+
+@dataclass
+class Fault:
+    """One pre-declared failure. See the module docstring for field
+    semantics; a fault needs at least one trigger (``dispatch`` or
+    ``request``)."""
+
+    op: str = "fail"
+    dispatch: int | None = None
+    request: Any = None
+    seconds: float = 0.0
+    times: int = 1
+    replica: int | None = None
+
+    def __post_init__(self):
+        if self.op not in ("fail", "wedge"):
+            raise ValueError(
+                f"fault op must be 'fail' or 'wedge', got {self.op!r}")
+        if self.dispatch is None and self.request is None:
+            raise ValueError("fault needs a trigger: dispatch or request")
+        if self.op == "wedge" and self.seconds <= 0:
+            raise ValueError("wedge fault needs seconds > 0")
+
+
+class FaultPlan:
+    """The engine-side hook object: owns its faults plus a dispatch
+    counter (one per engine — probes advance it too, so a spent fault
+    lets the breaker probe succeed while ``times=-1`` keeps a replica
+    down through every probe)."""
+
+    def __init__(self, faults):
+        self.faults = list(faults)
+        self.n_dispatches = 0
+        self.fired = 0
+
+    # --------------------------------------------------- construction
+
+    @classmethod
+    def from_env(cls, replica: int | None = None,
+                 env=None) -> "FaultPlan | None":
+        """Parse ``TONY_SERVE_FAULTS`` (a JSON fault object or list)
+        into the plan addressed to ``replica`` — None when the variable
+        is unset/empty or no fault targets this replica. Invalid specs
+        raise loudly: a chaos run with a silently ignored typo'd fault
+        would assert against a fault-free gateway."""
+        spec = (os.environ if env is None else env).get(ENV_VAR, "").strip()
+        if not spec:
+            return None
+        try:
+            docs = json.loads(spec)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{ENV_VAR} is not valid JSON: {e}") from None
+        if isinstance(docs, dict):
+            docs = [docs]
+        faults = []
+        for d in docs:
+            if not isinstance(d, dict):
+                raise ValueError(f"{ENV_VAR} entries must be objects: {d!r}")
+            f = Fault(**d)
+            if f.replica is None or replica is None or f.replica == replica:
+                faults.append(f)
+        return cls(faults) if faults else None
+
+    @classmethod
+    def fail_at(cls, dispatch: int, times: int = 1) -> "FaultPlan":
+        return cls([Fault("fail", dispatch=dispatch, times=times)])
+
+    @classmethod
+    def wedge_at(cls, dispatch: int, seconds: float,
+                 times: int = 1) -> "FaultPlan":
+        return cls([Fault("wedge", dispatch=dispatch, seconds=seconds,
+                          times=times)])
+
+    @classmethod
+    def fail_request(cls, request, times: int = 1) -> "FaultPlan":
+        return cls([Fault("fail", request=request, times=times)])
+
+    # --------------------------------------------------------- firing
+
+    def _fire(self, fault: Fault, what: str) -> None:
+        if fault.times > 0:
+            fault.times -= 1
+        self.fired += 1
+        if fault.op == "wedge":
+            log.warning("fault injection: wedging %.2fs at %s",
+                        fault.seconds, what)
+            time.sleep(fault.seconds)
+            return
+        log.warning("fault injection: failing %s", what)
+        raise InjectedFault(f"injected failure at {what}")
+
+    def on_dispatch(self) -> None:
+        """Hook at the top of ``Server.step()``; counts scheduler
+        dispatches and fires any armed dispatch-triggered fault."""
+        self.n_dispatches += 1
+        for f in self.faults:
+            if f.times == 0 or f.dispatch is None:
+                continue
+            if self.n_dispatches >= f.dispatch:
+                self._fire(f, f"dispatch {self.n_dispatches}")
+
+    def on_admit(self, request_id) -> None:
+        """Hook before a request's prefill admission dispatch."""
+        for f in self.faults:
+            if f.times == 0 or f.request is None:
+                continue
+            if f.request == request_id:
+                self._fire(f, f"admit of request {request_id!r}")

@@ -4,20 +4,117 @@
 # a shared-prefix round (same preamble, different tails) and assert the
 # prefix KV cache registered hits on /stats, run a speculation round
 # (repetitive prompt; /stats engine.spec must show accepted drafts and
-# the output must match a --speculate-k 0 control gateway), then
-# exercise the SIGTERM graceful drain. Every phase is bounded by
+# the output must match a --speculate-k 0 control gateway), exercise
+# the SIGTERM graceful drain, then a CHAOS round: a fresh 2-replica
+# gateway armed through TONY_SERVE_FAULTS has replica 0's dispatches
+# killed mid-run — every request must still answer 200 (failover, not
+# 5xx), /stats must show the supervision counters, and the dead
+# replica must rejoin via its breaker probe. Every phase is bounded by
 # `timeout`, so a hang exits nonzero instead of wedging CI.
 #
-# Usage: tools/serve_smoke.sh  (from the repo root; `make serve-smoke`)
+# Usage: tools/serve_smoke.sh       (repo root; `make serve-smoke`)
+#        SERVE_SMOKE_ROUNDS=chaos tools/serve_smoke.sh
+#                                   (chaos round only; `make chaos-smoke`)
 set -u
 
 PY=${PY:-python}
 BOUND=${SERVE_SMOKE_TIMEOUT:-300}   # whole-run ceiling, seconds
 WORK=$(mktemp -d /tmp/serve_smoke.XXXXXX)
+GW_PID=''
 CTRL_PID=''
-trap 'kill $GW_PID $CTRL_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
+CHAOS_PID=''
+trap 'kill $GW_PID $CTRL_PID $CHAOS_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
 
 fail() { echo "serve-smoke: FAIL: $1" >&2; exit 1; }
+
+# ---- chaos round (also standalone: SERVE_SMOKE_ROUNDS=chaos) ---------
+# the serving half of the TonY story: kill a replica's work, keep
+# serving. TONY_SERVE_FAULTS (serve/faults.py) deterministically fails
+# replica 0's 4th dispatch; with 6 concurrent requests in flight its
+# tickets must fail over token-exactly to replica 1 (zero 5xx), the
+# supervision counters must register the failure, and the breaker
+# probe must rejoin replica 0 (/healthz back to "ok").
+chaos_round() {
+    TONY_SERVE_FAULTS='{"op": "fail", "dispatch": 4, "replica": 0}' \
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --replicas 2 --port 0 --compile-cache '' \
+        --breaker-base 0.1 --breaker-max 1 \
+        >"$WORK/chaos_boot.log" 2>"$WORK/chaos_stderr.log" &
+    CHAOS_PID=$!
+    CHAOS_URL=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        CHAOS_URL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/chaos_boot.log")
+        [ -n "$CHAOS_URL" ] && break
+        kill -0 $CHAOS_PID 2>/dev/null || fail "chaos gateway died at boot: $(cat "$WORK/chaos_stderr.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$CHAOS_URL" ] || fail "chaos gateway did not print its URL within ${BOUND}s"
+    echo "serve-smoke: chaos gateway at $CHAOS_URL (replica 0 armed to die)"
+
+    CHAOS_PIDS=''
+    n=0
+    while [ $n -lt 6 ]; do
+        curl_s "$WORK/chaos_$n" "$CHAOS_URL/v1/generate" \
+            "{\"token_ids\": [$((1 + n)), 2, 3], \"max_new_tokens\": 8, \"id\": $n}" \
+            >"$WORK/chaos_${n}.code" &
+        CHAOS_PIDS="$CHAOS_PIDS $!"
+        n=$((n + 1))
+    done
+    wait $CHAOS_PIDS
+    n=0
+    while [ $n -lt 6 ]; do
+        # the whole point: a replica kill is failover, never a 5xx
+        [ "$(cat "$WORK/chaos_${n}.code")" = 200 ] || fail "chaos request $n -> $(cat "$WORK/chaos_${n}.code") (replica kill must fail over, not 5xx)"
+        grep -q '"finish_reason"' "$WORK/chaos_$n" || fail "chaos request $n: no finish_reason"
+        n=$((n + 1))
+    done
+
+    code=$(curl_s "$WORK/chaos_stats" "$CHAOS_URL/stats") || fail "chaos stats curl"
+    [ "$code" = 200 ] || fail "chaos stats -> $code"
+    $PY - "$WORK/chaos_stats" <<'EOF' || fail "chaos stats: supervision counters wrong"
+import json, sys
+stats = json.load(open(sys.argv[1]))
+assert stats["completed"] == 6, stats["completed"]
+assert stats["shed"] == {}, stats["shed"]  # zero 5xx
+sup = stats["supervision"]
+assert sup["replica_failures"] >= 1, sup
+assert sup["failovers"] >= 1 and sup["retries"] >= 1, sup
+EOF
+
+    # the dead replica must rejoin: /healthz back to "ok" (breaker
+    # probe succeeded; the injected fault was single-shot)
+    i=0
+    while [ $i -lt $BOUND ]; do
+        curl_s "$WORK/chaos_health" "$CHAOS_URL/healthz" >/dev/null 2>&1
+        grep -q '"status": "ok"' "$WORK/chaos_health" && break
+        sleep 1; i=$((i + 1))
+    done
+    grep -q '"status": "ok"' "$WORK/chaos_health" || fail "replica 0 never rejoined: $(cat "$WORK/chaos_health")"
+
+    # and serves real traffic again, then drains clean
+    code=$(curl_s "$WORK/chaos_after" "$CHAOS_URL/v1/generate" \
+        '{"token_ids": [7, 7], "max_new_tokens": 3}') || fail "post-rejoin curl"
+    [ "$code" = 200 ] || fail "post-rejoin request -> $code"
+    kill -TERM $CHAOS_PID
+    i=0
+    while kill -0 $CHAOS_PID 2>/dev/null; do
+        [ $i -ge $BOUND ] && fail "chaos gateway did not drain within ${BOUND}s of SIGTERM"
+        sleep 1; i=$((i + 1))
+    done
+    wait $CHAOS_PID
+    rc=$?
+    [ $rc = 0 ] || fail "chaos gateway exited $rc after SIGTERM"
+    CHAOS_PID=''
+    echo "serve-smoke: chaos OK (replica kill -> failover, zero 5xx, rejoin, clean drain)"
+}
+
+curl_s() { timeout -k 5 "$BOUND" curl -sS -o "$1" -w '%{http_code}' "$2" ${3:+-d "$3"}; }
+
+if [ "${SERVE_SMOKE_ROUNDS:-all}" = chaos ]; then
+    chaos_round   # `make chaos-smoke`: just the fault-injection round
+    exit 0
+fi
 
 # ---- boot the gateway on an ephemeral port ---------------------------
 JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
@@ -36,8 +133,6 @@ while [ $i -lt $BOUND ]; do
 done
 [ -n "$URL" ] || fail "gateway did not print its URL within ${BOUND}s"
 echo "serve-smoke: gateway at $URL"
-
-curl_s() { timeout -k 5 "$BOUND" curl -sS -o "$1" -w '%{http_code}' "$2" ${3:+-d "$3"}; }
 
 # ---- health ----------------------------------------------------------
 code=$(curl_s "$WORK/healthz" "$URL/healthz") || fail "healthz curl"
@@ -166,4 +261,9 @@ done
 wait $GW_PID
 rc=$?
 [ $rc = 0 ] || fail "gateway exited $rc after SIGTERM"
+GW_PID=''
 echo "serve-smoke: OK (10 requests, prefix hits, accepted drafts, clean drain)"
+
+# ---- chaos round: kill a replica's work, keep serving ----------------
+chaos_round
+echo "serve-smoke: ALL OK"
