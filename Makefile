@@ -11,8 +11,10 @@
 #   make test   - the full suite (~15-20 min on a 1-core box)
 #   make bench  - the driver-contract benchmark (one JSON line)
 #   make serve-smoke - boot a tiny-model gateway, concurrent curl
-#                 clients (unary + streaming), SIGTERM drain; every
-#                 phase `timeout`-bounded so a hang exits nonzero
+#                 clients (unary + streaming), a /metrics exposition +
+#                 /debug/trace + on-demand profile observability round,
+#                 SIGTERM drain; every phase `timeout`-bounded so a
+#                 hang exits nonzero
 #   make chaos-smoke - just the fault-injection round of serve-smoke:
 #                 a 2-replica gateway with replica 0's dispatches
 #                 killed via TONY_SERVE_FAULTS must keep serving

@@ -93,7 +93,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "HBM traffic — the serving default on TPU)")
     p.add_argument("--history", default="",
                    help="job-history root: record the gateway as a "
-                        "portal-browsable job with per-request metrics")
+                        "portal-browsable job with per-request metrics "
+                        "and Chrome-trace rows (metrics/traces.jsonl)")
+    p.add_argument("--profile-dir", default="",
+                   help="where POST /debug/profile drops its xplane "
+                        "captures (default: <history job dir>/profiles "
+                        "with --history, else ./profiles)")
+    p.add_argument("--trace-capacity", type=int, default=256,
+                   help="recent request traces kept for "
+                        "GET /debug/trace/<request_id>; 0 disables "
+                        "request tracing")
     p.add_argument("--drain-timeout", type=float, default=120.0,
                    help="max seconds to wait for in-flight requests on "
                         "shutdown")
@@ -167,6 +176,7 @@ def build_gateway(args, model, params, eos, *, metrics_store=None):
     if args.history:
         history = GatewayHistory(args.history,
                                  n_replicas=len(servers))
+    trace_capacity = getattr(args, "trace_capacity", 256)
     return Gateway(servers, max_queue=args.max_queue,
                    default_ttl_s=args.default_ttl,
                    metrics_store=metrics_store, history=history,
@@ -174,7 +184,10 @@ def build_gateway(args, model, params, eos, *, metrics_store=None):
                    stall_timeout_s=args.stall_timeout,
                    breaker_base_s=args.breaker_base,
                    breaker_max_s=args.breaker_max,
-                   quarantine_after=args.quarantine_after)
+                   quarantine_after=args.quarantine_after,
+                   tracing=trace_capacity > 0,
+                   trace_capacity=max(1, trace_capacity),
+                   profile_dir=getattr(args, "profile_dir", "") or None)
 
 
 def main(argv=None) -> int:

@@ -29,10 +29,20 @@ of role tasks onto a container pool). The pieces, front to back:
   queue and in-flight slots, then join the threads — zero accepted
   requests lost.
 - Every finished request records queue-wait / TTFT / TPOT / tokens
-  in+out: into the rolling ``/stats`` window (p50/p99), into a
+  in+out: into the rolling ``/stats`` window (p50/p99), into lifetime
+  fixed-bucket histograms (the ``/metrics`` exposition), into a
   ``metrics.MetricsStore`` under ``gateway:replica-<i>`` (the
   coordinator-side sink TaskMetricsMonitor pushes to), and optionally
   into a portal-browsable history job (``GatewayHistory``).
+- OBSERVABILITY (the TonY every-job-leaves-a-record story, request
+  granularity — ``tony_tpu.obs``, docs/OBSERVABILITY.md): every ticket
+  accumulates a span trace (attempt per replica placement, queue-wait,
+  the engine dispatches it rode; a failover's both attempts in ONE
+  trace) exported as Chrome trace-event JSON via ``/debug/trace/<id>``
+  and history ``metrics/traces.jsonl``; the engines' per-dispatch
+  timelines surface as ``/stats`` dispatch blocks; ``/metrics`` renders
+  everything as Prometheus text; ``POST /debug/profile`` arms an
+  on-demand jax.profiler capture polled by the replica threads.
 - SUPERVISION (the TonY ApplicationMaster story, ported to serving):
   every replica thread heartbeats per scheduler iteration; a
   ``LivenessMonitor`` watchdog declares a replica failed when its
@@ -65,11 +75,14 @@ import os
 import queue
 import threading
 import time
+import uuid
 import zlib
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable
 
+from tony_tpu.obs import Histogram, RequestTrace, TraceBuffer
+from tony_tpu.obs.timeline import DispatchTimeline
 from tony_tpu.serve import QueueFull, Request, Server
 
 log = logging.getLogger(__name__)
@@ -139,6 +152,10 @@ class GenRequest:
     id: Any = None
     ttl_s: float | None = None
     session: str | None = None
+    # set by the HTTP layer: when the front door read the request off
+    # the wire (time.monotonic()); the trace's http_receive span —
+    # None for in-process submits, whose trace starts at submit
+    t_receive: float | None = None
 
 
 # ticket lifecycle states
@@ -179,8 +196,10 @@ class Ticket:
         self.request = request
         self.deadline = deadline
         self.t_submit = time.monotonic()
+        self.t_queued = self.t_submit  # refreshed per enqueue (failover)
         self.t_admit: float | None = None
         self.t_first: float | None = None
+        self.trace: RequestTrace | None = None  # set by Gateway.submit
         self.replica: int | None = None
         self.state = QUEUED
         self.metrics: dict | None = None  # the done-event record
@@ -291,6 +310,7 @@ class _Replica:
         #                       after this is ever processed
         self._tickets: dict[int, Ticket] = {}  # engine id -> ticket
         self._next_id = 0
+        self._tl_cursor = 0  # dispatch-timeline read position (tracing)
         self._thread = threading.Thread(target=self._loop,
                                         name=f"gateway-replica-{index}",
                                         daemon=True)
@@ -314,6 +334,12 @@ class _Replica:
                 raise _ReplicaUnhealthy(
                     f"replica {self.index} is {self.state}")
             ticket.replica = self.index
+            ticket.t_queued = time.monotonic()
+            if ticket.trace is not None:
+                # one attempt span per placement on a replica; its
+                # epoch is the fencing tag the failover story pivots on
+                ticket.trace.begin_attempt(self.index, self.epoch,
+                                           t0=ticket.t_queued)
             self.queue.append(ticket)
             self.outstanding += ticket.cost
             self.cv.notify()
@@ -379,8 +405,13 @@ class _Replica:
                 # be discarded. _stream_deltas/_deliver fence
                 # internally, so the stale flag only skips the step.
                 if not stale:
-                    finished = (self.server.step()
-                                if self._server_busy() else [])
+                    busy = self._server_busy()
+                    finished = self.server.step() if busy else []
+                    if busy:
+                        # one WORKING iteration: the on-demand serving
+                        # profiler counts it (near-free attribute read
+                        # while no capture is armed)
+                        self.gateway.profiler.poll()
                     now = time.monotonic()
                     # INSIDE the try: an exception in the delivery half
                     # (a metrics/history consumer, say) must take the
@@ -388,6 +419,7 @@ class _Replica:
                     # it would kill this thread with state still
                     # HEALTHY, a permanently-lost replica no probe can
                     # ever resurrect
+                    self._attach_dispatch_spans(epoch)
                     self._stream_deltas(now, epoch)
                     self._deliver(finished, now, epoch)
             except Exception as e:
@@ -476,7 +508,48 @@ class _Replica:
                     self, [], [stray],
                     f"replica {self.index} failed during admission")
                 return
+            if ticket.trace is not None:
+                ticket.trace.add("queue_wait", ticket.t_queued, now,
+                                 attempt_key=(self.index, epoch),
+                                 engine_id=engine_id)
             free -= 1
+
+    def _attach_dispatch_spans(self, epoch: int) -> None:
+        """Fold the engine's new ``DispatchRecord``s into the traces of
+        the requests that rode them: admit records (prefill/hit_admit)
+        carry the engine id they admitted; decode/verify records carry
+        the engine ids live at dispatch time. Runs on the replica
+        thread after each step. Records for tickets already stolen are
+        DROPPED by the trace's ``attempt_key`` fence — checked against
+        the open attempt's (replica, epoch) tags atomically under the
+        trace lock, so even a steal + re-placement racing this snapshot
+        cannot mis-attribute a dead replica's dispatch to the
+        survivor's attempt."""
+        tl = self.server.timeline
+        if tl is None or self.gateway.traces is None:
+            return
+        new, self._tl_cursor = tl.take_new(self._tl_cursor)
+        if not new:
+            return
+        with self.cv:
+            tickets = dict(self._tickets)
+        key = (self.index, epoch)
+        for rec in new:
+            if rec.kind in ("prefill", "hit_admit"):
+                targets = [tickets.get(rec.request_id)]
+            else:
+                targets = [tickets.get(eid)
+                           for eid in rec.tags.get("requests", ())]
+            t1 = rec.t0 + rec.dur_ms / 1e3
+            tags = {k: v for k, v in rec.tags.items() if k != "requests"}
+            tags.update(occupancy=rec.occupancy, bucket=rec.bucket,
+                        tokens=rec.tokens)
+            if rec.compile:
+                tags["compile"] = True
+            for ticket in targets:
+                if ticket is not None and ticket.trace is not None:
+                    ticket.trace.add(rec.kind, rec.t0, t1,
+                                     attempt_key=key, **tags)
 
     def _stream_deltas(self, now: float, epoch: int) -> None:
         with self.cv:
@@ -527,6 +600,17 @@ class _Replica:
                             res.finish_reason, res.prefix_hit_tokens,
                             res.prefill_tokens_saved,
                             res.drafted, res.accepted)
+            if ticket.trace is not None:
+                ticket.trace.end_attempt(now, outcome="done")
+                ticket.trace.finish(
+                    now, outcome="done",
+                    finish_reason=res.finish_reason,
+                    tokens_in=metrics["tokens_in"],
+                    tokens_out=metrics["tokens_out"],
+                    ttft_ms=metrics["ttft_ms"],
+                    tpot_ms=metrics["tpot_ms"],
+                    attempts=ticket.attempts)
+                self.gateway._export_trace(ticket)
             self.gateway._record_done(self, metrics)
             ticket._emit(("done", res, metrics))
 
@@ -567,6 +651,10 @@ class _Replica:
                 # least-outstanding routing forever after rejoin
                 self.outstanding = max(0, self.outstanding - ticket.cost)
         self.gateway._record_shed(self, status)
+        if ticket.trace is not None:
+            ticket.trace.finish(outcome="shed", status=status,
+                                reason=reason)
+            self.gateway._export_trace(ticket)
         with ticket._emit_lock:
             # state flip + terminal emit together: a previous owner's
             # late token delta can't land after the final shed event
@@ -659,7 +747,7 @@ class _Replica:
                         "routing set", self.index)
             return True
 
-    def stats(self) -> dict:
+    def stats(self, include_dispatch: bool = False) -> dict:
         out = {
             "queued": self.n_queued,
             "active_slots": self.server.slots.n_active,
@@ -681,6 +769,13 @@ class _Replica:
         # prefix_* family) flat, so the MetricsStore numeric filter and
         # /stats both carry them per replica
         out.update(self.server.counters())
+        # per-dispatch timeline aggregates (kind -> count/ms/compile
+        # split/tokens) — opt-in: snapshot() wants it, but the
+        # per-request MetricsStore push (whose numeric filter would
+        # drop the nested dict anyway) must not pay a summary build on
+        # every completion
+        if include_dispatch and self.server.timeline is not None:
+            out["dispatch"] = self.server.timeline.summary()
         return out
 
 
@@ -697,6 +792,12 @@ class _Stats:
     def __init__(self, window: int = 1024):
         self.lock = threading.Lock()
         self.window: deque[dict] = deque(maxlen=window)
+        # LIFETIME latency distributions in fixed buckets (seconds) —
+        # the /metrics form a scraper can rate() and aggregate, where
+        # the rolling window's exact percentiles cannot; both are fed
+        # from the same per-request record so they can never disagree
+        self.hist = {key: Histogram()
+                     for key in ("queue_wait", "ttft", "tpot", "e2e")}
         self.accepted = 0
         self.completed = 0
         self.shed_by_status: dict[int, int] = {}
@@ -765,6 +866,8 @@ class GatewayHistory:
             self.app_id, n_replicas, os.uname().nodename))
         self._metrics_path = os.path.join(self.job_dir, "metrics",
                                           "requests.jsonl")
+        self._traces_path = os.path.join(self.job_dir, "metrics",
+                                         "traces.jsonl")
 
     def _append_event(self, event) -> None:
         with self._lock, open(self.jhist, "a") as f:
@@ -773,6 +876,13 @@ class GatewayHistory:
     def record(self, row: dict) -> None:
         with self._lock, open(self._metrics_path, "a") as f:
             f.write(json.dumps(row) + "\n")
+
+    def record_trace(self, doc: dict) -> None:
+        """One finished request's Chrome trace-event doc, one JSON doc
+        per line — keyed by the same request id requests.jsonl rows
+        carry, so the portal (or an operator's jq) links them."""
+        with self._lock, open(self._traces_path, "a") as f:
+            f.write(json.dumps(doc) + "\n")
 
     def close(self, status: str = "SUCCEEDED",
               metrics: dict | None = None) -> None:
@@ -804,7 +914,9 @@ class Gateway:
                  metrics_store=None, history: GatewayHistory | None = None,
                  max_attempts: int = 3, stall_timeout_s: float = 30.0,
                  breaker_base_s: float = 0.25, breaker_max_s: float = 8.0,
-                 quarantine_after: int = 5):
+                 quarantine_after: int = 5, tracing: bool = True,
+                 trace_capacity: int = 256,
+                 profile_dir: str | None = None):
         if not servers:
             raise ValueError("gateway needs at least one replica server")
         self.replicas = [_Replica(i, s, self) for i, s in enumerate(servers)]
@@ -824,10 +936,26 @@ class Gateway:
         self._beat_interval_s = max(0.05, stall_timeout_s / 10)
         self._watchdog = None
         self.stats = _Stats()
+        # request tracing (obs/trace.py): a bounded ring of finished
+        # traces behind GET /debug/trace/<id>, optionally mirrored into
+        # the history dir's metrics/traces.jsonl. tracing=False is the
+        # overhead A/B knob (bench extras.obs) — the layer is cheap
+        # enough to stay on in production.
+        self.traces = TraceBuffer(trace_capacity) if tracing else None
+        # on-demand serving profiles (profiler.ServeProfiler): armed by
+        # POST /debug/profile, burned down by replica threads' working
+        # iterations. Always constructed — an un-armed poll() is one
+        # attribute read.
+        from tony_tpu.profiler import ServeProfiler
+
+        if profile_dir is None and history is not None:
+            profile_dir = os.path.join(history.job_dir, "profiles")
+        self.profiler = ServeProfiler(profile_dir)
         self._lock = threading.Lock()
         self._drain_lock = threading.Lock()
         self._drain_done: bool | None = None
-        self._ids = iter(range(1 << 62))
+        self._host_cache: tuple[float, dict] | None = None
+        self._tpu_discoverer = None
         self._started = False
         self._closed = False
 
@@ -889,6 +1017,9 @@ class Gateway:
             self._watchdog = None
             if wd is not None:
                 wd.stop()
+            # a profile capture left mid-flight (operator armed it,
+            # traffic stopped) is finalized so its xplane files land
+            self.profiler.close()
             if self.history is not None:
                 self.history.close("SUCCEEDED" if ok else "KILLED",
                                    self.stats.snapshot())
@@ -929,7 +1060,11 @@ class Gateway:
             self.stats_shed(504)
             raise DeadlineExceeded("ttl_s already expired at submit")
         if request.id is None:
-            request.id = next(self._ids)
+            # server-minted UUID (clients may supply their own): echoed
+            # in responses, /stats window rows, history requests.jsonl,
+            # and keying the request's trace — the correlation handle
+            # TonY's per-task history gives every job
+            request.id = uuid.uuid4().hex
         with self._lock:
             if sum(r.n_queued for r in self.replicas) >= self.max_queue:
                 self.stats_shed(429)
@@ -938,6 +1073,17 @@ class Gateway:
             ticket = Ticket(request,
                             None if ttl is None
                             else time.monotonic() + ttl, on_event)
+            if self.traces is not None:
+                t0 = request.t_receive if request.t_receive is not None \
+                    else ticket.t_submit
+                trace = RequestTrace(request.id, t0=t0)
+                trace.root.tags.update(
+                    prompt_len=len(prompt),
+                    max_new_tokens=request.max_new_tokens)
+                if request.t_receive is not None:
+                    trace.add("http_receive", request.t_receive,
+                              ticket.t_submit, attempt=False)
+                ticket.trace = trace
             tried: set[int] = set()
             while True:
                 try:
@@ -1058,6 +1204,7 @@ class Gateway:
         the client already has). ``queued`` tickets never touched the
         engine: moved untouched, no attempt charged, no exclusion.
         Budget or fleet exhaustion sheds 503 (retriable) — never 500."""
+        now = time.monotonic()
         for ticket in admitted:
             ticket.attempts += 1
             ticket.excluded.add(replica.index)
@@ -1065,6 +1212,19 @@ class Gateway:
             with self.stats.lock:
                 self.stats.retries += len(admitted)
         for ticket in admitted + queued:
+            if ticket.trace is not None:
+                # close the failed attempt and mark the epoch fence:
+                # a chaos-path trace shows BOTH engine runs, with the
+                # failover instant between them (admitted=False means
+                # the ticket was still queued — moved, never charged)
+                admitted_here = any(ticket is t for t in admitted)
+                ticket.trace.end_attempt(
+                    now, outcome="failed" if admitted_here else "moved",
+                    reason=reason)
+                ticket.trace.add("failover", now, attempt=False,
+                                 from_replica=replica.index,
+                                 new_epoch=replica.epoch,
+                                 admitted=admitted_here)
             ticket.state = QUEUED
             ticket.replica = None
             if ticket.attempts >= self.max_attempts:
@@ -1111,6 +1271,10 @@ class Gateway:
         was already zeroed wholesale by the steal, so that is NOT
         touched). ``exc`` tells ``Ticket.result()`` which Shed subclass
         to raise when the bare status is ambiguous (the 503 family)."""
+        if ticket.trace is not None:
+            ticket.trace.finish(outcome="shed", status=status,
+                                reason=reason)
+            self._export_trace(ticket)
         with ticket._emit_lock:
             # state flip + terminal emit under the emit lock: a failed
             # replica's late token delta can't slip in AFTER the shed
@@ -1160,6 +1324,56 @@ class Gateway:
             } for r in self.replicas],
         }
 
+    # ----------------------------------------------------- observability
+
+    def _export_trace(self, ticket: Ticket) -> None:
+        """A finished (done or shed) trace goes into the debug ring
+        (``GET /debug/trace/<id>``) and — with history on — as one
+        Chrome trace-event JSON doc per line in
+        ``metrics/traces.jsonl``, next to the requests.jsonl rows the
+        same request id keys."""
+        if self.traces is None or ticket.trace is None:
+            return
+        self.traces.put(ticket.trace)
+        if self.history is not None:
+            try:
+                self.history.record_trace(ticket.trace.to_chrome())
+            except Exception:
+                # same contract as the requests.jsonl write: a dropped
+                # trace row must never cost the client its terminal
+                # event
+                log.exception("history trace write failed")
+
+    def _host_sample(self) -> dict:
+        """Host resource gauges: process-tree RSS from /proc, TPU
+        HBM/duty-cycle when the runtime exposes them (absent off-TPU).
+        TTL-cached so the /proc walk runs per snapshot-second, not per
+        request. Replicas are threads of THIS process, so the block is
+        process-level truth attached to every replica row (documented
+        in docs/OBSERVABILITY.md)."""
+        now = time.monotonic()
+        if self._host_cache is not None \
+                and now - self._host_cache[0] < 1.0:
+            return self._host_cache[1]
+        from tony_tpu.metrics.sampler import process_tree_rss_bytes
+
+        host: dict = {"rss_bytes": process_tree_rss_bytes(os.getpid())}
+        try:
+            if self._tpu_discoverer is None:
+                from tony_tpu.utils.tpu_info import TpuDiscoverer
+
+                self._tpu_discoverer = TpuDiscoverer()
+            tpu = self._tpu_discoverer.device_metrics()
+            if "hbm" in tpu:
+                host["tpu_hbm_bytes"] = int(tpu["hbm"])
+            if "util" in tpu:
+                host["tpu_util"] = round(tpu["util"], 3)
+        except Exception:  # noqa: BLE001 — discovery trouble degrades
+            # to an RSS-only block, never a broken /stats
+            log.debug("tpu metrics discovery failed", exc_info=True)
+        self._host_cache = (now, host)
+        return host
+
     # -------------------------------------------------------- accounting
 
     def stats_shed(self, status: int) -> None:
@@ -1183,6 +1397,10 @@ class Gateway:
             self.stats.drafted += metrics.get("drafted", 0)
             self.stats.draft_accepted += metrics.get("accepted", 0)
             self.stats.window.append(metrics)
+        for key, ms_key in (("queue_wait", "queue_wait_ms"),
+                            ("ttft", "ttft_ms"), ("tpot", "tpot_ms"),
+                            ("e2e", "e2e_ms")):
+            self.stats.hist[key].observe(metrics[ms_key] / 1e3)
         if self.history is not None:
             try:
                 self.history.record(metrics)
@@ -1210,10 +1428,14 @@ class Gateway:
         out = self.stats.snapshot()
         out["ready"] = self.ready
         out["draining"] = self.draining
-        out["replicas"] = [r.stats() for r in self.replicas]
+        out["replicas"] = [r.stats(include_dispatch=True)
+                           for r in self.replicas]
+        host = self._host_sample()
+        for row in out["replicas"]:
+            row["host"] = host
         out["queued"] = sum(r.n_queued for r in self.replicas)
         out["max_queue"] = self.max_queue
-        out["engine"] = self._engine_summary()
+        out["engine"] = self._engine_summary(out["replicas"])
         with self.stats.lock:
             out["supervision"] = {
                 "healthy_replicas": self.n_healthy,
@@ -1229,18 +1451,31 @@ class Gateway:
             }
         return out
 
-    def _engine_summary(self) -> dict:
+    def _engine_summary(self, replica_rows: list | None = None) -> dict:
         """Fleet-level engine counters: the device work behind the
         request percentiles (prefills run, decode rounds, occupancy,
         overshoot waste) plus the speculative-decoding and prefix-cache
         effectiveness blocks, summed across replicas — so /stats shows
-        savings NEXT TO the work they avoided."""
+        savings NEXT TO the work they avoided. ``replica_rows`` (the
+        per-replica stats rows snapshot() just built) donates its
+        ``dispatch`` blocks so one scrape takes each timeline's lock
+        once, not twice."""
         servers = [r.server for r in self.replicas]
         counts = [s.counters() for s in servers]
         total = lambda key: sum(c.get(key, 0) for c in counts)  # noqa: E731
         lookups = total("prefix_lookups")
         drafted = total("spec_drafted")
+        if replica_rows is not None:
+            dispatch_blocks = [row["dispatch"] for row in replica_rows
+                               if "dispatch" in row]
+        else:
+            dispatch_blocks = [s.timeline.summary() for s in servers
+                               if s.timeline is not None]
         return {
+            # fleet dispatch timeline: per-kind count / host-wall ms /
+            # compile split / tokens, merged across replicas — the
+            # /stats block ROADMAP 4's dispatch-overhead work reads
+            "dispatch": DispatchTimeline.merge(dispatch_blocks),
             "prefills": total("prefills"),
             "decode_steps": total("decode_steps"),
             "dispatches": total("dispatches"),

@@ -21,8 +21,23 @@ admission queue. Endpoints:
                       depths, p50/p95/p99 queue-wait/TTFT/TPOT, and
                       the engine rollup — prefills/decode steps/
                       occupancy/wasted_steps plus the engine.spec
-                      speculative-decoding acceptance block and the
-                      engine.prefix hit-rate block)
+                      speculative-decoding acceptance block, the
+                      engine.prefix hit-rate block, the engine.dispatch
+                      timeline block, and per-replica host gauges)
+  GET  /metrics       Prometheus text exposition (0.0.4) of the same
+                      numbers /stats carries: counters, gauges, and
+                      lifetime TTFT/TPOT/queue-wait/e2e histograms —
+                      what an autoscaler or scrape agent consumes
+  GET  /debug/trace   {"request_ids": [...]} — recently traced requests
+  GET  /debug/trace/<id>  one request's span tree as Chrome trace-event
+                      JSON (load it in chrome://tracing or Perfetto);
+                      failovers show as the request hopping attempt rows
+  POST /debug/profile?steps=N  arm a jax.profiler capture of the fleet's
+                      next N working scheduler iterations; returns the
+                      logdir the xplane files land in (409 while a
+                      capture is already pending/active)
+  GET  /debug/profile capture status (active/steps_left/captures/
+                      last_logdir/last_error)
 
 Shed mapping (core.Shed.http_status): 400 bad request, 429 admission
 queue full, 503 draining, 504 deadline exceeded. In streaming mode the
@@ -36,8 +51,10 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
+from urllib.parse import parse_qsl, unquote
 
 from tony_tpu.gateway.core import Gateway, GenRequest, Shed
 
@@ -76,16 +93,43 @@ class GatewayHandler(BaseHTTPRequestHandler):
                                     else "starting"})
         if path == "/stats":
             return self._send(200, self.gateway.snapshot())
+        if path == "/metrics":
+            from tony_tpu.obs import prometheus_text
+
+            return self._send_text(200, prometheus_text(self.gateway))
+        if path == "/debug/trace":
+            if self.gateway.traces is None:
+                return self._send(404, {"error": "tracing disabled"})
+            return self._send(200,
+                              {"request_ids": self.gateway.traces.ids()})
+        if path.startswith("/debug/trace/"):
+            if self.gateway.traces is None:
+                return self._send(404, {"error": "tracing disabled"})
+            rid = unquote(path[len("/debug/trace/"):])
+            trace = self.gateway.traces.get(rid)
+            if trace is None:
+                return self._send(404, {"error": f"no trace for "
+                                        f"request_id {rid!r} (buffer "
+                                        f"keeps the most recent "
+                                        f"{self.gateway.traces.capacity})"})
+            return self._send(200, trace.to_chrome())
+        if path == "/debug/profile":
+            return self._send(200, self.gateway.profiler.status())
         return self._send(404, {"error": "not found"})
 
     # ------------------------------------------------------------ POST
 
     def do_POST(self):
-        if self.path.partition("?")[0] != "/v1/generate":
+        t_receive = time.monotonic()
+        path, _, query = self.path.partition("?")
+        if path == "/debug/profile":
+            return self._profile_request(query)
+        if path != "/v1/generate":
             return self._send(404, {"error": "not found"})
         try:
             body = self._read_body()
             req, stream = self._parse_body(body)
+            req.t_receive = t_receive  # the trace's http_receive span
         except (TypeError, ValueError) as e:
             # TypeError too: int()/float()/iteration over wrong-typed
             # JSON values ({"token_ids": 123}, {"temperature": null})
@@ -103,6 +147,51 @@ class GatewayHandler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away; the request finishes server-side
             # and its deadline/shed path handles abandoned successors
+
+    def _profile_request(self, query: str) -> None:
+        """POST /debug/profile?steps=N[&logdir=<subdir>] — arm an
+        on-demand serving profile (profiler.ServeProfiler). The body is
+        ignored; the knobs ride the query string so `curl -XPOST
+        .../debug/profile?steps=20` is the whole interface. ``logdir``
+        is a RELATIVE name under the server's configured profile dir —
+        an absolute or traversing path would hand any HTTP client an
+        arbitrary-directory write primitive, so it 400s instead."""
+        import os
+
+        length = int(self.headers.get("Content-Length") or 0)
+        if length > 1 << 20:
+            # refusing to drain an arbitrarily large body; 413 closes
+            # the connection (the _send >=400 path), so the unread tail
+            # can never desync a keep-alive socket
+            return self._send(413, {"error": "request body too large"})
+        if length > 0:  # drain: unread body bytes would desync a
+            self.rfile.read(length)  # keep-alive socket
+        params = dict(parse_qsl(query))
+        logdir = None
+        sub = params.get("logdir")
+        if sub:
+            base = os.path.realpath(
+                self.gateway.profiler.default_logdir)
+            logdir = os.path.realpath(os.path.join(base, sub))
+            if logdir != base and not logdir.startswith(base + os.sep):
+                return self._send(400, {
+                    "error": "logdir must be a relative subpath of "
+                             "the server's profile dir "
+                             "(--profile-dir)"})
+            # fresh timestamped dir per capture: the xplane parsers sum
+            # every *.xplane.pb under a logdir, so re-using a name
+            # would silently double-count across captures
+            logdir = os.path.join(logdir,
+                                  f"profile-{int(time.time() * 1000)}")
+        try:
+            steps = int(params.get("steps", 10))
+            logdir = self.gateway.profiler.request(steps, logdir)
+        except ValueError as e:
+            return self._send(400, {"error": str(e)})
+        except RuntimeError as e:  # a capture is already in flight
+            return self._send(409, {"error": str(e)})
+        return self._send(200, {"armed": True, "steps": steps,
+                                "logdir": logdir})
 
     def _read_body(self) -> dict:
         length = int(self.headers.get("Content-Length") or 0)
@@ -131,13 +220,18 @@ class GatewayHandler(BaseHTTPRequestHandler):
         else:
             raise ValueError("request needs token_ids or prompt")
         ttl = d.get("ttl_s", d.get("timeout_s"))
+        # "request_id" is the documented spelling; "id" accepted for
+        # back-compat. Absent -> the gateway mints a UUID, echoed in
+        # every response/stats/history/trace surface so the client can
+        # correlate its request with the server-side records.
+        rid = d.get("request_id", d.get("id"))
         return GenRequest(
             ids,
             max_new_tokens=int(d.get("max_new_tokens", 64)),
             temperature=float(d.get("temperature", 0.0)),
             top_k=int(d.get("top_k", 0)),
             seed=int(d.get("seed", 0)),
-            id=d.get("id"),
+            id=rid,
             ttl_s=float(ttl) if ttl is not None else None,
             session=d.get("session"),
         ), bool(d.get("stream", False))
@@ -145,7 +239,8 @@ class GatewayHandler(BaseHTTPRequestHandler):
     # -------------------------------------------------------- responses
 
     def _finish_doc(self, res, metrics: dict) -> dict:
-        out = {"id": res.id, "token_ids": list(res.prompt) + list(res.tokens),
+        out = {"id": res.id, "request_id": res.id,
+               "token_ids": list(res.prompt) + list(res.tokens),
                "finish_reason": res.finish_reason, "metrics": metrics}
         if self.decode is not None:
             out["text"] = self.decode(out["token_ids"])
@@ -170,7 +265,9 @@ class GatewayHandler(BaseHTTPRequestHandler):
                 if not headers_sent:
                     self._start_stream()
                     headers_sent = True
-                self._chunk({"id": ticket.request.id, "token_ids": rest[0]})
+                self._chunk({"id": ticket.request.id,
+                             "request_id": ticket.request.id,
+                             "token_ids": rest[0]})
             elif kind == "done":
                 res, metrics = rest
                 if not headers_sent:
@@ -200,6 +297,17 @@ class GatewayHandler(BaseHTTPRequestHandler):
         data = (json.dumps(doc) + "\n").encode()
         self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
         self.wfile.flush()
+
+    def _send_text(self, code: int, text: str) -> None:
+        """Plain-text response — the Prometheus exposition format
+        (which is NOT JSON; scrapers parse the text format directly)."""
+        data = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def _send(self, code: int, doc: dict) -> None:
         data = json.dumps(doc).encode()

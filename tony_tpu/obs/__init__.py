@@ -1,0 +1,42 @@
+"""End-to-end serving observability: traces, timeline, exposition.
+
+The TonY lesson (PAPER.md L4/L6) applied to serving: orchestration is
+worth little if you cannot see where a request's time went. Three
+layers, each consumable on its own:
+
+- ``trace``: per-request span trees (queue-wait -> admit -> decode
+  rounds, one attempt span per engine run across failovers), exported
+  as Chrome trace-event JSON for Perfetto (``/debug/trace/<id>``);
+- ``timeline``: per-dispatch engine records (kind / occupancy / shape
+  bucket / host-wall duration, compile split from steady state) — the
+  ``/stats`` ``dispatches`` block and the sensor for dispatch-overhead
+  work;
+- ``prom`` + ``export``: dependency-free Prometheus text exposition of
+  the gateway's counters, gauges, and latency histograms
+  (``GET /metrics``).
+
+The whole layer is always-on-cheap (appends under small locks, export
+cost only when asked); bench ``extras.obs`` pins the overhead.
+"""
+
+from tony_tpu.obs.export import prometheus_text
+from tony_tpu.obs.prom import (DEFAULT_TIME_BUCKETS_S, Histogram,
+                               MetricFamily, escape_label_value, render)
+from tony_tpu.obs.timeline import DispatchRecord, DispatchTimeline
+from tony_tpu.obs.trace import (RequestTrace, Span, TraceBuffer,
+                                check_invariants)
+
+__all__ = [
+    "DEFAULT_TIME_BUCKETS_S",
+    "DispatchRecord",
+    "DispatchTimeline",
+    "Histogram",
+    "MetricFamily",
+    "RequestTrace",
+    "Span",
+    "TraceBuffer",
+    "check_invariants",
+    "escape_label_value",
+    "prometheus_text",
+    "render",
+]

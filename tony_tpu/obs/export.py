@@ -1,0 +1,226 @@
+"""Gateway -> Prometheus assembly: the ``GET /metrics`` document.
+
+Everything is derived from ``Gateway.snapshot()`` — the same payload
+``/stats`` serves — plus the gateway's lifetime latency histograms, so
+the two surfaces can never disagree: a scraper's counter and a human's
+JSON read the same numbers. Duck-typed against the gateway (no import
+of ``tony_tpu.gateway`` — this module sits below it).
+
+Naming follows the Prometheus conventions: ``_total`` counters,
+base-unit seconds/bytes, one ``replica`` label for per-replica series
+(aggregate with ``sum by ()``), a ``kind`` label on the dispatch
+timeline families, and a state-info family
+(``tony_replica_state{state="..."} 1``) for the breaker's string
+state. The full reference table lives in docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+from tony_tpu.obs.prom import MetricFamily, render
+
+# flat per-replica engine counters exported with a replica label;
+# everything else in the replica stats row is either covered by an
+# explicit family below or a string (state)
+_REPLICA_COUNTERS = (
+    ("prefills", "tony_engine_prefills_total",
+     "Prefill dispatches run (exact prefix hits skip one)"),
+    ("decode_steps", "tony_engine_decode_steps_total",
+     "Decode dispatch depth, summed (chunk k / verify window)"),
+    ("dispatches", "tony_engine_dispatches_total",
+     "Decode dispatches (chunk + verify)"),
+    ("wasted_steps", "tony_engine_wasted_steps_total",
+     "Per-slot token positions decoded and thrown away"),
+    ("spec_rounds", "tony_engine_spec_rounds_total",
+     "Speculative verify dispatches run"),
+    ("spec_drafted", "tony_engine_spec_drafted_total",
+     "Draft tokens sent through verify"),
+    ("spec_accepted", "tony_engine_spec_accepted_total",
+     "Draft tokens accepted by verify"),
+    ("prefix_lookups", "tony_engine_prefix_lookups_total",
+     "Admissions that consulted the prefix store"),
+    ("prefix_hits", "tony_engine_prefix_hits_total",
+     "Admissions seeded >= 1 cached prompt token"),
+    ("prefix_hit_tokens", "tony_engine_prefix_hit_tokens_total",
+     "Prompt tokens seeded from the prefix store"),
+    ("prefill_tokens_saved", "tony_engine_prefill_tokens_saved_total",
+     "Bucketed prefill work skipped via prefix reuse"),
+    ("completed", "tony_replica_completed_total",
+     "Requests delivered by this replica"),
+    ("shed", "tony_replica_shed_total",
+     "Requests shed charged to this replica"),
+    ("failures", "tony_replica_breaker_failures_total",
+     "Circuit-breaker trips (lifetime)"),
+    ("probes", "tony_replica_probes_total",
+     "Breaker probe generations attempted"),
+    ("rejoins", "tony_replica_rejoins_total",
+     "Probe successes that rejoined the routing set"),
+)
+
+_REPLICA_GAUGES = (
+    ("queued", "tony_replica_queued", "Tickets waiting in this replica's queue"),
+    ("active_slots", "tony_replica_active_slots",
+     "Cache slots currently decoding"),
+    ("batch_size", "tony_replica_slots", "Cache slots total"),
+    ("outstanding_tokens", "tony_replica_outstanding_tokens",
+     "Token-cost estimate of queued + in-flight work"),
+    ("heartbeat_age_s", "tony_replica_heartbeat_age_seconds",
+     "Seconds since the replica thread's last heartbeat"),
+    ("consecutive_failures", "tony_replica_consecutive_failures",
+     "Breaker failure streak since the last delivered result"),
+    ("epoch", "tony_replica_epoch", "Fencing epoch (bumps per failure)"),
+    ("prefix_entries", "tony_prefix_entries", "Prefix store entries resident"),
+    ("prefix_bytes", "tony_prefix_bytes", "Prefix store bytes resident"),
+    ("prefix_budget_bytes", "tony_prefix_budget_bytes",
+     "Prefix store byte budget"),
+)
+
+_SUPERVISION = (
+    ("replica_failures", "tony_replica_failures_total",
+     "HEALTHY -> BROKEN transitions across the fleet"),
+    ("failovers", "tony_failovers_total",
+     "Tickets requeued onto another replica"),
+    ("retries", "tony_retries_total",
+     "Failed engine runs charged to tickets"),
+    ("probes", "tony_probes_total", "Breaker probes across the fleet"),
+    ("rejoins", "tony_rejoins_total", "Breaker rejoins across the fleet"),
+    ("quarantines", "tony_quarantines_total", "Replicas quarantined"),
+)
+
+_HISTOGRAMS = (
+    ("queue_wait", "tony_request_queue_wait_seconds",
+     "Submit-to-slot-admission wait per completed request"),
+    ("ttft", "tony_request_ttft_seconds",
+     "Time to first token per completed request"),
+    ("tpot", "tony_request_tpot_seconds",
+     "Mean time per output token after the first, per request"),
+    ("e2e", "tony_request_e2e_seconds",
+     "Whole-life latency per completed request"),
+)
+
+
+def prometheus_text(gateway) -> str:
+    """Render the gateway's observability state as Prometheus text
+    exposition (0.0.4). One snapshot() drives everything."""
+    snap = gateway.snapshot()
+    fams: list[MetricFamily] = []
+
+    def counter(name, help_text, value, labels=None):
+        fams.append(MetricFamily(name, "counter", help_text)
+                    .add(value, labels))
+        return fams[-1]
+
+    def gauge(name, help_text, value, labels=None):
+        fams.append(MetricFamily(name, "gauge", help_text)
+                    .add(value, labels))
+        return fams[-1]
+
+    counter("tony_requests_accepted_total",
+            "Requests past the admission gate", snap["accepted"])
+    counter("tony_requests_completed_total",
+            "Requests finished with a result", snap["completed"])
+    shed = MetricFamily("tony_requests_shed_total", "counter",
+                        "Requests refused or given up on, by HTTP status")
+    for status, n in sorted(snap["shed"].items()):
+        shed.add(n, {"status": str(status)})
+    if snap["shed"]:
+        fams.append(shed)
+    counter("tony_tokens_in_total", "Prompt tokens accepted",
+            snap["tokens_in"])
+    counter("tony_tokens_out_total", "Tokens generated and delivered",
+            snap["tokens_out"])
+
+    sup = snap["supervision"]
+    for key, name, help_text in _SUPERVISION:
+        counter(name, help_text, sup[key])
+    gauge("tony_healthy_replicas", "Replicas currently routable",
+          sup["healthy_replicas"])
+    gauge("tony_replicas", "Replicas configured", sup["replicas"])
+    gauge("tony_queue_depth", "Tickets queued across the fleet",
+          snap["queued"])
+    gauge("tony_queue_max", "Admission queue bound", snap["max_queue"])
+    gauge("tony_gateway_ready", "1 while accepting (0 = draining)",
+          1 if snap["ready"] else 0)
+
+    eng = snap["engine"]
+    gauge("tony_engine_active_slots", "Live cache slots, fleet-wide",
+          eng["active_slots"])
+    gauge("tony_engine_slots", "Cache slots, fleet-wide", eng["slots"])
+    gauge("tony_prefix_enabled", "1 when the prefix store is on",
+          1 if eng["prefix"]["enabled"] else 0)
+    gauge("tony_spec_enabled", "1 when speculative decoding is on",
+          1 if eng["spec"]["enabled"] else 0)
+
+    rep_counter = {name: MetricFamily(name, "counter", help_text)
+                   for _, name, help_text in _REPLICA_COUNTERS}
+    rep_gauge = {name: MetricFamily(name, "gauge", help_text)
+                 for _, name, help_text in _REPLICA_GAUGES}
+    state_fam = MetricFamily(
+        "tony_replica_state", "gauge",
+        "Breaker state info: the labeled state reads 1")
+    disp = {
+        "tony_dispatch_count_total": MetricFamily(
+            "tony_dispatch_count_total", "counter",
+            "Engine dispatches by kind"),
+        "tony_dispatch_seconds_total": MetricFamily(
+            "tony_dispatch_seconds_total", "counter",
+            "Host wall seconds spent in dispatches by kind"),
+        "tony_dispatch_compiles_total": MetricFamily(
+            "tony_dispatch_compiles_total", "counter",
+            "First-call (compile) dispatches by kind"),
+        "tony_dispatch_compile_seconds_total": MetricFamily(
+            "tony_dispatch_compile_seconds_total", "counter",
+            "Seconds spent in first-call dispatches by kind"),
+        "tony_dispatch_tokens_total": MetricFamily(
+            "tony_dispatch_tokens_total", "counter",
+            "Tokens landed by dispatches by kind"),
+    }
+    # host gauges are PROCESS-level (replicas are threads of one
+    # process, every /stats row carries the identical block): exported
+    # UNLABELED, once — a replica label would make the idiomatic
+    # sum() over-report by n_replicas, the exact inflation class the
+    # xplane busiest-plane fix in this subsystem exists to prevent
+    host_rss = MetricFamily("tony_host_rss_bytes", "gauge",
+                            "Gateway process-tree resident set size")
+    host_hbm = MetricFamily("tony_host_tpu_hbm_bytes", "gauge",
+                            "TPU HBM bytes in use (absent off-TPU)")
+    host_util = MetricFamily("tony_host_tpu_util", "gauge",
+                             "TPU duty cycle percent (absent off-TPU)")
+    host = (snap["replicas"][0].get("host") or {}) \
+        if snap["replicas"] else {}
+    if "rss_bytes" in host:
+        host_rss.add(host["rss_bytes"])
+    if "tpu_hbm_bytes" in host:
+        host_hbm.add(host["tpu_hbm_bytes"])
+    if "tpu_util" in host:
+        host_util.add(host["tpu_util"])
+    for i, row in enumerate(snap["replicas"]):
+        labels = {"replica": str(i)}
+        for key, name, _ in _REPLICA_COUNTERS:
+            if key in row:
+                rep_counter[name].add(row[key], labels)
+        for key, name, _ in _REPLICA_GAUGES:
+            if key in row:
+                rep_gauge[name].add(row[key], labels)
+        state_fam.add(1, {**labels, "state": str(row.get("state", ""))})
+        for kind, agg in (row.get("dispatch") or {}).items():
+            kl = {**labels, "kind": kind}
+            disp["tony_dispatch_count_total"].add(agg["count"], kl)
+            # /stats keeps ms (human units); the exposition follows the
+            # prometheus base-unit convention like every other series
+            disp["tony_dispatch_seconds_total"].add(
+                round(agg["ms"] / 1e3, 6), kl)
+            disp["tony_dispatch_compiles_total"].add(agg["compiles"], kl)
+            disp["tony_dispatch_compile_seconds_total"].add(
+                round(agg["compile_ms"] / 1e3, 6), kl)
+            disp["tony_dispatch_tokens_total"].add(agg["tokens"], kl)
+    fams.extend(rep_counter.values())
+    fams.extend(rep_gauge.values())
+    fams.append(state_fam)
+    fams.extend(disp.values())
+    fams.extend([host_rss, host_hbm, host_util])
+
+    for key, name, help_text in _HISTOGRAMS:
+        hist = gateway.stats.hist.get(key)
+        if hist is not None:
+            fams.append(hist.family(name, help_text))
+    return render(fams)

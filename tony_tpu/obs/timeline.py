@@ -1,0 +1,160 @@
+"""Per-dispatch engine timeline: what every device program launch cost.
+
+The serving engine's counters (prefills / decode_steps / dispatches)
+say HOW MUCH device work ran; this module records WHEN and HOW LONG —
+one ``DispatchRecord`` per engine dispatch (prefill, hit-admit, decode
+chunk, spec-verify), with the live-slot occupancy, the program's shape
+knob (prefill bucket / chunk depth / verify window), the tokens the
+dispatch actually landed, and a first-call flag separating compile
+(or compile-cache-load) time from steady state. This is the direct
+sensor for ROADMAP item 4's dispatch-overhead attack: the roofline gap
+shows up here as host-side milliseconds per dispatch that the per-op
+xplane view cannot see.
+
+Durations are HOST WALL time from just before the dispatch call to
+just after the engine's host sync of its outputs — on an async backend
+that includes device execution plus transfer, which is exactly the
+latency a request experiences. The ``compile`` flag marks the first
+record of each (kind, shape) pair on this engine; with a warm
+in-process jit cache or a persistent compile cache the flagged call
+may be cheap — the flag means "first call", the duration says whether
+it compiled.
+
+A bounded ring keeps recent records for trace attachment and debug;
+cumulative per-kind aggregates survive eviction, so ``summary()`` (the
+``/stats`` ``dispatches`` block) is lifetime-accurate. Appending is a
+lock plus a dataclass — cheap enough to leave on in production, which
+the obs overhead gate (bench ``extras.obs``) pins.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class DispatchRecord:
+    """One engine dispatch. ``kind`` is "prefill" | "hit_admit" |
+    "decode" | "verify"; ``bucket`` is the program's static shape knob
+    (prefill bucket length, chunk depth, verify window — 0 for
+    hit_admit); ``tokens`` counts tokens the dispatch landed for
+    requests (trimmed overshoot excluded); ``request_id`` is set on
+    admit dispatches (the engine id of the admitted request)."""
+
+    kind: str
+    t0: float          # time.monotonic() at dispatch start
+    dur_ms: float      # host wall: dispatch + output sync
+    occupancy: int     # live slots at dispatch time
+    bucket: int
+    tokens: int
+    compile: bool      # first (kind, bucket) call on this engine
+    request_id: Any = None
+    tags: dict = field(default_factory=dict)
+    seq: int = 0       # assigned by the timeline, monotonically
+
+
+class DispatchTimeline:
+    """Ring of recent ``DispatchRecord``s + lifetime per-kind
+    aggregates. Thread-safe; the engine records from its owner thread,
+    readers (``/stats``, the trace attacher) snapshot from others."""
+
+    def __init__(self, capacity: int = 1024):
+        self._lock = threading.Lock()
+        self._ring: deque[DispatchRecord] = deque(maxlen=max(1, capacity))
+        self._seq = 0
+        # kind -> [count, total_ms, max_ms, compiles, compile_ms, tokens]
+        self._agg: dict[str, list[float]] = {}
+
+    def record(self, rec: DispatchRecord) -> None:
+        with self._lock:
+            self._seq += 1
+            rec.seq = self._seq
+            self._ring.append(rec)
+            agg = self._agg.setdefault(rec.kind, [0, 0.0, 0.0, 0, 0.0, 0])
+            agg[0] += 1
+            agg[1] += rec.dur_ms
+            agg[2] = max(agg[2], rec.dur_ms)
+            if rec.compile:
+                agg[3] += 1
+                agg[4] += rec.dur_ms
+            agg[5] += rec.tokens
+
+    def take_new(self, cursor: int) -> tuple[list[DispatchRecord], int]:
+        """Records with ``seq > cursor`` still in the ring, plus the new
+        cursor — the trace attacher's incremental read. Records evicted
+        before being read are simply gone (bounded memory beats
+        completeness for a debug surface). O(new), not O(ring): this
+        runs on the replica scheduler loop every iteration under the
+        same lock ``record()`` needs, so a full-ring scan per step
+        would be pure hot-loop waste."""
+        with self._lock:
+            if self._seq == cursor:
+                return [], cursor
+            new = []
+            for rec in reversed(self._ring):  # deque ends are O(1)
+                if rec.seq <= cursor:
+                    break
+                new.append(rec)
+            new.reverse()
+            return new, self._seq
+
+    def recent(self, n: int = 64) -> list[DispatchRecord]:
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def summary(self) -> dict:
+        """The ``/stats`` ``dispatches`` block: lifetime per-kind
+        aggregates with compile time split out, so steady-state
+        mean_ms answers "what does one dispatch cost" without the
+        first-call spike polluting it."""
+        out: dict = {}
+        with self._lock:
+            items = {k: list(v) for k, v in self._agg.items()}
+        for kind, (count, ms, max_ms, compiles, compile_ms, toks) in \
+                sorted(items.items()):
+            steady_n = count - compiles
+            steady_ms = ms - compile_ms
+            out[kind] = {
+                "count": int(count),
+                "ms": round(ms, 3),
+                "max_ms": round(max_ms, 3),
+                "compiles": int(compiles),
+                "compile_ms": round(compile_ms, 3),
+                "steady_mean_ms": round(steady_ms / steady_n, 3)
+                if steady_n else 0.0,
+                "tokens": int(toks),
+                "tokens_per_dispatch": round(toks / count, 3)
+                if count else 0.0,
+            }
+        return out
+
+    @staticmethod
+    def merge(summaries: list[dict]) -> dict:
+        """Sum per-kind summaries across replicas (the fleet view the
+        gateway's ``/stats`` carries): counts/ms/tokens add, max_ms
+        maxes, means are recomputed from the merged totals."""
+        merged: dict = {}
+        for s in summaries:
+            for kind, v in s.items():
+                m = merged.setdefault(kind, {
+                    "count": 0, "ms": 0.0, "max_ms": 0.0, "compiles": 0,
+                    "compile_ms": 0.0, "tokens": 0})
+                m["count"] += v["count"]
+                m["ms"] += v["ms"]
+                m["max_ms"] = max(m["max_ms"], v["max_ms"])
+                m["compiles"] += v["compiles"]
+                m["compile_ms"] += v["compile_ms"]
+                m["tokens"] += v["tokens"]
+        for kind, m in merged.items():
+            steady_n = m["count"] - m["compiles"]
+            steady_ms = m["ms"] - m["compile_ms"]
+            m["ms"] = round(m["ms"], 3)
+            m["compile_ms"] = round(m["compile_ms"], 3)
+            m["steady_mean_ms"] = round(steady_ms / steady_n, 3) \
+                if steady_n else 0.0
+            m["tokens_per_dispatch"] = round(m["tokens"] / m["count"], 3) \
+                if m["count"] else 0.0
+        return merged

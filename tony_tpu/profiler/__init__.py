@@ -1,4 +1,5 @@
 from tony_tpu.profiler.profiler import (
+    ServeProfiler,
     StepProfiler,
     maybe_start_server,
     trace,
@@ -9,15 +10,18 @@ from tony_tpu.profiler.xplane import (
     device_busy_ms,
     hbm_estimate_bytes,
     op_totals_ms,
+    per_plane_op_totals_ms,
     trace_device_ms,
 )
 
 __all__ = [
+    "ServeProfiler",
     "StepProfiler",
     "device_busy_ms",
     "hbm_estimate_bytes",
     "maybe_start_server",
     "op_totals_ms",
+    "per_plane_op_totals_ms",
     "trace",
     "trace_device_ms",
     "trigger_path",

@@ -22,7 +22,10 @@ Parsing is done directly from the ``*.xplane.pb`` protos that
   the slower python protobuf impl on processes that parse no xplanes).
 - The device plane is named ``/device:TPU:N``; its ``XLA Ops`` line
   carries one event per executed HLO op with ``duration_ps``. Summing
-  durations is safe: ops on one TPU core's line are serialized.
+  durations is safe WITHIN a plane: ops on one TPU core's line are
+  serialized. Across planes it is not — ``device_busy_ms`` reports the
+  busiest plane (critical-path chip), never the cross-chip sum, which
+  would inflate by n_devices on multi-chip traces.
 
 Everything degrades to ``None``/empty off-TPU or when tensorflow is
 absent, so callers can fall back to wall-clock.
@@ -115,11 +118,44 @@ def hlo_op_kind(name: str) -> str:
     return name.split("=", 1)[0].strip().lstrip("%").split(".")[0]
 
 
+def _plane_op_totals(plane, line_name: str,
+                     drop_control_flow: bool) -> dict[str, float] | None:
+    """Per-op busy ms on ONE device plane's ``line_name`` line. None
+    when the plane has no such line (not a device-op plane)."""
+    totals: dict[str, float] = {}
+    found = False
+    meta = {m.id: m.name for m in plane.event_metadata.values()}
+    for line in plane.lines:
+        if line.name != line_name:
+            continue
+        found = True
+        for ev in line.events:
+            name = meta.get(ev.metadata_id, str(ev.metadata_id))
+            # ' while(' / ' conditional(' can only be the HLO op
+            # kind (op names contain no spaces; operand refs are
+            # not followed by '('), so this cannot swallow a
+            # custom call from a jax fn NAMED while_*; the
+            # prefix check covers dumps whose metadata carries
+            # only the op name — 'while.3' never collides with
+            # 'while_scanner.3' (dot vs underscore)
+            if drop_control_flow and (
+                    " while(" in name or " conditional(" in name
+                    or name.lstrip("%").startswith(
+                        ("while.", "conditional."))):
+                continue
+            totals[name] = totals.get(name, 0.0) \
+                + ev.duration_ps / _PS_PER_MS
+    return totals if found else None
+
+
 def op_totals_ms(logdir: str, line_name: str = "XLA Ops",
                  drop_control_flow: bool = True) \
         -> dict[str, float] | None:
     """Total device-busy ms per op name, summed over every device plane
     and xplane file under ``logdir``. None when nothing parseable.
+    NOTE: the per-op SUM spans all chips (the per-op breakdown view);
+    for wall-comparable busy time use ``device_busy_ms``, which
+    aggregates per plane.
 
     ``drop_control_flow`` (default): skip while/conditional events —
     their duration INCLUDES the nested body ops, which the XLA Ops line
@@ -128,48 +164,54 @@ def op_totals_ms(logdir: str, line_name: str = "XLA Ops",
     its wall time before this filter). Filtering is by parsed HLO op
     KIND, not name prefix — a custom call from a jax fn named
     ``while_*`` must not vanish from the totals."""
+    per_plane = per_plane_op_totals_ms(logdir, line_name,
+                                       drop_control_flow)
+    if per_plane is None:
+        return None
     totals: dict[str, float] = {}
-    found = False
+    for plane_totals in per_plane.values():
+        for name, ms in plane_totals.items():
+            totals[name] = totals.get(name, 0.0) + ms
+    return totals
+
+
+def per_plane_op_totals_ms(logdir: str, line_name: str = "XLA Ops",
+                           drop_control_flow: bool = True) \
+        -> dict[str, dict[str, float]] | None:
+    """Per-device-plane per-op busy ms across every xplane file under
+    ``logdir`` (plane name -> {op name -> ms}). None when nothing
+    parseable — degrade, don't abort the caller's bench run."""
+    per_plane: dict[str, dict[str, float]] = {}
     for path in xplane_files(logdir):
         space = load_xspace(path)
         if space is None:
             continue  # unparseable dump: skip it, keep what parses
         for plane in device_planes(space):
-            meta = {m.id: m.name for m in plane.event_metadata.values()}
-            for line in plane.lines:
-                if line.name != line_name:
-                    continue
-                found = True
-                for ev in line.events:
-                    name = meta.get(ev.metadata_id, str(ev.metadata_id))
-                    # ' while(' / ' conditional(' can only be the HLO op
-                    # kind (op names contain no spaces; operand refs are
-                    # not followed by '('), so this cannot swallow a
-                    # custom call from a jax fn NAMED while_*; the
-                    # prefix check covers dumps whose metadata carries
-                    # only the op name — 'while.3' never collides with
-                    # 'while_scanner.3' (dot vs underscore)
-                    if drop_control_flow and (
-                            " while(" in name or " conditional(" in name
-                            or name.lstrip("%").startswith(
-                                ("while.", "conditional."))):
-                        continue
-                    totals[name] = totals.get(name, 0.0) \
-                        + ev.duration_ps / _PS_PER_MS
-    if not found:
+            totals = _plane_op_totals(plane, line_name, drop_control_flow)
+            if totals is None:
+                continue
+            agg = per_plane.setdefault(plane.name, {})
+            for name, ms in totals.items():
+                agg[name] = agg.get(name, 0.0) + ms
+    if not per_plane:
         _warn_degraded("no parseable device plane under " + logdir)
         return None
-    return totals
+    return per_plane
 
 
 def device_busy_ms(logdir: str, line_name: str = "XLA Ops") -> float | None:
-    """Total device-busy ms across the trace (sum of the per-op line —
-    serialized per core, so the sum IS busy time). None when the trace
-    has no device plane (e.g. CPU backend) or protos are unavailable."""
-    totals = op_totals_ms(logdir, line_name)
-    if totals is None:
+    """Busy ms of the BUSIEST device across the trace (per-plane sum of
+    the per-op line — serialized per core, so a plane's sum IS that
+    core's busy time; the max across planes is the critical-path chip,
+    the number comparable to wall clock). Summing across planes instead
+    would over-report by n_devices on a multi-chip trace — a 4-chip
+    data-parallel step would read as 4x "busier" than the wall it fits
+    in (ADVICE r5). None when the trace has no device plane (e.g. CPU
+    backend) or protos are unavailable."""
+    per_plane = per_plane_op_totals_ms(logdir, line_name)
+    if per_plane is None:
         return None
-    return sum(totals.values())
+    return max(sum(t.values()) for t in per_plane.values())
 
 
 def trace_device_ms(fn, args=(), steps: int = 10,

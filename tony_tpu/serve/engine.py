@@ -42,6 +42,7 @@ import functools
 import itertools
 import logging
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Iterator
@@ -53,6 +54,7 @@ import numpy as np
 from tony_tpu.models.generate import (init_cache, multi_decode_step,
                                       normalize_eos_ids,
                                       single_decode_step)
+from tony_tpu.obs.timeline import DispatchRecord, DispatchTimeline
 from tony_tpu.serve.faults import FaultPlan
 from tony_tpu.serve.prefix import PrefixStore
 from tony_tpu.serve.slots import SlotCache, _read_slot, cache_batch_axis
@@ -432,7 +434,8 @@ class Server:
                  min_bucket: int = 16, chunk_steps: int = 8,
                  max_pending: int = 1024, prefix_cache_mb: float = 0.0,
                  prefix_donate: bool = True, speculate_k: int = 0,
-                 fault_plan: FaultPlan | None = None):
+                 fault_plan: FaultPlan | None = None,
+                 timeline: bool = True):
         if model.cfg.quantized:
             # nothing structural in the way — the q8 apply is the same
             # model.apply — but untested here; fail loud, not wrong
@@ -475,6 +478,13 @@ class Server:
         #                       unit from `steps` — compare against
         #                       emitted tokens for utilization, the
         #                       pairing bench.py reports
+        # per-dispatch timeline (obs/timeline.py): one record per
+        # prefill / hit-admit / decode / verify dispatch with host-wall
+        # duration and a first-call compile flag; False = off, for the
+        # obs overhead A/B (bench extras.obs) — the layer itself is
+        # cheap enough to stay on in production
+        self.timeline = DispatchTimeline() if timeline else None
+        self._compiled: set = set()  # (kind, shape-bucket) pairs seen
         # speculative decoding (0 = off: zero overhead, no new programs)
         self.speculate_k = max(0, int(speculate_k))
         self._spec_ema = np.ones(batch_size, np.float64)
@@ -565,12 +575,17 @@ class Server:
         p = np.asarray(req.prompt, np.int32)
         max_len = self.model.cfg.max_seq_len
         slot = s.free_slots()[0]
+        t0 = time.monotonic()  # timeline: the whole admit (lookup +
+        occ = s.n_active       # dispatch + first-token sync)
         off, entry = 0, None
+        lookup_ms = None
         if self.prefix is not None:
             self.prefix_lookups += 1
             off, entry = self.prefix.acquire(p)
+            lookup_ms = (time.monotonic() - t0) * 1e3
         full_bucket = bucket_len(len(p), max_len, self.min_bucket)
         hit_tokens = saved = 0
+        d_kind, d_bucket = "prefill", full_bucket
         try:
             if entry is not None and off == len(p) \
                     and len(entry.tokens) == len(p) \
@@ -584,6 +599,7 @@ class Server:
                     jnp.float32(req.temperature), jnp.int32(req.top_k),
                     jax.random.PRNGKey(req.seed))
                 hit_tokens, saved = len(p), full_bucket
+                d_kind, d_bucket = "hit_admit", 0
             else:
                 if entry is not None:
                     # partial hit (or full-prompt match against a
@@ -608,6 +624,7 @@ class Server:
                     entry.row if entry is not None else None,
                     with_row=self.prefix is not None)
                 self.prefills += 1
+                d_bucket = lb
                 if self.prefix is not None:
                     cache, tok, key, row, last = out
                     self.prefix.insert(p, row, last)
@@ -622,7 +639,21 @@ class Server:
             self.prefix_hits += 1
             self.prefix_hit_tokens += hit_tokens
             self.prefill_tokens_saved += saved
-        tok = int(tok)
+        tok = int(tok)  # host sync: the admit dispatch is done here
+        if self.timeline is not None:
+            tags = {"prompt_len": len(p)}
+            if lookup_ms is not None:
+                tags["lookup_ms"] = round(lookup_ms, 3)
+            if hit_tokens:
+                tags["prefix_hit_tokens"] = hit_tokens
+            if off:
+                tags["offset"] = int(off)
+            key_ = (d_kind, d_bucket)
+            self.timeline.record(DispatchRecord(
+                d_kind, t0, (time.monotonic() - t0) * 1e3, occ,
+                d_bucket, 1, key_ not in self._compiled,
+                request_id=req.id, tags=tags))
+            self._compiled.add(key_)
         if tok in self.eos_ids or req.max_new_tokens == 1:
             # the slot row was written but never armed — the next admit
             # simply overwrites it
@@ -679,6 +710,10 @@ class Server:
         finished: list[Result] = []
         s = self.slots
         k = self._chunk_size()
+        if self.timeline is not None:
+            t0 = time.monotonic()
+            occ = s.n_active
+            riders = [lv.request.id for lv in self._live if lv is not None]
         cache, toks, rng = _decode_chunk(
             self.model, self.params, s.cache,
             jnp.asarray(s.last_token), jnp.asarray(s.positions()),
@@ -691,6 +726,12 @@ class Server:
         # np.array, not asarray: device arrays view as read-only and the
         # next admit writes its slot's key in place
         s.rng = np.array(rng, np.uint32)
+        if self.timeline is not None:
+            # duration closes at the host sync (np.asarray above), the
+            # latency a request actually experienced; tokens landed are
+            # counted below once the EOS/budget walk trims overshoot
+            dur_ms = (time.monotonic() - t0) * 1e3
+            landed = 0
 
         for slot in range(s.batch_size):
             live = self._live[slot]
@@ -715,10 +756,14 @@ class Server:
                 # slot's visible cache grew by k
                 s.lengths[slot] += k
                 s.last_token[slot] = int(toks[slot, k - 1])
+                if self.timeline is not None:
+                    landed += k
                 continue
             # tokens past the finish are chunk overshoot the host
             # trimmed: decoded, paid for, never reported
             self.wasted_steps += k - (j + 1)
+            if self.timeline is not None:
+                landed += j + 1
             finished.append(Result(req.id, list(req.prompt),
                                    live.generated, reason,
                                    live.prefix_hit_tokens,
@@ -728,6 +773,13 @@ class Server:
                 self._donate(live, slot)
             self._live[slot] = None
             s.evict(slot)
+        if self.timeline is not None:
+            key_ = ("decode", k)
+            self.timeline.record(DispatchRecord(
+                "decode", t0, dur_ms, occ, k, landed,
+                key_ not in self._compiled,
+                tags={"requests": riders}))
+            self._compiled.add(key_)
         return finished
 
     # ------------------------------------------------- speculative decode
@@ -834,6 +886,11 @@ class Server:
                 positions[slot, 1:1 + d.size] = \
                     s.lengths[slot] + 1 + np.arange(d.size)
                 draft_len[slot] = d.size
+        if self.timeline is not None:
+            t0 = time.monotonic()
+            occ = s.n_active
+            riders = [lv.request.id for lv in self._live
+                      if lv is not None]
         cache, emit, accepted, rng = _verify_chunk(
             self.model, self.params, s.cache, jnp.asarray(toks),
             jnp.asarray(positions), jnp.asarray(draft_len),
@@ -846,6 +903,9 @@ class Server:
         emit = np.asarray(emit)
         accepted = np.asarray(accepted)
         s.rng = np.array(rng, np.uint32)
+        if self.timeline is not None:
+            dur_ms = (time.monotonic() - t0) * 1e3  # closes at the sync
+            landed = 0
 
         for slot in range(b):
             live = self._live[slot]
@@ -881,6 +941,8 @@ class Server:
                     reason = "length"
                 if reason:
                     break
+            if self.timeline is not None:
+                landed += consumed
             if reason is None:
                 # fed last_token + a accepted drafts: the slot's
                 # position-exact span grew by accepted + 1
@@ -902,6 +964,15 @@ class Server:
                 self._donate(live, slot)
             self._live[slot] = None
             s.evict(slot)
+        if self.timeline is not None:
+            key_ = ("verify", window)
+            self.timeline.record(DispatchRecord(
+                "verify", t0, dur_ms, occ, window, landed,
+                key_ not in self._compiled,
+                tags={"requests": riders,
+                      "drafted": int(draft_len.sum()),
+                      "accepted": int(accepted.sum())}))
+            self._compiled.add(key_)
         return finished
 
     def _donate(self, live: _Live, slot: int) -> None:

@@ -117,6 +117,9 @@ if [ "${SERVE_SMOKE_ROUNDS:-all}" = chaos ]; then
 fi
 
 # ---- boot the gateway on an ephemeral port ---------------------------
+# TONY_PROFILE_DIR: the observability round's on-demand capture must
+# land under $WORK, not ./profiles in the checkout
+TONY_PROFILE_DIR="$WORK/profiles" \
 JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
     --replicas 2 --port 0 --compile-cache '' --speculate-k 4 \
     >"$WORK/boot.log" 2>"$WORK/stderr.log" &
@@ -251,6 +254,98 @@ assert spec["enabled"], spec
 assert spec["drafted"] > 0 and spec["accepted"] > 0, spec
 assert 0 < spec["acceptance_rate"] <= 1, spec
 EOF
+
+# ---- observability round: /metrics exposition + request traces ------
+# a request with a client-supplied request_id, then: scrape /metrics
+# and format-validate the exposition (HELP/TYPE headers, sample lines,
+# cumulative-monotonic histogram buckets ending in +Inf, the latency
+# histograms an autoscaler consumes), and fetch the request's trace as
+# Chrome trace-event JSON and span-check it
+code=$(curl_s "$WORK/obs_req" "$URL/v1/generate" \
+    '{"token_ids": [31, 32, 33], "max_new_tokens": 4, "request_id": "obs-1"}') \
+    || fail "obs request curl"
+[ "$code" = 200 ] || fail "obs request -> $code"
+grep -q '"request_id": "obs-1"' "$WORK/obs_req" || fail "request_id not echoed: $(cat "$WORK/obs_req")"
+
+code=$(curl_s "$WORK/metrics" "$URL/metrics") || fail "metrics curl"
+[ "$code" = 200 ] || fail "metrics -> $code"
+$PY - "$WORK/metrics" <<'EOF' || fail "/metrics exposition invalid"
+import re, sys
+text = open(sys.argv[1]).read()
+sample = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' (-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$')
+types, buckets = {}, {}
+for line in text.splitlines():
+    if not line:
+        continue
+    if line.startswith("# HELP "):
+        continue
+    if line.startswith("# TYPE "):
+        _, _, name, mtype = line.split(None, 3)
+        assert mtype in ("counter", "gauge", "histogram"), line
+        types[name] = mtype
+        continue
+    assert sample.match(line), f"malformed: {line!r}"
+    name = re.split(r"[{ ]", line, 1)[0]
+    base = re.sub(r"_(bucket|sum|count)$", "", name)
+    if types.get(base) == "histogram" and name.endswith("_bucket"):
+        series = re.sub(r',?le="[^"]+"', "", line.split(" ")[0])
+        le = re.search(r'le="([^"]+)"', line).group(1)
+        buckets.setdefault(series, []).append((le, float(line.rsplit(" ", 1)[1])))
+for series, pts in buckets.items():
+    vals = [v for _, v in pts]
+    assert vals == sorted(vals), f"non-monotonic buckets: {series}"
+    assert pts[-1][0] == "+Inf", f"missing +Inf: {series}"
+# the families the acceptance names, consistent with a live gateway
+assert types["tony_request_ttft_seconds"] == "histogram", types
+assert types["tony_request_tpot_seconds"] == "histogram"
+assert types["tony_request_queue_wait_seconds"] == "histogram"
+assert types["tony_replica_failures_total"] == "counter"
+assert types["tony_engine_prefix_hits_total"] == "counter"
+assert types["tony_engine_spec_accepted_total"] == "counter"
+assert re.search(r"^tony_requests_completed_total 11$", text, re.M), \
+    "completed counter wrong"
+EOF
+
+code=$(curl_s "$WORK/trace" "$URL/debug/trace/obs-1") || fail "trace curl"
+[ "$code" = 200 ] || fail "debug/trace -> $code"
+$PY - "$WORK/trace" <<'EOF' || fail "trace is not valid span-checked Chrome JSON"
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["otherData"]["request_id"] == "obs-1", doc["otherData"]
+events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+names = [e["name"] for e in events]
+assert names[0] == "request" and "attempt-1" in names, names
+assert "queue_wait" in names and ("prefill" in names or "hit_admit" in names), names
+root = events[0]
+# 5 us tolerance: ts is epoch MICROseconds (~1.7e15), where float64
+# granularity is ~0.25 us — exact comparisons are noise, not bugs
+for e in events:
+    assert e["dur"] >= 0 and e["ts"] >= root["ts"] - 5, e
+    assert e["ts"] + e["dur"] <= root["ts"] + root["dur"] + 5, e
+EOF
+echo "serve-smoke: observability OK (/metrics format-valid, trace span-checked)"
+
+# ---- on-demand profile round: arm, drive, capture lands --------------
+# steps=1: the next working scheduler iteration is captured. The FIRST
+# start_trace of a process can take >10 s (profiler plugin spin-up) —
+# bounded below, and well inside the default 30 s stall horizon.
+code=$(curl_s "$WORK/prof_arm" "$URL/debug/profile?steps=1&logdir=smoke" '{}') \
+    || fail "profile arm curl"
+[ "$code" = 200 ] || fail "profile arm -> $code: $(cat "$WORK/prof_arm")"
+i=0
+while [ $i -lt $BOUND ]; do
+    curl_s "$WORK/prof_drive" "$URL/v1/generate" \
+        '{"token_ids": [41, 42], "max_new_tokens": 3}' >/dev/null 2>&1
+    curl_s "$WORK/prof_status" "$URL/debug/profile" >/dev/null 2>&1
+    grep -q '"captures": [1-9]' "$WORK/prof_status" && break
+    sleep 1; i=$((i + 1))
+done
+grep -q '"captures": [1-9]' "$WORK/prof_status" || fail "profile capture never finished: $(cat "$WORK/prof_status")"
+echo "serve-smoke: profile OK (on-demand xplane capture landed)"
 
 kill -TERM $GW_PID
 i=0
