@@ -159,6 +159,25 @@ def test_bench_prefix_store_saves_prefill(bench):
     assert out["ttft_p50_speedup"] >= 1.0, out
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
+def test_bench_paged_bounds(bench):
+    """The extras.paged acceptance bounds: (a) equal-batch decode
+    holds >= 0.95x unpaged tok/s (gather overhead bounded), (b) at an
+    equal KV byte budget the paged side fits a strictly larger batch
+    and clears >= 1.3x aggregate tok/s on the mixed-length workload,
+    (c) a prefix-hit admission moves >= 10x fewer bytes than the
+    row-copy path, with the aliasing admits visible as cow_admit
+    dispatches (outputs are asserted identical inside the bench)."""
+    out = bench.bench_paged(False)
+    assert out["equal_batch_ratio"] >= 0.95, out
+    assert out["paged_batch"] > out["unpaged_batch"]
+    assert out["equal_hbm_speedup"] >= 1.3, out
+    assert out["cow_admit_dispatches_paged"] == \
+        out["hit_admit_dispatches_unpaged"], out
+    assert out["hit_bytes_ratio"] >= 10, out
+    assert out["outputs_identical"]
+
+
 def test_stdout_guard_artifact_is_final_line():
     """VERDICT item 7: everything printed inside the guard (python- or
     fd-level, as sub-benches and their children do) lands on stderr;

@@ -397,9 +397,14 @@ def test_wedge_during_drain_still_fails_over(tiny):
     # warm each engine's jits BEFORE arming: with a stall horizon this
     # tight (the point of the test), a first-step compile would read
     # as a stall — exactly the --stall-timeout footgun the docs call
-    # out. Warming first keeps the fault the ONLY slow dispatch.
+    # out. Warming first keeps the fault the ONLY slow dispatch. The
+    # warm generation runs LONG enough to cross every paged
+    # view-bucket boundary the real run will reach (the paged engine
+    # compiles one decode program per power-of-two live-extent bucket,
+    # so a short warm would leave a mid-drain compile that reads as a
+    # survivor stall).
     for s in servers:
-        list(s.run([Request([1, 2], max_new_tokens=2, id="warm")]))
+        list(s.run([Request([1, 2], max_new_tokens=28, id="warm")]))
         s.reset()
     servers[0].fault_plan = FaultPlan.wedge_at(2, seconds=2.0)
     # throttle the survivor (30 ms/dispatch, forever): its drain must

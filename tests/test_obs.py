@@ -262,12 +262,15 @@ def test_engine_timeline_records_reconcile_with_results(tiny):
     # ones; trimmed chunk overshoot is NOT counted as landed
     total_emitted = sum(len(r.tokens) for r in results)
     assert sum(r.tokens for r in decodes) == total_emitted - len(results)
-    # compile flag: exactly one first-call per distinct (kind, bucket)
+    # compile flag: exactly one first-call per distinct program shape —
+    # (kind, bucket) plus, on the paged engine, the bucketed view span
+    # (tags.view_tokens), which is a second shape knob
     for kind in ("prefill", "decode"):
         by_bucket = {}
         for r in recs:
             if r.kind == kind:
-                by_bucket.setdefault(r.bucket, []).append(r.compile)
+                key = (r.bucket, r.tags.get("view_tokens", 0))
+                by_bucket.setdefault(key, []).append(r.compile)
         for bucket, flags in by_bucket.items():
             assert flags[0] is True and not any(flags[1:]), (kind, bucket)
     # decode records carry the engine ids live at dispatch time
@@ -572,6 +575,25 @@ def test_metrics_exposition_format_and_stats_consistency(tiny):
         for i, row in enumerate(snap["replicas"]):
             assert (f'tony_engine_prefills_total{{replica="{i}"}} '
                     f'{row["prefills"]}') in text
+        # the paged-KV block: /metrics and /stats must agree on every
+        # kv_pages figure (per-replica gauges sum to the engine rollup)
+        kv = snap["engine"]["kv_pages"]
+        assert kv["enabled"]
+        assert "tony_kv_paged_enabled 1" in text
+        for key, gauge in (("kv_pages_total", "tony_kv_pages_total_pages"),
+                           ("kv_pages_used", "tony_kv_pages_used"),
+                           ("kv_cow_shared", "tony_kv_cow_shared_pages"),
+                           ("kv_bytes_resident", "tony_kv_bytes_resident"),
+                           ("kv_tokens_resident",
+                            "tony_kv_tokens_resident")):
+            rollup_key = key.replace("kv_pages_", "").replace("kv_", "")
+            total = 0
+            for i, row in enumerate(snap["replicas"]):
+                assert (f'{gauge}{{replica="{i}"}} '
+                        f'{row[key]}') in text
+                total += row[key]
+            assert kv[rollup_key] == total, (key, kv)
+        assert kv["used"] + kv["free"] == kv["total"]
     finally:
         assert gw.drain(timeout=60)
 

@@ -185,12 +185,13 @@ def test_evict_zeroes_rng_row(tiny):
 # ------------------------------------------------------ engine parity
 
 
-def test_exact_hit_skips_prefill_and_matches_solo(tiny):
+@pytest.mark.parametrize("paged", [True, False])
+def test_exact_hit_skips_prefill_and_matches_solo(tiny, paged):
     model, params = tiny
     prompt = [17, 46, 10, 20, 62, 26]
     solo = _solo(model, params, prompt, 6)
     server = Server(model, params, batch_size=1, min_bucket=8,
-                    prefix_cache_mb=32)
+                    prefix_cache_mb=32, paged=paged)
     first = _serve_one(server, prompt, 6)
     assert first.tokens == solo
     assert server.prefills == 1 and first.prefix_hit_tokens == 0
@@ -202,18 +203,21 @@ def test_exact_hit_skips_prefill_and_matches_solo(tiny):
     assert server.prefix_hits == 1 and server.prefix_lookups == 2
 
 
-def test_partial_hit_and_miss_match_store_off(tiny):
+@pytest.mark.parametrize("paged", [True, False])
+def test_partial_hit_and_miss_match_store_off(tiny, paged):
     """Shared-preamble prompts: every request on the store-on server
     must produce exactly the store-off (and solo) tokens, while the
     sharers register hit tokens. (All prompts share one length so the
-    whole test reuses a single solo-generate program.)"""
+    whole test reuses a single solo-generate program.) Runs the
+    matrix over both cache layouts: paged (page aliasing +
+    copy-on-write boundary forks) and the fixed-shape rows."""
     model, params = tiny
     pre = [3, 1, 4, 1]
     prompts = [pre + [11, 12], pre + [21, 22], pre + [31, 32],
                [40, 41, 30, 31, 20, 21]]
     on = Server(model, params, batch_size=2, min_bucket=8,
-                prefix_cache_mb=32)
-    off = Server(model, params, batch_size=2, min_bucket=8)
+                prefix_cache_mb=32, paged=paged)
+    off = Server(model, params, batch_size=2, min_bucket=8, paged=paged)
     for i, p in enumerate(prompts):
         want = _solo(model, params, p, 6)
         assert _serve_one(off, p, 6).tokens == want, p
@@ -271,30 +275,35 @@ def test_no_donation_when_disabled(tiny):
     assert server.prefix.stats()["inserts"] == 1
 
 
-def test_sampled_requests_identical_through_store(tiny):
+@pytest.mark.parametrize("paged", [True, False])
+def test_sampled_requests_identical_through_store(tiny, paged):
     """The exact-hit path samples from the STORED logits with the
     request's own knobs: a sampled request repeated behind a hit must
-    reproduce the store-off draws bit-for-bit."""
+    reproduce the store-off draws bit-for-bit (both cache layouts)."""
     model, params = tiny
     prompt = [1, 2, 3, 4]
     kw = dict(temperature=0.9, top_k=8, seed=7)
-    off = _serve_one(Server(model, params, batch_size=1, min_bucket=8),
+    off = _serve_one(Server(model, params, batch_size=1, min_bucket=8,
+                            paged=paged),
                      prompt, 5, **kw)
     on = Server(model, params, batch_size=1, min_bucket=8,
-                prefix_cache_mb=32)
+                prefix_cache_mb=32, paged=paged)
     first = _serve_one(on, prompt, 5, **kw)
     second = _serve_one(on, prompt, 5, **kw)  # exact hit
     assert first.tokens == second.tokens == off.tokens
     assert second.prefix_hit_tokens == len(prompt)
 
 
-def test_eviction_under_budget_pressure_keeps_parity(tiny):
+@pytest.mark.parametrize("paged", [True, False])
+def test_eviction_under_budget_pressure_keeps_parity(tiny, paged):
     """A budget that holds ~2 rows churns hard under 6 distinct
     prompts: entries evict mid-serving and outputs must stay exact;
-    the store never exceeds its byte budget."""
+    the store never exceeds its byte budget (page-granular accounting
+    in the paged layout, whole rows in the fixed-shape one)."""
     model, params = tiny
     server = Server(model, params, batch_size=2, min_bucket=8,
-                    prefix_cache_mb=2.1 * server_row_mb(tiny))
+                    prefix_cache_mb=2.1 * server_row_mb(tiny),
+                    paged=paged, kv_page_size=8)
     prompts = [[i + 1, 2, 3, i + 4, 5, 6] for i in range(6)]
     for p in prompts + prompts[:2]:
         assert _serve_one(server, p, 6).tokens == \

@@ -73,6 +73,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "verification; greedy outputs unchanged, "
                         "sampled requests unaffected). 0 disables; "
                         "acceptance shows on /stats under engine.spec")
+    p.add_argument("--kv-page-size", type=int, default=0,
+                   help="tokens per KV-cache page (the block-paged "
+                        "cache: residency bounded by actual tokens, "
+                        "prefix reuse by copy-on-write page sharing). "
+                        "0 auto-sizes from max_seq_len; utilization "
+                        "shows on /stats under engine.kv_pages")
+    p.add_argument("--kv-pages", type=int, default=0,
+                   help="KV page-pool size per replica; 0 auto-sizes "
+                        "(the unpaged-equivalent footprint, grown "
+                        "into free TpuDiscoverer HBM on TPU — same "
+                        "resolution style as --prefix-cache-mb)")
+    p.add_argument("--no-paged-kv", action="store_true",
+                   help="serve fixed-shape per-slot cache rows instead "
+                        "of the paged pool (A/B escape hatch; "
+                        "sliding-window models downgrade automatically)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000,
                    help="0 picks an ephemeral port")
@@ -153,11 +168,14 @@ def demo_model():
 
 def build_gateway(args, model, params, eos, *, metrics_store=None):
     """Servers + Gateway from parsed args (shared with tests/bench)."""
-    from tony_tpu.cli.generate import resolve_prefix_cache_mb
+    from tony_tpu.cli.generate import (resolve_paged_kv,
+                                       resolve_prefix_cache_mb)
     from tony_tpu.gateway import Gateway, GatewayHistory
     from tony_tpu.serve import FaultPlan, Server
 
     prefix_mb = resolve_prefix_cache_mb(args, model)
+    paged_kw = resolve_paged_kv(args, model, args.serve_batch,
+                                n_replicas=max(1, args.replicas))
     # TONY_SERVE_FAULTS arms deterministic fault injection per replica
     # (serve/faults.py) — the chaos-smoke hook; unset = None = zero cost
     servers = [Server(model, params, batch_size=args.serve_batch,
@@ -165,7 +183,8 @@ def build_gateway(args, model, params, eos, *, metrics_store=None):
                       max_pending=args.max_pending,
                       prefix_cache_mb=prefix_mb,
                       speculate_k=args.speculate_k,
-                      fault_plan=FaultPlan.from_env(replica=i))
+                      fault_plan=FaultPlan.from_env(replica=i),
+                      **paged_kw)
                for i in range(max(1, args.replicas))]
     armed = [i for i, s in enumerate(servers) if s.fault_plan is not None]
     if armed:

@@ -115,6 +115,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "prompt-lookup draft tokens verified per "
                         "batched dispatch (greedy outputs unchanged; "
                         "sampled requests decode normally). 0 disables")
+    p.add_argument("--kv-page-size", type=int, default=0,
+                   help="--serve mode: tokens per KV-cache page "
+                        "(block-paged cache; 0 auto-sizes from "
+                        "max_seq_len)")
+    p.add_argument("--kv-pages", type=int, default=0,
+                   help="--serve mode: KV page-pool size per replica "
+                        "(0 auto-sizes: the unpaged-equivalent "
+                        "footprint, grown into free HBM on TPU)")
+    p.add_argument("--no-paged-kv", action="store_true",
+                   help="--serve mode: fixed-shape per-slot cache rows "
+                        "instead of the paged pool (A/B escape hatch; "
+                        "sliding-window models downgrade automatically)")
     p.add_argument("--compile-cache",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "tony_tpu", "compile-cache"),
@@ -159,6 +171,50 @@ def load_model(model_dir: str):
     return model, params, config
 
 
+def resolve_paged_kv(args, model, batch_size: int,
+                     n_replicas: int = 1) -> dict:
+    """``Server(paged=..., kv_page_size=..., kv_pages=...)`` kwargs from
+    CLI args — shared with ``cli.gateway``, mirroring the
+    ``resolve_prefix_cache_mb`` precedent: the feature defaults ON, so
+    the CLIs degrade (stderr note) instead of crashing on model configs
+    the engine refuses (sliding-window attention), and ``--kv-pages 0``
+    auto-sizes the per-replica pool: the unpaged-equivalent footprint
+    (``batch x ceil(max_seq_len / page_size)`` — capacity parity) as
+    the floor, grown toward half the free HBM TpuDiscoverer reports
+    SPLIT ACROSS the ``n_replicas`` pools that will coexist (capped at
+    4x the floor) when a TPU is present — the freed fixed-shape waste
+    is exactly what bigger batches grow into."""
+    if getattr(args, "no_paged_kv", False):
+        return {"paged": False}
+    if model.cfg.sliding_window:
+        print("note: paged KV cache disabled (untested over "
+              "sliding-window attention)", file=sys.stderr)
+        return {"paged": False}
+    from tony_tpu.serve.slots import default_page_size, kv_page_nbytes
+
+    cfg = model.cfg
+    ps = int(getattr(args, "kv_page_size", 0) or 0) \
+        or default_page_size(cfg)
+    ps = max(1, min(ps, cfg.max_seq_len))
+    pages = int(getattr(args, "kv_pages", 0) or 0)
+    if pages <= 0:
+        base = batch_size * (-(-cfg.max_seq_len // ps))
+        pages = base
+        try:
+            from tony_tpu.utils.tpu_info import TpuDiscoverer
+
+            info = TpuDiscoverer().get_device_information()
+            free = sum(c.hbm_total_bytes - c.hbm_used_bytes
+                       for c in info.chips)
+            if free > 0:
+                hbm_pages = int(free * 0.5 / max(1, n_replicas)) \
+                    // kv_page_nbytes(cfg, ps)
+                pages = max(base, min(4 * base, hbm_pages))
+        except Exception:  # noqa: BLE001 — no TPU / no tpu-info binary:
+            pass           # the capacity-parity floor is always safe
+    return {"paged": True, "kv_page_size": ps, "kv_pages": pages}
+
+
 def resolve_prefix_cache_mb(args, model) -> float:
     """``--prefix-cache-mb``, downgraded to 0 (with a stderr note) for
     model configs the prefix store refuses — the flag defaults ON, so
@@ -192,13 +248,16 @@ def _serve_loop(model, params, args, eos) -> int:
 
     n_replicas = max(1, getattr(args, "serve_replicas", 1))
     prefix_mb = resolve_prefix_cache_mb(args, model)
+    paged_kw = resolve_paged_kv(args, model, args.serve_batch,
+                                n_replicas=n_replicas)
     # same chaos hook as the gateway CLI: TONY_SERVE_FAULTS arms
     # deterministic per-replica fault injection (serve/faults.py)
     servers = [Server(model, params["params"],
                       batch_size=args.serve_batch, eos_id=eos,
                       prefix_cache_mb=prefix_mb,
                       speculate_k=args.speculate_k,
-                      fault_plan=FaultPlan.from_env(replica=i))
+                      fault_plan=FaultPlan.from_env(replica=i),
+                      **paged_kw)
                for i in range(n_replicas)]
     armed = [i for i, s in enumerate(servers) if s.fault_plan is not None]
     if armed:

@@ -83,7 +83,7 @@ from typing import Any, Callable
 
 from tony_tpu.obs import Histogram, RequestTrace, TraceBuffer
 from tony_tpu.obs.timeline import DispatchTimeline
-from tony_tpu.serve import QueueFull, Request, Server
+from tony_tpu.serve import PoolExhausted, QueueFull, Request, Server
 
 log = logging.getLogger(__name__)
 
@@ -489,6 +489,12 @@ class _Replica:
                     self, [], [ticket],
                     f"replica {self.index} failed during admission")
                 return
+            except PoolExhausted as e:
+                # capacity, not malformation: the request can never fit
+                # this replica's KV page pool — 503 so a caller against
+                # a bigger deployment may legitimately retry
+                self._shed(ticket, 503, str(e), epoch=epoch)
+                continue
             except ValueError as e:
                 self._shed(ticket, 400, str(e), epoch=epoch)
                 continue
@@ -516,8 +522,9 @@ class _Replica:
 
     def _attach_dispatch_spans(self, epoch: int) -> None:
         """Fold the engine's new ``DispatchRecord``s into the traces of
-        the requests that rode them: admit records (prefill/hit_admit)
-        carry the engine id they admitted; decode/verify records carry
+        the requests that rode them: admit records (prefill/hit_admit/
+        cow_admit) carry the engine id they admitted; decode/verify
+        records carry
         the engine ids live at dispatch time. Runs on the replica
         thread after each step. Records for tickets already stolen are
         DROPPED by the trace's ``attempt_key`` fence — checked against
@@ -535,7 +542,7 @@ class _Replica:
             tickets = dict(self._tickets)
         key = (self.index, epoch)
         for rec in new:
-            if rec.kind in ("prefill", "hit_admit"):
+            if rec.kind in ("prefill", "hit_admit", "cow_admit"):
                 targets = [tickets.get(rec.request_id)]
             else:
                 targets = [tickets.get(eid)
@@ -1503,5 +1510,22 @@ class Gateway:
                 "bytes": total("prefix_bytes"),
                 "budget_bytes": total("prefix_budget_bytes"),
                 "evictions": total("prefix_evictions"),
+            },
+            # the paged-KV utilization block (ROADMAP 4's fixed-shape-
+            # waste sensor): how many pages exist / hold tokens / are
+            # shared copy-on-write, and how many bytes that keeps
+            # resident vs the tokens actually living in them
+            "kv_pages": {
+                "enabled": any(s.paged for s in servers),
+                "total": total("kv_pages_total"),
+                "used": total("kv_pages_used"),
+                "free": total("kv_pages_free"),
+                "reserved": total("kv_pages_reserved"),
+                "cow_shared": total("kv_cow_shared"),
+                "cow_forks": total("kv_cow_forks"),
+                "page_size": max((c.get("kv_page_size", 0)
+                                  for c in counts), default=0),
+                "bytes_resident": total("kv_bytes_resident"),
+                "tokens_resident": total("kv_tokens_resident"),
             },
         }

@@ -103,7 +103,8 @@ def _is_eos(tok, eos_ids):
     return hit
 
 
-def single_decode_step(model, params, cache, tok, positions=None):
+def single_decode_step(model, params, cache, tok, positions=None,
+                       page_table=None):
     """ONE token step through the KV cache: feed ``tok`` [b] at the
     current position(s), return ``(new_cache, last_logits [b, V])``.
 
@@ -112,15 +113,22 @@ def single_decode_step(model, params, cache, tok, positions=None):
     (``positions=None``, all rows in lockstep) and the per-slot path
     (``positions`` [b], every row at its own cache position — negative
     marks an empty slot) run the same model.apply; only the position
-    bookkeeping differs (Attention._decode_attention)."""
+    bookkeeping differs (Attention._decode_attention). ``page_table``
+    [b, max_pages] switches the per-slot path to the paged cache
+    layout (serve/slots.PagePool — ``cache`` holds page pools instead
+    of per-slot rows; same attention reduction over the gathered
+    view)."""
     kwargs = {} if positions is None else {"positions": positions}
+    if page_table is not None:
+        kwargs["page_table"] = page_table
     logits, vars_ = model.apply({"params": params, "cache": cache},
                                 tok[:, None], decode=True,
                                 mutable=["cache"], **kwargs)
     return vars_["cache"], logits[:, -1]
 
 
-def multi_decode_step(model, params, cache, toks, positions):
+def multi_decode_step(model, params, cache, toks, positions,
+                      page_table=None):
     """A ``k``-token per-slot window through the KV cache in ONE apply:
     feed ``toks`` [b, k] with every row at its own positions [b, k],
     return ``(new_cache, logits [b, k, V])`` — the logits AFTER each
@@ -135,10 +143,14 @@ def multi_decode_step(model, params, cache, toks, positions):
     ``positions[i, :]`` and attend causally by position (intra-window
     included); entries with ``positions[i, j] < 0`` are padding whose
     cache writes are dropped and whose logits are garbage
-    (Attention._decode_attention's [b, k] mode)."""
+    (Attention._decode_attention's [b, k] mode). ``page_table``
+    [b, max_pages] switches to the paged cache layout (the paged
+    serving engine's verify window AND its prefill: a prefill is just
+    one big per-slot window writing straight into the slot's pages)."""
+    kwargs = {} if page_table is None else {"page_table": page_table}
     logits, vars_ = model.apply({"params": params, "cache": cache},
                                 toks, decode=True, mutable=["cache"],
-                                positions=positions)
+                                positions=positions, **kwargs)
     return vars_["cache"], logits
 
 

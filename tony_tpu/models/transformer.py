@@ -337,7 +337,7 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, decode: bool = False, segment_ids=None,
-                 positions=None):
+                 positions=None, page_table=None):
         cfg = self.cfg
         b, l, _ = x.shape
         # logical sharding axes for these kernels come from path-name
@@ -356,7 +356,7 @@ class Attention(nn.Module):
         k = dense("k", (cfg.kv_heads, cfg.head_dim), qkv_bias)(x)
         v = dense("v", (cfg.kv_heads, cfg.head_dim), qkv_bias)(x)
         if decode:
-            out = self._decode_attention(q, k, v, positions)
+            out = self._decode_attention(q, k, v, positions, page_table)
         else:
             if cfg.positional == "rope":
                 positions = jnp.arange(l)
@@ -388,8 +388,29 @@ class Attention(nn.Module):
                 kernel_init=nn.initializers.normal(0.02))(out)
         return out
 
-    def _decode_attention(self, q, k, v, positions=None):
+    def _decode_attention(self, q, k, v, positions=None, page_table=None):
         """Incremental attention over a fixed-size KV cache.
+
+        ``page_table`` [b, max_pages] int32 switches the per-slot modes
+        to the PAGED cache layout (serve/slots.PagePool): the cache
+        leaves are page POOLS ``[n_pages, page_size, kvh, dh]`` (scales
+        ``[n_pages, page_size, kvh]``) with no batch dim — row i's
+        token at position p writes pool page ``page_table[i, p //
+        page_size]`` at offset ``p % page_size``, and row i attends
+        over the GATHER of its own pages, reshaped back to the
+        ``[max_pages * page_size]`` position-ordered view the unpaged
+        buffer would hold — same values at the same logical positions,
+        so the attention reduction (and greedy outputs) are identical
+        to the unpaged path. Table entries >= n_pages are UNALLOCATED
+        sentinels: writes through them drop (scatter mode="drop"),
+        gathers clamp to an arbitrary page whose junk the per-row
+        position-visibility mask hides — exactly the bucket-padding
+        argument. Positions at or past ``max_pages * page_size`` also
+        drop (a chunk overshooting a finished slot's budget must not
+        wrap into the slot's own live pages). The host allocator
+        guarantees every position that must LAND maps to an allocated,
+        unshared page (copy-on-write forks happen at admission,
+        serve/engine.py).
 
         Flax "cache" collection, the standard jittable decode shape: the
         cache is a static [b, max_seq_len, kv_heads, dh] buffer (GQA: only
@@ -450,6 +471,9 @@ class Attention(nn.Module):
         if not is_init:  # shape-only init pass
             return jnp.zeros((b, l, h, dh), q.dtype)
         per_slot = positions is not None
+        paged = page_table is not None
+        if paged and not per_slot:
+            raise ValueError("page_table requires per-slot positions")
         if per_slot:
             # normalize to the [b, l] window form: [b] is the classic
             # single-token step, [b, l] the speculative verify window
@@ -481,7 +505,48 @@ class Attention(nn.Module):
 
             k, k_sc = quantize_kv(k)  # quantize-on-write, after RoPE
             v, v_sc = quantize_kv(v)
-        if per_slot:
+        if paged:
+            # paged scatter: token (i, j) lands in pool page
+            # page_table[i, pos // page_size] at offset pos % page_size.
+            # Invalid entries — padding (pos < 0), positions past the
+            # table's span (budget overshoot), unallocated sentinel
+            # table entries (>= n_pages) — are redirected to the
+            # explicit out-of-range page index and DROPPED, never
+            # clamped: a clamp would overwrite a LIVE page (possibly a
+            # copy-on-write page another slot shares).
+            pool_k, pool_v = cached_k.value, cached_v.value
+            n_pages, ps = pool_k.shape[-4], pool_k.shape[-3]
+            span = page_table.shape[1] * ps
+            valid = (pos2d >= 0) & (pos2d < span)
+            safe = jnp.where(valid, pos2d, 0)
+            page = jnp.take_along_axis(page_table, safe // ps, axis=1)
+            page = jnp.where(valid, page, n_pages)  # drop via OOB
+            off = safe % ps
+            if quant:
+                k_scales.value = k_scales.value.at[page, off].set(
+                    k_sc, mode="drop")
+                v_scales.value = v_scales.value.at[page, off].set(
+                    v_sc, mode="drop")
+            pool_k = pool_k.at[page, off].set(k, mode="drop")
+            pool_v = pool_v.at[page, off].set(v, mode="drop")
+            cached_k.value = pool_k
+            cached_v.value = pool_v
+            # gather each row's pages back into the position-ordered
+            # [span] view the unpaged buffer would hold (position p =
+            # gather index p — identical values, identical reduction).
+            # Sentinel entries clamp to page n_pages-1: junk the
+            # visibility mask hides, same as bucket padding.
+            tab = jnp.clip(page_table, 0, n_pages - 1)
+            keys = jnp.take(pool_k, tab, axis=0).reshape(
+                b, span, kvh, dh)
+            values = jnp.take(pool_v, tab, axis=0).reshape(
+                b, span, kvh, dh)
+            if quant:
+                ksc = jnp.take(k_scales.value, tab, axis=0).reshape(
+                    b, span, kvh)
+                vsc = jnp.take(v_scales.value, tab, axis=0).reshape(
+                    b, span, kvh)
+        elif per_slot:
             # scatter each row's tokens at that row's own cache
             # positions (one batched scatter — no per-slot dispatch).
             # Invalid entries (empty slots, window padding: position
@@ -538,8 +603,10 @@ class Attention(nn.Module):
                 else cur + 1
             out = flash_decode(
                 q[:, 0], keys, values, length, window=win,
-                k_scale=k_scales.value if quant else None,
-                v_scale=v_scales.value if quant else None)
+                k_scale=(ksc if paged else k_scales.value)
+                if quant else None,
+                v_scale=(vsc if paged else v_scales.value)
+                if quant else None)
             return out[:, None].astype(q.dtype)
         if not per_slot and win > 0 and win + l <= max_len:
             # windowed decode: attend over a STATIC (window+l)-sized slice
@@ -562,8 +629,14 @@ class Attention(nn.Module):
         else:
             keys_att, values_att = keys, values
             if quant:
-                ks_att, vs_att = k_scales.value, v_scales.value
-            kv_pos = jnp.arange(max_len)
+                ks_att, vs_att = (ksc, vsc) if paged else \
+                    (k_scales.value, v_scales.value)
+            # size by the BUFFER, not cfg.max_seq_len: the paged
+            # engine's bucketed views run this branch with a cache
+            # shorter than max_len (every dropped column would have
+            # contributed exactly-0.0 softmax weight, so outputs are
+            # bit-identical — and the attention read is O(live extent))
+            kv_pos = jnp.arange(keys.shape[1])
         # grouped attention: q [b, l, kvh, group, dh] against kv [b, m, kvh, dh]
         qg = q.astype(jnp.float32).reshape(b, l, kvh, group, dh)
         # int8 cache: convert to bf16, not fp32 — int8 magnitudes
@@ -813,10 +886,11 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, decode: bool = False, segment_ids=None,
-                 positions=None):
+                 positions=None, page_table=None):
         attn_out = Attention(self.cfg, name="attn")(
             make_norm(self.cfg, "ln1")(x), decode=decode,
-            segment_ids=segment_ids, positions=positions)
+            segment_ids=segment_ids, positions=positions,
+            page_table=page_table)
         ffn_cls = MoEMLP if self.use_moe else MLP
         if (self.cfg.remat and not decode
                 and self.cfg.remat_policy == "attn_saved"):
@@ -860,10 +934,10 @@ class _ScanBody(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, segment_ids, positions):
+    def __call__(self, x, segment_ids, positions, page_table):
         return Block(self.cfg, name="block")(
             x, self.decode, segment_ids=segment_ids,
-            positions=positions), None
+            positions=positions, page_table=page_table), None
 
 
 class Transformer(nn.Module):
@@ -903,7 +977,7 @@ class Transformer(nn.Module):
         return pos_emb[pos][None].astype(cfg.dtype)
 
     def _scan_blocks(self, x, decode: bool, segment_ids=None,
-                     positions=None):
+                     positions=None, page_table=None):
         cfg = self.cfg
         body = _ScanBody
         if cfg.remat and not decode:
@@ -914,17 +988,18 @@ class Transformer(nn.Module):
             body,
             variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True},
-            in_axes=nn.broadcast,  # segment_ids/positions: same every layer
-            length=cfg.n_layers,
+            in_axes=nn.broadcast,  # segment_ids/positions/page_table:
+            length=cfg.n_layers,   # same every layer
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        x, _ = scanned(cfg, decode, name="layers")(x, segment_ids, positions)
+        x, _ = scanned(cfg, decode, name="layers")(x, segment_ids,
+                                                   positions, page_table)
         return x
 
     @nn.compact
     def __call__(self, tokens, decode: bool = False,
                  return_hidden: bool = False, segment_ids=None,
-                 positions=None):
+                 positions=None, page_table=None):
         """return_hidden=True yields the final [B, L, D] activations
         (post ln_f) instead of logits, for the chunked large-vocab loss
         (ops.xent.chunked_cross_entropy with params["embedding"]) — the
@@ -941,13 +1016,22 @@ class Transformer(nn.Module):
         an independent cache slot at its own position; negative = empty
         slot. [B, L] is the multi-token window (speculative verify):
         row i's token j sits at positions[i, j]; negative entries are
-        dropped padding. See Attention._decode_attention."""
+        dropped padding. See Attention._decode_attention.
+
+        page_table [B, max_pages] int32 (decode + positions only):
+        the PAGED cache layout — cache leaves are page pools
+        [n_pages, page_size, kvh, dh] (serve/slots.PagePool) and row
+        i's positions map through its page table; see
+        Attention._decode_attention."""
         if segment_ids is not None and decode:
             raise ValueError("segment_ids are a training-path feature; "
                              "decode has no segment notion")
         if positions is not None and not decode:
             raise ValueError("positions (per-slot decode) requires "
                              "decode=True")
+        if page_table is not None and positions is None:
+            raise ValueError("page_table (paged KV cache) requires "
+                             "per-slot positions")
         cfg = self.cfg
         embed = self.param("embedding", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.d_model), jnp.float32)
@@ -959,7 +1043,8 @@ class Transformer(nn.Module):
             x = x + self._learned_positions(tokens.shape[1], decode,
                                             positions)
         if cfg.scan_layers:
-            x = self._scan_blocks(x, decode, segment_ids, positions)
+            x = self._scan_blocks(x, decode, segment_ids, positions,
+                                  page_table)
         else:
             block = Block
             if cfg.remat and not decode:
@@ -970,7 +1055,8 @@ class Transformer(nn.Module):
             for i in range(cfg.n_layers):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
                 x = block(cfg, use_moe=use_moe, name=f"block_{i}")(
-                    x, decode, segment_ids=segment_ids, positions=positions)
+                    x, decode, segment_ids=segment_ids, positions=positions,
+                    page_table=page_table)
         x = make_norm(cfg, "ln_f")(x)
         if not cfg.tied_embeddings:
             head = self.param("lm_head", nn.initializers.normal(0.02),

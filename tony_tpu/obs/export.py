@@ -72,6 +72,23 @@ _REPLICA_GAUGES = (
     ("prefix_bytes", "tony_prefix_bytes", "Prefix store bytes resident"),
     ("prefix_budget_bytes", "tony_prefix_budget_bytes",
      "Prefix store byte budget"),
+    # paged-KV utilization (absent on unpaged replicas): the
+    # fixed-shape-waste sensor — resident bytes track allocated pages,
+    # tokens_resident what actually lives in them
+    ("kv_pages_total", "tony_kv_pages_total_pages", "KV page pool size"),
+    ("kv_pages_used", "tony_kv_pages_used", "KV pages allocated"),
+    ("kv_pages_free", "tony_kv_pages_free", "KV pages on the free list"),
+    ("kv_pages_reserved", "tony_kv_pages_reserved",
+     "KV pages reserved by admitted requests, not yet allocated"),
+    ("kv_cow_shared", "tony_kv_cow_shared_pages",
+     "KV pages held by more than one owner (copy-on-write sharing)"),
+    ("kv_cow_forks", "tony_kv_cow_forks",
+     "Copy-on-write page forks performed (lifetime)"),
+    ("kv_page_size", "tony_kv_page_size_tokens", "Tokens per KV page"),
+    ("kv_bytes_resident", "tony_kv_bytes_resident",
+     "Bytes of KV pool resident (allocated pages x page bytes)"),
+    ("kv_tokens_resident", "tony_kv_tokens_resident",
+     "Tokens resident in allocated pages (live slots + prefix store)"),
 )
 
 _SUPERVISION = (
@@ -149,6 +166,8 @@ def prometheus_text(gateway) -> str:
           1 if eng["prefix"]["enabled"] else 0)
     gauge("tony_spec_enabled", "1 when speculative decoding is on",
           1 if eng["spec"]["enabled"] else 0)
+    gauge("tony_kv_paged_enabled", "1 when the paged KV cache is on",
+          1 if eng.get("kv_pages", {}).get("enabled") else 0)
 
     rep_counter = {name: MetricFamily(name, "counter", help_text)
                    for _, name, help_text in _REPLICA_COUNTERS}

@@ -38,11 +38,15 @@ from typing import Any
 @dataclass
 class DispatchRecord:
     """One engine dispatch. ``kind`` is "prefill" | "hit_admit" |
-    "decode" | "verify"; ``bucket`` is the program's static shape knob
-    (prefill bucket length, chunk depth, verify window — 0 for
-    hit_admit); ``tokens`` counts tokens the dispatch landed for
-    requests (trimmed overshoot excluded); ``request_id`` is set on
-    admit dispatches (the engine id of the admitted request)."""
+    "cow_admit" | "decode" | "verify" — cow_admit is the PAGED
+    exact-prefix-hit admission (pages aliased host-side, one sampling
+    dispatch): its own kind so per-kind ``tokens_per_dispatch`` never
+    counts an aliasing admit as prefill work. ``bucket`` is the
+    program's static shape knob (prefill bucket length, chunk depth,
+    verify window — 0 for hit_admit/cow_admit); ``tokens`` counts
+    tokens the dispatch landed for requests (trimmed overshoot
+    excluded); ``request_id`` is set on admit dispatches (the engine
+    id of the admitted request)."""
 
     kind: str
     t0: float          # time.monotonic() at dispatch start

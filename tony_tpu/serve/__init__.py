@@ -3,12 +3,19 @@
 The TPU-serving analog of TonY's job multiplexing (``TonySession`` /
 ``TaskScheduler`` packing many jobs onto one container pool): many
 REQUESTS multiplex onto one resident KV cache. One jitted decode step
-of fixed shape [batch_size, max_seq_len] runs forever; requests stream
-through its slots — admitted into free slots at their own positions,
-evicted the moment they hit EOS or their token budget, replaced the
-same iteration (Orca/vLLM-style iteration-level scheduling). Static
-shapes mean the step compiles ONCE; mixed-length traffic never waits
-on the longest sequence in a batch. Shared-prefix traffic (system
+of fixed shape runs forever; requests stream through its slots —
+admitted into free slots at their own positions, evicted the moment
+they hit EOS or their token budget, replaced the same iteration
+(Orca/vLLM-style iteration-level scheduling). Static shapes mean the
+step compiles ONCE; mixed-length traffic never waits on the longest
+sequence in a batch. The cache itself is BLOCK-PAGED by default
+(serve/slots.PagePool — the PagedAttention idea on TPU static
+shapes): [n_pages, page_size] pools + per-slot page tables + a host
+free-list allocator bound HBM residency by actual tokens instead of
+batch_size x max_seq_len, with worst-case-reservation admission
+(backpressure, never preemption) and copy-on-write page sharing;
+``Server(paged=False)`` keeps the classic fixed-shape rows.
+Shared-prefix traffic (system
 prompts, few-shot preambles, multi-turn) additionally skips prefill
 work through the radix ``PrefixStore`` (serve/prefix.py), and
 predictable continuations (extractive/repetitive/templated output)
@@ -17,17 +24,20 @@ prompt-lookup drafting + one batched multi-token verify dispatch
 (``Server(speculate_k=...)``), greedy outputs unchanged.
 """
 
-from tony_tpu.serve.engine import (QueueFull, Request, Result, Server,
-                                   bucket_len)
+from tony_tpu.serve.engine import (PoolExhausted, QueueFull, Request,
+                                   Result, Server, bucket_len)
 from tony_tpu.serve.faults import Fault, FaultPlan, InjectedFault
 from tony_tpu.serve.prefix import PrefixStore, tree_nbytes
-from tony_tpu.serve.slots import (SlotCache, cache_batch_axis,
+from tony_tpu.serve.slots import (PagePool, SlotCache, cache_batch_axis,
+                                  page_nbytes, paged_cache,
                                   read_slot_row, write_slot_row)
 
 __all__ = [
     "Fault",
     "FaultPlan",
     "InjectedFault",
+    "PagePool",
+    "PoolExhausted",
     "PrefixStore",
     "QueueFull",
     "Request",
@@ -36,6 +46,8 @@ __all__ = [
     "SlotCache",
     "bucket_len",
     "cache_batch_axis",
+    "page_nbytes",
+    "paged_cache",
     "read_slot_row",
     "tree_nbytes",
     "write_slot_row",

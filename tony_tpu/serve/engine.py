@@ -57,7 +57,9 @@ from tony_tpu.models.generate import (init_cache, multi_decode_step,
 from tony_tpu.obs.timeline import DispatchRecord, DispatchTimeline
 from tony_tpu.serve.faults import FaultPlan
 from tony_tpu.serve.prefix import PrefixStore
-from tony_tpu.serve.slots import SlotCache, _read_slot, cache_batch_axis
+from tony_tpu.serve.slots import (PagePool, SlotCache, _read_slot,
+                                  cache_batch_axis, default_page_size,
+                                  paged_view, paged_write_back)
 
 log = logging.getLogger(__name__)
 
@@ -173,6 +175,43 @@ def _prefill_admit(model, params, cache, prompt, length, slot, temp,
 
 
 @jax.jit
+def _sample_first(logits, temp, top_k, key):
+    """The PAGED exact-hit admit: the stored pages are aliased into the
+    slot's table host-side (a refcount bump — no device copy at all,
+    vs the unpaged path's full ``write_slot_row``), so the only device
+    work left is sampling the first continuation from the stored
+    last-position logits with THIS request's knobs. One tiny dispatch
+    over [1, V]."""
+    tok, key = _sample_rows(logits, key[None],
+                            jnp.asarray(temp, jnp.float32)[None],
+                            jnp.asarray(top_k, jnp.int32)[None])
+    return tok[0].astype(jnp.int32), key[0]
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _paged_prefill_admit(model, params, cache, window, positions, length,
+                         table, temp, top_k, key):
+    """The paged fused admit: a prefill is ONE multi-token per-slot
+    window over the resident page pool — ``window`` [1, Lb] holds the
+    (suffix of the) prompt right-padded to its bucket, ``positions``
+    [1, Lb] its absolute positions (padding = -1, whose writes DROP —
+    unlike the unpaged bucket, no junk is ever written past the
+    prompt), ``table`` [1, max_pages] the slot's page table. K/V land
+    straight in the slot's pages (no separate row + slot-copy), the
+    last REAL position's logits feed the first-token sample. Returns
+    ``(cache, token, rng, last_logits [1, V])`` — the logits go to the
+    prefix store so the next exact hit skips everything."""
+    cache, logits = multi_decode_step(model, params, cache, window,
+                                      positions, page_table=table)
+    last = jax.lax.dynamic_slice_in_dim(logits, length - 1, 1,
+                                        axis=1)[:, 0]
+    tok, key = _sample_rows(last, key[None],
+                            jnp.asarray(temp, jnp.float32)[None],
+                            jnp.asarray(top_k, jnp.int32)[None])
+    return cache, tok[0].astype(jnp.int32), key[0], last
+
+
+@jax.jit
 def _hit_admit(cache, row, slot, logits, temp, top_k, key):
     """Exact-prompt prefix hit: NO prefill at all — copy the stored row
     into ``slot`` and sample the first continuation from the stored
@@ -256,14 +295,28 @@ def _sample_rows(logits, rngs, temps, top_ks):
 
 @functools.partial(jax.jit, static_argnames=("model", "n_steps"))
 def _decode_chunk(model, params, cache, tok, positions, temps, top_ks,
-                  rngs, *, n_steps: int):
+                  rngs, table=None, *, n_steps: int):
     """The resident serving step: ``n_steps`` decode micro-steps for
     EVERY slot as one lax.scan dispatch (empty slots compute garbage
     that nothing reads — the price of a never-recompiled static shape).
     Per-slot sampling and rng advance ride inside the scan; returns
     (cache, tokens [b, n_steps], rngs). ``n_steps`` is static (the
     scheduler quantizes it to powers of two, so at most
-    log2(chunk_steps)+1 programs ever compile)."""
+    log2(chunk_steps)+1 programs ever compile).
+
+    ``table`` [b, max_pages] switches to the paged cache layout — but
+    NOT by gathering inside every micro-step: the slot view is
+    gathered from the pools ONCE (``paged_view``), the whole scan runs
+    the plain unpaged per-slot program against it (bitwise-identical
+    math, and the gather cost amortizes over the chunk depth), and
+    only the chunk's ``b x n_steps`` new K/V entries scatter back to
+    their pages at the end (``paged_write_back``). The table is fixed
+    across the chunk, so the host pre-extends it to cover every
+    position the chunk will write (engine ``_decode_round``)."""
+    max_len = model.cfg.max_seq_len
+    pool_cache, start = cache, positions
+    if table is not None:
+        cache = paged_view(cache, table, max_len)
 
     def body(carry, _):
         cache, tok, positions, rngs = carry
@@ -282,12 +335,15 @@ def _decode_chunk(model, params, cache, tok, positions, temps, top_ks,
         carry, tok1 = body(carry, None)
         toks = tok1[:, None]
     cache, _, _, rngs = carry
+    if table is not None:
+        cache = paged_write_back(pool_cache, cache, table, start,
+                                 n_steps, max_len)
     return cache, toks, rngs
 
 
 @functools.partial(jax.jit, static_argnames=("model", "window"))
 def _verify_chunk(model, params, cache, toks, positions, draft_len,
-                  temps, top_ks, rngs, *, window: int):
+                  temps, top_ks, rngs, table=None, *, window: int):
     """The speculative verify dispatch: score ``window`` positions for
     EVERY slot in one batched multi-token pass (multi_decode_step) and
     judge each row's draft against its own greedy verdicts — the
@@ -315,9 +371,11 @@ def _verify_chunk(model, params, cache, toks, positions, draft_len,
       decodes on.
 
     ``window`` is static and power-of-two-plus-one bucketed, so at most
-    log2(speculate_k)+1 verify programs ever compile."""
+    log2(speculate_k)+1 verify programs ever compile. ``table``
+    [b, max_pages] switches to the paged cache layout (pre-extended by
+    the host to cover the window's writes)."""
     cache, logits = multi_decode_step(model, params, cache, toks,
-                                      positions)
+                                      positions, page_table=table)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [b, w]
     tok0, rngs = _sample_rows(logits[:, 0], rngs, temps, top_ks)
     emit = jnp.concatenate([tok0[:, None].astype(jnp.int32),
@@ -335,6 +393,17 @@ class QueueFull(RuntimeError):
     The typed backpressure signal — callers (the gateway's admission
     layer, the JSONL loop) translate it into 429/shedding instead of
     letting the queue grow without bound and OOMing the host."""
+
+
+class PoolExhausted(RuntimeError):
+    """``submit()`` refused: the request's worst-case KV-page need
+    (prompt + clamped max_new_tokens) exceeds the ENTIRE page pool, so
+    it could never be admitted — waiting would wedge the queue behind
+    it forever. Deliberately not a ValueError: the gateway sheds it
+    503 (capacity), not 400 (malformed) — resubmitting against a
+    bigger pool is legitimate. Transient pressure (the pool is
+    momentarily full of live requests) never raises: the request just
+    stays pending until pages free."""
 
 
 @dataclass
@@ -407,6 +476,19 @@ class Server:
     ``max_pending`` bounds the queue; past it ``submit()`` raises
     ``QueueFull`` instead of growing without bound.
 
+    ``paged`` (default on; ``False`` keeps the fixed-shape rows for
+    A/B, sliding-window models auto-downgrade) stores the KV cache as
+    block-granular PAGES (``kv_page_size`` tokens each, auto-sized
+    when 0) in a ``kv_pages``-page pool (auto = the unpaged-equivalent
+    ``batch_size * max_pages`` when 0) with per-slot page tables:
+    HBM residency is bounded by actual tokens, admission reserves each
+    request's worst case (no mid-stream preemption, pool pressure just
+    delays admission; a request bigger than the whole pool sheds
+    ``PoolExhausted``), and the prefix store shares pages
+    copy-on-write — an exact hit costs one [1, V] sampling dispatch
+    and donation is a refcount bump. Greedy outputs are token-exact
+    vs the unpaged path (tests/test_paged.py pins the matrix).
+
     ``speculate_k`` > 0 turns on speculative decoding (prompt-lookup
     drafting + batched verify, module functions ``_propose_draft`` /
     ``_verify_chunk``): rounds where any greedy slot's n-gram lookup
@@ -435,7 +517,8 @@ class Server:
                  max_pending: int = 1024, prefix_cache_mb: float = 0.0,
                  prefix_donate: bool = True, speculate_k: int = 0,
                  fault_plan: FaultPlan | None = None,
-                 timeline: bool = True):
+                 timeline: bool = True, paged: bool | None = None,
+                 kv_page_size: int = 0, kv_pages: int = 0):
         if model.cfg.quantized:
             # nothing structural in the way — the q8 apply is the same
             # model.apply — but untested here; fail loud, not wrong
@@ -448,6 +531,13 @@ class Server:
             # parity — the store's contract — is unpinned; fail loud
             raise NotImplementedError(
                 "prefix cache over sliding-window models is untested")
+        if paged and model.cfg.sliding_window:
+            # same precedent: the paged gather itself is window-agnostic
+            # but bitwise greedy parity against the unpaged windowed
+            # slice path is unpinned; explicit paged=True fails loud,
+            # the None default (and the CLIs) downgrade to unpaged
+            raise NotImplementedError(
+                "paged KV cache over sliding-window models is untested")
         self.model = model
         self.params = params
         # deterministic fault injection (serve/faults.py); None = off,
@@ -461,7 +551,29 @@ class Server:
         # per-token dispatch cost — the right setting for streaming)
         self.chunk_steps = max(1, chunk_steps)
         self.max_pending = max(1, max_pending)
-        self.slots = SlotCache(model, params, batch_size)
+        # paged KV (the PagedAttention idea on the TPU static-shape
+        # path): cache leaves become [n_pages, page_size, ...] pools,
+        # slots hold page tables, residency is bounded by actual tokens
+        # instead of batch * max_seq_len, and the prefix store shares
+        # pages copy-on-write instead of copying rows. Default ON
+        # (except sliding-window); paged=False keeps the fixed-shape
+        # rows for A/B.
+        self.paged = (not model.cfg.sliding_window) if paged is None \
+            else bool(paged)
+        if self.paged:
+            ps = int(kv_page_size) or default_page_size(model.cfg)
+            ps = max(1, min(ps, model.cfg.max_seq_len))
+            max_pages = -(-model.cfg.max_seq_len // ps)
+            # auto pool: the unpaged-equivalent footprint — every slot
+            # can still hold a full-length sequence, so capacity parity
+            # with the fixed-shape path is the floor; explicit
+            # kv_pages grows the batch into the same HBM or shrinks
+            # the footprint for short-sequence traffic
+            n_pages = int(kv_pages) or batch_size * max_pages
+            pool = PagePool(model, params, n_pages, ps)
+            self.slots = SlotCache(model, params, batch_size, pool=pool)
+        else:
+            self.slots = SlotCache(model, params, batch_size)
         self.pending: deque[Request] = deque()
         self._pending_lock = threading.Lock()
         self._live: list[_Live | None] = [None] * batch_size
@@ -491,17 +603,24 @@ class Server:
         self.spec_rounds = 0    # verify dispatches run
         self.spec_drafted = 0   # draft tokens sent through verify
         self.spec_accepted = 0  # draft tokens accepted
-        # prefix KV reuse (serve/prefix.py); 0 MB = off, zero overhead
-        self.prefix = PrefixStore(int(prefix_cache_mb * (1 << 20))) \
+        # prefix KV reuse (serve/prefix.py); 0 MB = off, zero overhead.
+        # Paged engines get a POOL-BACKED store: entries are page
+        # references (refcounted, copy-on-write), not copied rows
+        self.prefix = PrefixStore(
+            int(prefix_cache_mb * (1 << 20)),
+            pool=self.slots.pool if self.paged else None) \
             if prefix_cache_mb > 0 else None
         self.prefix_donate = prefix_donate
         self.prefix_lookups = 0       # admits that consulted the store
         self.prefix_hits = 0          # admits seeded >= 1 cached token
         self.prefix_hit_tokens = 0    # prompt tokens seeded, total
         self.prefill_tokens_saved = 0  # bucketed prefill work skipped
-        self._row_nbytes = _row_nbytes(self.slots.cache)
-        # a prefill-path entry = one cache row + its [1, V] fp32 logits
-        entry_nbytes = self._row_nbytes + 4 * model.cfg.vocab_size
+        self._row_nbytes = 0 if self.paged \
+            else _row_nbytes(self.slots.cache)
+        # the smallest useful entry: unpaged = one cache row + its
+        # [1, V] fp32 logits; paged = one PAGE + the logits
+        entry_nbytes = (self.slots.pool.page_nbytes if self.paged
+                        else self._row_nbytes) + 4 * model.cfg.vocab_size
         if self.prefix is not None \
                 and entry_nbytes > self.prefix.budget_bytes:
             # a budget that cannot hold even ONE entry would reject
@@ -535,6 +654,17 @@ class Server:
             request.id = next(self._ids)
         request.max_new_tokens = min(request.max_new_tokens,
                                      max_len - len(p))
+        if self.paged:
+            pool = self.slots.pool
+            worst = -(-(len(p) + request.max_new_tokens)
+                      // pool.page_size)
+            if worst > pool.n_pages:
+                # could NEVER be admitted — shedding now (503 at the
+                # gateway) beats wedging the queue head forever
+                raise PoolExhausted(
+                    f"request needs {worst} KV pages worst-case, the "
+                    f"pool holds {pool.n_pages} (raise --kv-pages or "
+                    "lower max_new_tokens)")
         with self._pending_lock:
             if len(self.pending) >= self.max_pending:
                 raise QueueFull(
@@ -556,11 +686,14 @@ class Server:
 
     # --------------------------------------------------------- scheduling
 
-    def _admit_one(self, req: Request, finished: list) -> None:
+    def _admit_one(self, req: Request, finished: list) -> bool:
         """Prefill ``req`` into a free slot (prefill + slot copy +
         first-token sample fused into one dispatch) — or finish it on
         the spot when the FIRST token already ends it (EOS, or a budget
         of one): no slot is burned on a request with nothing to decode.
+        Returns False (paged engines only) when the page pool cannot
+        grant the request's reservation right now — the caller requeues
+        it and stops admitting until pages free.
 
         With the prefix store on, the prompt's longest cached prefix is
         looked up first: an exact-prompt hit (stored logits available)
@@ -569,6 +702,8 @@ class Server:
         prefills only the bucketed SUFFIX at a position offset. Either
         way the freshly covered prompt is (re)inserted so the next
         sharer hits."""
+        if self.paged:
+            return self._admit_one_paged(req, finished)
         if self.fault_plan is not None:
             self.fault_plan.on_admit(req.id)
         s = self.slots
@@ -661,11 +796,186 @@ class Server:
             finished.append(Result(req.id, list(req.prompt), [tok],
                                    reason, hit_tokens, saved))
             s.cache = cache
-            return
+            return True
         s.cache = cache
         s.admit(slot, len(p), tok, req.temperature, req.top_k, key)
         self._spec_ema[slot] = 1.0  # new tenant: drafting re-enabled
         self._live[slot] = _Live(req, [tok], hit_tokens, saved)
+        return True
+
+    def _admit_one_paged(self, req: Request, finished: list) -> bool:
+        """The paged admission path. Ordering: (1) prefix lookup — the
+        reservation size depends on how many pages the prompt can
+        ALIAS; (2) reserve the worst-case PRIVATE page need (prompt +
+        clamped budget, minus aliased pages, plus one for a
+        copy-on-write fork when the seed boundary falls mid-page),
+        squeezing LRU prefix-store entries when the pool is tight; on
+        failure the request stays pending — no preemption, ever:
+        ``free >= reserved`` means an admitted request can always
+        allocate its way to its budget; (3) seed the slot's table by
+        SHARING the entry's pages (refcount bumps; the boundary page is
+        forked on device) — an exact hit's only other device work is
+        sampling the first token from the stored logits (the
+        ``cow_admit`` dispatch kind: NOT a prefill, and the timeline
+        must not count it as one); a partial hit or miss prefills the
+        bucketed suffix as one multi-token window writing straight
+        into the slot's pages (no row copy — the unpaged path's
+        ``write_slot_row`` admission copies are gone)."""
+        s = self.slots
+        pool = s.pool
+        ps = pool.page_size
+        p = np.asarray(req.prompt, np.int32)
+        max_len = self.model.cfg.max_seq_len
+        slot = s.free_slots()[0]
+        t0 = time.monotonic()  # timeline: the whole admit
+        occ = s.n_active
+        off, entry = 0, None
+        lookup_ms = None
+        if self.prefix is not None:
+            self.prefix_lookups += 1
+            off, entry = self.prefix.acquire(p)
+            lookup_ms = (time.monotonic() - t0) * 1e3
+        full_bucket = bucket_len(len(p), max_len, self.min_bucket)
+        exact = (entry is not None and off == len(p)
+                 and len(entry.tokens) == len(p)
+                 and entry.logits is not None)
+        if not exact and entry is not None:
+            # partial hit (or full-prompt match against a longer /
+            # logits-less entry): seed at most len(p)-1 tokens so >= 1
+            # real token remains to prefill the first-continuation
+            # logits from. No bucket-overflow shrink needed here: the
+            # paged window writes by absolute position and its padding
+            # DROPS, so any offset alignment is safe.
+            off = min(off, len(p) - 1)
+            if off <= 0:
+                self.prefix.release(entry)
+                off, entry = 0, None
+        seed = len(p) if exact else off
+        budget_end = len(p) + req.max_new_tokens  # submit() clamped
+        worst = -(-budget_end // ps)     # ceil: pages for the whole life
+        n_alias = -(-seed // ps)         # pages the entry donates
+        fork = 1 if seed % ps else 0     # mid-page boundary: CoW copy
+        need = worst - n_alias + fork
+        granted = pool.reserve(need)
+        while not granted and self.prefix is not None \
+                and self.prefix.evict_one():
+            granted = pool.reserve(need)
+        if not granted:
+            # transient exhaustion: live slots still hold the pages.
+            # Undo the lookup (the retry repeats it) and stay pending —
+            # submit() guarantees need <= n_pages, so slots finishing
+            # always unblocks this.
+            if entry is not None:
+                self.prefix.release(entry)
+            if self.prefix is not None:
+                self.prefix_lookups -= 1
+            return False
+        if self.fault_plan is not None:
+            # after the capacity check: a requeued request must not
+            # burn fault-injection triggers on every retry. Guarded:
+            # the reservation is not yet attached to the slot (that
+            # happens in seed_pages, after which reset()'s evicts
+            # reclaim it), so an injected crash here must hand it back
+            # or it leaks past the replica's recovery reset
+            try:
+                self.fault_plan.on_admit(req.id)
+            except BaseException:
+                pool.cancel(need)
+                if entry is not None:
+                    self.prefix.release(entry)
+                raise
+        hit_tokens = saved = 0
+        d_kind, d_bucket = "prefill", full_bucket
+        forked = False
+        try:
+            forked = s.seed_pages(
+                slot, entry.pages if entry is not None else [], seed,
+                need)
+            if exact:
+                # the aliasing admit: pages shared host-side, one
+                # [1, V] sampling dispatch — near-free, and bytes
+                # moved are the forked page (if any) instead of the
+                # unpaged path's whole cache row
+                tok, key = _sample_first(
+                    entry.logits, jnp.float32(req.temperature),
+                    jnp.int32(req.top_k), jax.random.PRNGKey(req.seed))
+                hit_tokens, saved = len(p), full_bucket
+                d_kind, d_bucket = "cow_admit", 0
+                view_tokens = 0
+            else:
+                suffix = p[off:]
+                lb = bucket_len(len(suffix), max_len, self.min_bucket)
+                s.ensure_pages(slot, len(p))
+                window = np.zeros((1, lb), np.int32)
+                window[0, :len(suffix)] = suffix
+                positions = np.full((1, lb), -1, np.int32)
+                positions[0, :len(suffix)] = \
+                    off + np.arange(len(suffix), dtype=np.int32)
+                # column-sliced to the prompt's page bucket: the
+                # prefill window's gather + attention span is O(prompt
+                # bucket), not O(max_seq_len)
+                cols = min(_bucket_pow2(-(-len(p) // ps)), s.max_pages)
+                view_tokens = cols * ps
+                cache, tok, key, last = _paged_prefill_admit(
+                    self.model, self.params, s.cache,
+                    jnp.asarray(window), jnp.asarray(positions),
+                    jnp.int32(len(suffix)),
+                    jnp.asarray(s.page_table[slot:slot + 1, :cols]),
+                    jnp.float32(req.temperature), jnp.int32(req.top_k),
+                    jax.random.PRNGKey(req.seed))
+                s.cache = cache
+                self.prefills += 1
+                d_bucket = lb
+                if self.prefix is not None:
+                    # pin the freshly covered prompt: a refcount bump
+                    # on the slot's own pages plus the stored logits —
+                    # the next exact sharer pays the cow_admit path
+                    self.prefix.insert(p, pages=s.slot_pages(slot,
+                                                             len(p)),
+                                       logits=last)
+                if entry is not None:
+                    hit_tokens, saved = off, full_bucket - lb
+        finally:
+            if entry is not None:
+                self.prefix.release(entry)
+        if hit_tokens:
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += hit_tokens
+            self.prefill_tokens_saved += saved
+        tok = int(tok)  # host sync: the admit dispatch is done here
+        if self.timeline is not None:
+            tags = {"prompt_len": len(p)}
+            if lookup_ms is not None:
+                tags["lookup_ms"] = round(lookup_ms, 3)
+            if hit_tokens:
+                tags["prefix_hit_tokens"] = hit_tokens
+            if off and not exact:
+                tags["offset"] = int(off)
+            if forked:
+                tags["cow_fork"] = True
+            if view_tokens:
+                tags["view_tokens"] = view_tokens
+            # the view span is a second program-shape knob in paged
+            # mode: the compile key must carry it or a recompile at a
+            # new span would be mislabeled steady
+            key_ = (d_kind, d_bucket, view_tokens)
+            self.timeline.record(DispatchRecord(
+                d_kind, t0, (time.monotonic() - t0) * 1e3, occ,
+                d_bucket, 1, key_ not in self._compiled,
+                request_id=req.id, tags=tags))
+            self._compiled.add(key_)
+        if tok in self.eos_ids or req.max_new_tokens == 1:
+            # finished before ever decoding: the slot was never armed —
+            # hand its page references straight back
+            reason = "eos" if tok in self.eos_ids else "length"
+            finished.append(Result(req.id, list(req.prompt), [tok],
+                                   reason, hit_tokens, saved))
+            s.release_pages(slot)
+            return True
+        s.admit(slot, len(p), tok, req.temperature, req.top_k, key)
+        self._spec_ema[slot] = 1.0  # new tenant: drafting re-enabled
+        self._live[slot] = _Live(req, [tok], hit_tokens, saved)
+        return True
 
     def _chunk_size(self) -> int:
         """Decode micro-steps for this iteration: enough for the
@@ -692,7 +1002,13 @@ class Server:
                 if not self.pending:
                     break
                 req = self.pending.popleft()
-            self._admit_one(req, finished)
+            if not self._admit_one(req, finished):
+                # paged pool cannot grant the reservation right now:
+                # requeue at the FRONT (FIFO order preserved) and stop
+                # admitting — live slots finishing will free pages
+                with self._pending_lock:
+                    self.pending.appendleft(req)
+                break
         if self.slots.n_active == 0:
             return finished
         finished.extend(self._decode_round())
@@ -710,6 +1026,31 @@ class Server:
         finished: list[Result] = []
         s = self.slots
         k = self._chunk_size()
+        table = None
+        if self.paged:
+            # the table is frozen across the chunk: pre-extend every
+            # live slot to cover the positions this chunk will write
+            # (capped at the slot's own budget — overshoot past a
+            # finish writes through the sentinel and drops). The table
+            # ships COLUMN-SLICED to a power-of-two bucket of the live
+            # extent: the gathered view — and every micro-step's
+            # attention read over it — is O(actual tokens), not
+            # O(max_seq_len); the dropped columns held junk whose
+            # masked softmax weight is exactly 0.0, so outputs are
+            # bit-identical (at most log2(max_pages) programs per
+            # chunk depth, the prefill-bucket discipline)
+            hi = 0
+            for slot, live in enumerate(self._live):
+                if live is not None:
+                    s.ensure_pages(slot, min(
+                        int(s.lengths[slot]) + k,
+                        len(live.request.prompt)
+                        + live.request.max_new_tokens))
+                    hi = max(hi, int(s.lengths[slot]) + k)
+            cols = min(_bucket_pow2(-(-hi // s.pool.page_size)),
+                       s.max_pages)
+            table = jnp.asarray(s.page_table[:, :cols])
+        view_tokens = cols * s.pool.page_size if self.paged else 0
         if self.timeline is not None:
             t0 = time.monotonic()
             occ = s.n_active
@@ -718,7 +1059,7 @@ class Server:
             self.model, self.params, s.cache,
             jnp.asarray(s.last_token), jnp.asarray(s.positions()),
             jnp.asarray(s.temperature), jnp.asarray(s.top_k),
-            jnp.asarray(s.rng), n_steps=k)
+            jnp.asarray(s.rng), table, n_steps=k)
         self.steps += k
         self.dispatches += 1
         s.cache = cache
@@ -774,11 +1115,13 @@ class Server:
             self._live[slot] = None
             s.evict(slot)
         if self.timeline is not None:
-            key_ = ("decode", k)
+            key_ = ("decode", k, view_tokens)
+            tags = {"requests": riders}
+            if view_tokens:
+                tags["view_tokens"] = view_tokens
             self.timeline.record(DispatchRecord(
                 "decode", t0, dur_ms, occ, k, landed,
-                key_ not in self._compiled,
-                tags={"requests": riders}))
+                key_ not in self._compiled, tags=tags))
             self._compiled.add(key_)
         return finished
 
@@ -886,6 +1229,24 @@ class Server:
                 positions[slot, 1:1 + d.size] = \
                     s.lengths[slot] + 1 + np.arange(d.size)
                 draft_len[slot] = d.size
+        table = None
+        if self.paged:
+            # window row i writes positions [lengths, lengths + d_i]
+            # (last_token + its drafts) — always within the slot's
+            # budget (drafts are clamped to remaining - 1), so the
+            # reservation covers it. Column-sliced like the chunk path:
+            # the verify gather reads O(live extent)
+            hi = 0
+            for slot, live in enumerate(self._live):
+                if live is not None:
+                    s.ensure_pages(slot, int(s.lengths[slot])
+                                   + int(draft_len[slot]) + 1)
+                    hi = max(hi, int(s.lengths[slot])
+                             + int(draft_len[slot]) + 1)
+            cols = min(_bucket_pow2(-(-hi // s.pool.page_size)),
+                       s.max_pages)
+            table = jnp.asarray(s.page_table[:, :cols])
+        view_tokens = cols * s.pool.page_size if self.paged else 0
         if self.timeline is not None:
             t0 = time.monotonic()
             occ = s.n_active
@@ -895,7 +1256,7 @@ class Server:
             self.model, self.params, s.cache, jnp.asarray(toks),
             jnp.asarray(positions), jnp.asarray(draft_len),
             jnp.asarray(s.temperature), jnp.asarray(s.top_k),
-            jnp.asarray(s.rng), window=window)
+            jnp.asarray(s.rng), table, window=window)
         self.steps += window
         self.dispatches += 1
         self.spec_rounds += 1
@@ -965,13 +1326,15 @@ class Server:
             self._live[slot] = None
             s.evict(slot)
         if self.timeline is not None:
-            key_ = ("verify", window)
+            key_ = ("verify", window, view_tokens)
+            tags = {"requests": riders,
+                    "drafted": int(draft_len.sum()),
+                    "accepted": int(accepted.sum())}
+            if view_tokens:
+                tags["view_tokens"] = view_tokens
             self.timeline.record(DispatchRecord(
                 "verify", t0, dur_ms, occ, window, landed,
-                key_ not in self._compiled,
-                tags={"requests": riders,
-                      "drafted": int(draft_len.sum()),
-                      "accepted": int(accepted.sum())}))
+                key_ not in self._compiled, tags=tags))
             self._compiled.add(key_)
         return finished
 
@@ -983,10 +1346,21 @@ class Server:
         extends this sequence and seeds from it instead of
         re-prefilling the whole conversation. ``wants()`` gates the
         row-extraction dispatch: already-stored or won't-fit sequences
-        cost zero device work."""
+        cost zero device work.
+
+        Paged engines donate by REFERENCE: the store pins the slot's
+        own pages (refcount bump, zero device work — the
+        ``read_slot_row`` extraction dispatch is gone), so there is
+        nothing to gate."""
         seq = np.asarray(list(live.request.prompt)
                          + live.generated[:-1], np.int32)
-        if seq.size == 0 or not self.prefix.wants(seq, self._row_nbytes):
+        if seq.size == 0:
+            return
+        if self.paged:
+            self.prefix.insert(
+                seq, pages=self.slots.slot_pages(slot, int(seq.size)))
+            return
+        if not self.prefix.wants(seq, self._row_nbytes):
             return
         row = _read_slot(self.slots.cache, jnp.int32(slot))
         self.prefix.insert(seq, row)
@@ -1041,6 +1415,28 @@ class Server:
             out["prefix_bytes"] = st["bytes"]
             out["prefix_budget_bytes"] = st["budget_bytes"]
             out["prefix_evictions"] = st["evictions"]
+        if self.paged:
+            # the kv_pages block: the fixed-shape-waste sensor. The
+            # unpaged cache is ALWAYS batch * max_seq_len resident;
+            # here bytes_resident tracks allocated pages only, and
+            # tokens_resident / bytes_resident says how much of that
+            # is real tokens (live slots + pinned prefix entries;
+            # positions shared copy-on-write count once per holder, so
+            # treat the ratio as an upper bound under heavy sharing)
+            ps = self.slots.pool.stats()
+            s = self.slots
+            tokens = int(s.lengths[s.active].sum())
+            if self.prefix is not None:
+                tokens += self.prefix.stats()["tokens"]
+            out["kv_pages_total"] = ps["total"]
+            out["kv_pages_used"] = ps["used"]
+            out["kv_pages_free"] = ps["free"]
+            out["kv_pages_reserved"] = ps["reserved"]
+            out["kv_cow_shared"] = ps["cow_shared"]
+            out["kv_cow_forks"] = ps["forks"]
+            out["kv_page_size"] = ps["page_size"]
+            out["kv_bytes_resident"] = ps["bytes_resident"]
+            out["kv_tokens_resident"] = tokens
         return out
 
     def reset(self) -> None:

@@ -59,16 +59,24 @@ class _Entry:
     """One stored sequence: a batch-1 cache row covering exactly
     ``tokens``, optionally the last-position logits (prefill-donated
     entries have them — the exact-hit fast path needs them to sample
-    the first continuation; EOS-donated rows don't)."""
+    the first continuation; EOS-donated rows don't).
 
-    __slots__ = ("tokens", "row", "logits", "nbytes", "node", "refcount",
-                 "tick")
+    PAGED stores (``PrefixStore(pool=...)``) keep ``pages`` — the page
+    ids whose concatenation covers ``tokens`` — instead of a copied
+    ``row``: the entry is a refcount on live pool pages, so donation
+    costs no device work and a hit aliases pages instead of copying
+    them (copy-on-write, serve/slots.PagePool)."""
+
+    __slots__ = ("tokens", "row", "logits", "pages", "nbytes", "node",
+                 "refcount", "tick")
 
     def __init__(self, tokens: np.ndarray, row: Any, logits: Any,
-                 nbytes: int, node: "_Node", tick: int):
+                 nbytes: int, node: "_Node", tick: int,
+                 pages: list | None = None):
         self.tokens = tokens
         self.row = row
         self.logits = logits
+        self.pages = pages
         self.nbytes = nbytes
         self.node = node
         self.refcount = 0
@@ -96,11 +104,24 @@ def _common_len(a: np.ndarray, b: np.ndarray) -> int:
 
 
 class PrefixStore:
-    """Radix store of prefilled cache rows under a byte budget."""
+    """Radix store of prefilled cache rows under a byte budget.
 
-    def __init__(self, budget_bytes: int):
+    With ``pool`` (a ``serve.slots.PagePool``) the store holds PAGE
+    REFERENCES instead of copied rows: an insert pins the sequence's
+    pool pages (one ``pool.share()`` per entry — zero device work), an
+    eviction unpins them, and the byte budget counts each UNIQUE page
+    once (entries sharing a prefix share its pages; double-charging
+    them would make the budget lie about pool residency). The byte
+    budget bounds how much of the pool the store may hog; the engine
+    additionally squeezes it (``evict_one``) when a slot admission
+    cannot reserve pages."""
+
+    def __init__(self, budget_bytes: int, pool: Any = None):
         self.budget_bytes = max(0, int(budget_bytes))
         self.bytes_used = 0
+        self.pool = pool
+        self._page_refs: dict[int, int] = {}  # page -> #entries holding
+        self.tokens_stored = 0
         self.root = _Node(np.empty(0, np.int32), None)
         self._entries: dict[bytes, _Entry] = {}
         self._lock = threading.Lock()
@@ -190,20 +211,30 @@ class PrefixStore:
                          if e.refcount > 0)
             return nbytes + pinned <= self.budget_bytes
 
-    def insert(self, tokens, row: Any, logits: Any = None) -> bool:
+    def insert(self, tokens, row: Any = None, logits: Any = None,
+               pages: list | None = None) -> bool:
         """Store ``row`` (a batch-1 cache pytree covering exactly
         ``tokens``) with optional last-position ``logits``. Returns
         False when refused (budget); re-inserting an existing sequence
-        just refreshes its LRU position."""
+        just refreshes its LRU position.
+
+        Paged stores take ``pages`` instead of ``row``: the pool pages
+        covering ``tokens``, pinned by refcount — pages already held
+        by another entry cost zero additional budget."""
         tokens = np.asarray(tokens, np.int32)
         if tokens.size == 0 or self.budget_bytes <= 0:
             return False
+        if (pages is not None) != (self.pool is not None):
+            raise ValueError("pages= requires a pool-backed store "
+                             "(and vice versa)")
         key = tokens.tobytes()
         with self._lock:
             existing = self._entries.get(key)
             if existing is not None:
                 existing.tick = next(self._ticks)
                 return True
+            if pages is not None:
+                return self._insert_pages(tokens, key, list(pages), logits)
             nbytes = tree_nbytes(row)
             if logits is not None:
                 nbytes += tree_nbytes(logits)
@@ -216,7 +247,56 @@ class PrefixStore:
             node.entry = entry
             self._entries[key] = entry
             self.bytes_used += nbytes
+            self.tokens_stored += int(tokens.size)
             self.inserts += 1
+            return True
+
+    def _insert_pages(self, tokens: np.ndarray, key: bytes,
+                      pages: list, logits: Any) -> bool:
+        """Paged insert under ``self._lock``. The bytes a paged entry
+        charges depend on what is ALREADY pinned (shared pages are
+        free), and evicting an LRU entry can un-share a page — so the
+        charge is recomputed after every eviction instead of once."""
+        logits_b = tree_nbytes(logits) if logits is not None else 0
+        while True:
+            fresh = sum(1 for p in set(pages)
+                        if self._page_refs.get(p, 0) == 0)
+            nbytes = fresh * self.pool.page_nbytes + logits_b
+            if self.bytes_used + nbytes <= self.budget_bytes:
+                break
+            victim = min(
+                (e for e in self._entries.values() if e.refcount == 0),
+                key=lambda e: e.tick, default=None)
+            if victim is None or nbytes > self.budget_bytes:
+                self.rejected += 1
+                return False
+            self._evict(victim)
+        node = self._insert_node(tokens)
+        entry = _Entry(tokens, None, logits, nbytes, node,
+                       next(self._ticks), pages=pages)
+        node.entry = entry
+        self._entries[key] = entry
+        self.pool.share(pages)
+        for p in pages:
+            self._page_refs[p] = self._page_refs.get(p, 0) + 1
+        self.bytes_used += nbytes
+        self.tokens_stored += int(tokens.size)
+        self.inserts += 1
+        return True
+
+    def evict_one(self) -> bool:
+        """Evict the least-recently-used unpinned entry (the engine's
+        pool-pressure squeeze: a slot admission that cannot reserve
+        pages frees store pages before giving up). False when every
+        entry is pinned by an in-flight acquire (or the store is
+        empty)."""
+        with self._lock:
+            victim = min(
+                (e for e in self._entries.values() if e.refcount == 0),
+                key=lambda e: e.tick, default=None)
+            if victim is None:
+                return False
+            self._evict(victim)
             return True
 
     def _insert_node(self, tokens: np.ndarray) -> _Node:
@@ -261,7 +341,24 @@ class PrefixStore:
 
     def _evict(self, entry: _Entry) -> None:
         del self._entries[entry.tokens.tobytes()]
-        self.bytes_used -= entry.nbytes
+        if entry.pages is not None:
+            # release the entry's page pins; only pages no OTHER entry
+            # still holds stop being charged (and, once every holder —
+            # store entries and slot tables alike — lets go, return to
+            # the pool's free list)
+            released = 0
+            for p in entry.pages:
+                self._page_refs[p] -= 1
+                if self._page_refs[p] == 0:
+                    del self._page_refs[p]
+                    released += self.pool.page_nbytes
+            self.pool.unref(entry.pages)
+            if entry.logits is not None:
+                released += tree_nbytes(entry.logits)
+            self.bytes_used -= released
+        else:
+            self.bytes_used -= entry.nbytes
+        self.tokens_stored -= int(entry.tokens.size)
         self.evictions += 1
         node = entry.node
         node.entry = None
@@ -290,6 +387,7 @@ class PrefixStore:
                 "entries": len(self._entries),
                 "bytes": self.bytes_used,
                 "budget_bytes": self.budget_bytes,
+                "tokens": self.tokens_stored,
                 "lookups": self.lookups,
                 "matched": self.matched,
                 "inserts": self.inserts,

@@ -88,17 +88,342 @@ def _read_slot(cache: Any, slot) -> Any:
     return read_slot_row(cache, slot)
 
 
+# --------------------------------------------------------- paged cache
+
+
+def paged_cache(model, params, n_pages: int, page_size: int) -> Any:
+    """A PAGED cache pytree: every batched leaf of a batch-1
+    ``init_cache`` tree — KV buffers ``[.., 1, max_len, kvh, dh]``,
+    int8 scales ``[.., 1, max_len, kvh]`` — becomes a page POOL with
+    ``(batch, max_len)`` replaced by ``(n_pages, page_size)``; shared
+    counters pass through (per-slot decode neither reads nor advances
+    them). The tree STRUCTURE is unchanged, so ``model.apply`` with a
+    ``page_table`` consumes it directly (flax returns the supplied
+    value — the declared init shape only matters on the init pass),
+    and scan_layers' stacked ``[n_layers, ...]`` leading axis is
+    preserved by the same from-the-right axis arithmetic
+    ``cache_batch_axis`` uses."""
+    def remap(path, leaf):
+        ax = cache_batch_axis(path, leaf)
+        if ax is None:
+            return leaf
+        shape = leaf.shape[:ax] + (n_pages, page_size) + leaf.shape[ax + 2:]
+        return jnp.zeros(shape, leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(
+        remap, init_cache(model, params, 1))
+
+
+def default_page_size(cfg) -> int:
+    """The auto ``kv_page_size`` for a model config: 64 tokens, scaled
+    down (floor 16, never past max_seq_len) for short-context models —
+    the ONE place this rule lives; ``Server`` and the CLI resolvers
+    both call it so their page geometries can never drift apart."""
+    ps = min(64, max(16, cfg.max_seq_len // 4))
+    return max(1, min(ps, cfg.max_seq_len))
+
+
+def kv_page_nbytes(cfg, page_size: int) -> int:
+    """Analytic bytes of ONE KV page for a model config (agrees with
+    ``page_nbytes`` of the built pool): n_layers x (K + V) x page_size
+    x kv_heads x head_dim at the cache dtype, plus the int8 mode's
+    fp32 scales. Lets the CLIs size ``--kv-pages`` from HBM before any
+    device allocation exists."""
+    item = 1 if cfg.kv_cache_quant else jnp.dtype(cfg.dtype).itemsize
+    per = 2 * page_size * cfg.kv_heads * cfg.head_dim * item
+    if cfg.kv_cache_quant:
+        per += 2 * page_size * cfg.kv_heads * 4
+    return cfg.n_layers * per
+
+
+def page_nbytes(cache: Any) -> int:
+    """Bytes ONE page occupies across a paged cache tree's pool leaves
+    (all layers; scales included) — the unit the allocator's stats and
+    the prefix store's paged byte budget account in."""
+    total = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        ax = cache_batch_axis(path, leaf)
+        if ax is not None:
+            nbytes = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            total += nbytes // leaf.shape[ax]
+    return total
+
+
+def copy_page(cache: Any, src, dst) -> Any:
+    """Copy pool page ``src`` onto page ``dst`` in every paged leaf —
+    the copy-on-write FORK: a slot aliasing a shared page that it must
+    write into (a prefix boundary falling mid-page) gets its own copy
+    of the whole page and writes there; the shared original stays
+    byte-identical for every other holder. Pure tree transform,
+    traceable (``_copy_page`` jits it with traced indices — one
+    compile ever)."""
+    def cp(path, leaf):
+        ax = cache_batch_axis(path, leaf)
+        if ax is None:
+            return leaf
+        row = jax.lax.dynamic_index_in_dim(leaf, jnp.asarray(src, jnp.int32),
+                                           axis=ax, keepdims=False)
+        return jax.lax.dynamic_update_index_in_dim(
+            leaf, row, jnp.asarray(dst, jnp.int32), axis=ax)
+
+    return jax.tree_util.tree_map_with_path(cp, cache)
+
+
+@jax.jit
+def _copy_page(cache: Any, src, dst) -> Any:
+    return copy_page(cache, src, dst)
+
+
+def paged_view(cache: Any, table, max_len: int) -> Any:
+    """Gather each slot's pages into an UNPAGED-looking cache: every
+    pool leaf ``[.., n_pages, ps, ..]`` becomes ``[.., b, span, ..]``
+    via one gather through ``table`` [b, cols] (sentinel entries clamp
+    to junk pages the visibility mask hides). The decode chunk runs
+    its whole lax.scan against this view — the per-micro-step compute
+    is then literally the unpaged program (bitwise parity for free: a
+    masked column contributes softmax weight exactly 0.0, so a view
+    holding fewer junk columns than the full buffer sums to the exact
+    same attention output), and the gather cost is paid once per
+    DISPATCH instead of once per micro-step (``paged_write_back``
+    returns the chunk's new K/V to the pool afterwards).
+
+    The engine passes a COLUMN-SLICED table covering a power-of-two
+    bucket of the live slots' extent, so the view — and with it every
+    micro-step's attention read — is O(actual tokens), not
+    O(max_seq_len): the fixed-shape path's biggest per-step waste
+    (scanning a mostly-empty [max_seq_len] buffer) disappears along
+    with the residency waste."""
+    def to_view(path, leaf):
+        ax = cache_batch_axis(path, leaf)
+        if ax is None:
+            return leaf
+        safe = jnp.clip(table, 0, leaf.shape[ax] - 1)
+        v = jnp.take(leaf, safe, axis=ax)  # [.., b, cols, ps, ..]
+        shape = v.shape[:ax] + (v.shape[ax],
+                                v.shape[ax + 1] * v.shape[ax + 2]) \
+            + v.shape[ax + 3:]
+        v = v.reshape(shape)
+        # span = cols * ps may exceed max_len (page-size rounding);
+        # the unpaged per-slot branch sizes its drop-redirect index by
+        # max_len, so never exceed it
+        limit = min(max_len, shape[ax + 1])
+        return jax.lax.slice_in_dim(v, 0, limit, axis=ax + 1)
+
+    return jax.tree_util.tree_map_with_path(to_view, cache)
+
+
+def paged_write_back(pool: Any, view: Any, table, start, n_steps: int,
+                     max_len: int) -> Any:
+    """Return a decode chunk's writes from the gathered ``view`` to the
+    page ``pool``: slot i's micro-step j wrote position ``start[i] + j``
+    (start < 0 = empty slot), so only those ``b x n_steps`` tokens move
+    — everything else in the view is an unmodified copy the pool
+    already holds. Out-of-range positions and sentinel table entries
+    drop, exactly like the direct paged scatter."""
+    b = table.shape[0]
+    pos_w = jnp.where(start[:, None] >= 0,
+                      start[:, None]
+                      + jnp.arange(n_steps, dtype=jnp.int32)[None, :], -1)
+    rows = jnp.arange(b)[:, None]
+
+    def wb(path, pleaf, vleaf):
+        ax = cache_batch_axis(path, pleaf)
+        if ax is None:
+            return pleaf
+        n_pg, ps = pleaf.shape[ax], pleaf.shape[ax + 1]
+        # the view (and a column-sliced table) may be shorter than
+        # max_len; positions past either bound must drop, never clamp
+        limit = min(max_len, table.shape[1] * ps, vleaf.shape[ax + 1])
+        valid = (pos_w >= 0) & (pos_w < limit)
+        safe = jnp.where(valid, pos_w, 0)
+        page = jnp.take_along_axis(table, safe // ps, axis=1)
+        page = jnp.where(valid, page, n_pg)  # drop via OOB
+        off = safe % ps
+        v2 = jnp.moveaxis(vleaf, (ax, ax + 1), (0, 1))
+        vals = v2[rows, safe]                # [b, n_steps, ..rest]
+        p2 = jnp.moveaxis(pleaf, (ax, ax + 1), (0, 1))
+        p2 = p2.at[page, off].set(vals, mode="drop")
+        return jnp.moveaxis(p2, (0, 1), (ax, ax + 1))
+
+    return jax.tree_util.tree_map_with_path(wb, pool, view)
+
+
+class PagePool:
+    """Block-granular KV-cache pages + a host-side free-list allocator.
+
+    The device side is ONE paged cache pytree (``paged_cache``): KV
+    leaves are ``[n_pages, page_size, kvh, dh]`` pools shared by every
+    slot AND the prefix store — built here, then handed off to the
+    owning ``SlotCache`` (which keeps the LIVE tree across dispatches;
+    ``self.cache`` is None afterwards so the t=0 allocation is not
+    pinned twice). The host side owns which page belongs to
+    whom: a free list, a per-page refcount (a page may be held by one
+    slot table and any number of prefix-store entries — copy-on-write
+    sharing), and a RESERVATION ledger.
+
+    Reservations are the no-preemption admission discipline: a slot
+    reserves its worst-case page count (prompt + clamped max_new,
+    minus aliased prefix pages) up front and allocates lazily from
+    that reservation as decode advances, so a mid-stream allocation
+    can never fail — ``free >= reserved`` is the invariant (allocation
+    from a reservation consumes one unit of each; unref only grows
+    free). Admission blocks (stays pending) when a reservation cannot
+    be granted, after the engine has squeezed the prefix store; it
+    never kills an in-flight request.
+    """
+
+    def __init__(self, model, params, n_pages: int, page_size: int):
+        if n_pages < 1 or page_size < 1:
+            raise ValueError("n_pages and page_size must be >= 1")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.cache = paged_cache(model, params, n_pages, page_size)
+        self.page_nbytes = page_nbytes(self.cache)
+        self.refcount = np.zeros(self.n_pages, np.int32)
+        # LIFO free list: recently freed pages are re-issued first
+        # (their content is junk either way; reuse keeps the hot set
+        # small)
+        self._free = list(range(self.n_pages - 1, -1, -1))
+        self.reserved = 0   # granted-not-yet-allocated pages
+        self.allocs = 0     # pages handed out, lifetime
+        self.frees = 0      # pages returned to the free list, lifetime
+        self.forks = 0      # copy-on-write page copies, lifetime
+        self.peak_used = 0  # high-water mark of allocated pages
+
+    # ------------------------------------------------------ accounting
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return self.n_pages - len(self._free)
+
+    def available(self) -> int:
+        """Pages grantable to a NEW reservation right now."""
+        return len(self._free) - self.reserved
+
+    def cow_shared(self) -> int:
+        """Pages currently held by more than one owner (a slot table
+        plus prefix-store entries, or several entries) — the
+        copy-on-write sharing the fixed-shape path paid row copies
+        for."""
+        return int((self.refcount > 1).sum())
+
+    # ------------------------------------------------------ allocation
+
+    def reserve(self, n: int) -> bool:
+        """Set aside ``n`` future pages; False when they are not there
+        (the caller sheds load or frees store pages and retries)."""
+        if n > self.available():
+            return False
+        self.reserved += n
+        return True
+
+    def cancel(self, n: int) -> None:
+        """Return ``n`` unused reserved pages (evict, or a request
+        finishing under its worst case)."""
+        if n > self.reserved:
+            raise ValueError(f"cancel({n}) exceeds reserved "
+                             f"{self.reserved}")
+        self.reserved -= n
+
+    def alloc(self, n: int, *, from_reservation: bool = False) -> list[int]:
+        """Pop ``n`` pages (refcount 1 each). ``from_reservation``
+        consumes previously reserved units — guaranteed to succeed by
+        the invariant; a bare alloc must fit ``available()``."""
+        if from_reservation:
+            if n > self.reserved:
+                raise RuntimeError(
+                    f"alloc({n}) exceeds reservation {self.reserved} — "
+                    "engine reservation accounting bug")
+            self.reserved -= n
+        elif n > self.available():
+            raise RuntimeError(
+                f"alloc({n}) exceeds available {self.available()}")
+        pages = [self._free.pop() for _ in range(n)]
+        self.refcount[pages] = 1
+        self.allocs += n
+        self.peak_used = max(self.peak_used, self.n_used)
+        return pages
+
+    def share(self, pages) -> None:
+        """One more holder for each of ``pages`` (aliasing a prefix
+        entry's pages into a slot table, or pinning a slot's pages
+        into a store entry — the refcount bump that replaced
+        ``read_slot_row``/``write_slot_row`` copies)."""
+        for p in pages:
+            if self.refcount[p] <= 0:
+                raise ValueError(f"share() of free page {p}")
+            self.refcount[p] += 1
+
+    def unref(self, pages) -> None:
+        """Drop one holder; pages reaching refcount 0 return to the
+        free list (their content is junk from that moment)."""
+        for p in pages:
+            if self.refcount[p] <= 0:
+                raise ValueError(f"unref() of free page {p}")
+            self.refcount[p] -= 1
+            if self.refcount[p] == 0:
+                self._free.append(p)
+                self.frees += 1
+
+    # ----------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        return {
+            "total": self.n_pages,
+            "used": self.n_used,
+            "free": self.n_free,
+            "reserved": self.reserved,
+            "cow_shared": self.cow_shared(),
+            "page_size": self.page_size,
+            "page_nbytes": self.page_nbytes,
+            "bytes_resident": self.n_used * self.page_nbytes,
+            "allocs": self.allocs,
+            "frees": self.frees,
+            "forks": self.forks,
+            "peak_used": self.peak_used,
+        }
+
+
 class SlotCache:
     """``batch_size`` cache slots + per-slot length/rng/EOS-side state.
 
     Host arrays are numpy (the scheduler mutates them every iteration);
     the cache pytree stays on device across the whole serve session.
+
+    With ``pool`` (a ``PagePool``) the cache is PAGED: ``self.cache``
+    is the pool's page tree, and each slot additionally owns a page
+    table row ``[max_pages] int32`` (unallocated tail = the
+    ``pool.n_pages`` sentinel, which the device scatter drops and the
+    gather clamps), a count of allocated pages, and the remainder of
+    its admission-time page reservation. Admit never copies a row —
+    prefill writes land straight in the slot's pages; evict returns
+    the slot's page references (shared pages survive under their other
+    holders) and cancels its remaining reservation.
     """
 
-    def __init__(self, model, params, batch_size: int):
+    def __init__(self, model, params, batch_size: int,
+                 pool: PagePool | None = None):
         self.batch_size = batch_size
         self.max_seq_len = model.cfg.max_seq_len
-        self.cache = init_cache(model, params, batch_size)
+        self.pool = pool
+        if pool is not None:
+            # take OWNERSHIP of the device tree: the live pools are
+            # reassigned onto self.cache after every dispatch, and a
+            # reference left on the pool would pin the t=0 allocation
+            # (a full duplicate of the KV pool) for the server's life
+            self.cache = pool.cache
+            pool.cache = None
+            self.max_pages = -(-self.max_seq_len // pool.page_size)
+            self.page_table = np.full((batch_size, self.max_pages),
+                                      pool.n_pages, np.int32)
+            self.n_slot_pages = np.zeros(batch_size, np.int32)
+            self.reserve_left = np.zeros(batch_size, np.int32)
+        else:
+            self.cache = init_cache(model, params, batch_size)
         self.lengths = np.zeros(batch_size, np.int32)
         self.active = np.zeros(batch_size, bool)
         self.last_token = np.zeros(batch_size, np.int32)
@@ -134,6 +459,10 @@ class SlotCache:
         if not 0 < length <= self.max_seq_len:
             raise ValueError(f"bad prompt length {length}")
         if row_cache is not None:
+            if self.pool is not None:
+                raise ValueError("paged slots take no row_cache — "
+                                 "prefill writes land in the slot's "
+                                 "pages directly")
             self.cache = _write_slot(self.cache, row_cache,
                                      jnp.int32(slot))
         self.lengths[slot] = length
@@ -146,13 +475,90 @@ class SlotCache:
     def evict(self, slot: int) -> None:
         """Free a slot (EOS / budget exhausted). Device state is left in
         place — an inactive slot's position is -1, so nothing reads it,
-        and the next admit overwrites the whole row."""
+        and the next admit overwrites the whole row. Paged: the slot's
+        page references are dropped (pages a prefix-store entry also
+        holds stay resident under their remaining refcount) and its
+        unspent reservation is returned."""
         self.active[slot] = False
         self.lengths[slot] = 0
         self.last_token[slot] = 0
         self.temperature[slot] = 0.0
         self.top_k[slot] = 0
         self.rng[slot] = 0
+        if self.pool is not None:
+            self.release_pages(slot)
+
+    # --------------------------------------------------- paged helpers
+
+    def release_pages(self, slot: int) -> None:
+        """Drop the slot's page references + unspent reservation (also
+        used directly for an admitted-then-immediately-finished request
+        whose slot was never armed)."""
+        n = int(self.n_slot_pages[slot])
+        if n:
+            self.pool.unref(self.page_table[slot, :n].tolist())
+        self.pool.cancel(int(self.reserve_left[slot]))
+        self.page_table[slot] = self.pool.n_pages
+        self.n_slot_pages[slot] = 0
+        self.reserve_left[slot] = 0
+
+    def seed_pages(self, slot: int, pages: list, seed_len: int,
+                   reserve: int) -> bool:
+        """Arm a fresh slot's table with a prefix-store entry's shared
+        pages covering positions ``[0, seed_len)`` plus a reservation
+        of ``reserve`` future pages. When ``seed_len`` falls mid-page,
+        the boundary page — shared, but about to be written at offsets
+        ``>= seed_len % page_size`` — is FORKED: one page copy on
+        device, the original stays pinned for its other holders.
+        Returns whether a fork happened. ``reserve`` must already be
+        granted by ``pool.reserve()`` and include the fork page."""
+        ps = self.pool.page_size
+        n_alias = -(-seed_len // ps) if seed_len else 0
+        use = [int(p) for p in pages[:n_alias]]
+        self.pool.share(use)
+        self.reserve_left[slot] = reserve
+        self.n_slot_pages[slot] = n_alias
+        self.page_table[slot, :n_alias] = use
+        self.page_table[slot, n_alias:] = self.pool.n_pages
+        if seed_len % ps == 0:
+            return False
+        (fresh,) = self.pool.alloc(1, from_reservation=True)
+        self.reserve_left[slot] -= 1
+        shared = use[-1]
+        self.cache = _copy_page(self.cache, jnp.int32(shared),
+                                jnp.int32(fresh))
+        self.pool.unref([shared])
+        self.page_table[slot, n_alias - 1] = fresh
+        self.pool.forks += 1
+        return True
+
+    def ensure_pages(self, slot: int, upto_pos: int) -> None:
+        """Grow the slot's table (from its reservation) until its pages
+        cover positions ``[0, upto_pos)`` — called before any dispatch
+        that writes those positions. Never allocates past the
+        reservation: positions beyond it are budget overshoot whose
+        writes the device scatter drops through the sentinel."""
+        ps = self.pool.page_size
+        have = int(self.n_slot_pages[slot])
+        want = min(-(-upto_pos // ps), self.max_pages)
+        grow = min(want - have, int(self.reserve_left[slot]))
+        if grow <= 0:
+            return
+        pages = self.pool.alloc(grow, from_reservation=True)
+        self.reserve_left[slot] -= grow
+        self.page_table[slot, have:have + grow] = pages
+        self.n_slot_pages[slot] = have + grow
+
+    def slot_pages(self, slot: int, n_tokens: int) -> list[int]:
+        """The slot's page ids covering positions ``[0, n_tokens)``
+        (all allocated by construction — donation reads only written
+        extents)."""
+        n = -(-n_tokens // self.pool.page_size)
+        if n > int(self.n_slot_pages[slot]):
+            raise ValueError(
+                f"slot {slot} holds {int(self.n_slot_pages[slot])} pages, "
+                f"{n} needed for {n_tokens} tokens")
+        return self.page_table[slot, :n].tolist()
 
     def reset(self) -> None:
         """Evict everything (a fresh serving session on the same cache

@@ -4,7 +4,10 @@
 # a shared-prefix round (same preamble, different tails) and assert the
 # prefix KV cache registered hits on /stats, run a speculation round
 # (repetitive prompt; /stats engine.spec must show accepted drafts and
-# the output must match a --speculate-k 0 control gateway), exercise
+# the output must match a --speculate-k 0 control gateway), a PAGED
+# round (a fresh gateway with a deliberately small KV page pool under
+# shared-prefix traffic: zero 5xx, /stats engine.kv_pages shows CoW
+# page sharing, outputs identical to a --no-paged-kv control), exercise
 # the SIGTERM graceful drain, then a CHAOS round: a fresh 2-replica
 # gateway armed through TONY_SERVE_FAULTS has replica 0's dispatches
 # killed mid-run — every request must still answer 200 (failover, not
@@ -23,7 +26,8 @@ WORK=$(mktemp -d /tmp/serve_smoke.XXXXXX)
 GW_PID=''
 CTRL_PID=''
 CHAOS_PID=''
-trap 'kill $GW_PID $CTRL_PID $CHAOS_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
+PAGED_PID=''
+trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
 
 fail() { echo "serve-smoke: FAIL: $1" >&2; exit 1; }
 
@@ -238,6 +242,89 @@ while kill -0 $CTRL_PID 2>/dev/null; do
 done
 CTRL_PID=''
 
+# ---- paged-KV round: tiny page pool under shared-prefix traffic ------
+# a deliberately small pool (10 pages x 8 tokens vs 4 slots x 64
+# max_seq_len) forces admissions through the reservation gate while
+# the prefix store aliases pages copy-on-write. Every request must
+# answer 200 (backpressure queues, never 5xx), /stats engine.kv_pages
+# must show live CoW sharing, and outputs must be byte-identical to a
+# --no-paged-kv control gateway.
+JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+    --replicas 1 --port 0 --compile-cache '' \
+    --kv-page-size 8 --kv-pages 10 \
+    >"$WORK/paged_boot.log" 2>"$WORK/paged_stderr.log" &
+PAGED_PID=$!
+PAGED_URL=''
+i=0
+while [ $i -lt $BOUND ]; do
+    PAGED_URL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/paged_boot.log")
+    [ -n "$PAGED_URL" ] && break
+    kill -0 $PAGED_PID 2>/dev/null || fail "paged gateway died at boot: $(cat "$WORK/paged_stderr.log")"
+    sleep 1; i=$((i + 1))
+done
+[ -n "$PAGED_URL" ] || fail "paged gateway did not print its URL within ${BOUND}s"
+
+JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+    --replicas 1 --port 0 --compile-cache '' --no-paged-kv \
+    >"$WORK/pctrl_boot.log" 2>"$WORK/pctrl_stderr.log" &
+CTRL_PID=$!
+PCTRL_URL=''
+i=0
+while [ $i -lt $BOUND ]; do
+    PCTRL_URL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/pctrl_boot.log")
+    [ -n "$PCTRL_URL" ] && break
+    kill -0 $CTRL_PID 2>/dev/null || fail "unpaged control gateway died at boot: $(cat "$WORK/pctrl_stderr.log")"
+    sleep 1; i=$((i + 1))
+done
+[ -n "$PCTRL_URL" ] || fail "unpaged control gateway did not print its URL within ${BOUND}s"
+
+PAGED_PREAMBLE='5, 4, 3, 2, 1, 6, 7, 8, 9, 10, 11, 12, 13, 14'
+n=0
+for TAIL in '21' '22' '21' '23' '22' '24'; do
+    REQ="{\"token_ids\": [$PAGED_PREAMBLE, $TAIL], \"max_new_tokens\": 4, \"id\": $n}"
+    code=$(curl_s "$WORK/paged_$n" "$PAGED_URL/v1/generate" "$REQ") \
+        || fail "paged round $n curl"
+    [ "$code" = 200 ] || fail "paged round $n -> $code (pool pressure must queue, not 5xx)"
+    code=$(curl_s "$WORK/pctrl_$n" "$PCTRL_URL/v1/generate" "$REQ") \
+        || fail "paged control $n curl"
+    [ "$code" = 200 ] || fail "paged control $n -> $code"
+    $PY - "$WORK/paged_$n" "$WORK/pctrl_$n" <<'EOF' || fail "paged round $n: output differs from unpaged control"
+import json, sys
+paged = json.load(open(sys.argv[1]))
+ctrl = json.load(open(sys.argv[2]))
+assert paged["token_ids"] == ctrl["token_ids"], (paged, ctrl)
+EOF
+    n=$((n + 1))
+done
+
+code=$(curl_s "$WORK/paged_stats" "$PAGED_URL/stats") || fail "paged stats curl"
+[ "$code" = 200 ] || fail "paged stats -> $code"
+$PY - "$WORK/paged_stats" <<'EOF' || fail "paged stats: kv_pages block wrong"
+import json, sys
+stats = json.load(open(sys.argv[1]))
+assert stats["completed"] == 6, stats["completed"]
+assert stats["shed"] == {}, stats["shed"]  # zero 5xx under pool pressure
+kv = stats["engine"]["kv_pages"]
+assert kv["enabled"], kv
+assert kv["total"] == 10 and kv["page_size"] == 8, kv
+assert kv["cow_shared"] > 0, kv   # prompt + donation entries share pages
+assert kv["used"] + kv["free"] == kv["total"], kv
+prefix = stats["engine"]["prefix"]
+assert prefix["hits"] > 0, prefix  # the exact repeats aliased, not copied
+EOF
+
+kill -TERM $PAGED_PID $CTRL_PID
+for P in $PAGED_PID $CTRL_PID; do
+    i=0
+    while kill -0 $P 2>/dev/null; do
+        [ $i -ge $BOUND ] && fail "paged-round gateway did not drain"
+        sleep 1; i=$((i + 1))
+    done
+done
+PAGED_PID=''
+CTRL_PID=''
+echo "serve-smoke: paged OK (small pool, CoW sharing, zero 5xx, outputs == unpaged control)"
+
 # ---- stats + graceful drain -----------------------------------------
 code=$(curl_s "$WORK/stats" "$URL/stats") || fail "stats curl"
 [ "$code" = 200 ] || fail "stats -> $code"
@@ -253,6 +340,8 @@ spec = engine["spec"]
 assert spec["enabled"], spec
 assert spec["drafted"] > 0 and spec["accepted"] > 0, spec
 assert 0 < spec["acceptance_rate"] <= 1, spec
+kv = engine["kv_pages"]  # the default gateway serves paged
+assert kv["enabled"] and kv["total"] > 0, kv
 EOF
 
 # ---- observability round: /metrics exposition + request traces ------
