@@ -19,6 +19,10 @@
 #                 a 2-replica gateway with replica 0's dispatches
 #                 killed via TONY_SERVE_FAULTS must keep serving
 #                 (failover, zero 5xx) and rejoin the dead replica
+#   make autoscale-smoke - just the elastic round of serve-smoke:
+#                 burst load at a min=1/max=3 gateway must scale up
+#                 (probe-admitted), serve with zero 5xx, and drain
+#                 back to the floor once idle
 
 PY ?= python
 
@@ -30,7 +34,7 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 	tests/test_workflow.py tests/test_tpu_info.py \
 	tests/test_compilecache.py tests/test_proxy.py tests/test_profiler.py
 
-.PHONY: lint smoke check test bench serve-smoke chaos-smoke
+.PHONY: lint smoke check test bench serve-smoke chaos-smoke autoscale-smoke
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -56,3 +60,6 @@ serve-smoke:
 
 chaos-smoke:
 	PY=$(PY) SERVE_SMOKE_ROUNDS=chaos sh tools/serve_smoke.sh
+
+autoscale-smoke:
+	PY=$(PY) SERVE_SMOKE_ROUNDS=autoscale sh tools/serve_smoke.sh

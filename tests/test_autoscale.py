@@ -1,0 +1,781 @@
+"""Elastic autoscaling + SLO-aware admission (ISSUE-9).
+
+The acceptance anchors:
+- chaos-style scaling: under a synthetic burst the autoscaler adds a
+  replica which enters via PROBE admission, scale-down drains with
+  zero accepted-request loss, and every output is byte-exact vs a
+  solo generate (no 5xx anywhere);
+- WFQ no-starvation: a saturating ``batch``-tier flood cannot starve
+  ``interactive`` requests (bounded admission rank / queue wait),
+  while an idle fleet still gives ``batch`` full throughput;
+- deadline anchoring: a failover re-enqueue cannot extend a
+  request's ``ttl_s`` deadline (it stays anchored to submit time).
+
+Plus units for the WFQueue scheduler, tenant quota buckets, the
+autoscaler's decision logic (hysteresis, cooldowns, bounds), the
+backends, and the new observability surfaces (queue block, admission
+block, scaler block, per-request tier fields). CPU-only tiny model;
+the timing-sensitive p99-vs-fixed-control comparison is slow-marked.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.gateway import (AutoScaler, BadRequest, Gateway, GatewayHTTP,
+                              GatewayQueueFull, GenRequest,
+                              NoHealthyReplicas, ProvisionerBackend,
+                              QuotaExceeded, ScaleError, TenantQuotas,
+                              ThreadBackend, Ticket, WFQueue,
+                              parse_tier_weights)
+from tony_tpu.gateway.core import BROKEN, HEALTHY, RETIRED
+from tony_tpu.models import Transformer, TransformerConfig, generate
+from tony_tpu.serve import Server
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=64,
+                            dtype=jnp.float32,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _server(tiny, **kw):
+    model, params = tiny
+    kw.setdefault("batch_size", 2)
+    kw.setdefault("min_bucket", 8)
+    return Server(model, params, **kw)
+
+
+def _solo(tiny, prompt, n):
+    model, params = tiny
+    out = generate(model, params, jnp.asarray([prompt], jnp.int32),
+                   max_new_tokens=n)
+    return np.asarray(out)[0].tolist()
+
+
+def _ticket(prompt_len=3, max_new=4, ttl_s=None, tier="standard"):
+    t = Ticket(GenRequest([1] * prompt_len, max_new_tokens=max_new,
+                          ttl_s=ttl_s), ttl_s)
+    t.tier = tier
+    return t
+
+
+# ------------------------------------------------------------- WFQueue
+
+
+def test_wfq_weighted_interleave_under_contention():
+    """Two saturated tiers with weights 2:1 and equal costs admit
+    ~2:1; the heavier tier never monopolizes."""
+    q = WFQueue({"a": 2.0, "b": 1.0})
+    for i in range(12):
+        q.push(_ticket(tier="a"))
+        q.push(_ticket(tier="b"))
+    order = [q.pop().tier for _ in range(18)]
+    # in any prefix, a's count tracks ~2x b's (off by at most one round)
+    for i in range(1, len(order) + 1):
+        a, b = order[:i].count("a"), order[:i].count("b")
+        assert a <= 2 * (b + 1) and b <= a, (i, order)
+
+
+def test_wfq_single_tier_is_work_conserving():
+    """Only batch queued: it gets the full admission rate in FIFO
+    order — weights shape contention, they never reserve idle
+    capacity."""
+    q = WFQueue()
+    tickets = [_ticket(tier="batch") for _ in range(6)]
+    for t in tickets:
+        q.push(t)
+    assert [q.pop() for _ in range(6)] == tickets
+    assert q.pop() is None and len(q) == 0
+
+
+def test_wfq_idle_tier_catches_up_no_banked_credit():
+    """A tier waking from idle is caught up to the busiest floor: it
+    gets priority for one round, not unbounded credit for the time it
+    sat idle."""
+    q = WFQueue({"a": 1.0, "b": 1.0})
+    for _ in range(8):
+        q.push(_ticket(tier="b"))
+    for _ in range(4):
+        q.pop()  # b accumulates virtual work while a idles
+    q.push(_ticket(tier="a"))
+    for _ in range(4):
+        q.push(_ticket(tier="a"))
+    assert q.pop().tier == "a"  # the wake-up pop
+    # equal weights from the caught-up floor: strict alternation, NOT
+    # four more a's cashing in idle time
+    order = [q.pop().tier for _ in range(6)]
+    assert order.count("a") <= 4 and order[:2] != ["a", "a"], order
+
+
+def test_wfq_deadline_first_within_tier():
+    """Within a tier, the ticket closest to its deadline pops first;
+    deadline-less tickets keep arrival order behind any deadline."""
+    q = WFQueue()
+    none1 = _ticket(ttl_s=None)
+    late = _ticket(ttl_s=60.0)
+    soon = _ticket(ttl_s=0.5)
+    none2 = _ticket(ttl_s=None)
+    for t in (none1, late, soon, none2):
+        q.push(t)
+    assert [q.pop() for _ in range(4)] == [soon, late, none1, none2]
+
+
+def test_wfq_unpop_restores_position_and_charge():
+    q = WFQueue()
+    first, second = _ticket(ttl_s=1.0), _ticket(ttl_s=2.0)
+    q.push(first)
+    q.push(second)
+    got = q.pop()
+    assert got is first
+    q.unpop(got)
+    assert len(q) == 2
+    assert q.pop() is first and q.pop() is second
+
+
+def test_wfq_steal_all_preserves_tiers_and_empties():
+    q = WFQueue()
+    tickets = [_ticket(tier=t) for t in
+               ("batch", "interactive", "standard", "batch")]
+    for t in tickets:
+        q.push(t)
+    stolen = q.steal_all()
+    assert sorted(t.tier for t in stolen) == sorted(t.tier for t in tickets)
+    assert len(q) == 0 and not q
+    # unknown tier is a programming error (gateway validates earlier)
+    with pytest.raises(KeyError):
+        q.push(_ticket(tier="nope"))
+
+
+def test_parse_tier_weights():
+    assert parse_tier_weights("") == {"interactive": 8.0, "standard": 4.0,
+                                      "batch": 1.0}
+    assert parse_tier_weights("gold=2,bronze=0.5") == {"gold": 2.0,
+                                                       "bronze": 0.5}
+    with pytest.raises(ValueError, match="not a number"):
+        parse_tier_weights("gold=shiny")
+    with pytest.raises(ValueError, match="starve"):
+        parse_tier_weights("gold=0")
+    with pytest.raises(ValueError, match="name=weight"):
+        parse_tier_weights("gold")
+
+
+# --------------------------------------------------------- tenant quota
+
+
+def test_tenant_quota_bucket_refill_and_retry_after():
+    q = TenantQuotas(rate_tokens_per_s=10.0, burst_tokens=30.0)
+    now = 1000.0
+    assert q.admit("acme", 30, now) is None  # full burst admits
+    retry = q.admit("acme", 20, now)  # empty bucket refuses
+    assert retry == pytest.approx(2.0)  # 20 tokens / 10 per s
+    assert q.admit("other", 20, now) is None  # tenants isolated
+    assert q.admit("acme", 20, now + 2.0) is None  # refilled
+    st = q.stats()
+    assert st["tenants"] == 2 and st["enabled"]
+    # refund: a charge whose request got zero service goes back
+    q2 = TenantQuotas(rate_tokens_per_s=10.0, burst_tokens=30.0)
+    assert q2.admit("t", 30, now) is None
+    assert q2.admit("t", 5, now) is not None  # empty
+    q2.refund("t", 30)
+    assert q2.admit("t", 30, now) is None  # whole burst back
+
+
+def test_tenant_quota_disabled_and_oversize_clamp():
+    assert TenantQuotas(0.0).admit("anyone", 10**9) is None  # off
+    q = TenantQuotas(rate_tokens_per_s=10.0, burst_tokens=20.0)
+    # a request bigger than the burst charges one full burst — huge
+    # requests stay admittable instead of refusing forever
+    assert q.admit("t", 10**6, now=0.0) is None
+    assert q.admit("t", 1, now=0.0) is not None  # bucket emptied
+    # anonymous traffic shares one bucket under quotas
+    assert q.admit(None, 20, now=0.0) is None
+    assert q.admit(None, 20, now=0.0) is not None
+
+
+# -------------------------------------------------- gateway admission
+
+
+def test_gateway_quota_429_and_unknown_priority_400(tiny):
+    gw = Gateway([_server(tiny)], max_queue=16,
+                 tenant_quota_rate=10.0, tenant_quota_burst=30.0)
+    with pytest.raises(BadRequest, match="unknown priority"):
+        gw.submit(GenRequest([1, 2], max_new_tokens=2, priority="vip"))
+    gw.submit(GenRequest([1] * 10, max_new_tokens=20, tenant="acme"))
+    with pytest.raises(QuotaExceeded) as e:
+        gw.submit(GenRequest([1] * 10, max_new_tokens=20, tenant="acme"))
+    assert e.value.http_status == 429 and e.value.retry_after_s > 0
+    # quota sheds are counted separately from capacity sheds (the
+    # autoscaler must not grow the fleet to chase a tenant's limit)
+    snap = gw.snapshot()
+    assert snap["shed"] == {400: 1, 429: 1}  # the vip 400 + quota 429
+    assert snap["admission"]["quota"]["rejections"] == 1
+    assert snap["admission"]["quota"]["enabled"]
+    assert gw.scale_signals()["shed_capacity_total"] == 0
+
+
+def test_quota_not_charged_when_request_never_queues(tiny):
+    """A request refused by the queue bound (checked BEFORE the quota
+    gate) or by fleet health (refunded after) must not drain the
+    tenant's bucket — zero service means zero tokens spent."""
+    gw = Gateway([_server(tiny)], max_queue=1,
+                 tenant_quota_rate=1.0, tenant_quota_burst=20.0)
+    gw.submit(GenRequest([1] * 5, max_new_tokens=5, tenant="t"))  # 10
+    with pytest.raises(GatewayQueueFull):
+        gw.submit(GenRequest([1] * 5, max_new_tokens=5, tenant="t"))
+    # the bound 429 fired BEFORE the quota gate: bucket untouched
+    assert gw.quotas._buckets["t"][0] == pytest.approx(10.0, abs=0.5)
+    # fleet-health refusal happens AFTER the charge: it refunds
+    gw2 = Gateway([_server(tiny)], max_queue=8,
+                  tenant_quota_rate=1.0, tenant_quota_burst=20.0)
+    gw2.submit(GenRequest([1] * 5, max_new_tokens=5, tenant="t"))
+    with gw2.replicas[0].cv:
+        gw2.replicas[0].state = BROKEN
+    with pytest.raises(NoHealthyReplicas):
+        gw2.submit(GenRequest([1] * 5, max_new_tokens=5, tenant="t"))
+    # the NoHealthyReplicas charge was refunded
+    assert gw2.quotas._buckets["t"][0] == pytest.approx(10.0, abs=0.5)
+
+
+def test_snapshot_queue_block_and_tier_fields(tiny, tmp_path):
+    """Satellites 1+2: the queue block (depth / oldest wait / enqueue
+    rate) and tenant/priority/queue_pos in window rows + history
+    requests.jsonl."""
+    from tony_tpu.gateway import GatewayHistory
+
+    hist = GatewayHistory(str(tmp_path), n_replicas=1)
+    gw = Gateway([_server(tiny)], max_queue=32, history=hist)
+    gw.submit(GenRequest([1, 2, 3], max_new_tokens=3, id="a",
+                         tenant="acme", priority="interactive"))
+    gw.submit(GenRequest([4, 5], max_new_tokens=3, id="b",
+                         priority="batch"))
+    time.sleep(0.05)
+    snap = gw.snapshot()  # pre-start: the queue is holding both
+    q = snap["queue"]
+    assert q["depth"] == 2
+    assert q["oldest_wait_s"] > 0
+    assert q["enqueue_rate_per_s"] > 0
+    assert q["by_tier"] == {"interactive": 1, "batch": 1}
+    assert q["per_replica"][0]["replica"] == 0
+    assert q["per_replica"][0]["depth"] == 2
+    adm = snap["admission"]
+    assert adm["by_tier"]["interactive"]["queued"] == 1
+    assert adm["tiers"]["interactive"] > adm["tiers"]["batch"]
+    row = snap["replicas"][0]
+    assert row["enqueued"] == 2 and row["oldest_wait_s"] > 0
+    gw.start()
+    assert gw.drain(timeout=120)
+    rows = [json.loads(ln) for ln in open(
+        tmp_path / "intermediate" / hist.app_id / "metrics" /
+        "requests.jsonl")]
+    by_id = {r["id"]: r for r in rows}
+    assert by_id["a"]["tenant"] == "acme"
+    assert by_id["a"]["priority"] == "interactive"
+    assert by_id["b"]["tenant"] is None
+    assert by_id["b"]["priority"] == "batch"
+    assert all(r["queue_pos"] >= 0 for r in rows)
+    snap = gw.snapshot()
+    assert snap["admission"]["by_tier"]["batch"]["completed"] == 1
+    assert snap["admission"]["by_tier"]["interactive"]["completed"] == 1
+
+
+def test_wfq_batch_flood_cannot_starve_interactive(tiny):
+    """THE WFQ acceptance pin: 16 queued batch requests, then 4
+    interactive arrivals — the interactive tier is admitted almost
+    immediately (at most a couple of batch admissions ahead of it),
+    while an idle fleet (the batch-only phase after interactive
+    drains) still gives batch its full throughput."""
+    servers = [_server(tiny, batch_size=1, chunk_steps=1)]
+    gw = Gateway(servers, max_queue=64)  # NOT started: queue builds up
+    batch = [gw.submit(GenRequest([1 + i % 5, 2, 3], max_new_tokens=4,
+                                  id=f"b{i}", priority="batch"))
+             for i in range(16)]
+    inter = [gw.submit(GenRequest([7, 2 + i], max_new_tokens=4,
+                                  id=f"i{i}", priority="interactive"))
+             for i in range(4)]
+    gw.start()
+    for t in batch + inter:
+        t.result(timeout=240)
+    # admission order: every interactive ticket entered a slot before
+    # all but (at most) 2 of the 16 batch tickets
+    last_inter_admit = max(t.t_admit for t in inter)
+    batch_before = sum(1 for t in batch if t.t_admit < last_inter_admit)
+    assert batch_before <= 2, (batch_before,
+                               sorted(t.t_admit for t in batch),
+                               last_inter_admit)
+    # bounded queue wait: interactive p99 beats the batch median
+    inter_waits = sorted(t.metrics["queue_wait_ms"] for t in inter)
+    batch_waits = sorted(t.metrics["queue_wait_ms"] for t in batch)
+    assert inter_waits[-1] < batch_waits[len(batch_waits) // 2], (
+        inter_waits, batch_waits)
+    # full batch throughput once interactive is gone: every batch
+    # request completed (nothing starved, nothing shed)
+    snap = gw.snapshot()
+    assert snap["admission"]["by_tier"]["batch"]["completed"] == 16
+    assert snap["admission"]["by_tier"]["interactive"]["completed"] == 4
+    assert snap["shed"] == {}
+    assert gw.drain(timeout=60)
+
+
+def test_deadline_anchored_to_submit_across_failover(tiny):
+    """Satellite 3: a failover re-enqueue refreshes ``t_queued`` but
+    must NOT extend the request's deadline — ``ttl_s`` counts from the
+    original submit. The ticket here gets 0.5 s of life, fails over at
+    ~0.3 s (deadline under refreshed-at-enqueue semantics would be
+    ~0.8 s), and is checked at ~0.7 s: anchored semantics shed it 504."""
+    servers = [_server(tiny, batch_size=1) for _ in range(2)]
+    gw = Gateway(servers, max_queue=16)  # not started: deterministic
+    ticket = gw.submit(GenRequest([1, 2, 3], max_new_tokens=4,
+                                  ttl_s=0.5, id="anchored"))
+    assert ticket.deadline == pytest.approx(ticket.t_submit + 0.5)
+    time.sleep(0.3)
+    victim = gw.replicas[ticket.replica]
+    gw._fail_replica(victim, victim.epoch, "injected for the test")
+    assert ticket.replica != victim.index  # moved, untouched (queued)
+    assert ticket.attempts == 0
+    # the re-enqueue refreshed t_queued; the deadline must not move
+    assert ticket.t_queued > ticket.t_submit
+    assert ticket.deadline == pytest.approx(ticket.t_submit + 0.5)
+    time.sleep(0.4)  # now past the anchored deadline, inside a
+    # hypothetical refreshed one
+    gw.start()
+    from tony_tpu.gateway import DeadlineExceeded
+
+    with pytest.raises(DeadlineExceeded):
+        ticket.result(timeout=120)
+    snap = gw.snapshot()
+    assert snap["shed"].get(504) == 1
+    assert gw.drain(timeout=60)
+
+
+# --------------------------------------------------- dynamic membership
+
+
+def test_add_replica_probe_admission_and_remove_zero_loss(tiny):
+    """add_replica joins via a real probe generation (state BROKEN
+    until the probe lands), serves traffic, and remove_replica drains
+    zero-loss and releases the engine."""
+    gw = Gateway([_server(tiny)], max_queue=64,
+                 breaker_base_s=0.02, breaker_max_s=0.1).start()
+    idx = gw.add_replica(_server(tiny), probe=True)
+    r = gw.replicas[idx]
+    assert r.spawned
+    deadline = time.monotonic() + 60
+    saw_non_healthy = r.state != HEALTHY
+    while r.state != HEALTHY and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert saw_non_healthy, "scale-up must not join routing instantly"
+    assert r.state == HEALTHY
+    assert gw.stats.probes >= 1 and gw.stats.rejoins >= 1
+    # both replicas do real work under load
+    tickets = [gw.submit(GenRequest([1 + i % 5, 2], max_new_tokens=3,
+                                    id=i)) for i in range(12)]
+    for t in tickets:
+        t.result(timeout=120)
+    assert all(rep.completed >= 1 for rep in gw.replicas)
+    # scale-down: zero-loss, engine released, out of /stats rows
+    inflight = [gw.submit(GenRequest([9, 8, 7], max_new_tokens=3, id="z"))]
+    assert gw.remove_replica(idx, timeout=120)
+    assert r.retired and r.state == RETIRED and r.server is None
+    for t in inflight:
+        assert t.result(timeout=120).tokens == _solo(tiny, [9, 8, 7], 3)
+    snap = gw.snapshot()
+    assert [row["replica"] for row in snap["replicas"]] == [0]
+    assert snap["supervision"]["replicas_added"] == 1
+    assert snap["supervision"]["replicas_removed"] == 1
+    assert snap["supervision"]["retired"] == 1
+    with pytest.raises(ValueError, match="last live replica"):
+        gw.remove_replica(0)
+    assert gw.drain(timeout=60)
+    assert snap["shed"] == {}
+
+
+# ----------------------------------------------------- scaler decisions
+
+
+class _FakeGateway:
+    """Just enough gateway for AutoScaler.decide(): no replicas, no
+    engines — signal dicts are handed in directly."""
+
+    def __init__(self):
+        self.scaler = None
+        self.live_replicas = []
+        self.history = None
+
+
+def _sig(**kw):
+    base = dict(now=time.monotonic(), replicas_live=1,
+                replicas_routable=1, depth=0, oldest_wait_s=0.0,
+                enqueue_rate_per_s=0.0, by_tier={}, per_replica=[],
+                active_slots=0, slots=4, shed_capacity_total=0,
+                ttft_hist={"count": 0, "sum": 0.0, "buckets": {}},
+                kv_pages_total=0, kv_pages_free=0)
+    base.update(kw)
+    return base
+
+
+def _scaler(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("up_stable", 2)
+    kw.setdefault("down_stable", 3)
+    kw.setdefault("cooldown_up_s", 0.05)
+    kw.setdefault("cooldown_down_s", 0.05)
+    return AutoScaler(_FakeGateway(), ThreadBackend(lambda: None), **kw)
+
+
+def test_scaler_decide_hysteresis_streaks_and_bounds():
+    sc = _scaler()
+    hot = _sig(depth=10, oldest_wait_s=2.0)
+    now = time.monotonic()
+    assert sc.decide(hot, now) == (None, ["queue_depth 10 (10.0/replica)",
+                                          "oldest_wait 2.00s"])
+    action, _ = sc.decide(hot, now)  # second consecutive breach
+    assert action == "up"
+    # at the ceiling the same pressure is a no-op
+    sc2 = _scaler(max_replicas=1)
+    for _ in range(5):
+        action, _ = sc2.decide(_sig(depth=10), now)
+    assert action is None
+    # idle needs down_stable consecutive ticks AND live > min
+    sc3 = _scaler()
+    idle = _sig(replicas_live=2)
+    assert sc3.decide(idle, now)[0] is None
+    assert sc3.decide(idle, now)[0] is None
+    assert sc3.decide(idle, now)[0] == "down"
+    # at the floor, idleness never scales down
+    sc4 = _scaler()
+    for _ in range(6):
+        action, _ = sc4.decide(_sig(replicas_live=1), now)
+    assert action is None
+
+
+def test_scaler_below_floor_scales_up_without_pressure():
+    """An under-provisioned fleet (boot below --autoscale-min, or a
+    prior scale-up failed) grows toward the floor regardless of
+    pressure, paced by the cooldown."""
+    sc = _scaler(min_replicas=2, max_replicas=3)
+    now = time.monotonic()
+    action, reasons = sc.decide(_sig(replicas_live=1), now)
+    assert action == "up" and reasons == ["below floor (1 < min 2)"]
+    sc._after_action(up=True)  # cooldown paces the retry
+    assert sc.decide(_sig(replicas_live=1), now)[0] is None
+
+
+def test_scaler_alternating_signals_never_flap():
+    """Hysteresis: pressure interleaved with calm ticks never crosses
+    a streak threshold — the loop cannot flap."""
+    sc = _scaler(up_stable=2, down_stable=2)
+    now = time.monotonic()
+    busy = _sig(depth=10, replicas_live=2, active_slots=4)
+    calm = _sig(replicas_live=2, active_slots=2)  # not idle (slots hot)
+    for _ in range(10):
+        assert sc.decide(busy, now)[0] is None
+        assert sc.decide(calm, now)[0] is None
+
+
+def test_scaler_cooldown_blocks_actions():
+    sc = _scaler(up_stable=1, cooldown_up_s=30.0)
+    now = time.monotonic()
+    assert sc.decide(_sig(depth=10, replicas_live=1), now)[0] == "up"
+    sc._after_action(up=True)  # what _scale_up does
+    for _ in range(5):
+        assert sc.decide(_sig(depth=10, replicas_live=1),
+                         time.monotonic())[0] is None
+
+
+def test_scaler_slo_burn_from_histogram_deltas():
+    sc = _scaler(up_stable=1, ttft_slo_s=0.1, slo_burn=0.25,
+                 min_slo_sample=4)
+    # seed the cumulative baseline
+    sc._ttft_burn(_sig(ttft_hist={"count": 10, "sum": 1.0,
+                                  "buckets": {"0.1": 10}}))
+    # 6 of the next 8 completions blew the 100 ms SLO
+    burn = sc._ttft_burn(_sig(ttft_hist={
+        "count": 18, "sum": 9.0, "buckets": {"0.1": 12, "0.5": 6}}))
+    assert burn == pytest.approx(0.75)
+    # too small a delta to vote
+    assert sc._ttft_burn(_sig(ttft_hist={
+        "count": 19, "sum": 9.5, "buckets": {"0.1": 12, "0.5": 7}})) is None
+    # an SLO BETWEEN bucket edges rounds UP to the next edge: the
+    # straddling bucket counts as within-SLO (a fleet at 0.28 s with a
+    # 0.3 s SLO must not read as 100% burn)
+    sc2 = _scaler(up_stable=1, ttft_slo_s=0.3, slo_burn=0.25,
+                  min_slo_sample=4)
+    sc2._ttft_burn(_sig(ttft_hist={"count": 0, "sum": 0, "buckets": {}}))
+    burn = sc2._ttft_burn(_sig(ttft_hist={
+        "count": 10, "sum": 2.8, "buckets": {"0.5": 10}}))
+    assert burn == 0.0
+    burn = sc2._ttft_burn(_sig(ttft_hist={
+        "count": 20, "sum": 22.8, "buckets": {"0.5": 10, "2.5": 10}}))
+    assert burn == pytest.approx(1.0)
+
+
+def test_scaler_kv_pressure_signal():
+    sc = _scaler(kv_used_frac=0.9)
+    reasons = sc._pressure_reasons(_sig(kv_pages_total=100,
+                                        kv_pages_free=5))
+    assert any("kv_pages" in r for r in reasons)
+    assert sc._pressure_reasons(_sig(kv_pages_total=100,
+                                     kv_pages_free=50)) == []
+
+
+def test_provisioner_backend_acquires_and_releases():
+    """ProvisionerBackend: one slice per dynamic replica, deprovision
+    on destroy, deprovision-on-failed-build, typed ScaleError on
+    acquisition failure."""
+    events = []
+
+    class FakeProv:
+        def __init__(self, slot, fail=False):
+            self.slot, self.fail = slot, fail
+
+        def provision(self):
+            if self.fail:
+                raise RuntimeError("quota")
+            events.append(("provision", self.slot))
+            return [f"10.0.0.{self.slot}"]
+
+        def deprovision(self):
+            events.append(("deprovision", self.slot))
+
+    backend = ProvisionerBackend(lambda slot: FakeProv(slot),
+                                 lambda hosts: {"hosts": hosts})
+    s0 = backend.create()
+    assert s0 == {"hosts": ["10.0.0.0"]}
+    backend.destroy(s0)
+    assert events == [("provision", 0), ("deprovision", 0)]
+    with pytest.raises(ScaleError, match="provision failed"):
+        ProvisionerBackend(lambda slot: FakeProv(slot, fail=True),
+                           lambda hosts: None).create()
+    # server build failing after a successful provision tears the
+    # slice back down — no leaked capacity
+    events.clear()
+
+    def bad_build(hosts):
+        raise RuntimeError("oom")
+
+    backend2 = ProvisionerBackend(lambda slot: FakeProv(slot), bad_build)
+    with pytest.raises(ScaleError, match="server build"):
+        backend2.create()
+    assert events == [("provision", 0), ("deprovision", 0)]
+
+
+def test_scaler_survives_backend_failure(tiny):
+    """A broken backend costs a recorded up_failed decision + a
+    cooldown, never a dead loop or a broken gateway."""
+
+    def explode():
+        raise RuntimeError("no capacity")
+
+    gw = Gateway([_server(tiny)], max_queue=64).start()
+    sc = AutoScaler(gw, ThreadBackend(explode), min_replicas=1,
+                    max_replicas=2, up_stable=1, up_queue_depth=0.5,
+                    cooldown_up_s=30.0)
+    tickets = [gw.submit(GenRequest([1, 2, 3], max_new_tokens=8, id=i))
+               for i in range(8)]
+    assert sc.tick() == "up"  # pressured -> tries, fails, records
+    assert sc.errors == 1 and sc.scale_ups == 0
+    assert [d["action"] for d in sc.decisions] == ["up_failed"]
+    assert sc.tick() is None  # cooldown: no hot-looping the backend
+    for t in tickets:
+        t.result(timeout=120)  # gateway unharmed
+    assert gw.drain(timeout=60)
+
+
+def test_scale_up_failed_join_releases_capacity(tiny):
+    """Capacity acquired for a scale-up whose gateway join then fails
+    (e.g. the gateway closed while a slow slice provision was in
+    flight) is released — a billed TPU slice must never leak."""
+    events = []
+
+    class Backend:
+        def create(self):
+            events.append("create")
+            return "capacity"
+
+        def destroy(self, server):
+            events.append(("destroy", server))
+
+        def describe(self):
+            return "fake"
+
+    gw = Gateway([_server(tiny)], max_queue=8).start()
+    sc = AutoScaler(gw, Backend(), min_replicas=1, max_replicas=2)
+    assert gw.drain(timeout=60)  # closes the gateway (and stops sc)
+    sc._scale_up(_sig(), ["test"])  # add_replica -> GatewayClosed
+    assert events == ["create", ("destroy", "capacity")]
+    assert sc.scale_ups == 0 and sc.errors == 1
+    assert [d["action"] for d in sc.decisions] == ["up_failed"]
+
+
+# ------------------------------------------------- the scaling anchor
+
+
+def test_autoscaler_burst_scales_up_probe_admitted_then_drains(tiny):
+    """The ISSUE-9 chaos-style scaling anchor: a synthetic burst makes
+    the autoscaler add a replica (entering via probe admission), every
+    stream stays byte-exact with zero 5xx, and once idle the fleet
+    drains back to the floor with zero accepted-request loss."""
+    gw = Gateway([_server(tiny, chunk_steps=1)], max_queue=256,
+                 breaker_base_s=0.02, breaker_max_s=0.1).start()
+    sc = AutoScaler(
+        gw, ThreadBackend(lambda: _server(tiny, chunk_steps=1)),
+        min_replicas=1, max_replicas=2, interval_s=0.05,
+        up_queue_depth=1.5, up_wait_s=0.5, up_stable=1, down_stable=3,
+        cooldown_up_s=0.1, cooldown_down_s=0.2,
+        drain_timeout_s=120).start()
+    prompts = [[1 + i % 5, 2, 3] for i in range(24)]
+    streams: dict[int, list] = {i: [] for i in range(len(prompts))}
+
+    def on_event(ticket, event):
+        if event[0] == "tokens":
+            streams[ticket.request.id].extend(event[1])
+
+    tickets = [gw.submit(GenRequest(p, max_new_tokens=12, id=i), on_event)
+               for i, p in enumerate(prompts)]
+    results = [t.result(timeout=240) for t in tickets]
+    deadline = time.monotonic() + 60
+    while sc.scale_ups < 1 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert sc.scale_ups >= 1, sc.status()
+    # probe admission: the newcomer went through a real probe
+    assert gw.stats.probes >= 1 and gw.stats.rejoins >= 1
+    # byte-exact everywhere: result AND the streamed deltas
+    for i, res in enumerate(results):
+        want = _solo(tiny, prompts[i], 12)
+        assert res.tokens == want, i
+        assert streams[i] == want, i
+    # idle -> drains back to the floor, zero loss along the way
+    while len(gw.live_replicas) > 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert len(gw.live_replicas) == 1, sc.status()
+    assert sc.scale_downs >= 1
+    snap = gw.snapshot()
+    assert snap["completed"] == len(prompts)
+    assert snap["shed"] == {}  # zero 5xx (or any shed) throughout
+    assert snap["scaler"]["scale_ups"] >= 1
+    assert snap["scaler"]["last_decisions"], snap["scaler"]
+    assert gw.drain(timeout=120)
+
+
+def test_scaling_decisions_land_in_history(tiny, tmp_path):
+    from tony_tpu.gateway import GatewayHistory
+
+    hist = GatewayHistory(str(tmp_path), n_replicas=1)
+    gw = Gateway([_server(tiny)], max_queue=64, history=hist,
+                 breaker_base_s=0.02, breaker_max_s=0.1).start()
+    sc = AutoScaler(gw, ThreadBackend(lambda: _server(tiny)),
+                    min_replicas=1, max_replicas=2, up_stable=1,
+                    cooldown_up_s=0.0)
+    tickets = [gw.submit(GenRequest([1, 2], max_new_tokens=6, id=i))
+               for i in range(10)]
+    assert sc.tick() == "up"
+    for t in tickets:
+        t.result(timeout=120)
+    assert gw.drain(timeout=120)
+    rows = [json.loads(ln) for ln in open(
+        tmp_path / "intermediate" / hist.app_id / "metrics" /
+        "scaling.jsonl")]
+    assert rows and rows[0]["action"] == "up"
+    assert rows[0]["reasons"] and "replicas_live" in rows[0]
+
+
+# ---------------------------------------------------------------- http
+
+
+def test_http_quota_retry_after_and_priority(tiny):
+    # slow refill on purpose: the first request's decode time must not
+    # refill the bucket enough to admit the second
+    gw = Gateway([_server(tiny, chunk_steps=1)], max_queue=16,
+                 tenant_quota_rate=0.5, tenant_quota_burst=12.0).start()
+    http = GatewayHTTP(gw).start()
+    url = f"http://{http.host}:{http.port}"
+    try:
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=json.dumps({"token_ids": [1, 2, 3], "max_new_tokens": 8,
+                             "tenant": "acme",
+                             "priority": "interactive"}).encode(),
+            headers={"Content-Type": "application/json"})
+        doc = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        assert doc["metrics"]["priority"] == "interactive"
+        assert doc["metrics"]["tenant"] == "acme"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(urllib.request.Request(
+                url + "/v1/generate",
+                data=json.dumps({"token_ids": [1] * 10,
+                                 "max_new_tokens": 20,
+                                 "tenant": "acme"}).encode(),
+                headers={"Content-Type": "application/json"}),
+                timeout=120)
+        assert e.value.code == 429
+        assert int(e.value.headers["Retry-After"]) >= 1
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(urllib.request.Request(
+                url + "/v1/generate",
+                data=json.dumps({"token_ids": [9], "max_new_tokens": 2,
+                                 "priority": "vip"}).encode(),
+                headers={"Content-Type": "application/json"}),
+                timeout=120)
+        assert e.value.code == 400
+        # /stats and /metrics carry the new families
+        stats = json.loads(urllib.request.urlopen(
+            url + "/stats", timeout=30).read())
+        assert stats["queue"]["depth"] == 0
+        assert stats["admission"]["quota"]["rejections"] == 1
+        text = urllib.request.urlopen(url + "/metrics",
+                                      timeout=30).read().decode()
+        assert "tony_quota_rejections_total 1" in text
+        assert 'tony_tier_queue_wait_seconds_bucket{tier="interactive"' \
+            in text
+        assert "tony_queue_oldest_wait_seconds" in text
+    finally:
+        gw.drain(timeout=60)
+        http.stop()
+
+
+# ----------------------------------------------------------- slow gate
+
+
+@pytest.mark.slow  # timing comparison; tier-1 runs -m 'not slow'
+def test_scaleup_beats_fixed_fleet_p99_queue_wait(tiny):
+    """The acceptance's perf clause: under the same burst, the
+    autoscaled fleet's p99 queue wait drops vs a fixed-size control."""
+
+    def burst(gw):
+        tickets = [gw.submit(GenRequest([1 + i % 5, 2, 3],
+                                        max_new_tokens=24, id=i))
+                   for i in range(24)]
+        for t in tickets:
+            t.result(timeout=300)
+        waits = sorted(t.metrics["queue_wait_ms"] for t in tickets)
+        return waits[int(0.99 * (len(waits) - 1))]
+
+    fixed = Gateway([_server(tiny, chunk_steps=1)], max_queue=256).start()
+    p99_fixed = burst(fixed)
+    assert fixed.drain(timeout=120)
+
+    gw = Gateway([_server(tiny, chunk_steps=1)], max_queue=256,
+                 breaker_base_s=0.02, breaker_max_s=0.1).start()
+    AutoScaler(gw, ThreadBackend(lambda: _server(tiny, chunk_steps=1)),
+               min_replicas=1, max_replicas=3, interval_s=0.05,
+               up_queue_depth=1.5, up_wait_s=0.3, up_stable=1,
+               down_stable=50, cooldown_up_s=0.2,
+               drain_timeout_s=120).start()
+    p99_scaled = burst(gw)
+    assert gw.scaler.scale_ups >= 1
+    assert gw.drain(timeout=120)
+    assert p99_scaled < p99_fixed, (p99_scaled, p99_fixed)

@@ -189,6 +189,83 @@ def test_provisioner_from_conf_requires_accel_type():
         provisioner_from_conf(conf, "app")
 
 
+# -- autoscaler scale paths (ISSUE-9) -----------------------------------
+
+
+def test_static_provisioner_scale_idempotence():
+    """The autoscaler backend's contract on the no-op provisioner:
+    provision()/deprovision() are idempotent (repeat calls return the
+    same hosts / stay no-ops, state stays READY) — a scale-up/down
+    cycle through a StaticProvisioner must never mutate capacity."""
+    prov = StaticProvisioner(["h1", "h2"])
+    assert prov.state == STATE_READY
+    assert prov.provision() == ["h1", "h2"]
+    assert prov.provision() == ["h1", "h2"]  # re-provision: same hosts
+    prov.deprovision()
+    prov.deprovision()  # double-release: no-op, no raise
+    assert prov.state == STATE_READY
+    assert prov.provision() == ["h1", "h2"]  # usable after release
+    assert StaticProvisioner().provision() == []  # hostless default
+
+
+def test_static_provisioner_drives_autoscaler_backend():
+    """ProvisionerBackend over StaticProvisioners: each create()
+    acquires through provision(), destroy() releases exactly the
+    matching slice — the in-process analog of the TPU-VM scale path."""
+    from tony_tpu.gateway import ProvisionerBackend
+
+    provs = {}
+
+    def factory(slot):
+        provs[slot] = StaticProvisioner([f"host-{slot}"])
+        return provs[slot]
+
+    backend = ProvisionerBackend(factory, lambda hosts: list(hosts))
+    s0, s1 = backend.create(), backend.create()
+    assert (s0, s1) == (["host-0"], ["host-1"])
+    backend.destroy(s0)
+    backend.destroy(s0)  # unknown/already-destroyed: no-op
+    assert provs[1].provision() == ["host-1"]  # s1's slice untouched
+
+
+def test_provisioner_from_conf_bad_numeric_conf_is_typed():
+    """Malformed numeric conf values fail TYPED (ConfError naming the
+    key), not as a bare int() stack trace — both at set() time (typed
+    keys) and at provisioner_from_conf() time (values that bypassed
+    coercion, e.g. a hand-edited final conf)."""
+    conf = TonyConf()
+    with pytest.raises(ConfError, match="timeout-ms must be an integer"):
+        conf.set("tony.provisioner.timeout-ms", "soon")
+    conf2 = TonyConf()
+    conf2.set("tony.provisioner.mode", "queued")
+    conf2.set("tony.provisioner.accelerator-type", "v5p-8")
+    # values can reach the reader uncoerced (hand-edited final conf);
+    # the dispatch must still fail typed, naming the key
+    conf2._values["tony.tpu.num-slices"] = "many"
+    with pytest.raises(ConfError, match="num-slices must be an integer"):
+        provisioner_from_conf(conf2, "app")
+    conf3 = TonyConf()
+    conf3.set("tony.provisioner.mode", "tpu-vm")
+    conf3.set("tony.provisioner.accelerator-type", "v5p-8")
+    conf3._values["tony.worker.instances"] = 2
+    conf3._values["tony.worker.chips"] = "lots"
+    with pytest.raises(ConfError, match="chips must be an integer"):
+        provisioner_from_conf(conf3, "app")
+
+
+def test_provisioner_from_conf_missing_conf_dispatch():
+    """Dispatch with missing conf: mode none + no hosts is a working
+    empty StaticProvisioner (local devices); slice modes without an
+    accelerator type fail typed."""
+    prov = provisioner_from_conf(TonyConf(), "app")
+    assert isinstance(prov, StaticProvisioner)
+    assert prov.provision() == []
+    conf = TonyConf()
+    conf.set("tony.provisioner.mode", "queued")
+    with pytest.raises(ConfError, match="accelerator-type"):
+        provisioner_from_conf(conf, "app")
+
+
 # -- local preflight ----------------------------------------------------
 
 

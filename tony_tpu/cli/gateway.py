@@ -28,6 +28,15 @@ replica re-earns admission through a circuit breaker
 (``--breaker-base``/``--breaker-max`` backoff, ``--quarantine-after``
 strikes). ``TONY_SERVE_FAULTS`` arms deterministic fault injection for
 chaos testing (``make chaos-smoke``; see ``serve/faults.py``).
+
+Elastic autoscaling + admission tiers (ISSUE-9; docs/SERVING.md):
+``--autoscale-max N`` arms the control loop — the fleet grows from
+``--replicas`` up to N under queue/SLO pressure (new replicas join
+via circuit-breaker probe admission) and drains back to
+``--autoscale-min`` when idle (zero-loss). Requests may carry
+``priority`` (weighted-fair-queued tiers, ``--tier-weights``) and
+``tenant`` (token-rate quotas, ``--tenant-quota`` -> 429 +
+Retry-After on breach).
 """
 
 from __future__ import annotations
@@ -141,6 +150,46 @@ def build_parser() -> argparse.ArgumentParser:
                    help="consecutive failures (probe failures included) "
                         "before a replica is quarantined out of the "
                         "rotation for good")
+    p.add_argument("--tier-weights", default="",
+                   help="admission tier spec 'name=weight,...' "
+                        "(default interactive=8,standard=4,batch=1); "
+                        "requests pick a tier via their 'priority' "
+                        "field, weights shape WFQ interleaving under "
+                        "contention (idle fleets give any tier full "
+                        "throughput)")
+    p.add_argument("--tenant-quota", type=float, default=0.0,
+                   help="per-tenant token-rate quota in tokens/s over "
+                        "estimated request cost (prompt + budget); a "
+                        "tenant over its rate gets 429 + Retry-After. "
+                        "0 disables (the default)")
+    p.add_argument("--tenant-burst", type=float, default=0.0,
+                   help="per-tenant burst bucket in tokens "
+                        "(default 4x --tenant-quota)")
+    p.add_argument("--autoscale-max", type=int, default=0,
+                   help="arm the elastic autoscaler: grow the fleet "
+                        "up to this many replicas under queue/SLO "
+                        "pressure (probe-admitted), drain back to "
+                        "--autoscale-min when idle. 0 = fixed fleet "
+                        "(the default)")
+    p.add_argument("--autoscale-min", type=int, default=0,
+                   help="fleet floor for scale-down "
+                        "(default: --replicas)")
+    p.add_argument("--autoscale-interval", type=float, default=1.0,
+                   help="autoscaler control-loop tick in seconds")
+    p.add_argument("--autoscale-up-queue", type=float, default=4.0,
+                   help="queued requests per routable replica that "
+                        "count as scale-up pressure")
+    p.add_argument("--autoscale-up-wait", type=float, default=1.0,
+                   help="oldest queued wait (s) that counts as "
+                        "scale-up pressure")
+    p.add_argument("--autoscale-ttft-slo", type=float, default=0.0,
+                   help="TTFT SLO in seconds: scale-up pressure when "
+                        ">10%% of a tick's completions exceed it "
+                        "(0 disables the SLO-burn signal)")
+    p.add_argument("--autoscale-cooldown-up", type=float, default=5.0,
+                   help="lockout after a scale-up (s)")
+    p.add_argument("--autoscale-cooldown-down", type=float, default=30.0,
+                   help="lockout after a scale-down (s)")
     p.add_argument("--compile-cache",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "tony_tpu", "compile-cache"),
@@ -166,26 +215,46 @@ def demo_model():
     return model, params
 
 
-def build_gateway(args, model, params, eos, *, metrics_store=None):
-    """Servers + Gateway from parsed args (shared with tests/bench)."""
+def server_factory(args, model, params, eos):
+    """One replica engine from parsed args — shared by boot-time
+    construction AND the autoscaler's ThreadBackend, so a dynamically
+    added replica is configured identically to a boot one (weights
+    shared; its own KV cache/prefix store; TONY_SERVE_FAULTS applies
+    by its fleet index, so chaos rounds can arm dynamic replicas
+    too)."""
     from tony_tpu.cli.generate import (resolve_paged_kv,
                                        resolve_prefix_cache_mb)
-    from tony_tpu.gateway import Gateway, GatewayHistory
     from tony_tpu.serve import FaultPlan, Server
 
     prefix_mb = resolve_prefix_cache_mb(args, model)
+    # size the per-replica KV pool for the fleet CEILING: a pool sized
+    # for --replicas would oversubscribe HBM the moment the scaler
+    # grows past it
+    ceiling = max(1, args.replicas,
+                  getattr(args, "autoscale_max", 0) or 0)
     paged_kw = resolve_paged_kv(args, model, args.serve_batch,
-                                n_replicas=max(1, args.replicas))
-    # TONY_SERVE_FAULTS arms deterministic fault injection per replica
-    # (serve/faults.py) — the chaos-smoke hook; unset = None = zero cost
-    servers = [Server(model, params, batch_size=args.serve_batch,
+                                n_replicas=ceiling)
+
+    def make(index: int):
+        return Server(model, params, batch_size=args.serve_batch,
                       eos_id=eos, chunk_steps=args.chunk_steps,
                       max_pending=args.max_pending,
                       prefix_cache_mb=prefix_mb,
                       speculate_k=args.speculate_k,
-                      fault_plan=FaultPlan.from_env(replica=i),
+                      fault_plan=FaultPlan.from_env(replica=index),
                       **paged_kw)
-               for i in range(max(1, args.replicas))]
+
+    return make
+
+
+def build_gateway(args, model, params, eos, *, metrics_store=None):
+    """Servers + Gateway from parsed args (shared with tests/bench)."""
+    from tony_tpu.gateway import Gateway, GatewayHistory
+
+    # TONY_SERVE_FAULTS arms deterministic fault injection per replica
+    # (serve/faults.py) — the chaos-smoke hook; unset = None = zero cost
+    make = server_factory(args, model, params, eos)
+    servers = [make(i) for i in range(max(1, args.replicas))]
     armed = [i for i, s in enumerate(servers) if s.fault_plan is not None]
     if armed:
         logging.getLogger(__name__).warning(
@@ -206,7 +275,48 @@ def build_gateway(args, model, params, eos, *, metrics_store=None):
                    quarantine_after=args.quarantine_after,
                    tracing=trace_capacity > 0,
                    trace_capacity=max(1, trace_capacity),
-                   profile_dir=getattr(args, "profile_dir", "") or None)
+                   profile_dir=getattr(args, "profile_dir", "") or None,
+                   tier_weights=getattr(args, "tier_weights", "") or None,
+                   tenant_quota_rate=getattr(args, "tenant_quota", 0.0),
+                   tenant_quota_burst=getattr(args, "tenant_burst", 0.0))
+
+
+def build_scaler(args, gateway, model, params, eos):
+    """Arm the elastic autoscaler when --autoscale-max asks for one:
+    a ThreadBackend over the same server factory boot replicas used
+    (weights shared — scale-up costs one KV cache + the probe's
+    compile, not a checkpoint load). Returns None when not armed."""
+    max_replicas = getattr(args, "autoscale_max", 0)
+    if not max_replicas:
+        return None
+    from tony_tpu.gateway import AutoScaler, ThreadBackend
+
+    boot = max(1, args.replicas)
+    if max_replicas < boot:
+        raise SystemExit(f"--autoscale-max {max_replicas} is below "
+                         f"--replicas {boot}")
+    floor = max(1, getattr(args, "autoscale_min", 0) or boot)
+    if floor > max_replicas:
+        raise SystemExit(f"--autoscale-min {floor} is above "
+                         f"--autoscale-max {max_replicas}")
+    make = server_factory(args, model, params, eos)
+    # a dynamic replica's fleet index is wherever the (append-only)
+    # replica list currently ends — read at create time, so a failed
+    # create/join cannot desync TONY_SERVE_FAULTS addressing for the
+    # replicas that come after it (only the scaler thread creates, so
+    # the read cannot race another add)
+    backend = ThreadBackend(lambda: make(len(gateway.replicas)))
+    return AutoScaler(
+        gateway, backend,
+        min_replicas=floor,
+        max_replicas=max_replicas,
+        interval_s=getattr(args, "autoscale_interval", 1.0),
+        up_queue_depth=getattr(args, "autoscale_up_queue", 4.0),
+        up_wait_s=getattr(args, "autoscale_up_wait", 1.0),
+        ttft_slo_s=getattr(args, "autoscale_ttft_slo", 0.0),
+        cooldown_up_s=getattr(args, "autoscale_cooldown_up", 5.0),
+        cooldown_down_s=getattr(args, "autoscale_cooldown_down", 30.0),
+        drain_timeout_s=getattr(args, "drain_timeout", 120.0))
 
 
 def main(argv=None) -> int:
@@ -251,11 +361,16 @@ def main(argv=None) -> int:
 
     gateway = build_gateway(args, model, params, eos,
                             metrics_store=MetricsStore()).start()
+    scaler = build_scaler(args, gateway, model, params, eos)
+    if scaler is not None:
+        scaler.start()
     http = GatewayHTTP(gateway, host=args.host, port=args.port,
                        encode=encode, decode=decode).start()
+    elastic = "" if scaler is None else \
+        (f", autoscale {scaler.min_replicas}-{scaler.max_replicas}")
     print(f"tony-tpu gateway at http://{http.host}:{http.port} "
           f"({max(1, args.replicas)} replica(s) x {args.serve_batch} "
-          f"slots)", flush=True)
+          f"slots{elastic})", flush=True)
 
     stop = threading.Event()
 

@@ -119,7 +119,15 @@ class TonyConf:
         if t is bool and not isinstance(value, bool):
             return str(value).strip().lower() in ("true", "1", "yes")
         if t is int and not isinstance(value, int):
-            return int(str(value).strip())
+            try:
+                return int(str(value).strip())
+            except ValueError:
+                # a typo'd numeric in a conf file must fail as a typed,
+                # key-naming ConfError — "invalid literal for int()"
+                # with no key is useless to an operator (and the
+                # provisioner/autoscaler paths log exceptions verbatim)
+                raise ConfError(
+                    f"{key} must be an integer, got {value!r}") from None
         if t is str:
             return str(value)
         return value

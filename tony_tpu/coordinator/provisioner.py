@@ -63,8 +63,8 @@ def required_chips(conf: TonyConf) -> int:
     (ref: per-container GPU counts, util/Utils.java:420-430)."""
     total = 0
     for role in conf.roles():
-        inst = conf.get_int(f"tony.{role}.instances")
-        chips = conf.get_int(f"tony.{role}.chips")
+        inst = _conf_int(conf, f"tony.{role}.instances", 0)
+        chips = _conf_int(conf, f"tony.{role}.chips", 0)
         if inst > 0 and chips > 0:
             total += inst * chips
     return total
@@ -344,8 +344,25 @@ class TpuVmProvisioner(Provisioner):
         self.state = STATE_NONE
 
 
+def _conf_int(conf: TonyConf, key: str, default: int) -> int:
+    """``get_int`` with a TYPED failure: a garbage value in a numeric
+    provisioner key must fail the submission with a ConfError naming
+    the key, not escape as a bare ValueError stack trace — the
+    autoscaler's ProvisionerBackend (gateway/autoscale.py) turns any
+    provisioning exception into a logged decision, and 'invalid
+    literal for int()' tells an operator nothing."""
+    try:
+        return conf.get_int(key, default)
+    except (TypeError, ValueError) as e:
+        raise ConfError(f"{key} must be an integer "
+                        f"(got {conf.get(key)!r}): {e}") from None
+
+
 def provisioner_from_conf(conf: TonyConf, app_id: str) -> Provisioner:
-    """Build the configured provisioner (cheap: no subprocess here)."""
+    """Build the configured provisioner (cheap: no subprocess here).
+    Raises ``ConfError`` (typed, operator-readable) for unknown modes,
+    undersized slices, and malformed numeric values — never a bare
+    ``ValueError`` stack trace."""
     mode = str(conf.get("tony.provisioner.mode", "none"))
     if mode == "none":
         hosts = [h.strip() for h in
@@ -357,7 +374,7 @@ def provisioner_from_conf(conf: TonyConf, app_id: str) -> Provisioner:
     accel = str(conf.get("tony.provisioner.accelerator-type", "")) or \
         str(conf.get("tony.tpu.topology", ""))
     need = required_chips(conf)
-    n_nodes = max(1, conf.get_int("tony.tpu.num-slices", 1))
+    n_nodes = max(1, _conf_int(conf, "tony.tpu.num-slices", 1))
     have = chips_in_accelerator_type(accel) * n_nodes
     if need > 0 and have > 0 and have < need:
         raise ConfError(
@@ -379,12 +396,13 @@ def provisioner_from_conf(conf: TonyConf, app_id: str) -> Provisioner:
         spot=conf.get_bool("tony.provisioner.spot"),
         reuse=conf.get_bool("tony.provisioner.reuse", True),
         keep=conf.get_bool("tony.provisioner.keep"),
-        timeout_s=conf.get_int("tony.provisioner.timeout-ms", 900_000) / 1000,
-        poll_interval_s=conf.get_int(
-            "tony.provisioner.poll-interval-ms", 10_000) / 1000,
+        timeout_s=_conf_int(conf, "tony.provisioner.timeout-ms",
+                            900_000) / 1000,
+        poll_interval_s=_conf_int(
+            conf, "tony.provisioner.poll-interval-ms", 10_000) / 1000,
         network=str(conf.get("tony.provisioner.network", "")),
         labels=str(conf.get("tony.provisioner.labels", "")),
-        node_count=conf.get_int("tony.tpu.num-slices", 1))
+        node_count=_conf_int(conf, "tony.tpu.num-slices", 1))
 
 
 def preflight_chips(conf: TonyConf) -> str | None:

@@ -1,20 +1,31 @@
 """Serving front door over N ``serve.Server`` replicas.
 
 ``core`` is the admission/routing/drain machinery (pure Python, no
-sockets — unit-testable); ``http`` is the stdlib network face. The CLI
-entrypoint is ``python -m tony_tpu.cli.gateway``; ``tony-tpu generate
---serve`` drives the same core over stdin/stdout JSONL.
+sockets — unit-testable); ``admission`` the weighted-fair-queuing
+tiers + tenant quotas; ``autoscale`` the elastic control loop driving
+``Gateway.add_replica``/``remove_replica``; ``http`` the stdlib
+network face. The CLI entrypoint is ``python -m tony_tpu.cli.gateway``;
+``tony-tpu generate --serve`` drives the same core over stdin/stdout
+JSONL.
 """
 
+from tony_tpu.gateway.admission import (DEFAULT_TIER, DEFAULT_TIER_WEIGHTS,
+                                        TenantQuotas, WFQueue,
+                                        parse_tier_weights)
+from tony_tpu.gateway.autoscale import (AutoScaler, ProvisionerBackend,
+                                        ScaleError, ThreadBackend)
 from tony_tpu.gateway.core import (BadRequest, DeadlineExceeded, Gateway,
                                    GatewayClosed, GatewayHistory,
                                    GatewayQueueFull, GenRequest,
-                                   NoHealthyReplicas, RetryBudgetExhausted,
-                                   Shed, Ticket)
+                                   NoHealthyReplicas, QuotaExceeded,
+                                   RetryBudgetExhausted, Shed, Ticket)
 from tony_tpu.gateway.http import GatewayHTTP
 
 __all__ = [
+    "AutoScaler",
     "BadRequest",
+    "DEFAULT_TIER",
+    "DEFAULT_TIER_WEIGHTS",
     "DeadlineExceeded",
     "Gateway",
     "GatewayClosed",
@@ -23,7 +34,14 @@ __all__ = [
     "GatewayQueueFull",
     "GenRequest",
     "NoHealthyReplicas",
+    "ProvisionerBackend",
+    "QuotaExceeded",
     "RetryBudgetExhausted",
+    "ScaleError",
     "Shed",
+    "TenantQuotas",
+    "ThreadBackend",
     "Ticket",
+    "WFQueue",
+    "parse_tier_weights",
 ]

@@ -43,6 +43,26 @@ of role tasks onto a container pool). The pieces, front to back:
   timelines surface as ``/stats`` dispatch blocks; ``/metrics`` renders
   everything as Prometheus text; ``POST /debug/profile`` arms an
   on-demand jax.profiler capture polled by the replica threads.
+- ADMISSION TIERS (``gateway/admission.py``, docs/SERVING.md): each
+  replica's queue is a weighted fair queue over priority tiers
+  (``interactive``/``standard``/``batch``) — a saturating batch flood
+  cannot starve interactive requests, an idle fleet still gives batch
+  its full throughput — with per-tenant token-rate quotas priced as
+  immediate 429 + ``Retry-After`` (``QuotaExceeded``), and
+  deadline-first ordering within a tier. Stolen (failover) tickets
+  keep their tier and are never re-charged quota.
+- ELASTICITY (``gateway/autoscale.py`` — the TonY
+  acquire-and-release-to-match-the-job loop, serving flavor):
+  ``add_replica()`` grows the fleet at runtime, with the newcomer
+  entering through the circuit breaker's PROBE path — it joins
+  routing only after a real probe generation (which also pays its
+  compile warmup off the traffic path); ``remove_replica()`` shrinks
+  it over the existing zero-loss drain (the retiring replica leaves
+  routing immediately, finishes its queue and in-flight slots, then
+  parks RETIRED with its engine released). The ``AutoScaler`` drives
+  both from the fleet's own signals (queue depth + oldest wait, shed
+  rate, TTFT SLO burn, KV-page pressure) behind hysteresis, cooldowns
+  and min/max bounds.
 - SUPERVISION (the TonY ApplicationMaster story, ported to serving):
   every replica thread heartbeats per scheduler iteration; a
   ``LivenessMonitor`` watchdog declares a replica failed when its
@@ -81,6 +101,9 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable
 
+from tony_tpu.gateway.admission import (DEFAULT_TIER, WFQueue, TenantQuotas,
+                                        parse_tier_weights)
+from tony_tpu.gateway.admission import DEFAULT_TIER_WEIGHTS as _DEFAULT_WEIGHTS
 from tony_tpu.obs import Histogram, RequestTrace, TraceBuffer
 from tony_tpu.obs.timeline import DispatchTimeline
 from tony_tpu.serve import PoolExhausted, QueueFull, Request, Server
@@ -105,6 +128,19 @@ class BadRequest(Shed):
 
 class GatewayQueueFull(Shed):
     http_status = 429
+
+
+class QuotaExceeded(Shed):
+    """The tenant's token bucket can't cover this request right now:
+    429 with an honest ``Retry-After`` (seconds until the bucket
+    refills enough). Priced at submit, never queued — a tenant's
+    overrun cannot occupy queue slots other tenants need."""
+
+    http_status = 429
+
+    def __init__(self, reason: str, retry_after_s: float = 1.0):
+        super().__init__(reason)
+        self.retry_after_s = max(0.0, retry_after_s)
 
 
 class GatewayClosed(Shed):
@@ -152,6 +188,12 @@ class GenRequest:
     id: Any = None
     ttl_s: float | None = None
     session: str | None = None
+    # multi-tenant admission (gateway/admission.py): ``priority`` names
+    # a WFQ tier (None -> "standard"; unknown names are a 400),
+    # ``tenant`` keys the token-rate quota bucket (None -> the shared
+    # anonymous bucket when quotas are on)
+    tenant: str | None = None
+    priority: str | None = None
     # set by the HTTP layer: when the front door read the request off
     # the wire (time.monotonic()); the trace's http_receive span —
     # None for in-process submits, whose trace starts at submit
@@ -163,9 +205,13 @@ QUEUED, RUNNING, DONE, SHED = "QUEUED", "RUNNING", "DONE", "SHED"
 
 # replica health states (the circuit-breaker cycle): HEALTHY routable,
 # BROKEN waiting out its breaker backoff, PROBING running the probe
-# generation, QUARANTINED out of the rotation for good
-HEALTHY, BROKEN, PROBING, QUARANTINED = ("healthy", "broken", "probing",
-                                         "quarantined")
+# generation, QUARANTINED out of the rotation for good, RETIRED
+# scale-down finished its zero-loss drain and released the engine
+HEALTHY, BROKEN, PROBING, QUARANTINED, RETIRED = (
+    "healthy", "broken", "probing", "quarantined", "retired")
+
+# window for the per-replica recent-enqueue-rate sensor (queue block)
+_ENQ_RATE_WINDOW_S = 10.0
 
 
 class Ticket:
@@ -191,10 +237,10 @@ class Ticket:
     from latency.
     """
 
-    def __init__(self, request: GenRequest, deadline: float | None,
+    def __init__(self, request: GenRequest, ttl_s: float | None,
                  on_event: Callable | None = None):
         self.request = request
-        self.deadline = deadline
+        self.ttl_s = ttl_s
         self.t_submit = time.monotonic()
         self.t_queued = self.t_submit  # refreshed per enqueue (failover)
         self.t_admit: float | None = None
@@ -202,6 +248,15 @@ class Ticket:
         self.trace: RequestTrace | None = None  # set by Gateway.submit
         self.replica: int | None = None
         self.state = QUEUED
+        # admission-tier bookkeeping (set by Gateway.submit): the WFQ
+        # tier travels WITH the ticket, so a failover re-enqueue keeps
+        # its priority; quota was charged once at submit and never
+        # again. queue_pos is the position it joined its (last) queue
+        # at — the after-the-fact tier-behavior audit trail.
+        self.tier = DEFAULT_TIER
+        self.tenant: str | None = None
+        self.queue_pos = -1
+        self._wfq_key: tuple | None = None  # set by WFQueue.push
         self.metrics: dict | None = None  # the done-event record
         self.events: queue.Queue = queue.Queue()
         self.attempts = 0  # engine runs that FAILED (retry budget)
@@ -218,6 +273,15 @@ class Ticket:
     @property
     def cost(self) -> int:
         return len(self.request.prompt) + self.request.max_new_tokens
+
+    @property
+    def deadline(self) -> float | None:
+        """Absolute deadline, DERIVED from the original submit time so
+        it is structurally impossible for a failover re-enqueue (which
+        refreshes ``t_queued``) to extend it: a request gets ``ttl_s``
+        of wall clock from submit, across however many replicas it
+        visits."""
+        return None if self.ttl_s is None else self.t_submit + self.ttl_s
 
     def _emit(self, event: tuple) -> None:
         self.events.put(event)
@@ -290,11 +354,23 @@ class _Replica:
         self.index = index
         self.server = server
         self.gateway = gateway
-        self.queue: deque[Ticket] = deque()
+        self.queue = WFQueue(gateway.tier_weights)
         self.cv = threading.Condition()
         self.outstanding = 0  # token-cost estimate: queued + in-flight
         self.completed = 0
         self.shed = 0
+        # queue sensors (the /stats "queue" block — the autoscaler's
+        # primary pressure signal): lifetime enqueue counter plus a
+        # short timestamp ring for the recent enqueue rate
+        self.enqueued = 0
+        self._enq_times: deque[float] = deque(maxlen=256)
+        # scale-down (Gateway.remove_replica): ``retiring`` leaves the
+        # routing set immediately while the thread finishes its queue
+        # and in-flight slots; ``retired`` marks the drain complete and
+        # the engine released
+        self.retiring = False
+        self.retired = False
+        self.spawned = False  # added by add_replica (vs boot-time)
         # supervision / breaker state (all mutated under self.cv except
         # the plain counters, which only this thread or the gateway's
         # failure path touch)
@@ -311,6 +387,7 @@ class _Replica:
         self._tickets: dict[int, Ticket] = {}  # engine id -> ticket
         self._next_id = 0
         self._tl_cursor = 0  # dispatch-timeline read position (tracing)
+        self._probe_first = False  # scale-up: earn admission via probe
         self._thread = threading.Thread(target=self._loop,
                                         name=f"gateway-replica-{index}",
                                         daemon=True)
@@ -327,12 +404,14 @@ class _Replica:
                 # after the stop signal could otherwise strand forever
                 # on a thread that already exited
                 raise GatewayClosed("gateway is draining")
-            if self.state != HEALTHY:
+            if self.state != HEALTHY or self.retiring:
                 # closes the route-vs-fail race: the router saw this
-                # replica healthy, the breaker opened before the
-                # enqueue landed — the caller re-routes
+                # replica healthy, the breaker opened (or a scale-down
+                # started retiring it) before the enqueue landed — the
+                # caller re-routes
                 raise _ReplicaUnhealthy(
-                    f"replica {self.index} is {self.state}")
+                    f"replica {self.index} is "
+                    f"{'retiring' if self.retiring else self.state}")
             ticket.replica = self.index
             ticket.t_queued = time.monotonic()
             if ticket.trace is not None:
@@ -340,7 +419,9 @@ class _Replica:
                 # epoch is the fencing tag the failover story pivots on
                 ticket.trace.begin_attempt(self.index, self.epoch,
                                            t0=ticket.t_queued)
-            self.queue.append(ticket)
+            ticket.queue_pos = self.queue.push(ticket)
+            self.enqueued += 1
+            self._enq_times.append(ticket.t_queued)
             self.outstanding += ticket.cost
             self.cv.notify()
 
@@ -350,12 +431,43 @@ class _Replica:
 
     @property
     def busy(self) -> bool:
-        return bool(self.server.slots.n_active or self.server.n_pending
-                    or self.queue)
+        return bool(self._server_busy() or self.queue)
+
+    def queue_signals(self, now: float | None = None) -> dict:
+        """The per-replica queue block: depth, oldest-wait age, recent
+        enqueue rate, per-tier depths — the autoscaler's primary
+        sensor, exported per replica on /stats and /metrics."""
+        if now is None:
+            now = time.monotonic()
+        with self.cv:
+            depth = len(self.queue)
+            oldest = self.queue.oldest_t_queued()
+            recent = sum(1 for t in self._enq_times
+                         if now - t <= _ENQ_RATE_WINDOW_S)
+            span = _ENQ_RATE_WINDOW_S
+            if recent == self._enq_times.maxlen:
+                # the ring saturated inside the window: rate over the
+                # span actually retained, else heavy bursts (the exact
+                # loads this sensor exists for) read as a flat ceiling
+                span = max(1e-3, now - self._enq_times[0])
+            by_tier = self.queue.depth_by_tier()
+        return {
+            "depth": depth,
+            "oldest_wait_s": round(max(0.0, now - oldest), 3)
+            if oldest is not None else 0.0,
+            "enqueue_rate_per_s": round(recent / span, 3),
+            "by_tier": by_tier,
+        }
 
     # ------------------------------------------------------------ loop
 
-    def start(self) -> None:
+    def start(self, probe_first: bool = False) -> None:
+        """``probe_first=True`` is the SCALE-UP entry (add_replica):
+        the replica starts BROKEN and runs the circuit breaker's probe
+        cycle before it ever joins routing — a new replica earns
+        admission exactly the way a recovered one does, and its first
+        compiles happen on the probe, off the traffic path."""
+        self._probe_first = probe_first
         self._thread.start()
 
     def signal_stop(self) -> None:
@@ -368,6 +480,14 @@ class _Replica:
             self._thread.join(timeout)
 
     def _loop(self) -> None:
+        if self._probe_first:
+            # scale-up path: prove the engine works (and pay its first
+            # compiles) through a real probe generation before joining
+            # routing — _recover() ends with the rejoin that registers
+            # us with the watchdog and flips us HEALTHY
+            self._probe_first = False
+            if not self._recover():
+                return
         while True:
             with self.cv:
                 epoch = self.epoch
@@ -447,7 +567,10 @@ class _Replica:
                     return
 
     def _server_busy(self) -> bool:
-        return bool(self.server.slots.n_active or self.server.n_pending)
+        server = self.server  # single read vs concurrent retirement
+        if server is None:  # retired: engine released
+            return False
+        return bool(server.slots.n_active or server.n_pending)
 
     def _admit_from_queue(self, epoch: int) -> None:
         """Move tickets into the engine, AT MOST as many as there are
@@ -457,9 +580,11 @@ class _Replica:
         free = len(self.server.slots.free_slots()) - self.server.n_pending
         while free > 0:
             with self.cv:
-                if not self.queue:
+                ticket = self.queue.pop()  # the WFQ decision: least
+                # virtual work among non-empty tiers, deadline-first
+                # within the tier
+                if ticket is None:
                     return
-                ticket = self.queue.popleft()
             now = time.monotonic()
             if ticket.deadline is not None and now >= ticket.deadline:
                 self._shed(ticket, 504,
@@ -483,7 +608,8 @@ class _Replica:
                 # ticket on a BROKEN queue forever
                 with self.cv:
                     if self.epoch == epoch:
-                        self.queue.appendleft(ticket)
+                        self.queue.unpop(ticket)  # back at its old
+                        # position, tier charge refunded
                         return
                 self.gateway._failover(
                     self, [], [ticket],
@@ -644,6 +770,13 @@ class _Replica:
             "attempts": ticket.attempts,  # failed engine runs this
             # request survived (0 = no failover; latency fields span
             # the whole life, retries included)
+            # tier audit trail (ISSUE-9): which tenant/tier this ran
+            # as and the queue position it joined its (last) queue at
+            # — so WFQ behavior is checkable after the fact from the
+            # /stats window and history requests.jsonl
+            "tenant": ticket.tenant,
+            "priority": ticket.tier,
+            "queue_pos": ticket.queue_pos,
             "finish_reason": res.finish_reason,
         }
 
@@ -657,7 +790,7 @@ class _Replica:
                 # subtracting again would drive it negative and skew
                 # least-outstanding routing forever after rejoin
                 self.outstanding = max(0, self.outstanding - ticket.cost)
-        self.gateway._record_shed(self, status)
+        self.gateway._record_shed(self, status, tier=ticket.tier)
         if ticket.trace is not None:
             ticket.trace.finish(outcome="shed", status=status,
                                 reason=reason)
@@ -755,10 +888,21 @@ class _Replica:
             return True
 
     def stats(self, include_dispatch: bool = False) -> dict:
+        # NOTE: no queue_signals() here — stats() runs on the
+        # per-request MetricsStore push (every completion/shed), and
+        # the oldest-wait scan is O(queue depth) under the cv. The
+        # snapshot path merges the queue block in itself, once per
+        # scrape (Gateway.snapshot).
+        server = self.server  # single read: remove_replica nulls the
+        # attribute concurrently, and a check-then-access would race
         out = {
+            "replica": self.index,
             "queued": self.n_queued,
-            "active_slots": self.server.slots.n_active,
-            "batch_size": self.server.slots.batch_size,
+            "enqueued": self.enqueued,
+            "active_slots": server.slots.n_active
+            if server is not None else 0,
+            "batch_size": server.slots.batch_size
+            if server is not None else 0,
             "outstanding_tokens": self.outstanding,
             "completed": self.completed,
             "shed": self.shed,
@@ -775,14 +919,16 @@ class _Replica:
         # engine counters (prefills, decode_steps, dispatches, the
         # prefix_* family) flat, so the MetricsStore numeric filter and
         # /stats both carry them per replica
-        out.update(self.server.counters())
+        if server is not None:
+            out.update(server.counters())
         # per-dispatch timeline aggregates (kind -> count/ms/compile
         # split/tokens) — opt-in: snapshot() wants it, but the
         # per-request MetricsStore push (whose numeric filter would
         # drop the nested dict anyway) must not pay a summary build on
         # every completion
-        if include_dispatch and self.server.timeline is not None:
-            out["dispatch"] = self.server.timeline.summary()
+        if include_dispatch and server is not None \
+                and server.timeline is not None:
+            out["dispatch"] = server.timeline.summary()
         return out
 
 
@@ -808,6 +954,13 @@ class _Stats:
         self.accepted = 0
         self.completed = 0
         self.shed_by_status: dict[int, int] = {}
+        # per-tier admission accounting (WFQ observability): lifetime
+        # completed/shed counts plus a queue-wait histogram per tier —
+        # the surface that proves batch cannot starve interactive
+        self.completed_by_tier: dict[str, int] = {}
+        self.shed_by_tier: dict[str, int] = {}
+        self.tier_wait: dict[str, Histogram] = {}
+        self.quota_rejections = 0
         self.tokens_in = 0
         self.tokens_out = 0
         self.prefix_hit_tokens = 0
@@ -821,6 +974,11 @@ class _Stats:
         self.probes = 0
         self.rejoins = 0
         self.quarantines = 0
+        # elasticity (the TonY acquire/release loop): runtime
+        # membership changes, however triggered (autoscaler or a
+        # direct add_replica/remove_replica call)
+        self.replicas_added = 0
+        self.replicas_removed = 0
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -875,6 +1033,8 @@ class GatewayHistory:
                                           "requests.jsonl")
         self._traces_path = os.path.join(self.job_dir, "metrics",
                                          "traces.jsonl")
+        self._scaling_path = os.path.join(self.job_dir, "metrics",
+                                          "scaling.jsonl")
 
     def _append_event(self, event) -> None:
         with self._lock, open(self.jhist, "a") as f:
@@ -890,6 +1050,14 @@ class GatewayHistory:
         carry, so the portal (or an operator's jq) links them."""
         with self._lock, open(self._traces_path, "a") as f:
             f.write(json.dumps(doc) + "\n")
+
+    def record_scaling(self, row: dict) -> None:
+        """One autoscaler decision (action, reason, the signals it
+        read) in ``metrics/scaling.jsonl`` — rendered by the portal's
+        metrics page next to requests.jsonl, so an operator can answer
+        "why did the fleet grow at 14:02" from the job history."""
+        with self._lock, open(self._scaling_path, "a") as f:
+            f.write(json.dumps(row) + "\n")
 
     def close(self, status: str = "SUCCEEDED",
               metrics: dict | None = None) -> None:
@@ -923,10 +1091,29 @@ class Gateway:
                  breaker_base_s: float = 0.25, breaker_max_s: float = 8.0,
                  quarantine_after: int = 5, tracing: bool = True,
                  trace_capacity: int = 256,
-                 profile_dir: str | None = None):
+                 profile_dir: str | None = None,
+                 tier_weights: dict[str, float] | str | None = None,
+                 tenant_quota_rate: float = 0.0,
+                 tenant_quota_burst: float = 0.0):
         if not servers:
             raise ValueError("gateway needs at least one replica server")
+        # admission tiers + quotas (gateway/admission.py): weights may
+        # arrive as the CLI's "name=w,..." spec; quotas default OFF
+        if isinstance(tier_weights, str):
+            tier_weights = parse_tier_weights(tier_weights)
+        self.tier_weights = dict(tier_weights) if tier_weights \
+            else None  # None -> WFQueue's defaults
+        if self.tier_weights is not None \
+                and DEFAULT_TIER not in self.tier_weights:
+            raise ValueError(
+                f"tier weights must include the default tier "
+                f"{DEFAULT_TIER!r} (got {sorted(self.tier_weights)})")
+        self.quotas = TenantQuotas(tenant_quota_rate, tenant_quota_burst)
         self.replicas = [_Replica(i, s, self) for i, s in enumerate(servers)]
+        # model bound captured once: replicas share the model config,
+        # and a retired replica's released engine must not be the
+        # thing submit() validates against
+        self._max_seq_len = servers[0].model.cfg.max_seq_len
         self.max_queue = max(1, max_queue)
         self.default_ttl_s = default_ttl_s
         self.metrics_store = metrics_store
@@ -965,6 +1152,10 @@ class Gateway:
         self._tpu_discoverer = None
         self._started = False
         self._closed = False
+        # an attached AutoScaler (autoscale.AutoScaler registers
+        # itself): snapshot() surfaces its status block, drain() stops
+        # its loop before closing the fleet
+        self.scaler = None
 
     # --------------------------------------------------------- lifecycle
 
@@ -998,6 +1189,13 @@ class Gateway:
         Returns True when everything drained inside ``timeout``.
         Idempotent — a second call (stop() after drain()) returns the
         first outcome instead of re-finalizing the history job."""
+        scaler = self.scaler
+        if scaler is not None:
+            # stop the control loop FIRST: a scale-up racing the drain
+            # would find _closed and fail, but there is no reason to
+            # let it try — and a scale-down's remove_replica must not
+            # interleave with the fleet-wide join below
+            scaler.stop()
         with self._drain_lock:
             if self._drain_done is not None:
                 return self._drain_done
@@ -1036,6 +1234,146 @@ class Gateway:
     def stop(self, timeout: float | None = None) -> bool:
         return self.drain(timeout)
 
+    # -------------------------------------------------------- elasticity
+
+    @property
+    def live_replicas(self) -> list[_Replica]:
+        """Replicas that are part of the fleet: not retired, not mid
+        scale-down drain. (Routability is stricter — see ``_route``.)"""
+        return [r for r in self.replicas
+                if not r.retired and not r.retiring]
+
+    def add_replica(self, server: Server, *, probe: bool = True) -> int:
+        """Grow the fleet at runtime (the autoscaler's scale-up
+        primitive; also a valid operator call). With ``probe=True``
+        (the default, and the only setting the autoscaler uses) the
+        new replica enters through the circuit breaker's PROBE path:
+        it starts BROKEN, runs a real tiny generation through the
+        traffic code paths — paying its first compiles off the traffic
+        path — and joins routing only when that probe succeeds,
+        exactly the way a recovered replica re-earns admission.
+        Returns the new replica's index."""
+        if not self._started:
+            raise RuntimeError("add_replica() needs a started gateway")
+        with self._lock:
+            if self._closed:
+                raise GatewayClosed("gateway is draining")
+            replica = _Replica(len(self.replicas), server, self)
+            replica.spawned = True
+            if probe:
+                replica.state = BROKEN  # joins routing via _recover()
+            self.replicas.append(replica)
+        if not probe:
+            wd = self._watchdog  # snapshot (see _beat)
+            if wd is not None:
+                wd.register(str(replica.index))
+        replica.start(probe_first=probe)
+        with self.stats.lock:
+            self.stats.replicas_added += 1
+        log.warning("replica %d added (%s)", replica.index,
+                    "probe admission" if probe else "immediate")
+        return replica.index
+
+    def remove_replica(self, index: int,
+                       timeout: float | None = None) -> bool:
+        """Shrink the fleet at runtime over the existing ZERO-LOSS
+        drain: the replica leaves routing immediately (``retiring`` —
+        new submits re-route, the enqueue race re-routes), finishes
+        every queued + in-flight request it holds, then parks RETIRED
+        with its engine released (the KV cache's memory goes back to
+        the provisioner's account). A dispatch that wedges during the
+        drain still fails over: the watchdog keeps watching until the
+        thread is joined. Refuses to remove the last live replica.
+        Returns True when the drain completed inside ``timeout``."""
+        replica = self.replicas[index]  # IndexError = caller bug
+        with self._lock:
+            if replica.retired:
+                return True
+            live = self.live_replicas
+            if replica in live and len(live) <= 1:
+                raise ValueError(
+                    "cannot remove the last live replica (drain() the "
+                    "gateway instead)")
+            with replica.cv:
+                replica.retiring = True
+                replica.cv.notify_all()
+        replica.signal_stop()
+        replica.join(timeout)
+        if replica._thread.is_alive():
+            # still draining past the deadline: leave it retiring (out
+            # of routing, still finishing work) — the caller may retry
+            return False
+        self._unwatch(replica)
+        with replica.cv:
+            replica.retired = True
+            replica.state = RETIRED
+            # release the engine: the whole point of scale-down is
+            # giving the KV cache + weights references back; stats()
+            # and busy() guard against the None
+            replica.server = None
+        with self.stats.lock:
+            self.stats.replicas_removed += 1
+        log.warning("replica %d retired (zero-loss drain complete)",
+                    index)
+        return True
+
+    def scale_signals(self) -> dict:
+        """One consistent read of everything the autoscaler watches:
+        queue pressure (depth / oldest wait / enqueue rate), capacity
+        sheds, the TTFT histogram (SLO burn is computed from deltas of
+        it), occupancy, and KV page pressure. Also the source of the
+        /stats ``queue`` block, so the autoscaler and a human reading
+        /stats see the same numbers."""
+        now = time.monotonic()
+        live = self.live_replicas
+        queue = self._queue_block(live, now)
+        servers = [s for s in (r.server for r in live) if s is not None]
+        counts = [s.counters() for s in servers]
+        with self.stats.lock:
+            # capacity sheds only: quota 429s are policy, not pressure
+            # — an autoscaler feeding on them would grow the fleet to
+            # chase a tenant's rate limit
+            shed_capacity = sum(
+                n for status, n in self.stats.shed_by_status.items()
+                if status in (429, 503, 504)) - self.stats.quota_rejections
+        return {
+            "now": now,
+            "replicas_live": len(live),
+            "replicas_routable": sum(1 for r in live
+                                     if r.state == HEALTHY),
+            **queue,
+            "active_slots": sum(s.slots.n_active for s in servers),
+            "slots": sum(s.slots.batch_size for s in servers),
+            "shed_capacity_total": max(0, shed_capacity),
+            "ttft_hist": self.stats.hist["ttft"].snapshot(),
+            "kv_pages_total": sum(c.get("kv_pages_total", 0)
+                                  for c in counts),
+            "kv_pages_free": sum(c.get("kv_pages_free", 0)
+                                 for c in counts),
+        }
+
+    def _queue_block(self, replicas: list[_Replica], now: float) -> dict:
+        """The queue-pressure block, ONE implementation for both
+        consumers — the autoscaler's ``scale_signals()`` and the
+        /stats ``queue`` block — so they cannot drift apart."""
+        per_replica = []
+        by_tier: dict[str, int] = {}
+        for r in replicas:
+            sig = r.queue_signals(now)
+            sig["replica"] = r.index
+            per_replica.append(sig)
+            for tier, n in sig["by_tier"].items():
+                by_tier[tier] = by_tier.get(tier, 0) + n
+        return {
+            "depth": sum(s["depth"] for s in per_replica),
+            "oldest_wait_s": max((s["oldest_wait_s"]
+                                  for s in per_replica), default=0.0),
+            "enqueue_rate_per_s": round(
+                sum(s["enqueue_rate_per_s"] for s in per_replica), 3),
+            "by_tier": by_tier,
+            "per_replica": per_replica,
+        }
+
     # --------------------------------------------------------- admission
 
     def submit(self, request: GenRequest,
@@ -1050,7 +1388,7 @@ class Gateway:
             self.stats_shed(503)
             raise GatewayClosed("gateway is draining")
         prompt = list(request.prompt)
-        max_len = self.replicas[0].server.model.cfg.max_seq_len
+        max_len = self._max_seq_len
         if not prompt:
             self.stats_shed(400)
             raise BadRequest("empty prompt")
@@ -1061,11 +1399,20 @@ class Gateway:
         if request.max_new_tokens < 1:
             self.stats_shed(400)
             raise BadRequest("max_new_tokens must be >= 1")
+        tier = request.priority if request.priority is not None \
+            else DEFAULT_TIER
+        weights = self.tier_weights if self.tier_weights is not None \
+            else _DEFAULT_WEIGHTS
+        if tier not in weights:
+            self.stats_shed(400)
+            raise BadRequest(f"unknown priority {tier!r} "
+                             f"(tiers: {', '.join(weights)})")
         ttl = request.ttl_s if request.ttl_s is not None \
             else self.default_ttl_s
         if ttl is not None and ttl <= 0:
             self.stats_shed(504)
             raise DeadlineExceeded("ttl_s already expired at submit")
+        cost = len(prompt) + request.max_new_tokens
         if request.id is None:
             # server-minted UUID (clients may supply their own): echoed
             # in responses, /stats window rows, history requests.jsonl,
@@ -1073,20 +1420,41 @@ class Gateway:
             # TonY's per-task history gives every job
             request.id = uuid.uuid4().hex
         with self._lock:
-            if sum(r.n_queued for r in self.replicas) >= self.max_queue:
+            if sum(r.n_queued for r in self.replicas
+                   if not r.retired) >= self.max_queue:
                 self.stats_shed(429)
                 raise GatewayQueueFull(
                     f"admission queue at max_queue={self.max_queue}")
-            ticket = Ticket(request,
-                            None if ttl is None
-                            else time.monotonic() + ttl, on_event)
+            # tenant quota AFTER validation + the queue bound (a
+            # request the gateway can't even queue must not drain the
+            # tenant's bucket), BEFORE the ticket exists. Charged
+            # exactly once — failover re-enqueues never re-pass this
+            # gate — and refunded on the no-service exits below.
+            retry_after = self.quotas.admit(request.tenant, cost)
+            if retry_after is not None:
+                with self.stats.lock:
+                    self.stats.quota_rejections += 1
+                    self.stats.shed_by_tier[tier] = \
+                        self.stats.shed_by_tier.get(tier, 0) + 1
+                self.stats_shed(429)
+                raise QuotaExceeded(
+                    f"tenant {request.tenant or '(anonymous)'!r} over "
+                    f"its token rate ({self.quotas.rate:g}/s, burst "
+                    f"{self.quotas.burst:g}); retry in {retry_after:.2f}s",
+                    retry_after_s=retry_after)
+            ticket = Ticket(request, ttl, on_event)
+            ticket.tier = tier
+            ticket.tenant = request.tenant
             if self.traces is not None:
                 t0 = request.t_receive if request.t_receive is not None \
                     else ticket.t_submit
                 trace = RequestTrace(request.id, t0=t0)
                 trace.root.tags.update(
                     prompt_len=len(prompt),
-                    max_new_tokens=request.max_new_tokens)
+                    max_new_tokens=request.max_new_tokens,
+                    priority=tier,
+                    **({"tenant": request.tenant}
+                       if request.tenant else {}))
                 if request.t_receive is not None:
                     trace.add("http_receive", request.t_receive,
                               ticket.t_submit, attempt=False)
@@ -1096,6 +1464,8 @@ class Gateway:
                 try:
                     replica = self._route(request, tried)
                 except NoHealthyReplicas:
+                    self.quotas.refund(request.tenant, cost)  # zero
+                    # service delivered: the bucket must not pay
                     self.stats_shed(503)
                     raise
                 try:
@@ -1111,6 +1481,7 @@ class Gateway:
                     tried.add(replica.index)  # flipped between route
                     # and enqueue: re-route among the others
                 except GatewayClosed:  # the drain race
+                    self.quotas.refund(request.tenant, cost)
                     self.stats_shed(503)
                     raise
         with self.stats.lock:
@@ -1126,14 +1497,22 @@ class Gateway:
         HEALTHY replicas outside ``excluded`` are candidates; none left
         raises ``NoHealthyReplicas`` (503, retriable)."""
         healthy = [r for r in self.replicas
-                   if r.state == HEALTHY and r.index not in excluded]
+                   if r.state == HEALTHY and not r.retiring
+                   and r.index not in excluded]
         if not healthy:
             raise NoHealthyReplicas(
                 "no healthy replica (states: "
-                + ", ".join(r.state for r in self.replicas) + ")")
+                + ", ".join(r.state + ("/retiring" if r.retiring else "")
+                            for r in self.replicas if not r.retired) + ")")
         if request.session is not None:
+            # affinity hashes over the CURRENT membership (retired
+            # replicas excluded): a scale event remaps sessions — a
+            # cache preference reshuffle, never a correctness issue
+            candidates = [r for r in self.replicas
+                          if not r.retired and not r.retiring]
             key = zlib.crc32(str(request.session).encode())
-            pinned = self.replicas[key % len(self.replicas)]
+            pinned = candidates[key % len(candidates)] if candidates \
+                else None
             if pinned in healthy:
                 return pinned
         return min(healthy, key=lambda r: (r.outstanding, r.index))
@@ -1189,8 +1568,9 @@ class Gateway:
             replica.consecutive_failures += 1
             admitted = list(replica._tickets.values())
             replica._tickets.clear()
-            queued = list(replica.queue)
-            replica.queue.clear()
+            queued = replica.queue.steal_all()  # WFQ service order;
+            # tickets keep their tier, so the survivor's queue
+            # re-applies the same fairness
             replica.outstanding = 0
             replica.cv.notify_all()
         wd = self._watchdog  # snapshot (see _beat)
@@ -1289,7 +1669,7 @@ class Gateway:
             ticket.state = SHED
             ticket._shed_exc_cls = exc
             replica.shed += 1
-            self._record_shed(replica, status)
+            self._record_shed(replica, status, tier=ticket.tier)
             ticket._emit(("shed", status, reason))
 
     def _note_probe(self, replica: _Replica) -> None:
@@ -1318,17 +1698,19 @@ class Gateway:
         age, so a load balancer sees a DEGRADED gateway (one replica
         down, still serving) before anything 503s."""
         now = time.monotonic()
+        live = [r for r in self.replicas if not r.retired]
         n = self.n_healthy
         return {
-            "status": "ok" if n == len(self.replicas)
+            "status": "ok" if n == len(live)
             else ("degraded" if n else "down"),
             "healthy": n,
             "replicas": [{
                 "replica": r.index,
                 "state": r.state,
+                "retiring": r.retiring,
                 "heartbeat_age_s": round(now - r.last_beat, 3),
                 "consecutive_failures": r.consecutive_failures,
-            } for r in self.replicas],
+            } for r in live],
         }
 
     # ----------------------------------------------------- observability
@@ -1388,8 +1770,13 @@ class Gateway:
             self.stats.shed_by_status[status] = \
                 self.stats.shed_by_status.get(status, 0) + 1
 
-    def _record_shed(self, replica: _Replica, status: int) -> None:
+    def _record_shed(self, replica: _Replica, status: int,
+                     tier: str | None = None) -> None:
         self.stats_shed(status)
+        if tier is not None:
+            with self.stats.lock:
+                self.stats.shed_by_tier[tier] = \
+                    self.stats.shed_by_tier.get(tier, 0) + 1
         self._push_replica_metrics(replica)
 
     def _record_done(self, replica: _Replica, metrics: dict) -> None:
@@ -1403,7 +1790,15 @@ class Gateway:
                 metrics.get("prefill_tokens_saved", 0)
             self.stats.drafted += metrics.get("drafted", 0)
             self.stats.draft_accepted += metrics.get("accepted", 0)
+            tier = metrics.get("priority") or DEFAULT_TIER
+            self.stats.completed_by_tier[tier] = \
+                self.stats.completed_by_tier.get(tier, 0) + 1
+            if tier not in self.stats.tier_wait:
+                self.stats.tier_wait[tier] = Histogram()
             self.stats.window.append(metrics)
+        # per-tier queue-wait histogram: the lifetime surface that
+        # proves WFQ's no-starvation promise on /metrics
+        self.stats.tier_wait[tier].observe(metrics["queue_wait_ms"] / 1e3)
         for key, ms_key in (("queue_wait", "queue_wait_ms"),
                             ("ttft", "ttft_ms"), ("tpot", "tpot_ms"),
                             ("e2e", "e2e_ms")):
@@ -1435,18 +1830,66 @@ class Gateway:
         out = self.stats.snapshot()
         out["ready"] = self.ready
         out["draining"] = self.draining
-        out["replicas"] = [r.stats(include_dispatch=True)
-                           for r in self.replicas]
+        # retired replicas drop out of the per-replica rows (and their
+        # engine counters out of the fleet rollup — per-replica series
+        # end when a replica does, like any scraped pod's); the
+        # gateway-level request counters above are lifetime
+        now = time.monotonic()
+        live = [r for r in self.replicas if not r.retired]
+        # one queue_signals per replica per scrape (the O(depth)
+        # oldest-wait scan runs here, never on the per-request metrics
+        # push), via the same helper scale_signals() uses — the
+        # autoscaler and a human reading /stats see the same numbers
+        queue = self._queue_block(live, now)
+        sig_by_index = {s["replica"]: s for s in queue["per_replica"]}
+        rows = []
         host = self._host_sample()
-        for row in out["replicas"]:
+        for r in live:
+            row = r.stats(include_dispatch=True)
+            sig = sig_by_index[r.index]
+            row["oldest_wait_s"] = sig["oldest_wait_s"]
+            row["enqueue_rate_per_s"] = sig["enqueue_rate_per_s"]
+            row["queued_by_tier"] = sig["by_tier"]
             row["host"] = host
-        out["queued"] = sum(r.n_queued for r in self.replicas)
+            rows.append(row)
+        out["replicas"] = rows
+        out["queued"] = queue["depth"]
         out["max_queue"] = self.max_queue
-        out["engine"] = self._engine_summary(out["replicas"])
+        # the ISSUE-9 queue block: fleet + per-replica queue sensors
+        # (depth, oldest-wait age, enqueue rate) — the autoscaler's
+        # primary input, useful standalone on /stats and /metrics
+        out["queue"] = queue
+        out["engine"] = self._engine_summary(rows, live)
         with self.stats.lock:
+            tiers = sorted(set(self.stats.completed_by_tier)
+                           | set(self.stats.shed_by_tier)
+                           | set(queue["by_tier"]))
+            tier_rows = {}
+            for tier in tiers:
+                waits = sorted(
+                    r["queue_wait_ms"] for r in self.stats.window
+                    if (r.get("priority") or DEFAULT_TIER) == tier)
+                tier_rows[tier] = {
+                    "queued": queue["by_tier"].get(tier, 0),
+                    "completed": self.stats.completed_by_tier.get(tier, 0),
+                    "shed": self.stats.shed_by_tier.get(tier, 0),
+                    "queue_wait_ms": {
+                        "p50": _percentile(waits, 0.50),
+                        "p99": _percentile(waits, 0.99)},
+                }
+            out["admission"] = {
+                "tiers": dict(self.tier_weights if self.tier_weights
+                              is not None else _DEFAULT_WEIGHTS),
+                "by_tier": tier_rows,
+                "quota": {**self.quotas.stats(),
+                          "rejections": self.stats.quota_rejections},
+            }
             out["supervision"] = {
                 "healthy_replicas": self.n_healthy,
-                "replicas": len(self.replicas),
+                "replicas": len(live),
+                "retired": len(self.replicas) - len(live),
+                "replicas_added": self.stats.replicas_added,
+                "replicas_removed": self.stats.replicas_removed,
                 "max_attempts": self.max_attempts,
                 "stall_timeout_s": self.stall_timeout_s,
                 "replica_failures": self.stats.replica_failures,
@@ -1456,9 +1899,13 @@ class Gateway:
                 "rejoins": self.stats.rejoins,
                 "quarantines": self.stats.quarantines,
             }
+        scaler = self.scaler
+        if scaler is not None:
+            out["scaler"] = scaler.status()
         return out
 
-    def _engine_summary(self, replica_rows: list | None = None) -> dict:
+    def _engine_summary(self, replica_rows: list | None = None,
+                        live: list | None = None) -> dict:
         """Fleet-level engine counters: the device work behind the
         request percentiles (prefills run, decode rounds, occupancy,
         overshoot waste) plus the speculative-decoding and prefix-cache
@@ -1467,7 +1914,9 @@ class Gateway:
         per-replica stats rows snapshot() just built) donates its
         ``dispatch`` blocks so one scrape takes each timeline's lock
         once, not twice."""
-        servers = [r.server for r in self.replicas]
+        replicas = live if live is not None \
+            else [r for r in self.replicas if not r.retired]
+        servers = [r.server for r in replicas if r.server is not None]
         counts = [s.counters() for s in servers]
         total = lambda key: sum(c.get(key, 0) for c in counts)  # noqa: E731
         lookups = total("prefix_lookups")

@@ -39,10 +39,18 @@ admission queue. Endpoints:
   GET  /debug/profile capture status (active/steps_left/captures/
                       last_logdir/last_error)
 
+Multi-tenant admission fields on POST /v1/generate (docs/SERVING.md):
+``priority`` names a weighted-fair-queuing tier (``interactive`` /
+``standard`` / ``batch`` by default; unknown -> 400) and ``tenant``
+keys the per-tenant token-rate quota — a tenant past its rate gets an
+immediate 429 whose ``Retry-After`` header says when its bucket
+refills (``core.QuotaExceeded``), distinct from the queue-bound 429.
+
 Shed mapping (core.Shed.http_status): 400 bad request, 429 admission
-queue full, 503 draining, 504 deadline exceeded. In streaming mode the
-status line is only committed at the FIRST event, so a request shed
-while queued still gets its real status code, not a 200 with an error
+queue full OR tenant quota (the quota flavor carries Retry-After),
+503 draining, 504 deadline exceeded. In streaming mode the status
+line is only committed at the FIRST event, so a request shed while
+queued still gets its real status code, not a 200 with an error
 trailer.
 """
 
@@ -50,6 +58,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -138,7 +147,14 @@ class GatewayHandler(BaseHTTPRequestHandler):
         try:
             ticket = self.gateway.submit(req)
         except Shed as e:
-            return self._send(e.http_status, {"error": e.reason})
+            headers = None
+            retry = getattr(e, "retry_after_s", None)
+            if retry is not None:
+                # quota 429: an honest machine-readable backoff (whole
+                # seconds, ceil'd, floor 1 — "0" reads as "now")
+                headers = {"Retry-After": str(max(1, math.ceil(retry)))}
+            return self._send(e.http_status, {"error": e.reason},
+                              headers=headers)
         try:
             if stream:
                 self._respond_stream(ticket)
@@ -225,6 +241,8 @@ class GatewayHandler(BaseHTTPRequestHandler):
         # every response/stats/history/trace surface so the client can
         # correlate its request with the server-side records.
         rid = d.get("request_id", d.get("id"))
+        tenant = d.get("tenant")
+        priority = d.get("priority")
         return GenRequest(
             ids,
             max_new_tokens=int(d.get("max_new_tokens", 64)),
@@ -234,6 +252,10 @@ class GatewayHandler(BaseHTTPRequestHandler):
             id=rid,
             ttl_s=float(ttl) if ttl is not None else None,
             session=d.get("session"),
+            # multi-tenant admission: tier + quota identity (validated
+            # by the gateway — unknown priority names are a 400)
+            tenant=str(tenant) if tenant is not None else None,
+            priority=str(priority) if priority is not None else None,
         ), bool(d.get("stream", False))
 
     # -------------------------------------------------------- responses
@@ -309,7 +331,8 @@ class GatewayHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def _send(self, code: int, doc: dict) -> None:
+    def _send(self, code: int, doc: dict,
+              headers: dict | None = None) -> None:
         data = json.dumps(doc).encode()
         if code >= 400:
             # error replies may leave a POST body unread; under
@@ -319,6 +342,8 @@ class GatewayHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         if code >= 400:
             self.send_header("Connection", "close")
         self.end_headers()

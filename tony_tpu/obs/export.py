@@ -48,6 +48,9 @@ _REPLICA_COUNTERS = (
      "Requests delivered by this replica"),
     ("shed", "tony_replica_shed_total",
      "Requests shed charged to this replica"),
+    ("enqueued", "tony_replica_enqueued_total",
+     "Tickets ever enqueued on this replica (failover re-enqueues "
+     "included)"),
     ("failures", "tony_replica_breaker_failures_total",
      "Circuit-breaker trips (lifetime)"),
     ("probes", "tony_replica_probes_total",
@@ -58,6 +61,10 @@ _REPLICA_COUNTERS = (
 
 _REPLICA_GAUGES = (
     ("queued", "tony_replica_queued", "Tickets waiting in this replica's queue"),
+    ("oldest_wait_s", "tony_replica_queue_oldest_wait_seconds",
+     "Age of the oldest ticket waiting in this replica's queue"),
+    ("enqueue_rate_per_s", "tony_replica_enqueue_rate",
+     "Recent enqueues per second (10 s window)"),
     ("active_slots", "tony_replica_active_slots",
      "Cache slots currently decoding"),
     ("batch_size", "tony_replica_slots", "Cache slots total"),
@@ -92,6 +99,10 @@ _REPLICA_GAUGES = (
 )
 
 _SUPERVISION = (
+    ("replicas_added", "tony_replicas_added_total",
+     "Replicas added at runtime (autoscaler or operator)"),
+    ("replicas_removed", "tony_replicas_removed_total",
+     "Replicas retired at runtime via zero-loss drain"),
     ("replica_failures", "tony_replica_failures_total",
      "HEALTHY -> BROKEN transitions across the fleet"),
     ("failovers", "tony_failovers_total",
@@ -158,6 +169,59 @@ def prometheus_text(gateway) -> str:
     gauge("tony_gateway_ready", "1 while accepting (0 = draining)",
           1 if snap["ready"] else 0)
 
+    # the queue block (ISSUE-9): the autoscaler's primary sensor,
+    # scrapable standalone
+    q = snap.get("queue") or {}
+    if q:
+        gauge("tony_queue_oldest_wait_seconds",
+              "Age of the oldest queued ticket, fleet-wide",
+              q["oldest_wait_s"])
+        gauge("tony_queue_enqueue_rate",
+              "Recent enqueues per second, fleet-wide (10 s window)",
+              q["enqueue_rate_per_s"])
+
+    # admission tiers: per-tier depth/completed/shed counters and the
+    # per-tier queue-wait histogram (the WFQ no-starvation evidence)
+    adm = snap.get("admission") or {}
+    if adm.get("by_tier"):
+        tq = MetricFamily("tony_tier_queued", "gauge",
+                          "Tickets queued, by admission tier")
+        tc = MetricFamily("tony_tier_completed_total", "counter",
+                          "Requests completed, by admission tier")
+        ts = MetricFamily("tony_tier_shed_total", "counter",
+                          "Requests shed, by admission tier")
+        for tier, row in sorted(adm["by_tier"].items()):
+            labels = {"tier": tier}
+            tq.add(row["queued"], labels)
+            tc.add(row["completed"], labels)
+            ts.add(row["shed"], labels)
+        fams.extend([tq, tc, ts])
+    quota = adm.get("quota") or {}
+    if quota.get("enabled"):
+        gauge("tony_quota_rate_tokens", "Per-tenant token-rate quota",
+              quota["rate_tokens_per_s"])
+        gauge("tony_quota_tenants", "Tenant buckets tracked",
+              quota["tenants"])
+        counter("tony_quota_rejections_total",
+                "Requests refused 429 for tenant quota breach",
+                quota["rejections"])
+
+    # autoscaler (absent on fixed fleets)
+    sc = snap.get("scaler")
+    if sc:
+        gauge("tony_scaler_replicas_min", "Autoscaler fleet floor",
+              sc["min_replicas"])
+        gauge("tony_scaler_replicas_max", "Autoscaler fleet ceiling",
+              sc["max_replicas"])
+        gauge("tony_replicas_live", "Replicas live (not retired)",
+              sc["replicas_live"])
+        counter("tony_scale_ups_total", "Autoscaler scale-up actions",
+                sc["scale_ups"])
+        counter("tony_scale_downs_total",
+                "Autoscaler scale-down actions", sc["scale_downs"])
+        counter("tony_scaler_errors_total",
+                "Autoscaler tick/action errors", sc["errors"])
+
     eng = snap["engine"]
     gauge("tony_engine_active_slots", "Live cache slots, fleet-wide",
           eng["active_slots"])
@@ -213,7 +277,9 @@ def prometheus_text(gateway) -> str:
     if "tpu_util" in host:
         host_util.add(host["tpu_util"])
     for i, row in enumerate(snap["replicas"]):
-        labels = {"replica": str(i)}
+        # rows carry their own fleet index (with elastic membership a
+        # row's POSITION no longer equals its replica id)
+        labels = {"replica": str(row.get("replica", i))}
         for key, name, _ in _REPLICA_COUNTERS:
             if key in row:
                 rep_counter[name].add(row[key], labels)
@@ -242,4 +308,22 @@ def prometheus_text(gateway) -> str:
         hist = gateway.stats.hist.get(key)
         if hist is not None:
             fams.append(hist.family(name, help_text))
+    # per-tier queue-wait histogram: one family, a tier label per
+    # series (merged samples — duplicate HELP/TYPE headers would break
+    # the exposition format)
+    # snapshot under the stats lock: _record_done inserts a new
+    # tier's Histogram concurrently, and iterating the live dict
+    # could raise mid-scrape
+    with gateway.stats.lock:
+        tier_hists = dict(getattr(gateway.stats, "tier_wait", {}))
+    if tier_hists:
+        fam = MetricFamily(
+            "tony_tier_queue_wait_seconds", "histogram",
+            "Submit-to-slot-admission wait per completed request, "
+            "by admission tier")
+        for tier in sorted(tier_hists):
+            fam.samples.extend(tier_hists[tier].family(
+                "tony_tier_queue_wait_seconds", "",
+                {"tier": tier}).samples)
+        fams.append(fam)
     return render(fams)

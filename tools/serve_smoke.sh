@@ -15,9 +15,16 @@
 # replica must rejoin via its breaker probe. Every phase is bounded by
 # `timeout`, so a hang exits nonzero instead of wedging CI.
 #
+# Finally an AUTOSCALE round: a min=1/max=3 elastic gateway under
+# burst load must scale up (the new replica probe-admitted into
+# routing), serve the whole burst with zero 5xx, and drain back to
+# the one-replica floor once idle.
+#
 # Usage: tools/serve_smoke.sh       (repo root; `make serve-smoke`)
 #        SERVE_SMOKE_ROUNDS=chaos tools/serve_smoke.sh
 #                                   (chaos round only; `make chaos-smoke`)
+#        SERVE_SMOKE_ROUNDS=autoscale tools/serve_smoke.sh
+#                                   (autoscale round only; `make autoscale-smoke`)
 set -u
 
 PY=${PY:-python}
@@ -27,7 +34,8 @@ GW_PID=''
 CTRL_PID=''
 CHAOS_PID=''
 PAGED_PID=''
-trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
+SCALE_PID=''
+trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
 
 fail() { echo "serve-smoke: FAIL: $1" >&2; exit 1; }
 
@@ -115,8 +123,99 @@ EOF
 
 curl_s() { timeout -k 5 "$BOUND" curl -sS -o "$1" -w '%{http_code}' "$2" ${3:+-d "$3"}; }
 
+# ---- autoscale round (also standalone: SERVE_SMOKE_ROUNDS=autoscale) --
+# the elastic loop end-to-end on a real subprocess gateway: burst 16
+# concurrent requests at a min=1/max=3 fleet with aggressive scaler
+# knobs -> every request 200 (zero 5xx), /stats scaler shows >=1
+# scale-up with the newcomer PROBE-admitted (supervision.probes/
+# rejoins), and once traffic stops the fleet drains back to 1 live
+# replica (scale-down rides the zero-loss drain).
+autoscale_round() {
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --replicas 1 --port 0 --compile-cache '' \
+        --autoscale-max 3 --autoscale-min 1 --autoscale-interval 0.2 \
+        --autoscale-up-queue 1.5 --autoscale-up-wait 0.5 \
+        --autoscale-cooldown-up 0.5 --autoscale-cooldown-down 1 \
+        --breaker-base 0.1 --breaker-max 1 \
+        >"$WORK/scale_boot.log" 2>"$WORK/scale_stderr.log" &
+    SCALE_PID=$!
+    SCALE_URL=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        SCALE_URL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/scale_boot.log")
+        [ -n "$SCALE_URL" ] && break
+        kill -0 $SCALE_PID 2>/dev/null || fail "autoscale gateway died at boot: $(cat "$WORK/scale_stderr.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$SCALE_URL" ] || fail "autoscale gateway did not print its URL within ${BOUND}s"
+    echo "serve-smoke: autoscale gateway at $SCALE_URL (min 1 / max 3)"
+
+    SCALE_PIDS=''
+    n=0
+    while [ $n -lt 16 ]; do
+        curl_s "$WORK/scale_$n" "$SCALE_URL/v1/generate" \
+            "{\"token_ids\": [$((1 + n % 5)), 2, 3], \"max_new_tokens\": 12, \"id\": $n}" \
+            >"$WORK/scale_${n}.code" &
+        SCALE_PIDS="$SCALE_PIDS $!"
+        n=$((n + 1))
+    done
+    wait $SCALE_PIDS
+    n=0
+    while [ $n -lt 16 ]; do
+        # the whole point: burst pressure scales, it never 5xxes
+        [ "$(cat "$WORK/scale_${n}.code")" = 200 ] || fail "autoscale request $n -> $(cat "$WORK/scale_${n}.code") (burst must scale, not shed)"
+        grep -q '"finish_reason"' "$WORK/scale_$n" || fail "autoscale request $n: no finish_reason"
+        n=$((n + 1))
+    done
+
+    # scale-up must have happened (probe-admitted), then the fleet
+    # must drain back to the floor; poll /stats for both
+    i=0
+    while [ $i -lt $BOUND ]; do
+        curl_s "$WORK/scale_stats" "$SCALE_URL/stats" >/dev/null 2>&1
+        $PY - "$WORK/scale_stats" <<'EOF' 2>/dev/null && break
+import json, sys
+s = json.load(open(sys.argv[1]))
+sc = s["scaler"]
+assert sc["scale_ups"] >= 1
+assert s["supervision"]["probes"] >= 1 and s["supervision"]["rejoins"] >= 1
+assert sc["replicas_live"] == 1  # drained back to the floor
+assert sc["scale_downs"] >= 1
+EOF
+        sleep 1; i=$((i + 1))
+    done
+    $PY - "$WORK/scale_stats" <<'EOF' || fail "autoscale stats never converged: $(cat "$WORK/scale_stats")"
+import json, sys
+s = json.load(open(sys.argv[1]))
+sc = s["scaler"]
+assert sc["scale_ups"] >= 1, sc
+assert s["supervision"]["probes"] >= 1 and s["supervision"]["rejoins"] >= 1, \
+    s["supervision"]
+assert sc["replicas_live"] == 1, sc   # back at the floor
+assert sc["scale_downs"] >= 1, sc
+assert s["completed"] == 16, s["completed"]
+assert s["shed"] == {}, s["shed"]     # zero 5xx across the whole round
+EOF
+
+    kill -TERM $SCALE_PID
+    i=0
+    while kill -0 $SCALE_PID 2>/dev/null; do
+        [ $i -ge $BOUND ] && fail "autoscale gateway did not drain within ${BOUND}s of SIGTERM"
+        sleep 1; i=$((i + 1))
+    done
+    wait $SCALE_PID
+    rc=$?
+    [ $rc = 0 ] || fail "autoscale gateway exited $rc after SIGTERM"
+    SCALE_PID=''
+    echo "serve-smoke: autoscale OK (burst -> scale-up probe-admitted, zero 5xx, drained to floor)"
+}
+
 if [ "${SERVE_SMOKE_ROUNDS:-all}" = chaos ]; then
     chaos_round   # `make chaos-smoke`: just the fault-injection round
+    exit 0
+fi
+if [ "${SERVE_SMOKE_ROUNDS:-all}" = autoscale ]; then
+    autoscale_round   # `make autoscale-smoke`: just the elastic round
     exit 0
 fi
 
@@ -450,4 +549,7 @@ echo "serve-smoke: OK (10 requests, prefix hits, accepted drafts, clean drain)"
 
 # ---- chaos round: kill a replica's work, keep serving ----------------
 chaos_round
+
+# ---- autoscale round: burst -> scale up -> drain to the floor --------
+autoscale_round
 echo "serve-smoke: ALL OK"
