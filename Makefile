@@ -1,8 +1,11 @@
 # Build/verify entry points (reference parity: the gradle build's
 # check/test wiring, build.gradle:113-116 + .circleci/config.yml).
 #
-#   make lint   - static analysis: ruff when installed, else the in-tree
-#                 AST checker (tools/lint.py) — same core rules
+#   make lint   - static analysis: ruff when installed AND the in-tree
+#                 AST checker (tools/lint.py) — ruff alone would let
+#                 the in-tree rules drift on boxes that have it, and
+#                 vice versa; tools/serve_smoke.sh runs the same gate
+#                 at its top so smoke runs fail fast on lint drift
 #   make smoke  - <60 s unit tier (no jax-heavy model/e2e suites):
 #                 config, session, scheduler, rpc, events, utils,
 #                 remotefs, runtimes, workflow, tpu_info, compilecache,
@@ -34,15 +37,24 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 	tests/test_workflow.py tests/test_tpu_info.py \
 	tests/test_compilecache.py tests/test_proxy.py tests/test_profiler.py
 
-.PHONY: lint smoke check test bench serve-smoke chaos-smoke autoscale-smoke
+#   make goodput-smoke - just the goodput/alerts round of serve-smoke:
+#                 a tiny KV page pool under load must fire a
+#                 kv_pages_pressure alert (visible on /stats, in
+#                 history alerts.jsonl, and on the portal), resolve
+#                 once idle, and /debug/goodput must name the largest
+#                 waste bucket
+
+.PHONY: lint smoke check test bench serve-smoke chaos-smoke \
+	autoscale-smoke goodput-smoke
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
-		echo "ruff check"; ruff check $(LINT_PATHS); \
+		echo "ruff check"; ruff check $(LINT_PATHS) || exit 1; \
 	else \
-		echo "tools/lint.py (no ruff in image)"; \
-		$(PY) tools/lint.py $(LINT_PATHS); \
+		echo "(no ruff in image — in-tree checker only)"; \
 	fi
+	@echo "tools/lint.py"
+	@$(PY) tools/lint.py $(LINT_PATHS)
 
 smoke:
 	$(PY) -m pytest $(SMOKE_TESTS) -q -p no:cacheprovider
@@ -63,3 +75,6 @@ chaos-smoke:
 
 autoscale-smoke:
 	PY=$(PY) SERVE_SMOKE_ROUNDS=autoscale sh tools/serve_smoke.sh
+
+goodput-smoke:
+	PY=$(PY) SERVE_SMOKE_ROUNDS=goodput sh tools/serve_smoke.sh

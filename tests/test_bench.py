@@ -178,6 +178,27 @@ def test_bench_paged_bounds(bench):
     assert out["outputs_identical"]
 
 
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
+def test_bench_goodput_ledger_and_overhead_gate(bench):
+    """The extras.goodput acceptance bounds (ISSUE-10): (a) the ledger
+    produced by the product sensor is well-formed — bucket fractions
+    sum to <= 1.0, a largest waste bucket is named, useful work is
+    nonzero, and the CPU arm reports bytes with utilization null (no
+    roofline reference, no made-up percentage); (b) the PR-6 overhead
+    discipline re-run with goodput+alerts armed: TPOT with the whole
+    observability stack (timeline + tracing + cost model + alert bus)
+    enabled within 1.1x of fully disabled, min-over-adjacent-pairs
+    statistic."""
+    out = bench.bench_goodput(False)
+    assert out["ledger_sum"] <= 1.0 + 1e-6, out
+    assert out["largest_waste"] in (
+        "compile", "padding", "overshoot", "spec_rejected", "idle"), out
+    assert out["useful_fraction"] > 0, out
+    assert out["decode_est_bytes"] > 0, out
+    assert out["decode_hbm_bw_pct"] is None, out  # CPU: null, honest
+    assert out["tpot_ratio_armed_off"] <= 1.1, out
+
+
 def test_stdout_guard_artifact_is_final_line():
     """VERDICT item 7: everything printed inside the guard (python- or
     fd-level, as sub-benches and their children do) lands on stderr;

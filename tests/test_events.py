@@ -177,6 +177,64 @@ def test_portal_pages_and_api(tmp_path):
         portal.stop()
 
 
+def test_portal_renders_gateway_scaling_and_alerts(tmp_path):
+    """ISSUE-10 satellite: the gateway history job's scaling.jsonl
+    (written since PR 8) and the new alerts.jsonl render on the
+    portal's metrics page — previously no test ever opened the page
+    on either file. Rows are written through the REAL GatewayHistory
+    record paths, then fetched over the portal's HTML page and its
+    JSON twin."""
+    import json as _json
+    import urllib.request
+
+    from tony_tpu.gateway import GatewayHistory
+    from tony_tpu.portal.app import Portal
+
+    root = str(tmp_path)
+    hist = GatewayHistory(root, app_id="application_gateway_obs",
+                          n_replicas=2)
+    hist.record({"id": "r1", "replica": 0, "ttft_ms": 5.0,
+                 "tokens_out": 4})
+    hist.record_scaling({"t": 1.0, "action": "scale_up",
+                         "reason": "queue_depth", "replicas_live": 2})
+    hist.record_scaling({"t": 9.0, "action": "scale_down",
+                         "reason": "idle", "replicas_live": 1})
+    hist.record_alert({"t": 2.0, "alert": "kv_pages_pressure",
+                       "severity": "warning", "state": "firing",
+                       "message": "KV page pool under pressure"})
+    hist.record_alert({"t": 6.0, "alert": "kv_pages_pressure",
+                       "severity": "warning", "state": "resolved",
+                       "message": "KV page pool under pressure"})
+    hist.close("SUCCEEDED")
+
+    portal = Portal(root, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{portal.port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return r.status, r.read().decode()
+
+        status, body = get("/job/application_gateway_obs/metrics")
+        assert status == 200
+        # each jsonl file renders as its own section next to requests
+        assert "<h3>requests</h3>" in body
+        assert "<h3>scaling</h3>" in body
+        assert "<h3>alerts</h3>" in body
+        assert "scale_up" in body and "scale_down" in body
+        assert "kv_pages_pressure" in body
+        assert "firing" in body and "resolved" in body
+        status, body = get("/api/job/application_gateway_obs/metrics")
+        series = _json.loads(body)
+        assert [r["action"] for r in series["scaling"]] == \
+            ["scale_up", "scale_down"]
+        assert [r["state"] for r in series["alerts"]] == \
+            ["firing", "resolved"]
+        assert series["alerts"][0]["alert"] == "kv_pages_pressure"
+    finally:
+        portal.stop()
+
+
 def test_portal_token_auth_and_pagination(tmp_path):
     """Hardening: with a token set, unauthenticated requests get 401;
     bearer header and ?token= both pass. The index paginates and the

@@ -420,8 +420,12 @@ def test_shed_request_trace_is_exported(tiny):
     model, params = tiny
     gw = _mk_gateway(tiny).start()
     try:
+        # ttl 100 ns: positive (a ttl <= 0 is refused AT SUBMIT, before
+        # a trace exists), yet expired by the time the replica's pop
+        # runs its deadline check — 0.0001 s flaked on fast boxes where
+        # an idle replica's cv wakeup admitted inside the window
         t = gw.submit(GenRequest([1, 2], max_new_tokens=4, id="dead",
-                                 ttl_s=0.0001))
+                                 ttl_s=1e-7))
         with pytest.raises(Exception):
             t.result(timeout=60)
         tr = gw.traces.get("dead")
@@ -594,6 +598,40 @@ def test_metrics_exposition_format_and_stats_consistency(tiny):
                 total += row[key]
             assert kv[rollup_key] == total, (key, kv)
         assert kv["used"] + kv["free"] == kv["total"]
+        # ISSUE-10: the goodput gauges carry the same ledger /stats
+        # engine.goodput does. The ledger is TIME-dependent (idle
+        # grows between two snapshots), so the exported values are
+        # parsed back and compared with a drift tolerance; the
+        # sums-to-<=1 invariant must hold exactly on the exported
+        # document itself (both surfaces render ONE snapshot each).
+        gp = snap["engine"]["goodput"]
+        assert gp["buckets"] and sum(gp["buckets"].values()) <= 1 + 1e-6
+        exported = {
+            m.group(1): float(m.group(2)) for m in re.finditer(
+                r'tony_goodput_fraction\{bucket="([^"]+)"\} (\S+)',
+                text)}
+        assert set(exported) == set(gp["buckets"])
+        assert sum(exported.values()) <= 1.0 + 1e-6
+        for bucket, v in gp["buckets"].items():
+            assert exported[bucket] == pytest.approx(v, abs=0.05), bucket
+        # per-replica dispatch cost estimates ride the dispatch family
+        # (pure counters: exact across snapshots)
+        from tony_tpu.obs.prom import _fmt
+
+        for i, row in enumerate(snap["replicas"]):
+            for kind, agg in row["dispatch"].items():
+                assert (f'tony_dispatch_est_bytes_total{{replica="{i}"'
+                        f',kind="{kind}"}} '
+                        f'{_fmt(agg["est_bytes"])}') in text
+        # build info + alert families (ISSUE-10 satellites)
+        assert types["tony_build_info"] == "gauge"
+        assert 'tony_build_info{version="' in text
+        assert "tony_alerts_enabled 1" in text
+        al = snap["alerts"]
+        assert al["enabled"] and "kv_pages_pressure" in al["rules"]
+        for rule in al["rules"]:
+            assert (f'tony_alerts_fired_total{{alert="{rule}"}} '
+                    f'{al["fired"].get(rule, 0)}') in text
     finally:
         assert gw.drain(timeout=60)
 

@@ -29,6 +29,16 @@ replica re-earns admission through a circuit breaker
 strikes). ``TONY_SERVE_FAULTS`` arms deterministic fault injection for
 chaos testing (``make chaos-smoke``; see ``serve/faults.py``).
 
+Goodput + alerts (ISSUE-10; docs/OBSERVABILITY.md): every dispatch is
+priced by an analytic cost model (bytes/FLOPs, HBM-BW%/MFU with
+``--hbm-gbps`` or a known chip), the wall clock decomposes into a
+goodput ledger (``/stats engine.goodput``, ``GET /debug/goodput``
+names the largest waste bucket), and a rule engine fires deduplicated
+alerts (queue aging, KV-page pressure, TTFT-SLO burn, breaker flap,
+goodput collapse) into ``/stats alerts``, ``tony_alerts_*`` and
+history ``metrics/alerts.jsonl`` (``--alert-*`` knobs, ``--no-alerts``
+off switch).
+
 Elastic autoscaling + admission tiers (ISSUE-9; docs/SERVING.md):
 ``--autoscale-max N`` arms the control loop — the fleet grows from
 ``--replicas`` up to N under queue/SLO pressure (new replicas join
@@ -190,6 +200,30 @@ def build_parser() -> argparse.ArgumentParser:
                    help="lockout after a scale-up (s)")
     p.add_argument("--autoscale-cooldown-down", type=float, default=30.0,
                    help="lockout after a scale-down (s)")
+    p.add_argument("--hbm-gbps", type=float, default=0.0,
+                   help="peak HBM bandwidth reference in GB/s for the "
+                        "goodput ledger's per-dispatch HBM-BW%% / MFU "
+                        "estimates (0 auto-detects from the chip "
+                        "table / TONY_HBM_GBPS; unknown chips and CPU "
+                        "report bytes with utilization null)")
+    p.add_argument("--no-alerts", action="store_true",
+                   help="disable the serving alert bus (rule engine "
+                        "over queue/KV/SLO/breaker/goodput signals "
+                        "feeding /stats alerts, tony_alerts_* and "
+                        "history alerts.jsonl) — the A/B escape hatch")
+    p.add_argument("--alert-interval", type=float, default=1.0,
+                   help="alert rule evaluation cadence in seconds")
+    p.add_argument("--alert-queue-wait", type=float, default=5.0,
+                   help="queue_aging alert: oldest queued wait (s) "
+                        "that counts as an aging queue")
+    p.add_argument("--alert-kv-free-frac", type=float, default=0.15,
+                   help="kv_pages_pressure alert: free-after-"
+                        "reservation fraction of the page pool under "
+                        "which live load counts as pressure")
+    p.add_argument("--alert-ttft-slo", type=float, default=0.0,
+                   help="ttft_slo_burn alert: TTFT SLO in seconds "
+                        "(>10%% of a tick's completions over it "
+                        "fires; 0 disables the rule)")
     p.add_argument("--compile-cache",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "tony_tpu", "compile-cache"),
@@ -242,6 +276,7 @@ def server_factory(args, model, params, eos):
                       prefix_cache_mb=prefix_mb,
                       speculate_k=args.speculate_k,
                       fault_plan=FaultPlan.from_env(replica=index),
+                      hbm_gbps=getattr(args, "hbm_gbps", 0.0),
                       **paged_kw)
 
     return make
@@ -278,7 +313,18 @@ def build_gateway(args, model, params, eos, *, metrics_store=None):
                    profile_dir=getattr(args, "profile_dir", "") or None,
                    tier_weights=getattr(args, "tier_weights", "") or None,
                    tenant_quota_rate=getattr(args, "tenant_quota", 0.0),
-                   tenant_quota_burst=getattr(args, "tenant_burst", 0.0))
+                   tenant_quota_burst=getattr(args, "tenant_burst", 0.0),
+                   alerts=not getattr(args, "no_alerts", False),
+                   alert_interval_s=getattr(args, "alert_interval", 1.0),
+                   alert_thresholds={
+                       "queue_wait_s": getattr(args, "alert_queue_wait",
+                                               5.0),
+                       "kv_free_frac": getattr(args,
+                                               "alert_kv_free_frac",
+                                               0.15),
+                       "ttft_slo_s": getattr(args, "alert_ttft_slo",
+                                             0.0),
+                   })
 
 
 def build_scaler(args, gateway, model, params, eos):

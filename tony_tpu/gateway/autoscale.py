@@ -315,17 +315,12 @@ class AutoScaler:
         next edge). None = disabled or too small a sample to vote."""
         if self.ttft_slo_s <= 0:
             return None
-        hist = sig["ttft_hist"]
-        total = hist["count"]
-        buckets = [(float("inf") if le == "+Inf" else float(le), n)
-                   for le, n in hist["buckets"].items()]
-        # the SLO rounds UP to the next bucket edge: the straddling
-        # bucket (values <= that edge, possibly all meeting the SLO)
-        # counts as WITHIN — an SLO between edges must not report the
-        # whole fleet as burning
-        eff = min((e for e, _ in buckets if e >= self.ttft_slo_s),
-                  default=float("inf"))
-        over = sum(n for e, n in buckets if e > eff)
+        from tony_tpu.obs.prom import hist_over_edge
+
+        # SLO-rounds-up-to-the-next-edge semantics live in ONE place
+        # (obs/prom.hist_over_edge), shared with the alert bus's
+        # ttft_slo_burn rule — the two surfaces must never disagree
+        over, total = hist_over_edge(sig["ttft_hist"], self.ttft_slo_s)
         d_total = total - self._last_ttft[0]
         d_over = over - self._last_ttft[1]
         self._last_ttft = (total, over)

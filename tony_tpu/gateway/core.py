@@ -105,6 +105,8 @@ from tony_tpu.gateway.admission import (DEFAULT_TIER, WFQueue, TenantQuotas,
                                         parse_tier_weights)
 from tony_tpu.gateway.admission import DEFAULT_TIER_WEIGHTS as _DEFAULT_WEIGHTS
 from tony_tpu.obs import Histogram, RequestTrace, TraceBuffer
+from tony_tpu.obs.alerts import AlertBus, default_rules
+from tony_tpu.obs.goodput import merge_ledgers
 from tony_tpu.obs.timeline import DispatchTimeline
 from tony_tpu.serve import PoolExhausted, QueueFull, Request, Server
 
@@ -1035,6 +1037,8 @@ class GatewayHistory:
                                          "traces.jsonl")
         self._scaling_path = os.path.join(self.job_dir, "metrics",
                                           "scaling.jsonl")
+        self._alerts_path = os.path.join(self.job_dir, "metrics",
+                                         "alerts.jsonl")
 
     def _append_event(self, event) -> None:
         with self._lock, open(self.jhist, "a") as f:
@@ -1059,6 +1063,14 @@ class GatewayHistory:
         with self._lock, open(self._scaling_path, "a") as f:
             f.write(json.dumps(row) + "\n")
 
+    def record_alert(self, row: dict) -> None:
+        """One alert fire/resolve transition in
+        ``metrics/alerts.jsonl`` — the portal's metrics page renders
+        it next to requests/scaling, so "what was alerting at 14:02"
+        is answerable from the job history."""
+        with self._lock, open(self._alerts_path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
     def close(self, status: str = "SUCCEEDED",
               metrics: dict | None = None) -> None:
         from tony_tpu.events import history
@@ -1072,6 +1084,42 @@ class GatewayHistory:
             os.environ.get("USER", "unknown"), status))
         with self._lock:
             os.replace(self.jhist, final)
+
+
+class _AlertLoop(threading.Thread):
+    """The alert bus's evaluation cadence: one consistent
+    ``Gateway.alert_signals()`` read per tick through
+    ``AlertBus.evaluate()``, transitions logged and appended to
+    history ``metrics/alerts.jsonl``. Daemon + stop-event so drain()
+    shuts it down before the fleet join (an alert evaluated against a
+    half-drained fleet would be noise)."""
+
+    def __init__(self, gateway: "Gateway", interval_s: float):
+        super().__init__(name="gateway-alerts", daemon=True)
+        self.gateway = gateway
+        self.interval_s = max(0.05, interval_s)
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        gw = self.gateway
+        while not self._stop.wait(self.interval_s):
+            try:
+                events = gw.alerts.evaluate(gw.alert_signals())
+            except Exception:
+                log.exception("alert evaluation failed")
+                continue
+            for ev in events:
+                (log.warning if ev.state == "firing" else log.info)(
+                    "alert %s %s: %s %s", ev.alert, ev.state.upper(),
+                    ev.message, ev.detail)
+                if gw.history is not None:
+                    try:
+                        gw.history.record_alert(ev.to_row())
+                    except Exception:
+                        log.exception("history alert write failed")
 
 
 class Gateway:
@@ -1094,7 +1142,9 @@ class Gateway:
                  profile_dir: str | None = None,
                  tier_weights: dict[str, float] | str | None = None,
                  tenant_quota_rate: float = 0.0,
-                 tenant_quota_burst: float = 0.0):
+                 tenant_quota_burst: float = 0.0,
+                 alerts: bool = True, alert_interval_s: float = 1.0,
+                 alert_thresholds: dict | None = None):
         if not servers:
             raise ValueError("gateway needs at least one replica server")
         # admission tiers + quotas (gateway/admission.py): weights may
@@ -1156,6 +1206,15 @@ class Gateway:
         # itself): snapshot() surfaces its status block, drain() stops
         # its loop before closing the fleet
         self.scaler = None
+        # the alert/event bus (obs/alerts.py): a rule engine evaluated
+        # on the same consistent snapshot the autoscaler reads, firing
+        # deduplicated fire/resolve events into /stats ``alerts``,
+        # /metrics ``tony_alerts_*``, and history metrics/alerts.jsonl.
+        # alerts=False is the A/B knob (bench extras.goodput).
+        self.alerts = AlertBus(default_rules(alert_thresholds)) \
+            if alerts else None
+        self._alert_loop = _AlertLoop(self, alert_interval_s) \
+            if alerts else None
 
     # --------------------------------------------------------- lifecycle
 
@@ -1172,6 +1231,8 @@ class Gateway:
         for r in self.replicas:
             self._watchdog.register(str(r.index))
             r.start()
+        if self._alert_loop is not None:
+            self._alert_loop.start()
         self._started = True
         return self
 
@@ -1196,6 +1257,10 @@ class Gateway:
             # let it try — and a scale-down's remove_replica must not
             # interleave with the fleet-wide join below
             scaler.stop()
+        if self._alert_loop is not None:
+            # same reasoning: an alert evaluated over a half-joined
+            # fleet is noise, and the history file is about to close
+            self._alert_loop.stop()
         with self._drain_lock:
             if self._drain_done is not None:
                 return self._drain_done
@@ -1350,6 +1415,73 @@ class Gateway:
                                   for c in counts),
             "kv_pages_free": sum(c.get("kv_pages_free", 0)
                                  for c in counts),
+            "kv_pages_reserved": sum(c.get("kv_pages_reserved", 0)
+                                     for c in counts),
+        }
+
+    def alert_signals(self) -> dict:
+        """``scale_signals()`` plus what the alert rules additionally
+        watch (breaker failure counts, replica states, fleet goodput,
+        token flow) — ONE consistent read, so an alert and a scale
+        decision can never disagree about the fleet they saw."""
+        sig = self.scale_signals()
+        live = self.live_replicas
+        with self.stats.lock:
+            sig["replica_failures"] = self.stats.replica_failures
+            sig["completed"] = self.stats.completed
+            sig["tokens_out"] = self.stats.tokens_out
+        sig["states"] = [r.state for r in live]
+        fleet = self.fleet_goodput(live)
+        if fleet:
+            sig["goodput_useful"] = fleet.get("useful_fraction")
+            # raw milliseconds, not fractions: the collapse rule
+            # needs per-tick DELTAS of useful vs dispatch time (a
+            # cumulative fraction decays during idle lulls with
+            # nothing wrong; a wall denominator reads trickle traffic
+            # as collapse)
+            sig["goodput_dispatch_ms"] = fleet.get("dispatch_ms")
+            sig["goodput_useful_ms"] = sum(
+                v for k, v in fleet.get("ms", {}).items()
+                if k.startswith("useful."))
+        else:
+            sig["goodput_useful"] = None
+            sig["goodput_dispatch_ms"] = None
+            sig["goodput_useful_ms"] = None
+        return sig
+
+    def fleet_goodput(self, live: list | None = None) -> dict:
+        """Fleet goodput ledger: per-replica ledgers merged weighted
+        by wall clock (obs/goodput.merge_ledgers). Empty dict when no
+        replica runs a timeline."""
+        replicas = live if live is not None else self.live_replicas
+        ledgers = []
+        for r in replicas:
+            server = r.server  # single read vs concurrent retirement
+            if server is not None:
+                ledgers.append(server.goodput())
+        return merge_ledgers(ledgers)
+
+    def goodput_report(self) -> dict:
+        """The ``GET /debug/goodput`` payload: the fleet ledger with
+        its single largest waste bucket named, plus each replica's own
+        ledger (per-kind bytes/FLOPs and HBM-BW%/MFU where a roofline
+        reference exists — null on CPU)."""
+        live = self.live_replicas
+        per_replica = []
+        for r in live:
+            server = r.server
+            if server is None:
+                continue
+            g = server.goodput()
+            if g is not None:
+                g["replica"] = r.index
+                per_replica.append(g)
+        fleet = merge_ledgers(per_replica)
+        return {
+            "enabled": bool(per_replica),
+            "fleet": fleet,
+            "largest_waste": fleet.get("largest_waste"),
+            "replicas": per_replica,
         }
 
     def _queue_block(self, replicas: list[_Replica], now: float) -> dict:
@@ -1851,6 +1983,11 @@ class Gateway:
             row["enqueue_rate_per_s"] = sig["enqueue_rate_per_s"]
             row["queued_by_tier"] = sig["by_tier"]
             row["host"] = host
+            server = r.server  # single read vs concurrent retirement
+            if server is not None:
+                g = server.goodput()
+                if g is not None:
+                    row["goodput"] = g
             rows.append(row)
         out["replicas"] = rows
         out["queued"] = queue["depth"]
@@ -1899,6 +2036,14 @@ class Gateway:
                 "rejoins": self.stats.rejoins,
                 "quarantines": self.stats.quarantines,
             }
+        # fleet goodput ledger, merged from the per-replica ledgers
+        # the rows above already computed (wall-clock weighted)
+        out["engine"]["goodput"] = merge_ledgers(
+            [row.get("goodput") for row in rows])
+        if self.alerts is not None:
+            out["alerts"] = {"enabled": True, **self.alerts.snapshot()}
+        else:
+            out["alerts"] = {"enabled": False}
         scaler = self.scaler
         if scaler is not None:
             out["scaler"] = scaler.status()

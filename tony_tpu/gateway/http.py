@@ -29,9 +29,18 @@ admission queue. Endpoints:
                       lifetime TTFT/TPOT/queue-wait/e2e histograms —
                       what an autoscaler or scrape agent consumes
   GET  /debug/trace   {"request_ids": [...]} — recently traced requests
+  GET  /debug/traces  the browsable listing: buffered trace ids PLUS
+                      terminal tags (outcome, finish_reason, tokens,
+                      attempts) — how you find the trace worth opening
   GET  /debug/trace/<id>  one request's span tree as Chrome trace-event
                       JSON (load it in chrome://tracing or Perfetto);
                       failovers show as the request hopping attempt rows
+  GET  /debug/goodput the roofline ledger report: wall clock decomposed
+                      into useful/compile/padding/overshoot/
+                      spec-rejected/idle bucket fractions (sum <= 1),
+                      fleet + per replica, largest waste bucket named;
+                      per-kind HBM-BW%/MFU where a roofline reference
+                      is known (null on CPU)
   POST /debug/profile?steps=N  arm a jax.profiler capture of the fleet's
                       next N working scheduler iterations; returns the
                       logdir the xplane files land in (409 while a
@@ -111,6 +120,20 @@ class GatewayHandler(BaseHTTPRequestHandler):
                 return self._send(404, {"error": "tracing disabled"})
             return self._send(200,
                               {"request_ids": self.gateway.traces.ids()})
+        if path == "/debug/traces":
+            # the browsable listing: ids PLUS terminal tags (outcome,
+            # finish_reason, tokens, attempts) — /debug/trace/<id>
+            # required already knowing the id; this is how you find it
+            if self.gateway.traces is None:
+                return self._send(404, {"error": "tracing disabled"})
+            return self._send(200, {
+                "capacity": self.gateway.traces.capacity,
+                "traces": self.gateway.traces.summaries()})
+        if path == "/debug/goodput":
+            # the roofline ledger report: fleet + per-replica bucket
+            # fractions with the single largest waste bucket named —
+            # "where does the other 67% go", as an endpoint
+            return self._send(200, self.gateway.goodput_report())
         if path.startswith("/debug/trace/"):
             if self.gateway.traces is None:
                 return self._send(404, {"error": "tracing disabled"})

@@ -15,11 +15,23 @@ layers, each consumable on its own:
   the gateway's counters, gauges, and latency histograms
   (``GET /metrics``).
 
+- ``goodput`` + ``alerts``: the attribution layer — an analytic
+  bytes/FLOPs cost model stamped onto every timeline record, a
+  wall-clock goodput ledger whose named buckets sum to <= 1
+  (``/stats`` ``engine.goodput``, ``GET /debug/goodput``), and a
+  rule-engine alert bus emitting deduplicated fire/resolve events
+  (``/stats`` ``alerts``, history ``metrics/alerts.jsonl``).
+
 The whole layer is always-on-cheap (appends under small locks, export
-cost only when asked); bench ``extras.obs`` pins the overhead.
+cost only when asked); bench ``extras.obs`` and ``extras.goodput``
+pin the overhead.
 """
 
+from tony_tpu.obs.alerts import AlertBus, AlertEvent, Rule, default_rules
 from tony_tpu.obs.export import prometheus_text
+from tony_tpu.obs.goodput import (CostModel, detect_hbm_gbps,
+                                  detect_peak_flops, ledger,
+                                  merge_ledgers)
 from tony_tpu.obs.prom import (DEFAULT_TIME_BUCKETS_S, Histogram,
                                MetricFamily, escape_label_value, render)
 from tony_tpu.obs.timeline import DispatchRecord, DispatchTimeline
@@ -28,15 +40,24 @@ from tony_tpu.obs.trace import (RequestTrace, Span, TraceBuffer,
 
 __all__ = [
     "DEFAULT_TIME_BUCKETS_S",
+    "AlertBus",
+    "AlertEvent",
+    "CostModel",
     "DispatchRecord",
     "DispatchTimeline",
     "Histogram",
     "MetricFamily",
     "RequestTrace",
+    "Rule",
     "Span",
     "TraceBuffer",
     "check_invariants",
+    "default_rules",
+    "detect_hbm_gbps",
+    "detect_peak_flops",
     "escape_label_value",
+    "ledger",
+    "merge_ledgers",
     "prometheus_text",
     "render",
 ]

@@ -18,6 +18,32 @@ from __future__ import annotations
 
 from tony_tpu.obs.prom import MetricFamily, render
 
+_BUILD_INFO: dict | None = None
+
+
+def build_info_labels() -> dict:
+    """The ``tony_build_info`` label set, computed ONCE per process
+    (the commit lookup shells out to git): package version, jax
+    version, and the git commit — so a scrape can correlate a
+    regression with the deploy that shipped it. "unknown" where a
+    deployed wheel has no git checkout."""
+    global _BUILD_INFO
+    if _BUILD_INFO is None:
+        from tony_tpu.version import __version__, _git
+
+        try:
+            import jax
+
+            jax_version = jax.__version__
+        except Exception:  # noqa: BLE001 — exporter must render anyway
+            jax_version = "unknown"
+        _BUILD_INFO = {
+            "version": __version__,
+            "jax": jax_version,
+            "commit": _git("rev-parse", "--short", "HEAD"),
+        }
+    return _BUILD_INFO
+
 # flat per-replica engine counters exported with a replica label;
 # everything else in the replica stats row is either covered by an
 # explicit family below or a string (state)
@@ -142,6 +168,12 @@ def prometheus_text(gateway) -> str:
                     .add(value, labels))
         return fams[-1]
 
+    # info-style build family (value always 1; the labels ARE the
+    # data): scrapes can join regressions against deploys
+    fams.append(MetricFamily(
+        "tony_build_info", "gauge",
+        "Build/version info: the labeled series reads 1")
+        .add(1, build_info_labels()))
     counter("tony_requests_accepted_total",
             "Requests past the admission gate", snap["accepted"])
     counter("tony_requests_completed_total",
@@ -233,6 +265,53 @@ def prometheus_text(gateway) -> str:
     gauge("tony_kv_paged_enabled", "1 when the paged KV cache is on",
           1 if eng.get("kv_pages", {}).get("enabled") else 0)
 
+    # the goodput ledger (obs/goodput.py): fleet wall-clock bucket
+    # fractions — sum(tony_goodput_fraction) <= 1 by construction, and
+    # the values are the same numbers /stats engine.goodput carries
+    gp = eng.get("goodput") or {}
+    if gp.get("buckets"):
+        frac = MetricFamily(
+            "tony_goodput_fraction", "gauge",
+            "Fleet wall-clock fraction by goodput ledger bucket "
+            "(useful.<kind> / compile / padding / overshoot / "
+            "spec_rejected / idle; sums to <= 1)")
+        for bucket, v in sorted(gp["buckets"].items()):
+            frac.add(v, {"bucket": bucket})
+        fams.append(frac)
+        gauge("tony_goodput_useful_fraction",
+              "Fleet useful-work fraction of wall clock",
+              gp.get("useful_fraction", 0.0))
+        gauge("tony_goodput_wall_seconds",
+              "Wall clock attributed by the goodput ledger, summed "
+              "across replicas", round(gp.get("wall_ms", 0.0) / 1e3, 3))
+
+    # alert bus (obs/alerts.py): active alerts as an info-style gauge
+    # plus lifetime fire/resolve counters per rule
+    al = snap.get("alerts") or {}
+    gauge("tony_alerts_enabled", "1 when the alert bus is armed",
+          1 if al.get("enabled") else 0)
+    if al.get("enabled"):
+        gauge("tony_alerts_active_count", "Alerts currently firing",
+              len(al.get("active", ())))
+        if al.get("active"):
+            act = MetricFamily(
+                "tony_alerts_active", "gauge",
+                "Currently-firing alerts: the labeled alert reads 1")
+            for a in al["active"]:
+                act.add(1, {"alert": a["alert"],
+                            "severity": a["severity"]})
+            fams.append(act)
+        fired = MetricFamily("tony_alerts_fired_total", "counter",
+                             "Alert fire transitions, by rule")
+        resolved = MetricFamily(
+            "tony_alerts_resolved_total", "counter",
+            "Alert resolve transitions, by rule")
+        for rule in sorted(al.get("rules", ())):
+            labels = {"alert": rule}
+            fired.add(al.get("fired", {}).get(rule, 0), labels)
+            resolved.add(al.get("resolved", {}).get(rule, 0), labels)
+        fams.extend([fired, resolved])
+
     rep_counter = {name: MetricFamily(name, "counter", help_text)
                    for _, name, help_text in _REPLICA_COUNTERS}
     rep_gauge = {name: MetricFamily(name, "gauge", help_text)
@@ -256,6 +335,14 @@ def prometheus_text(gateway) -> str:
         "tony_dispatch_tokens_total": MetricFamily(
             "tony_dispatch_tokens_total", "counter",
             "Tokens landed by dispatches by kind"),
+        "tony_dispatch_est_bytes_total": MetricFamily(
+            "tony_dispatch_est_bytes_total", "counter",
+            "Analytic bytes-moved estimate by kind (obs/goodput.py "
+            "cost model)"),
+        "tony_dispatch_est_flops_total": MetricFamily(
+            "tony_dispatch_est_flops_total", "counter",
+            "Analytic FLOPs estimate by kind (obs/goodput.py cost "
+            "model)"),
     }
     # host gauges are PROCESS-level (replicas are threads of one
     # process, every /stats row carries the identical block): exported
@@ -298,6 +385,10 @@ def prometheus_text(gateway) -> str:
             disp["tony_dispatch_compile_seconds_total"].add(
                 round(agg["compile_ms"] / 1e3, 6), kl)
             disp["tony_dispatch_tokens_total"].add(agg["tokens"], kl)
+            disp["tony_dispatch_est_bytes_total"].add(
+                agg.get("est_bytes", 0), kl)
+            disp["tony_dispatch_est_flops_total"].add(
+                agg.get("est_flops", 0), kl)
     fams.extend(rep_counter.values())
     fams.extend(rep_gauge.values())
     fams.append(state_fam)

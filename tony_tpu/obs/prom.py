@@ -135,6 +135,24 @@ class Histogram:
                                         + ["+Inf"], self._counts))}
 
 
+def hist_over_edge(hist_snapshot: dict, threshold: float) -> tuple:
+    """``(samples over the threshold, total samples)`` from a
+    ``Histogram.snapshot()`` dict. The threshold rounds UP to the next
+    bucket edge: the straddling bucket (values <= that edge, possibly
+    all meeting the threshold) counts as WITHIN — a threshold between
+    edges must not report the whole fleet as over. ONE implementation
+    shared by the autoscaler's TTFT-SLO-burn signal and the alert
+    bus's ``ttft_slo_burn`` rule, so a scale decision and an alert can
+    never disagree about the same histogram."""
+    total = hist_snapshot.get("count", 0)
+    buckets = [(float("inf") if le == "+Inf" else float(le), n)
+               for le, n in hist_snapshot.get("buckets", {}).items()]
+    eff = min((e for e, _ in buckets if e >= threshold),
+              default=float("inf"))
+    over = sum(n for e, n in buckets if e > eff)
+    return over, total
+
+
 def render(families: list[MetricFamily]) -> str:
     """The whole exposition document (trailing newline included, as
     the spec requires)."""

@@ -58,6 +58,21 @@ class DispatchRecord:
     request_id: Any = None
     tags: dict = field(default_factory=dict)
     seq: int = 0       # assigned by the timeline, monotonically
+    # goodput-attribution fields (obs/goodput.py): ``work`` is the
+    # program's static position capacity (chunk depth x batch, verify
+    # window x batch, prefill bucket, 1 for admits), ``fed`` the
+    # positions actually given real inputs (depth x occupancy,
+    # last-token + drafts, suffix length), ``rejected`` the
+    # speculative-draft positions the verify pass refused. The
+    # duration split the ledger uses is exact by construction:
+    # useful + padding + overshoot + rejected positions == work.
+    # ``est_bytes``/``est_flops`` are the CostModel's analytic program
+    # cost (0 when no cost model is attached).
+    work: int = 0
+    fed: int = 0
+    rejected: int = 0
+    est_bytes: float = 0.0
+    est_flops: float = 0.0
 
 
 class DispatchTimeline:
@@ -65,26 +80,67 @@ class DispatchTimeline:
     aggregates. Thread-safe; the engine records from its owner thread,
     readers (``/stats``, the trace attacher) snapshot from others."""
 
+    # per-kind lifetime aggregate template; the *_ms split keys are the
+    # goodput ledger's input (obs/goodput.py)
+    _AGG_KEYS = ("count", "ms", "max_ms", "compiles", "compile_ms",
+                 "tokens", "work", "fed", "est_bytes", "est_flops",
+                 "est_bytes_steady", "est_flops_steady",
+                 "useful_ms", "padding_ms", "overshoot_ms",
+                 "rejected_ms")
+
     def __init__(self, capacity: int = 1024):
         self._lock = threading.Lock()
         self._ring: deque[DispatchRecord] = deque(maxlen=max(1, capacity))
         self._seq = 0
-        # kind -> [count, total_ms, max_ms, compiles, compile_ms, tokens]
-        self._agg: dict[str, list[float]] = {}
+        self._agg: dict[str, dict[str, float]] = {}
 
     def record(self, rec: DispatchRecord) -> None:
         with self._lock:
             self._seq += 1
             rec.seq = self._seq
             self._ring.append(rec)
-            agg = self._agg.setdefault(rec.kind, [0, 0.0, 0.0, 0, 0.0, 0])
-            agg[0] += 1
-            agg[1] += rec.dur_ms
-            agg[2] = max(agg[2], rec.dur_ms)
+            agg = self._agg.setdefault(
+                rec.kind, {k: 0.0 for k in self._AGG_KEYS})
+            agg["count"] += 1
+            agg["ms"] += rec.dur_ms
+            agg["max_ms"] = max(agg["max_ms"], rec.dur_ms)
+            agg["tokens"] += rec.tokens
+            agg["work"] += rec.work
+            agg["fed"] += rec.fed
+            agg["est_bytes"] += rec.est_bytes
+            agg["est_flops"] += rec.est_flops
             if rec.compile:
-                agg[3] += 1
-                agg[4] += rec.dur_ms
-            agg[5] += rec.tokens
+                # a first-call dispatch is all compile bucket: its
+                # duration is dominated by program build / cache load,
+                # and splitting it by positions would charge compile
+                # time to "useful"
+                agg["compiles"] += 1
+                agg["compile_ms"] += rec.dur_ms
+                return
+            # steady-only cost sums: the utilization estimate divides
+            # by steady milliseconds, so its numerator must exclude
+            # compile-marked records too — lifetime est_bytes above
+            # keeps pricing every dispatch for the /metrics counters
+            agg["est_bytes_steady"] += rec.est_bytes
+            agg["est_flops_steady"] += rec.est_flops
+            work = max(1, rec.work)
+            # useful positions: tokens LANDED for decode/verify; for a
+            # prefill the landed-token count is 1 (the sampled first
+            # token) but the useful work is the fed suffix window; the
+            # single-position admits are all useful
+            if rec.kind == "prefill":
+                useful = min(rec.fed, work)
+            elif rec.work <= 1:
+                useful = work
+            else:
+                useful = min(rec.tokens, rec.fed)
+            rejected = min(max(0, rec.rejected), max(0, rec.fed - useful))
+            padding = max(0, work - max(rec.fed, useful))
+            overshoot = max(0, work - useful - rejected - padding)
+            agg["useful_ms"] += rec.dur_ms * useful / work
+            agg["rejected_ms"] += rec.dur_ms * rejected / work
+            agg["padding_ms"] += rec.dur_ms * padding / work
+            agg["overshoot_ms"] += rec.dur_ms * overshoot / work
 
     def take_new(self, cursor: int) -> tuple[list[DispatchRecord], int]:
         """Records with ``seq > cursor`` still in the ring, plus the new
@@ -113,50 +169,73 @@ class DispatchTimeline:
         """The ``/stats`` ``dispatches`` block: lifetime per-kind
         aggregates with compile time split out, so steady-state
         mean_ms answers "what does one dispatch cost" without the
-        first-call spike polluting it."""
+        first-call spike polluting it. The goodput extension rides
+        along: position accounting (``work``/``fed``), the analytic
+        ``est_bytes``/``est_flops`` totals, and the per-kind duration
+        split (``useful_ms``/``padding_ms``/``overshoot_ms``/
+        ``rejected_ms``) the ledger folds with the wall clock."""
         out: dict = {}
         with self._lock:
-            items = {k: list(v) for k, v in self._agg.items()}
-        for kind, (count, ms, max_ms, compiles, compile_ms, toks) in \
-                sorted(items.items()):
-            steady_n = count - compiles
-            steady_ms = ms - compile_ms
+            items = {k: dict(v) for k, v in self._agg.items()}
+        for kind, a in sorted(items.items()):
+            steady_n = a["count"] - a["compiles"]
+            steady_ms = a["ms"] - a["compile_ms"]
             out[kind] = {
-                "count": int(count),
-                "ms": round(ms, 3),
-                "max_ms": round(max_ms, 3),
-                "compiles": int(compiles),
-                "compile_ms": round(compile_ms, 3),
+                "count": int(a["count"]),
+                "ms": round(a["ms"], 3),
+                "max_ms": round(a["max_ms"], 3),
+                "compiles": int(a["compiles"]),
+                "compile_ms": round(a["compile_ms"], 3),
                 "steady_mean_ms": round(steady_ms / steady_n, 3)
                 if steady_n else 0.0,
-                "tokens": int(toks),
-                "tokens_per_dispatch": round(toks / count, 3)
-                if count else 0.0,
+                "tokens": int(a["tokens"]),
+                "tokens_per_dispatch": round(a["tokens"] / a["count"], 3)
+                if a["count"] else 0.0,
+                "work": int(a["work"]),
+                "fed": int(a["fed"]),
+                "est_bytes": round(a["est_bytes"], 1),
+                "est_flops": round(a["est_flops"], 1),
+                "est_bytes_steady": round(a["est_bytes_steady"], 1),
+                "est_flops_steady": round(a["est_flops_steady"], 1),
+                "useful_ms": round(a["useful_ms"], 3),
+                "padding_ms": round(a["padding_ms"], 3),
+                "overshoot_ms": round(a["overshoot_ms"], 3),
+                "rejected_ms": round(a["rejected_ms"], 3),
             }
         return out
 
-    @staticmethod
-    def merge(summaries: list[dict]) -> dict:
+    # summed across replicas in merge(); max_ms maxes, means recompute
+    _SUM_KEYS = ("count", "ms", "compiles", "compile_ms", "tokens",
+                 "work", "fed", "est_bytes", "est_flops",
+                 "est_bytes_steady", "est_flops_steady", "useful_ms",
+                 "padding_ms", "overshoot_ms", "rejected_ms")
+
+    @classmethod
+    def merge(cls, summaries: list[dict]) -> dict:
         """Sum per-kind summaries across replicas (the fleet view the
-        gateway's ``/stats`` carries): counts/ms/tokens add, max_ms
-        maxes, means are recomputed from the merged totals."""
+        gateway's ``/stats`` carries): counts/ms/tokens/bytes/flops and
+        the ledger splits add, max_ms maxes, means are recomputed from
+        the merged totals."""
         merged: dict = {}
         for s in summaries:
             for kind, v in s.items():
-                m = merged.setdefault(kind, {
-                    "count": 0, "ms": 0.0, "max_ms": 0.0, "compiles": 0,
-                    "compile_ms": 0.0, "tokens": 0})
-                m["count"] += v["count"]
-                m["ms"] += v["ms"]
-                m["max_ms"] = max(m["max_ms"], v["max_ms"])
-                m["compiles"] += v["compiles"]
-                m["compile_ms"] += v["compile_ms"]
-                m["tokens"] += v["tokens"]
+                m = merged.setdefault(kind, dict.fromkeys(
+                    cls._SUM_KEYS, 0.0))
+                m["max_ms"] = max(m.get("max_ms", 0.0), v["max_ms"])
+                for key in cls._SUM_KEYS:
+                    m[key] += v.get(key, 0)
         for kind, m in merged.items():
             steady_n = m["count"] - m["compiles"]
             steady_ms = m["ms"] - m["compile_ms"]
-            m["ms"] = round(m["ms"], 3)
-            m["compile_ms"] = round(m["compile_ms"], 3)
+            for key in ("count", "compiles", "tokens", "work", "fed"):
+                m[key] = int(m[key])
+            for key in ("ms", "compile_ms", "useful_ms", "padding_ms",
+                        "overshoot_ms", "rejected_ms"):
+                m[key] = round(m[key], 3)
+            m["est_bytes"] = round(m["est_bytes"], 1)
+            m["est_flops"] = round(m["est_flops"], 1)
+            m["est_bytes_steady"] = round(m["est_bytes_steady"], 1)
+            m["est_flops_steady"] = round(m["est_flops_steady"], 1)
             m["steady_mean_ms"] = round(steady_ms / steady_n, 3) \
                 if steady_n else 0.0
             m["tokens_per_dispatch"] = round(m["tokens"] / m["count"], 3) \

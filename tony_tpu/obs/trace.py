@@ -312,6 +312,26 @@ class TraceBuffer:
         with self._lock:
             return list(self._traces)
 
+    def summaries(self) -> list[dict]:
+        """The ``GET /debug/traces`` listing: one row per buffered
+        trace — request id, attempt count, and the TERMINAL tags the
+        root span carries (outcome, finish_reason/status, token
+        counts, latency) — so an operator can find the trace worth
+        opening without already knowing its request_id."""
+        with self._lock:
+            traces = list(self._traces.values())
+        out = []
+        for t in traces:
+            tags = {k: v for k, v in t.root.tags.items()
+                    if k != "request_id"}
+            # "placements": replica placements (attempt spans) — the
+            # root's own "attempts" terminal tag keeps its metrics
+            # meaning (FAILED engine runs) and must not be clobbered
+            out.append({"request_id": str(t.request_id),
+                        "placements": t.n_attempts,
+                        "done": t.done, **tags})
+        return out
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._traces)

@@ -54,6 +54,8 @@ import numpy as np
 from tony_tpu.models.generate import (init_cache, multi_decode_step,
                                       normalize_eos_ids,
                                       single_decode_step)
+from tony_tpu.obs.goodput import (CostModel, detect_hbm_gbps,
+                                  detect_peak_flops, ledger)
 from tony_tpu.obs.timeline import DispatchRecord, DispatchTimeline
 from tony_tpu.serve.faults import FaultPlan
 from tony_tpu.serve.prefix import PrefixStore
@@ -518,7 +520,8 @@ class Server:
                  prefix_donate: bool = True, speculate_k: int = 0,
                  fault_plan: FaultPlan | None = None,
                  timeline: bool = True, paged: bool | None = None,
-                 kv_page_size: int = 0, kv_pages: int = 0):
+                 kv_page_size: int = 0, kv_pages: int = 0,
+                 hbm_gbps: float = 0.0):
         if model.cfg.quantized:
             # nothing structural in the way — the q8 apply is the same
             # model.apply — but untested here; fail loud, not wrong
@@ -597,6 +600,36 @@ class Server:
         # cheap enough to stay on in production
         self.timeline = DispatchTimeline() if timeline else None
         self._compiled: set = set()  # (kind, shape-bucket) pairs seen
+        # goodput attribution (obs/goodput.py): wall-clock anchor for
+        # the ledger plus the analytic cost model that stamps
+        # est_bytes/est_flops on every timeline record. The roofline
+        # reference (peak HBM GB/s) comes from --hbm-gbps when given,
+        # else the chip table; 0 on CPU — records still carry bytes,
+        # utilization reports null.
+        self._t0 = time.monotonic()
+        self.hbm_gbps = float(hbm_gbps) if hbm_gbps > 0 \
+            else detect_hbm_gbps()
+        self.peak_flops = detect_peak_flops()
+        self.cost = None
+        if self.timeline is not None:
+            leaves = jax.tree_util.tree_leaves(params)
+            param_bytes = sum(
+                int(np.prod(x.shape)) * x.dtype.itemsize for x in leaves)
+            param_count = sum(int(np.prod(x.shape)) for x in leaves)
+            cfg = model.cfg
+            if self.paged:
+                pool = self.slots.pool
+                kv_tok = pool.page_nbytes / max(1, pool.page_size)
+            else:
+                kv_tok = _row_nbytes(self.slots.cache) \
+                    / max(1, cfg.max_seq_len)
+            head_dim = cfg.explicit_head_dim \
+                or cfg.d_model // cfg.n_heads
+            self.cost = CostModel(
+                param_bytes=param_bytes, param_count=param_count,
+                kv_token_bytes=kv_tok, n_heads=cfg.n_heads,
+                head_dim=head_dim, vocab_size=cfg.vocab_size,
+                hbm_gbps=self.hbm_gbps, peak_flops=self.peak_flops)
         # speculative decoding (0 = off: zero overhead, no new programs)
         self.speculate_k = max(0, int(speculate_k))
         self._spec_ema = np.ones(batch_size, np.float64)
@@ -631,6 +664,47 @@ class Server:
                 "budget is %.1f MB (raise --prefix-cache-mb)",
                 entry_nbytes / (1 << 20), prefix_cache_mb)
             self.prefix = None
+
+    # ----------------------------------------------------- observability
+
+    def _record_dispatch(self, kind: str, t0: float, dur_ms: float,
+                         occ: int, bucket: int, tokens: int, key_,
+                         *, request_id=None, tags: dict | None = None,
+                         work: int = 0, fed: int = 0,
+                         rejected: int = 0,
+                         est: tuple = (0.0, 0.0)) -> None:
+        """One timeline record, goodput-stamped: position accounting
+        (work/fed/rejected — the ledger's exact duration split) plus
+        the cost model's bytes/FLOPs estimate, with per-dispatch
+        HBM-BW% / MFU tags when a roofline reference is known."""
+        tags = tags or {}
+        est_bytes, est_flops = est
+        if self.cost is not None and est_bytes:
+            bw, mfu = self.cost.utilization(est_bytes, est_flops,
+                                            dur_ms)
+            if bw is not None:
+                tags["hbm_bw_pct"] = bw
+            if mfu is not None:
+                tags["mfu_pct"] = mfu
+        self.timeline.record(DispatchRecord(
+            kind, t0, dur_ms, occ, bucket, tokens,
+            key_ not in self._compiled, request_id=request_id,
+            tags=tags, work=work, fed=fed, rejected=rejected,
+            est_bytes=est_bytes, est_flops=est_flops))
+        self._compiled.add(key_)
+
+    def goodput(self) -> dict | None:
+        """The per-replica goodput ledger (obs/goodput.py): this
+        engine's wall clock decomposed into useful/compile/padding/
+        overshoot/spec-rejected/idle bucket fractions that sum to
+        <= 1.0, with per-kind HBM-BW%/MFU when the roofline reference
+        is known. None with the timeline off (no data to attribute)."""
+        if self.timeline is None:
+            return None
+        wall_ms = (time.monotonic() - self._t0) * 1e3
+        return ledger(self.timeline.summary(), wall_ms,
+                      hbm_gbps=self.hbm_gbps,
+                      peak_flops=self.peak_flops)
 
     # ------------------------------------------------------------ intake
 
@@ -783,12 +857,16 @@ class Server:
                 tags["prefix_hit_tokens"] = hit_tokens
             if off:
                 tags["offset"] = int(off)
-            key_ = (d_kind, d_bucket)
-            self.timeline.record(DispatchRecord(
+            if d_kind == "hit_admit":
+                work = fed = 1
+                est = self.cost.hit_admit(self._row_nbytes)
+            else:
+                work, fed = d_bucket, len(p) - off
+                est = self.cost.prefill(d_bucket, off)
+            self._record_dispatch(
                 d_kind, t0, (time.monotonic() - t0) * 1e3, occ,
-                d_bucket, 1, key_ not in self._compiled,
-                request_id=req.id, tags=tags))
-            self._compiled.add(key_)
+                d_bucket, 1, (d_kind, d_bucket), request_id=req.id,
+                tags=tags, work=work, fed=fed, est=est)
         if tok in self.eos_ids or req.max_new_tokens == 1:
             # the slot row was written but never armed — the next admit
             # simply overwrites it
@@ -955,15 +1033,21 @@ class Server:
                 tags["cow_fork"] = True
             if view_tokens:
                 tags["view_tokens"] = view_tokens
+            if d_kind == "cow_admit":
+                work = fed = 1
+                est = self.cost.cow_admit(
+                    pool.page_nbytes if forked else 0)
+            else:
+                work, fed = d_bucket, len(p) - off
+                est = self.cost.prefill(d_bucket, off, view_tokens)
             # the view span is a second program-shape knob in paged
             # mode: the compile key must carry it or a recompile at a
             # new span would be mislabeled steady
-            key_ = (d_kind, d_bucket, view_tokens)
-            self.timeline.record(DispatchRecord(
+            self._record_dispatch(
                 d_kind, t0, (time.monotonic() - t0) * 1e3, occ,
-                d_bucket, 1, key_ not in self._compiled,
-                request_id=req.id, tags=tags))
-            self._compiled.add(key_)
+                d_bucket, 1, (d_kind, d_bucket, view_tokens),
+                request_id=req.id, tags=tags, work=work, fed=fed,
+                est=est)
         if tok in self.eos_ids or req.max_new_tokens == 1:
             # finished before ever decoding: the slot was never armed —
             # hand its page references straight back
@@ -1115,14 +1199,15 @@ class Server:
             self._live[slot] = None
             s.evict(slot)
         if self.timeline is not None:
-            key_ = ("decode", k, view_tokens)
             tags = {"requests": riders}
             if view_tokens:
                 tags["view_tokens"] = view_tokens
-            self.timeline.record(DispatchRecord(
+            view = view_tokens or self.model.cfg.max_seq_len
+            self._record_dispatch(
                 "decode", t0, dur_ms, occ, k, landed,
-                key_ not in self._compiled, tags=tags))
-            self._compiled.add(key_)
+                ("decode", k, view_tokens), tags=tags,
+                work=k * s.batch_size, fed=k * occ,
+                est=self.cost.decode(k, s.batch_size, view))
         return finished
 
     # ------------------------------------------------- speculative decode
@@ -1326,16 +1411,18 @@ class Server:
             self._live[slot] = None
             s.evict(slot)
         if self.timeline is not None:
-            key_ = ("verify", window, view_tokens)
-            tags = {"requests": riders,
-                    "drafted": int(draft_len.sum()),
+            drafted_n = int(draft_len.sum())
+            tags = {"requests": riders, "drafted": drafted_n,
                     "accepted": int(accepted.sum())}
             if view_tokens:
                 tags["view_tokens"] = view_tokens
-            self.timeline.record(DispatchRecord(
+            view = view_tokens or self.model.cfg.max_seq_len
+            self._record_dispatch(
                 "verify", t0, dur_ms, occ, window, landed,
-                key_ not in self._compiled, tags=tags))
-            self._compiled.add(key_)
+                ("verify", window, view_tokens), tags=tags,
+                work=window * b, fed=occ + drafted_n,
+                rejected=drafted_n - int(accepted.sum()),
+                est=self.cost.verify(window, b, view))
         return finished
 
     def _donate(self, live: _Live, slot: int) -> None:

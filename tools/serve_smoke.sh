@@ -15,16 +15,25 @@
 # replica must rejoin via its breaker probe. Every phase is bounded by
 # `timeout`, so a hang exits nonzero instead of wedging CI.
 #
-# Finally an AUTOSCALE round: a min=1/max=3 elastic gateway under
+# Then an AUTOSCALE round: a min=1/max=3 elastic gateway under
 # burst load must scale up (the new replica probe-admitted into
 # routing), serve the whole burst with zero 5xx, and drain back to
 # the one-replica floor once idle.
+#
+# Finally a GOODPUT/ALERTS round (ISSUE-10): a deliberately tiny KV
+# page pool under concurrent load fires a kv_pages_pressure alert
+# (/stats alerts + history alerts.jsonl + the portal's metrics page),
+# resolves after load stops, and /debug/goodput names the largest
+# waste bucket on the live subprocess gateway. The whole script also
+# starts with the `make check` lint gate so smoke fails fast on drift.
 #
 # Usage: tools/serve_smoke.sh       (repo root; `make serve-smoke`)
 #        SERVE_SMOKE_ROUNDS=chaos tools/serve_smoke.sh
 #                                   (chaos round only; `make chaos-smoke`)
 #        SERVE_SMOKE_ROUNDS=autoscale tools/serve_smoke.sh
 #                                   (autoscale round only; `make autoscale-smoke`)
+#        SERVE_SMOKE_ROUNDS=goodput tools/serve_smoke.sh
+#                                   (goodput/alerts round only; `make goodput-smoke`)
 set -u
 
 PY=${PY:-python}
@@ -35,9 +44,18 @@ CTRL_PID=''
 CHAOS_PID=''
 PAGED_PID=''
 SCALE_PID=''
-trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
+GP_PID=''
+PORTAL_PID=''
+trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
 
 fail() { echo "serve-smoke: FAIL: $1" >&2; exit 1; }
+
+# ---- lint gate (fail fast, before booting anything) ------------------
+# exactly `make lint` (ruff when the box has it AND the in-tree AST
+# checker, one source of truth for paths and policy) — a smoke run on
+# a lint-drifted tree stops here, not after minutes of gateway rounds
+make lint PY="$PY" || fail "lint findings (run: make lint)"
+echo "serve-smoke: lint clean"
 
 # ---- chaos round (also standalone: SERVE_SMOKE_ROUNDS=chaos) ---------
 # the serving half of the TonY story: kill a replica's work, keep
@@ -210,6 +228,154 @@ EOF
     echo "serve-smoke: autoscale OK (burst -> scale-up probe-admitted, zero 5xx, drained to floor)"
 }
 
+# ---- goodput/alerts round (also standalone: SERVE_SMOKE_ROUNDS=goodput)
+# ISSUE-10 acceptance: a deliberately tiny KV page pool (6 pages x 8
+# tokens vs 4 slots wanting 40+ token lifetimes) under concurrent load
+# must fire a kv_pages_pressure alert — visible in /stats alerts, in
+# history metrics/alerts.jsonl, and on the portal's metrics page —
+# then RESOLVE once load stops; /debug/goodput must name a largest
+# waste bucket on the live subprocess gateway.
+goodput_round() {
+    GHIST="$WORK/ghistory"
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --replicas 1 --port 0 --compile-cache '' \
+        --kv-page-size 8 --kv-pages 6 --prefix-cache-mb 0 \
+        --history "$GHIST" --alert-interval 0.2 \
+        >"$WORK/gp_boot.log" 2>"$WORK/gp_stderr.log" &
+    GP_PID=$!
+    GP_URL=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        GP_URL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/gp_boot.log")
+        [ -n "$GP_URL" ] && break
+        kill -0 $GP_PID 2>/dev/null || fail "goodput gateway died at boot: $(cat "$WORK/gp_stderr.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$GP_URL" ] || fail "goodput gateway did not print its URL within ${BOUND}s"
+    echo "serve-smoke: goodput gateway at $GP_URL (6x8-token KV pool)"
+
+    # 6 concurrent 40-token requests: the pool holds ~one lifetime at
+    # a time, so reservation pressure is sustained while the rest wait
+    GP_PIDS=''
+    n=0
+    while [ $n -lt 6 ]; do
+        curl_s "$WORK/gp_$n" "$GP_URL/v1/generate" \
+            "{\"token_ids\": [$((1 + n)), 2, 3], \"max_new_tokens\": 40, \"id\": $n}" \
+            >"$WORK/gp_${n}.code" &
+        GP_PIDS="$GP_PIDS $!"
+        n=$((n + 1))
+    done
+    # poll /stats WHILE the load is in flight: the alert must show up
+    # live, not post-hoc
+    FIRED=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        curl_s "$WORK/gp_stats" "$GP_URL/stats" >/dev/null 2>&1
+        $PY - "$WORK/gp_stats" <<'EOF' 2>/dev/null && { FIRED=1; break; }
+import json, sys
+s = json.load(open(sys.argv[1]))
+assert any(a["alert"] == "kv_pages_pressure"
+           for a in s["alerts"]["active"])
+EOF
+        sleep 1; i=$((i + 1))
+    done
+    wait $GP_PIDS
+    [ -n "$FIRED" ] || fail "kv_pages_pressure never fired in /stats alerts: $(cat "$WORK/gp_stats")"
+    n=0
+    while [ $n -lt 6 ]; do
+        [ "$(cat "$WORK/gp_${n}.code")" = 200 ] || fail "goodput request $n -> $(cat "$WORK/gp_${n}.code") (pool pressure must queue, not 5xx)"
+        n=$((n + 1))
+    done
+
+    # load stopped -> the alert must RESOLVE (active empties)
+    i=0
+    while [ $i -lt $BOUND ]; do
+        curl_s "$WORK/gp_stats2" "$GP_URL/stats" >/dev/null 2>&1
+        $PY - "$WORK/gp_stats2" <<'EOF' 2>/dev/null && break
+import json, sys
+s = json.load(open(sys.argv[1]))
+assert not s["alerts"]["active"]
+assert s["alerts"]["resolved"].get("kv_pages_pressure", 0) >= 1
+EOF
+        sleep 1; i=$((i + 1))
+    done
+    $PY - "$WORK/gp_stats2" <<'EOF' || fail "kv_pages_pressure never resolved: $(cat "$WORK/gp_stats2")"
+import json, sys
+s = json.load(open(sys.argv[1]))
+assert not s["alerts"]["active"], s["alerts"]["active"]
+assert s["alerts"]["resolved"].get("kv_pages_pressure", 0) >= 1, \
+    s["alerts"]["resolved"]
+EOF
+
+    # /debug/goodput on the live gateway: ledger sums <= 1 and a
+    # largest waste bucket is NAMED
+    code=$(curl_s "$WORK/gp_goodput" "$GP_URL/debug/goodput") || fail "goodput curl"
+    [ "$code" = 200 ] || fail "debug/goodput -> $code"
+    $PY - "$WORK/gp_goodput" <<'EOF' || fail "/debug/goodput report wrong: $(cat "$WORK/gp_goodput")"
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["enabled"], doc
+assert doc["largest_waste"] in ("compile", "padding", "overshoot",
+                                "spec_rejected", "idle"), doc
+total = sum(doc["fleet"]["buckets"].values())
+assert total <= 1.0 + 1e-6, total
+assert doc["fleet"]["buckets"].get("useful.decode", 0) > 0, doc["fleet"]
+EOF
+
+    # drain; the history job closes with alerts.jsonl on disk
+    kill -TERM $GP_PID
+    i=0
+    while kill -0 $GP_PID 2>/dev/null; do
+        [ $i -ge $BOUND ] && fail "goodput gateway did not drain within ${BOUND}s of SIGTERM"
+        sleep 1; i=$((i + 1))
+    done
+    wait $GP_PID
+    rc=$?
+    [ $rc = 0 ] || fail "goodput gateway exited $rc after SIGTERM"
+    GP_PID=''
+
+    ALERTS_JSONL=$(ls "$GHIST"/intermediate/*/metrics/alerts.jsonl 2>/dev/null | head -1)
+    [ -n "$ALERTS_JSONL" ] || fail "no metrics/alerts.jsonl written under $GHIST"
+    $PY - "$ALERTS_JSONL" <<'EOF' || fail "alerts.jsonl rows wrong: $(cat "$ALERTS_JSONL")"
+import json, sys
+rows = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+states = {(r["alert"], r["state"]) for r in rows}
+assert ("kv_pages_pressure", "firing") in states, states
+assert ("kv_pages_pressure", "resolved") in states, states
+EOF
+
+    # the portal renders alerts.jsonl next to requests.jsonl: boot it
+    # on the history dir and fetch the job's metrics page
+    APP_ID=$(ls "$GHIST/intermediate" | head -1)
+    [ -n "$APP_ID" ] || fail "no history job dir under $GHIST"
+    $PY -m tony_tpu.portal --history "$GHIST" --port 0 \
+        >"$WORK/portal_boot.log" 2>&1 &
+    PORTAL_PID=$!
+    PORTAL_URL=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        # head -1: the URL prints twice (log.info on stderr + the
+        # stdout banner), and sed would hand curl both lines
+        PORTAL_URL=$(sed -n 's/.*portal at \(http[s]*:[^ ]*\).*/\1/p' "$WORK/portal_boot.log" | head -1)
+        [ -n "$PORTAL_URL" ] && break
+        kill -0 $PORTAL_PID 2>/dev/null || fail "portal died at boot: $(cat "$WORK/portal_boot.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$PORTAL_URL" ] || fail "portal did not print its URL within ${BOUND}s"
+    code=$(curl_s "$WORK/portal_metrics" "$PORTAL_URL/job/$APP_ID/metrics") || fail "portal metrics curl"
+    [ "$code" = 200 ] || fail "portal metrics page -> $code"
+    grep -q 'alerts' "$WORK/portal_metrics" || fail "portal metrics page has no alerts section"
+    grep -q 'kv_pages_pressure' "$WORK/portal_metrics" || fail "portal metrics page does not show the alert rows"
+    kill $PORTAL_PID 2>/dev/null
+    wait $PORTAL_PID 2>/dev/null
+    PORTAL_PID=''
+    echo "serve-smoke: goodput OK (kv_pages_pressure fired + resolved, alerts.jsonl + portal render, /debug/goodput names largest waste)"
+}
+
+if [ "${SERVE_SMOKE_ROUNDS:-all}" = goodput ]; then
+    goodput_round   # `make goodput-smoke`: just the goodput/alerts round
+    exit 0
+fi
 if [ "${SERVE_SMOKE_ROUNDS:-all}" = chaos ]; then
     chaos_round   # `make chaos-smoke`: just the fault-injection round
     exit 0
@@ -552,4 +718,7 @@ chaos_round
 
 # ---- autoscale round: burst -> scale up -> drain to the floor --------
 autoscale_round
+
+# ---- goodput/alerts round: tiny pool -> alert fires -> resolves ------
+goodput_round
 echo "serve-smoke: ALL OK"
