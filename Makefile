@@ -43,9 +43,14 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 #                 history alerts.jsonl, and on the portal), resolve
 #                 once idle, and /debug/goodput must name the largest
 #                 waste bucket
+#   make remote-smoke - just the remote-replica round of serve-smoke:
+#                 2 replica-agent subprocesses behind an --agents
+#                 gateway; kill -9 one mid-run -> zero 5xx, outputs
+#                 token-exact vs a local-replica control, the corpse
+#                 quarantined, the survivor SIGTERM-drained clean
 
 .PHONY: lint smoke check test bench serve-smoke chaos-smoke \
-	autoscale-smoke goodput-smoke
+	autoscale-smoke goodput-smoke remote-smoke
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -78,3 +83,6 @@ autoscale-smoke:
 
 goodput-smoke:
 	PY=$(PY) SERVE_SMOKE_ROUNDS=goodput sh tools/serve_smoke.sh
+
+remote-smoke:
+	PY=$(PY) SERVE_SMOKE_ROUNDS=remote sh tools/serve_smoke.sh

@@ -1,0 +1,220 @@
+"""Direct unit tests for ``coordinator/liveness.LivenessMonitor``.
+
+It always had indirect coverage (the gateway watchdog, the session
+supervision paths) but no dedicated file; now it is ALSO the lease
+authority for remote replica agents (gateway/remote.py) — expiry
+timing, re-register-after-expiry and the unregister-vs-expiry race
+are exactly the behaviors the remote failover story leans on.
+"""
+
+import threading
+import time
+
+from tony_tpu.config import ConfError, TonyConf
+from tony_tpu.coordinator.liveness import (LivenessMonitor,
+                                           heartbeat_rpc_timeout_s,
+                                           liveness_expiry_s)
+
+
+def _monitor(interval_ms=20, max_missed=3, expired=None):
+    expired = expired if expired is not None else []
+    mon = LivenessMonitor(interval_ms=interval_ms, max_missed=max_missed,
+                          on_expired=expired.append)
+    return mon, expired
+
+
+class TestExpiry:
+    def test_expiry_horizon_formula(self):
+        import pytest
+
+        # expiry = interval * max(3, max_missed): the floor keeps a
+        # 1-miss config from flapping on scheduler jitter
+        mon, _ = _monitor(interval_ms=100, max_missed=7)
+        assert mon.expiry_s == pytest.approx(0.7)
+        mon, _ = _monitor(interval_ms=100, max_missed=1)
+        assert mon.expiry_s == pytest.approx(0.3)
+
+    def test_silent_task_expires_once(self):
+        mon, expired = _monitor()
+        mon.register("a")
+        mon.start()
+        try:
+            deadline = time.monotonic() + 5
+            while not expired and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert expired == ["a"]
+            # the entry is REMOVED on expiry: no repeat firing for the
+            # same outage (the remote lease leans on one-shot expiry)
+            time.sleep(mon.expiry_s * 3)
+            assert expired == ["a"]
+        finally:
+            mon.stop()
+
+    def test_pinged_task_survives(self):
+        mon, expired = _monitor()
+        mon.register("a")
+        mon.start()
+        try:
+            until = time.monotonic() + mon.expiry_s * 4
+            while time.monotonic() < until:
+                mon.ping("a")
+                time.sleep(0.005)
+            assert expired == []
+        finally:
+            mon.stop()
+
+    def test_expiry_timing_not_early(self):
+        # a task must NOT expire before the horizon: ping once at
+        # t=0, it should still be watched at expiry_s/2
+        mon, expired = _monitor(interval_ms=50, max_missed=4)  # 0.2s
+        mon.register("a")
+        mon.start()
+        try:
+            time.sleep(mon.expiry_s / 2)
+            assert expired == []
+        finally:
+            mon.stop()
+
+    def test_ping_after_expiry_is_inert(self):
+        # ping() only refreshes REGISTERED tasks: after an expiry
+        # removed the entry, pings are no-ops (the caller must
+        # re-register — pinned next)
+        mon, expired = _monitor()
+        mon.register("a")
+        mon.start()
+        try:
+            deadline = time.monotonic() + 5
+            while not expired and time.monotonic() < deadline:
+                time.sleep(0.01)
+            mon.ping("a")
+            time.sleep(mon.expiry_s * 2)
+            assert expired == ["a"]  # the ping resurrected nothing
+        finally:
+            mon.stop()
+
+    def test_reregister_after_expiry_watches_again(self):
+        # the remote-lease recovery story: the heartbeat loop calls
+        # register() on every success, so a host that comes back is
+        # watched (and can expire) again
+        mon, expired = _monitor()
+        mon.register("a")
+        mon.start()
+        try:
+            deadline = time.monotonic() + 5
+            while not expired and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert expired == ["a"]
+            mon.register("a")  # the agent is back
+            deadline = time.monotonic() + 5
+            while len(expired) < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert expired == ["a", "a"]  # dies again, fires again
+        finally:
+            mon.stop()
+
+
+class TestUnregisterRace:
+    def test_unregister_stops_watching(self):
+        mon, expired = _monitor()
+        mon.register("a")
+        mon.start()
+        try:
+            mon.unregister("a")
+            time.sleep(mon.expiry_s * 3)
+            assert expired == []
+        finally:
+            mon.stop()
+
+    def test_unregister_vs_expiry_race_never_doubles(self):
+        # hammer register/unregister against a fast-expiring monitor:
+        # however the race lands, a task unregistered and never
+        # re-registered must not fire afterwards, and concurrent
+        # mutation must never crash the monitor thread
+        mon, expired = _monitor(interval_ms=5, max_missed=3)
+        mon.start()
+        stop = threading.Event()
+
+        def churn():
+            while not stop.is_set():
+                mon.register("r")
+                mon.unregister("r")
+
+        threads = [threading.Thread(target=churn) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join()
+        mon.unregister("r")
+        fired_before = len(expired)
+        time.sleep(mon.expiry_s * 4)
+        mon.stop()
+        # no firing after the final unregister (races during the churn
+        # may legitimately have fired when a register stood >expiry)
+        assert len(expired) == fired_before
+        # the monitor thread survived the churn (stop() joined it)
+        assert not mon._thread.is_alive()
+
+    def test_clear_drops_everything(self):
+        mon, expired = _monitor()
+        mon.register("a")
+        mon.register("b")
+        mon.clear()
+        mon.start()
+        try:
+            time.sleep(mon.expiry_s * 3)
+            assert expired == []
+        finally:
+            mon.stop()
+
+    def test_on_expired_exception_does_not_kill_monitor(self):
+        fired = []
+
+        def boom(task_id):
+            fired.append(task_id)
+            raise RuntimeError("handler bug")
+
+        mon = LivenessMonitor(interval_ms=10, max_missed=3,
+                              on_expired=boom)
+        mon.register("a")
+        mon.register("b")
+        mon.start()
+        try:
+            deadline = time.monotonic() + 5
+            while len(fired) < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert sorted(fired) == ["a", "b"]  # the first handler
+            # exception didn't stop the second expiry
+        finally:
+            mon.stop()
+
+
+class TestConfFormulas:
+    def test_liveness_expiry_from_conf(self):
+        conf = TonyConf(load_defaults=False)
+        conf.set("tony.task.heartbeat-interval-ms", "500")
+        conf.set("tony.task.max-missed-heartbeats", "10")
+        assert liveness_expiry_s(conf) == 5.0
+
+    def test_expiry_floor_of_three_misses(self):
+        conf = TonyConf(load_defaults=False)
+        conf.set("tony.task.heartbeat-interval-ms", "1000")
+        conf.set("tony.task.max-missed-heartbeats", "1")
+        assert liveness_expiry_s(conf) == 3.0
+
+    def test_heartbeat_rpc_timeout_coercion(self):
+        # string conf values coerce through get_int; the timeout is
+        # 2x the interval with a 2 s floor
+        conf = TonyConf(load_defaults=False)
+        conf.set("tony.task.heartbeat-interval-ms", "4000")
+        assert heartbeat_rpc_timeout_s(conf) == 8.0
+        conf.set("tony.task.heartbeat-interval-ms", "100")
+        assert heartbeat_rpc_timeout_s(conf) == 2.0  # the floor
+
+    def test_bad_numeric_conf_raises_typed_error_naming_key(self):
+        import pytest
+
+        conf = TonyConf(load_defaults=False)
+        with pytest.raises(ConfError, match="heartbeat-interval-ms"):
+            conf.set("tony.task.heartbeat-interval-ms", "fast")

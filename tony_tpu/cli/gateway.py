@@ -64,11 +64,34 @@ def build_parser() -> argparse.ArgumentParser:
         prog="tony-tpu gateway",
         description="HTTP serving front door over N continuous-batching "
                     "replicas")
-    src = p.add_mutually_exclusive_group(required=True)
+    src = p.add_mutually_exclusive_group()
     src.add_argument("--model", help="local checkpoint directory (HF format)")
     src.add_argument("--demo-model", action="store_true",
                      help="serve a tiny random decoder (no checkpoint, "
                           "token_ids requests only) — for smoke tests")
+    p.add_argument("--remote-replica", action="store_true",
+                   help="serve ON replica agents instead of in-process "
+                        "threads: launch one `python -m "
+                        "tony_tpu.cli.replica` subprocess per replica "
+                        "(localhost; provisioned hosts run the same CLI "
+                        "there) and drive each through a RemoteServer "
+                        "stub — lease heartbeats, epoch fencing, "
+                        "resumable token streams, token-exact failover "
+                        "on host death (docs/SERVING.md)")
+    p.add_argument("--agents", default="",
+                   help="comma-separated host:port of ALREADY RUNNING "
+                        "replica agents to attach to (implies remote "
+                        "mode; the fleet is this list and the gateway "
+                        "process loads no model weights at all)")
+    p.add_argument("--agent-heartbeat", type=float, default=1.0,
+                   help="gateway->agent heartbeat interval in seconds; "
+                        "the lease horizon is interval x max(3, "
+                        "--agent-lease-misses) — no successful "
+                        "heartbeat for that long fails the replica "
+                        "over (token-exact)")
+    p.add_argument("--agent-lease-misses", type=int, default=5,
+                   help="missed heartbeats before an agent's lease "
+                        "expires (see --agent-heartbeat)")
     p.add_argument("--replicas", type=int, default=1,
                    help="data-parallel serve.Server replicas (each with "
                         "its own KV cache and scheduler thread)")
@@ -282,15 +305,117 @@ def server_factory(args, model, params, eos):
     return make
 
 
+def agent_argv(args, index: int) -> list:
+    """The ``python -m tony_tpu.cli.replica`` argv mirroring this
+    gateway's engine knobs — a launched agent must be configured
+    exactly like an in-process replica would have been."""
+    argv = ["--serve-batch", str(args.serve_batch),
+            "--chunk-steps", str(args.chunk_steps),
+            "--prefix-cache-mb", str(args.prefix_cache_mb),
+            "--speculate-k", str(args.speculate_k),
+            "--kv-page-size", str(args.kv_page_size),
+            "--kv-pages", str(args.kv_pages),
+            "--max-pending", str(args.max_pending),
+            "--eos-id", str(args.eos_id),
+            "--dtype", args.dtype,
+            "--replica-index", str(index),
+            # launched agents share THIS host: auto-sized KV pools
+            # must divide its HBM by the fleet CEILING, exactly like
+            # in-process replicas do (the PR-8 oversubscription rule)
+            "--host-share", str(max(1, args.replicas,
+                                    getattr(args, "autoscale_max", 0)
+                                    or 0)),
+            "--port", "0"]
+    if args.no_paged_kv:
+        argv.append("--no-paged-kv")
+    if args.demo_model:
+        argv.append("--demo-model")
+    else:
+        argv += ["--model", args.model]
+    if getattr(args, "compile_cache", ""):
+        argv += ["--compile-cache", args.compile_cache]
+    return argv
+
+
+def remote_server_factory(args):
+    """``make(index, hosts=None) -> RemoteServer`` — the remote twin
+    of ``server_factory``. ``hosts`` is a provisioned slice's host
+    list (``ProvisionerBackend.server_factory(hosts)`` — the grown
+    remote mode): a ``host:port`` entry attaches to an agent already
+    listening there (the slice's boot ran ``cli.replica``); a bare
+    localhost entry (or no hosts — the dev/smoke shape) launches the
+    agent as a local subprocess via ``launch_local_agent``.
+    ``TONY_SERVE_FAULTS`` transport faults arm at the stub by fleet
+    index while engine faults ride the launched agent's environment —
+    one env var, both failure planes."""
+    import tempfile
+
+    from tony_tpu.gateway.remote import RemoteServer, launch_local_agent
+    from tony_tpu.serve import FaultPlan
+
+    def stub(address: str, index: int, proc=None) -> RemoteServer:
+        return RemoteServer(
+            address,
+            heartbeat_interval_s=getattr(args, "agent_heartbeat", 1.0),
+            lease_misses=getattr(args, "agent_lease_misses", 5),
+            stall_timeout_s=args.stall_timeout,
+            transport_faults=FaultPlan.transport_from_env(replica=index),
+            agent_proc=proc)
+
+    def make(index: int, hosts=None) -> RemoteServer:
+        if hosts:
+            h = str(hosts[0])
+            if ":" in h:
+                return stub(h, index)
+            if h not in ("localhost", "127.0.0.1"):
+                raise ValueError(
+                    f"remote host {h!r} must either run `python -m "
+                    f"tony_tpu.cli.replica` itself and be given as "
+                    f"host:port, or be localhost (subprocess launch)")
+        port_dir = tempfile.mkdtemp(prefix=f"tony-agent-{index}-")
+        proc, address = launch_local_agent(
+            agent_argv(args, index),
+            port_file=os.path.join(port_dir, "agent.port"))
+        try:
+            return stub(address, index, proc=proc)
+        except Exception:
+            # the stub never existed, so nothing will ever close() it:
+            # reap the agent here or a failed boot (bad engine, armed
+            # boot fault) leaks a full engine's memory per attempt
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                proc.kill()
+            raise
+
+    return make
+
+
 def build_gateway(args, model, params, eos, *, metrics_store=None):
-    """Servers + Gateway from parsed args (shared with tests/bench)."""
+    """Servers + Gateway from parsed args (shared with tests/bench).
+    Remote mode (``--agents`` attach / ``--remote-replica`` launch)
+    ignores ``model``/``params`` — the agents own the weights and the
+    gateway process is a pure router."""
     from tony_tpu.gateway import Gateway, GatewayHistory
 
+    agents = [a.strip() for a in getattr(args, "agents", "").split(",")
+              if a.strip()]
     # TONY_SERVE_FAULTS arms deterministic fault injection per replica
     # (serve/faults.py) — the chaos-smoke hook; unset = None = zero cost
-    make = server_factory(args, model, params, eos)
-    servers = [make(i) for i in range(max(1, args.replicas))]
-    armed = [i for i, s in enumerate(servers) if s.fault_plan is not None]
+    if agents:
+        rmake = remote_server_factory(args)
+        servers = [rmake(i, hosts=[addr])
+                   for i, addr in enumerate(agents)]
+    elif getattr(args, "remote_replica", False):
+        rmake = remote_server_factory(args)
+        servers = [rmake(i) for i in range(max(1, args.replicas))]
+    else:
+        make = server_factory(args, model, params, eos)
+        servers = [make(i) for i in range(max(1, args.replicas))]
+    armed = [i for i, s in enumerate(servers)
+             if s.fault_plan is not None
+             or getattr(s, "transport_faults", None) is not None]
     if armed:
         logging.getLogger(__name__).warning(
             "fault injection ARMED on replica(s) %s via TONY_SERVE_FAULTS",
@@ -345,13 +470,23 @@ def build_scaler(args, gateway, model, params, eos):
     if floor > max_replicas:
         raise SystemExit(f"--autoscale-min {floor} is above "
                          f"--autoscale-max {max_replicas}")
-    make = server_factory(args, model, params, eos)
     # a dynamic replica's fleet index is wherever the (append-only)
     # replica list currently ends — read at create time, so a failed
     # create/join cannot desync TONY_SERVE_FAULTS addressing for the
     # replicas that come after it (only the scaler thread creates, so
     # the read cannot race another add)
-    backend = ThreadBackend(lambda: make(len(gateway.replicas)))
+    if getattr(args, "agents", "").strip():
+        raise SystemExit(
+            "--autoscale-max cannot mint new agents in --agents attach "
+            "mode (the fleet is the given list); use --remote-replica "
+            "launch mode or a provisioner backend")
+    if getattr(args, "remote_replica", False):
+        rmake = remote_server_factory(args)
+        backend = ThreadBackend(
+            lambda: rmake(len(gateway.replicas)), label="remote-agent")
+    else:
+        make = server_factory(args, model, params, eos)
+        backend = ThreadBackend(lambda: make(len(gateway.replicas)))
     return AutoScaler(
         gateway, backend,
         min_replicas=floor,
@@ -366,7 +501,15 @@ def build_scaler(args, gateway, model, params, eos):
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    remote = bool(args.agents.strip()) or args.remote_replica
+    if not args.model and not args.demo_model and not args.agents:
+        parser.error("one of --model / --demo-model / --agents is "
+                     "required")
+    if args.remote_replica and not (args.model or args.demo_model):
+        parser.error("--remote-replica needs --model or --demo-model "
+                     "to hand to the launched agents")
     logging.basicConfig(level=logging.INFO)
     if args.compile_cache:
         from tony_tpu.utils import compilecache
@@ -374,7 +517,23 @@ def main(argv=None) -> int:
         compilecache.enable(args.compile_cache)
 
     encode = decode = None
-    if args.demo_model:
+    model = params = None
+    eos: list = []
+    if remote:
+        # the gateway process is a pure router: the agents own the
+        # weights (and pay the compiles). With a checkpoint named, load
+        # ONLY the tokenizer so text prompts still work at the door.
+        if args.model:
+            try:
+                import transformers
+
+                tok = transformers.AutoTokenizer.from_pretrained(
+                    args.model)
+                encode, decode = tok.encode, tok.decode
+            except Exception:  # noqa: BLE001 — token_ids still serve
+                print("note: no tokenizer in model dir; token_ids "
+                      "requests only", file=sys.stderr)
+    elif args.demo_model:
         model, params, eos = *demo_model(), \
             ([args.eos_id] if args.eos_id >= 0 else [])
     else:
@@ -414,9 +573,14 @@ def main(argv=None) -> int:
                        encode=encode, decode=decode).start()
     elastic = "" if scaler is None else \
         (f", autoscale {scaler.min_replicas}-{scaler.max_replicas}")
+    n_rep = len(gateway.replicas)
+    mode = ""
+    if remote:
+        mode = " remote agents: " + ", ".join(
+            r.host for r in gateway.replicas)
     print(f"tony-tpu gateway at http://{http.host}:{http.port} "
-          f"({max(1, args.replicas)} replica(s) x {args.serve_batch} "
-          f"slots{elastic})", flush=True)
+          f"({n_rep} replica(s) x {args.serve_batch} "
+          f"slots{elastic}{mode})", flush=True)
 
     stop = threading.Event()
 

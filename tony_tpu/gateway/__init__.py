@@ -3,10 +3,13 @@
 ``core`` is the admission/routing/drain machinery (pure Python, no
 sockets — unit-testable); ``admission`` the weighted-fair-queuing
 tiers + tenant quotas; ``autoscale`` the elastic control loop driving
-``Gateway.add_replica``/``remove_replica``; ``http`` the stdlib
-network face. The CLI entrypoint is ``python -m tony_tpu.cli.gateway``;
-``tony-tpu generate --serve`` drives the same core over stdin/stdout
-JSONL.
+``Gateway.add_replica``/``remove_replica``; ``remote`` the
+remote-replica stub (serve ON provisioned hosts: a replica agent per
+host, lease heartbeats, epoch fencing, resumable streams); ``http``
+the stdlib network face. The CLI entrypoint is ``python -m
+tony_tpu.cli.gateway``; ``tony-tpu generate --serve`` drives the same
+core over stdin/stdout JSONL; ``python -m tony_tpu.cli.replica`` is
+the per-host agent.
 """
 
 from tony_tpu.gateway.admission import (DEFAULT_TIER, DEFAULT_TIER_WEIGHTS,
@@ -20,8 +23,12 @@ from tony_tpu.gateway.core import (BadRequest, DeadlineExceeded, Gateway,
                                    NoHealthyReplicas, QuotaExceeded,
                                    RetryBudgetExhausted, Shed, Ticket)
 from tony_tpu.gateway.http import GatewayHTTP
+from tony_tpu.gateway.remote import (AgentHTTPError, AgentTransport,
+                                     RemoteServer, launch_local_agent)
 
 __all__ = [
+    "AgentHTTPError",
+    "AgentTransport",
     "AutoScaler",
     "BadRequest",
     "DEFAULT_TIER",
@@ -36,6 +43,7 @@ __all__ = [
     "NoHealthyReplicas",
     "ProvisionerBackend",
     "QuotaExceeded",
+    "RemoteServer",
     "RetryBudgetExhausted",
     "ScaleError",
     "Shed",
@@ -43,5 +51,6 @@ __all__ = [
     "ThreadBackend",
     "Ticket",
     "WFQueue",
+    "launch_local_agent",
     "parse_tier_weights",
 ]

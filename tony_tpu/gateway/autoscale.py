@@ -57,8 +57,10 @@ class ThreadBackend:
     chips. ``destroy`` drops the reference — the engine was already
     released by ``remove_replica``."""
 
-    def __init__(self, server_factory):
+    def __init__(self, server_factory, label: str = "thread"):
         self._factory = server_factory
+        self._label = label  # /stats backend name ("remote-agent"
+        #                      when the factory launches agent stubs)
         self.created = 0
         self.destroyed = 0
 
@@ -68,10 +70,16 @@ class ThreadBackend:
         return server
 
     def destroy(self, server) -> None:
+        # remote stubs: a destroyed replica's agent must not outlive
+        # it (remove_replica already closed a RETIRING one; a server
+        # that never joined — failed probe admission — is closed here)
+        from tony_tpu.gateway.remote import close_server
+
+        close_server(server, "thread-backend destroy")
         self.destroyed += 1
 
     def describe(self) -> str:
-        return "thread"
+        return self._label
 
 
 class ProvisionerBackend:
@@ -83,7 +91,16 @@ class ProvisionerBackend:
     ``ScaleError`` (a failed acquisition must cost a logged decision
     and a cooldown, never a crashed control loop); a provision that
     succeeded but whose server construction failed is deprovisioned
-    on the spot — no leaked slices."""
+    on the spot — no leaked slices.
+
+    The REMOTE mode (the closed TonY loop): pass
+    ``cli.gateway.remote_server_factory(args)`` as the server factory
+    (``lambda hosts: rmake(index, hosts=hosts)``) and the acquired
+    slice's hosts get a replica AGENT (``cli/replica.py``) with a
+    ``RemoteServer`` stub returned — the engine runs on the slice,
+    and ``destroy()`` closing the stub then deprovisioning the slice
+    is exactly "the dead host's capacity goes back" with nothing
+    leaked."""
 
     def __init__(self, provisioner_factory, server_factory):
         self._provisioner_factory = provisioner_factory
@@ -111,6 +128,13 @@ class ProvisionerBackend:
         return server
 
     def destroy(self, server) -> None:
+        # remote stubs first: stop heartbeating (and reap a launched
+        # agent) BEFORE the slice under it is deleted — the lease
+        # machinery must not spend a deprovision window counting
+        # connect errors against a host that is going away on purpose
+        from tony_tpu.gateway.remote import close_server
+
+        close_server(server, "provisioner-backend destroy")
         prov = self._slices.pop(id(server), None)
         if prov is not None:
             try:

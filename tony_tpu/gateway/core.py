@@ -85,6 +85,19 @@ of role tasks onto a container pool). The pieces, front to back:
   exposes per-replica heartbeat age + breaker state, ``/readyz`` flips
   503 when zero replicas are healthy, and every failure / retry /
   probe / rejoin counts into ``/stats`` ``supervision``.
+- REMOTE REPLICAS (``gateway/remote.py``, docs/SERVING.md): a replica
+  whose ``server`` is a ``RemoteServer`` stub runs its engine on
+  another host behind a replica agent (``serve/agent.py``). The same
+  ``_Replica`` scheduler drives it — routing, WFQ, deadlines,
+  failover, the breaker and every stats rollup are identical — while
+  the stub adds the network layer: a heartbeat LEASE (reusing
+  ``coordinator/liveness.LivenessMonitor``) whose expiry funnels into
+  ``_fail_replica`` exactly like a watchdog stall, the PR-5 epoch
+  fence carried on every call and echoed in every response (stale
+  either way is discarded), resumable per-request token streams (a
+  dropped connection to a healthy agent is a reconnect at the held
+  offset, not a failover), and in-lease connect retries with capped
+  jittered backoff. A dead host is just a wedged replica.
 """
 
 from __future__ import annotations
@@ -356,6 +369,15 @@ class _Replica:
         self.index = index
         self.server = server
         self.gateway = gateway
+        # REMOTE replicas (gateway/remote.RemoteServer): the server is
+        # a stub over an agent on another host — bind its lease
+        # machinery into the gateway's failure funnel, and carry the
+        # host address so per-request records can name the machine
+        # that served them ("local" for in-process thread replicas)
+        self.host = getattr(server, "host_addr", "local")
+        bind = getattr(server, "bind_supervisor", None)
+        if bind is not None:
+            bind(lambda reason, _r=self: gateway._fail_remote(_r, reason))
         self.queue = WFQueue(gateway.tier_weights)
         self.cv = threading.Condition()
         self.outstanding = 0  # token-cost estimate: queued + in-flight
@@ -626,6 +648,23 @@ class _Replica:
             except ValueError as e:
                 self._shed(ticket, 400, str(e), epoch=epoch)
                 continue
+            except (ConnectionError, TimeoutError, OSError):
+                # REMOTE submit failed in transit (the stub's in-lease
+                # retries already ran): put the popped ticket back
+                # where the failover steal can find it, then let the
+                # raise take the scheduler's exception route into
+                # _fail_replica. Epoch-fenced like the QueueFull path:
+                # if the steal already ran, this ticket was missed by
+                # it and must be failed over directly.
+                with self.cv:
+                    if self.epoch == epoch:
+                        self.queue.unpop(ticket)
+                        raise
+                self.gateway._failover(
+                    self, [], [ticket],
+                    f"replica {self.index} transport failed during "
+                    f"admission")
+                return
             with self.cv:
                 if self.epoch != epoch:
                     # declared failed mid-admission: the ticket we just
@@ -757,6 +796,11 @@ class _Replica:
         return {
             "id": ticket.request.id,
             "replica": self.index,
+            # WHICH MACHINE served it (agent address for remote
+            # replicas, "local" for in-process threads): the field
+            # that lets an operator attribute a bad TTFT to a host
+            # from the /stats window or history requests.jsonl
+            "host": self.host,
             "queue_wait_ms": round(
                 (ticket.t_admit - ticket.t_submit) * 1e3, 3),
             "ttft_ms": round(ttft * 1e3, 3),
@@ -923,6 +967,13 @@ class _Replica:
         # /stats both carry them per replica
         if server is not None:
             out.update(server.counters())
+        # remote replicas: the transport block (rtt, heartbeat age,
+        # reconnects, retries, stale-epoch drops) — nested, so the
+        # MetricsStore numeric filter skips it while /stats and
+        # /metrics carry it
+        ts = getattr(server, "transport_stats", None)
+        if ts is not None:
+            out["transport"] = ts()
         # per-dispatch timeline aggregates (kind -> count/ms/compile
         # split/tokens) — opt-in: snapshot() wants it, but the
         # per-request MetricsStore push (whose numeric filter would
@@ -1287,6 +1338,14 @@ class Gateway:
             self._watchdog = None
             if wd is not None:
                 wd.stop()
+            # remote replicas: stop lease/heartbeat machinery after
+            # the fleet join (attached agents keep running — they
+            # belong to whoever started them; launched agents are
+            # drained and reaped)
+            from tony_tpu.gateway.remote import close_server
+
+            for r in self.replicas:
+                close_server(r.server, f"replica {r.index} drain")
             # a profile capture left mid-flight (operator armed it,
             # traffic stopped) is finalized so its xplane files land
             self.profiler.close()
@@ -1375,7 +1434,14 @@ class Gateway:
             # release the engine: the whole point of scale-down is
             # giving the KV cache + weights references back; stats()
             # and busy() guard against the None
+            server = replica.server
             replica.server = None
+        # remote replicas: stop the stub's lease/heartbeat machinery
+        # (and, for agents the stub launched, drain + reap the agent
+        # process) — a retired replica must not keep pinging a host
+        from tony_tpu.gateway.remote import close_server
+
+        close_server(server, f"replica {index} retire")
         with self.stats.lock:
             self.stats.replicas_removed += 1
         log.warning("replica %d retired (zero-loss drain complete)",
@@ -1668,6 +1734,20 @@ class Gateway:
         wd = self._watchdog  # snapshot (see _beat)
         if wd is not None:
             wd.unregister(str(replica.index))
+
+    def _fail_remote(self, replica: _Replica, reason: str) -> None:
+        """A remote replica's lease expired (or its agent reported a
+        terminal condition mid-stream): the network-side analog of the
+        watchdog's stall — same funnel, same token-exact failover.
+        Runs on the stub's lease-monitor (or stream-reader) thread;
+        ``_fail_replica``'s epoch/state fence makes a duplicate report
+        (lease expiry racing a reader's dead-agent discovery) a
+        no-op."""
+        with replica.cv:
+            epoch = replica.epoch
+        self._fail_replica(replica, epoch,
+                           f"replica {replica.index} ({replica.host}): "
+                           f"{reason}")
 
     def _on_stall(self, task_id: str) -> None:
         """Watchdog expiry: the replica's thread stopped beating —

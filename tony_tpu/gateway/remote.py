@@ -1,0 +1,694 @@
+"""Remote replicas: the gateway-side stub over a replica agent.
+
+The other half of ``serve/agent.py`` — the piece that closes the TonY
+loop for serving: the ApplicationMaster doesn't run the work, it
+acquires hosts and SUPERVISES the TaskExecutors running there.
+``RemoteServer`` presents the exact ``serve.Server`` surface the
+in-process ``_Replica`` scheduler drives (``submit`` / ``step`` /
+``live_progress`` / ``counters`` / ``reset`` / ``slots``), so
+routing, WFQ admission, deadlines, autoscaling and the stats rollups
+work UNCHANGED over a replica that lives on another machine. What
+changes is only what a network adds:
+
+- **Lease heartbeats**: a heartbeat thread GETs the agent's
+  ``/healthz`` every ``heartbeat_interval_s``; each success pings a
+  ``coordinator/liveness.LivenessMonitor`` lease (the same expiry
+  machinery TonY's AM runs over its task heartbeats). No successful
+  heartbeat for the lease horizon — dead process, network partition,
+  black hole, it cannot matter which — expires the lease, and the
+  bound supervisor callback funnels into the gateway's existing
+  ``_fail_replica`` -> token-exact failover. A dead host is just a
+  wedged replica.
+- **The epoch fence, over the wire**: every call carries the stub's
+  epoch and every agent response echoes one. ``reset()`` (the
+  breaker's recovery step) bumps the epoch; readers discard any line
+  carrying an older echo (``stale_epoch_drops``), and the agent
+  itself refuses calls older than what it has adopted (409) — a
+  wedged-then-revived host can neither deliver stale tokens nor
+  accept stale work.
+- **Resume, not failover, for connection blips**: each in-flight
+  request has a reader thread on the agent's resumable NDJSON stream
+  (absolute token offsets). A dropped connection to a HEALTHY agent
+  reconnects at ``offset = tokens already held`` and the stream
+  continues exactly — no retry budget charged, no replica failed.
+  Connect errors retry with capped exponential backoff + jitter
+  *within* the lease (a transient blip is not a failover); only the
+  lease decides death.
+- **Typed refusals**: the agent maps engine refusals to ``kind`` tags
+  and the stub re-raises the real types (``QueueFull``,
+  ``PoolExhausted``, ``ValueError``), so the gateway's admission
+  paths cannot tell local from remote.
+
+Transport fault injection (``serve/faults.py`` transport ops, armed
+via ``TONY_SERVE_FAULTS`` -> ``FaultPlan.transport_from_env``) hooks
+the two choke points here — once per HTTP call, once per stream read
+— so refuse / black-hole / delay / disconnect-mid-stream / half-open
+are all deterministic, testable failure modes instead of hardware
+folklore.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import random
+import subprocess
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+from tony_tpu.serve.agent import result_from_doc
+from tony_tpu.serve.engine import PoolExhausted, QueueFull, Request
+
+log = logging.getLogger(__name__)
+
+
+def close_server(server, what: str) -> None:
+    """Best-effort close of a replica server's remote machinery
+    (lease/heartbeat threads, launched agent reaping) — a no-op for
+    local engines, which have no ``close``. The ONE teardown helper
+    every retire/destroy/drain path shares: teardown trouble is a
+    logged event, never a dead caller."""
+    close = getattr(server, "close", None)
+    if close is None:
+        return
+    try:
+        close()
+    except Exception:
+        log.exception("%s: remote server close failed", what)
+
+
+class AgentHTTPError(RuntimeError):
+    """A non-200 the agent answered deliberately (vs a transport
+    error): carries the status and the parsed body."""
+
+    def __init__(self, status: int, doc: dict):
+        super().__init__(f"agent answered {status}: "
+                         f"{doc.get('error', '(no error body)')}")
+        self.status = status
+        self.doc = doc
+
+
+class AgentTransport:
+    """One agent's HTTP client: JSON calls + NDJSON streams, an epoch
+    header on everything, fault hooks at the choke points, and capped
+    exponential backoff with jitter on CONNECT errors (refused/reset
+    before a response) — the in-lease transient-blip absorber. Read
+    timeouts are never retried here: the caller already paid the
+    wait, and the lease is the authority on death."""
+
+    def __init__(self, address: str, *, connect_timeout_s: float = 2.0,
+                 read_timeout_s: float = 5.0, connect_retries: int = 3,
+                 backoff_base_s: float = 0.05, backoff_max_s: float = 0.5,
+                 fault_plan=None):
+        host, _, port = address.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"agent address must be host:port, "
+                             f"got {address!r}")
+        self.address = address
+        self.host, self.port = host, int(port)
+        self.connect_timeout_s = connect_timeout_s
+        self.read_timeout_s = read_timeout_s
+        self.connect_retries = max(0, connect_retries)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.fault_plan = fault_plan
+        # transport observability (the /stats ``transport`` block)
+        self.retries = 0         # connect-error retries that happened
+        self.connect_errors = 0  # connect errors seen (pre-retry)
+        self._lock = threading.Lock()
+        self._rng = random.Random(0xA9E27 ^ hash(address))
+
+    def _backoff(self, attempt: int) -> float:
+        base = min(self.backoff_max_s,
+                   self.backoff_base_s * (2 ** attempt))
+        # full jitter (half to full of the computed backoff): retries
+        # from many stubs against one recovering host must not arrive
+        # in lockstep
+        with self._lock:
+            return base * (0.5 + 0.5 * self._rng.random())
+
+    def call(self, method: str, path: str, doc: dict | None = None,
+             *, epoch: int = 0, request=None,
+             timeout: float | None = None) -> dict:
+        """One JSON request/response. Raises ``AgentHTTPError`` on a
+        non-200, ``ConnectionError``/``TimeoutError`` on transport
+        failure (after in-lease connect retries)."""
+        attempt = 0
+        while True:
+            conn = None
+            try:
+                # the fault hook INSIDE the retry scope: an injected
+                # refusal must exercise the same backoff path a real
+                # one would, or the chaos tests prove nothing
+                if self.fault_plan is not None:
+                    self.fault_plan.on_call(f"{method} {path}",
+                                            request=request)
+                conn = http.client.HTTPConnection(
+                    self.host, self.port,
+                    timeout=timeout if timeout is not None
+                    else self.read_timeout_s)
+                body = None if doc is None else json.dumps(doc).encode()
+                conn.request(method, path, body=body, headers={
+                    "X-Tony-Epoch": str(epoch),
+                    "Content-Type": "application/json"})
+                resp = conn.getresponse()
+                data = resp.read()
+                out = json.loads(data) if data else {}
+                if resp.status != 200:
+                    raise AgentHTTPError(resp.status, out)
+                return out
+            except (ConnectionError, TimeoutError, OSError) as e:
+                refused = isinstance(e, (ConnectionRefusedError,
+                                         ConnectionResetError,
+                                         BrokenPipeError))
+                with self._lock:
+                    self.connect_errors += 1
+                if not refused or attempt >= self.connect_retries:
+                    raise
+                with self._lock:
+                    self.retries += 1
+                time.sleep(self._backoff(attempt))
+                attempt += 1
+            finally:
+                if conn is not None:
+                    conn.close()
+
+    def stream_lines(self, path: str, *, epoch: int = 0, request=None):
+        """Generator over one NDJSON stream's parsed docs. Transport
+        trouble mid-stream raises; a clean server-side close just ends
+        the generator (the reader's resume logic treats both as a
+        disconnect). No internal retry — resume-by-offset IS the
+        retry, and it needs the caller's current offset."""
+        if self.fault_plan is not None:
+            self.fault_plan.on_call(f"GET {path}", request=request)
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.read_timeout_s)
+        try:
+            conn.request("GET", path,
+                         headers={"X-Tony-Epoch": str(epoch)})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise AgentHTTPError(resp.status,
+                                     json.loads(resp.read() or b"{}"))
+            while True:
+                if self.fault_plan is not None:
+                    self.fault_plan.on_stream(path, request=request)
+                line = resp.readline()
+                if not line:
+                    return
+                yield json.loads(line)
+        except (ConnectionError, TimeoutError, OSError):
+            with self._lock:
+                self.connect_errors += 1
+            raise
+        finally:
+            conn.close()
+
+
+class _RemoteTicket:
+    """One in-flight request's stub-side record: the absolute token
+    sequence received so far plus the terminal result doc."""
+
+    __slots__ = ("id", "epoch", "tokens", "result")
+
+    def __init__(self, request_id, epoch: int):
+        self.id = request_id
+        self.epoch = epoch
+        self.tokens: list[int] = []
+        self.result: dict | None = None
+
+
+class _RemoteSlots:
+    """The ``server.slots`` view the ``_Replica`` scheduler reads:
+    slot occupancy mirrors the agent's batch, tracked stub-side as
+    in-flight tickets (the stub never over-admits past it)."""
+
+    def __init__(self, remote: "RemoteServer", batch_size: int):
+        self._remote = remote
+        self.batch_size = batch_size
+
+    @property
+    def n_active(self) -> int:
+        return len(self._remote._tickets)
+
+    def free_slots(self) -> list[int]:
+        return list(range(max(0, self.batch_size - self.n_active)))
+
+
+class RemoteServer:
+    """The ``serve.Server``-shaped stub over one replica agent. See
+    the module docstring; the ``_Replica`` scheduler drives this
+    exactly like a local engine."""
+
+    # surface parity with serve.Server attributes the gateway reads
+    timeline = None
+    fault_plan = None  # engine faults live on the AGENT's engine
+
+    def __init__(self, address: str, *, heartbeat_interval_s: float = 1.0,
+                 lease_misses: int = 5, connect_timeout_s: float = 2.0,
+                 read_timeout_s: float = 5.0, boot_timeout_s: float = 60.0,
+                 stall_timeout_s: float = 30.0,
+                 transport_faults=None, agent_proc=None):
+        self.transport = AgentTransport(
+            address, connect_timeout_s=connect_timeout_s,
+            read_timeout_s=read_timeout_s, fault_plan=transport_faults)
+        self.transport_faults = transport_faults
+        self.host_addr = address
+        self.heartbeat_interval_s = max(0.05, heartbeat_interval_s)
+        self.lease_misses = max(1, lease_misses)
+        self.stall_timeout_s = stall_timeout_s
+        self.agent_proc = agent_proc  # a subprocess we launched (owned)
+        self.epoch = 0
+        self._tickets: dict = {}
+        self._cond = threading.Condition()
+        self._progress = False
+        self._dead: str | None = None
+        self._closed = False
+        self._on_dead = None
+        self._monitor = None
+        self._hb_thread: threading.Thread | None = None
+        # transport observability
+        self._stats_lock = threading.Lock()
+        self.reconnects = 0
+        self.stale_epoch_drops = 0
+        self.lease_expiries = 0
+        self.heartbeat_failures = 0
+        self._rtt_ms = 0.0  # EMA over heartbeat round trips
+        self._last_hb = time.monotonic()
+        info = self._wait_ready(boot_timeout_s)
+        self.agent_id = info.get("agent_id", "?")
+        self.model = SimpleNamespace(cfg=SimpleNamespace(
+            max_seq_len=int(info["max_seq_len"])))
+        self.slots = _RemoteSlots(self, int(info["batch_size"]))
+        self.paged = bool(info.get("paged", False))
+        self.speculate_k = int(info.get("speculate_k", 0))
+        # the engine-summary probe reads ``prefix is not None``
+        self.prefix = True if info.get("prefix") else None
+        self._counters = dict(info.get("counters", {}))
+
+    # ------------------------------------------------------------ boot
+
+    def _wait_ready(self, timeout_s: float) -> dict:
+        deadline = time.monotonic() + timeout_s
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            try:
+                doc = self.transport.call("GET", "/healthz",
+                                          epoch=self.epoch)
+                if doc.get("ok"):
+                    return doc
+                last = RuntimeError(f"agent not ok: "
+                                    f"{doc.get('failed') or 'draining'}")
+            except (ConnectionError, TimeoutError, OSError,
+                    AgentHTTPError) as e:
+                last = e
+            time.sleep(0.1)
+        raise RuntimeError(
+            f"replica agent at {self.host_addr} not ready after "
+            f"{timeout_s:.0f}s: {type(last).__name__}: {last}")
+
+    # ----------------------------------------------------- supervision
+
+    def bind_supervisor(self, on_dead) -> None:
+        """Called by ``_Replica``: arms the lease. ``on_dead(reason)``
+        is the funnel into ``Gateway._fail_replica`` — fired (at most
+        once per outage) when the agent misses a whole lease of
+        heartbeats. Re-binding replaces the callback (the heartbeat
+        machinery starts once)."""
+        from tony_tpu.coordinator.liveness import LivenessMonitor
+
+        self._on_dead = on_dead
+        if self._monitor is None:
+            self._monitor = LivenessMonitor(
+                interval_ms=max(1, int(self.heartbeat_interval_s * 1000)),
+                max_missed=self.lease_misses,
+                on_expired=self._lease_expired).start()
+            self._monitor.register("agent")
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop,
+                name=f"agent-hb-{self.host_addr}", daemon=True)
+            self._hb_thread.start()
+
+    @property
+    def lease_s(self) -> float:
+        """The lease horizon (the LivenessMonitor expiry formula)."""
+        return self.heartbeat_interval_s * max(3, self.lease_misses)
+
+    def _hb_loop(self) -> None:
+        while not self._closed:
+            t0 = time.monotonic()
+            try:
+                doc = self.transport.call(
+                    "GET", "/healthz", epoch=self.epoch,
+                    timeout=max(self.heartbeat_interval_s, 2.0))
+                busy = doc.get("n_active", 0) or doc.get("n_pending", 0)
+                wedged = bool(busy) and \
+                    doc.get("stepper_age_s", 0.0) > self.stall_timeout_s
+                if doc.get("ok") and not wedged:
+                    rtt_ms = (time.monotonic() - t0) * 1e3
+                    with self._stats_lock:
+                        self._rtt_ms = rtt_ms if self._rtt_ms == 0.0 \
+                            else 0.8 * self._rtt_ms + 0.2 * rtt_ms
+                        self._last_hb = time.monotonic()
+                    counters = doc.get("counters")
+                    if isinstance(counters, dict):
+                        self._counters = counters
+                    # register (not ping): also RESURRECTS the lease
+                    # entry after an expiry once the agent is back
+                    if self._monitor is not None:
+                        self._monitor.register("agent")
+                else:
+                    # the agent process answered but its engine is
+                    # failed/draining — or busy with a stepper that
+                    # stopped beating (a WEDGED dispatch behind a
+                    # healthy HTTP face): alive on the network, dead
+                    # for serving — no lease ping, same as silence
+                    with self._stats_lock:
+                        self.heartbeat_failures += 1
+            except (ConnectionError, TimeoutError, OSError,
+                    AgentHTTPError, ValueError):
+                with self._stats_lock:
+                    self.heartbeat_failures += 1
+            left = self.heartbeat_interval_s - (time.monotonic() - t0)
+            if left > 0:
+                time.sleep(left)
+
+    def _lease_expired(self, task_id: str) -> None:
+        reason = (f"agent {self.host_addr} lease expired: no heartbeat "
+                  f"for {self.lease_s:.1f}s")
+        with self._stats_lock:
+            self.lease_expiries += 1
+        self._note_dead(reason)
+
+    def _note_dead(self, reason: str) -> None:
+        """Mark the transport dead (``step``/``submit`` raise until the
+        next ``reset``) and fire the supervisor funnel."""
+        if self._closed:
+            return
+        with self._cond:
+            if self._dead is None:
+                self._dead = reason
+            self._cond.notify_all()
+        cb = self._on_dead
+        if cb is not None:
+            try:
+                cb(reason)
+            except Exception:
+                log.exception("remote supervisor callback failed")
+
+    # ------------------------------------------------- engine surface
+
+    @property
+    def n_pending(self) -> int:
+        return 0  # admission maps 1:1 onto agent slots (no stub queue)
+
+    @property
+    def n_active(self) -> int:
+        return len(self._tickets)
+
+    @property
+    def done(self) -> bool:
+        return not self._tickets
+
+    def submit(self, request: Request):
+        if self._dead:
+            raise ConnectionError(self._dead)
+        doc = {
+            "id": request.id, "prompt": list(request.prompt),
+            "max_new_tokens": request.max_new_tokens,
+            "temperature": request.temperature, "top_k": request.top_k,
+            "seed": request.seed, "epoch": self.epoch,
+        }
+        try:
+            resp = self.transport.call("POST", "/v1/submit", doc,
+                                       epoch=self.epoch,
+                                       request=request.id)
+        except AgentHTTPError as e:
+            kind = e.doc.get("kind", "")
+            if kind == "QueueFull":
+                raise QueueFull(e.doc.get("error", str(e))) from None
+            if kind == "PoolExhausted":
+                raise PoolExhausted(e.doc.get("error", str(e))) from None
+            if e.status == 400 or kind == "ValueError":
+                raise ValueError(e.doc.get("error", str(e))) from None
+            if e.status == 409:
+                with self._stats_lock:
+                    self.stale_epoch_drops += 1
+            # 409 stale epoch / 503 draining-or-failed: this replica
+            # cannot take work right now — surface as a transport
+            # failure so the scheduler's failover path owns it
+            raise ConnectionError(str(e)) from e
+        rid = resp.get("id", request.id)
+        with self._cond:
+            ticket = _RemoteTicket(rid, self.epoch)
+            self._tickets[rid] = ticket
+        threading.Thread(target=self._read_stream, args=(ticket,),
+                         name=f"agent-stream-{self.host_addr}",
+                         daemon=True).start()
+        return rid
+
+    def step(self) -> list:
+        """One scheduler beat: wait briefly for stream progress, then
+        hand back any finished results. Raises when the transport has
+        been declared dead — the scheduler's exception route."""
+        with self._cond:
+            if self._dead:
+                raise ConnectionError(self._dead)
+            ready = [t for t in self._tickets.values()
+                     if t.result is not None]
+            if not ready and not self._progress:
+                self._cond.wait(timeout=0.05)
+                if self._dead:
+                    raise ConnectionError(self._dead)
+                ready = [t for t in self._tickets.values()
+                         if t.result is not None]
+            self._progress = False
+            for t in ready:
+                del self._tickets[t.id]
+        return [result_from_doc(t.result) for t in ready]
+
+    def live_progress(self, since: dict | None = None) -> dict:
+        with self._cond:
+            out = {}
+            for t in self._tickets.values():
+                start = since.get(t.id, 0) if since else 0
+                out[t.id] = t.tokens[start:]
+            return out
+
+    def counters(self) -> dict:
+        return dict(self._counters)
+
+    def goodput(self):
+        return None  # the agent's engine owns its timeline/ledger
+
+    def reset(self) -> None:
+        """The breaker's recovery step, remote flavor: bump the epoch
+        (fencing off every outstanding stream and any late agent
+        output), drop local tickets, clear the dead marker so probes
+        can try again, and hard-reset the AGENT's engine under the new
+        epoch (ghost requests on a wedged-then-revived host die
+        here). Raises when the agent is unreachable — the recovery
+        loop logs and laps."""
+        with self._cond:
+            self.epoch += 1
+            epoch = self.epoch
+            self._tickets.clear()
+            self._dead = None
+            self._progress = False
+            self._cond.notify_all()
+        try:
+            self.transport.call("POST", "/v1/reset", {"epoch": epoch},
+                                epoch=epoch, timeout=10.0)
+        except (ConnectionError, TimeoutError, OSError) as e:
+            raise ConnectionError(
+                f"agent {self.host_addr} reset failed: {e}") from e
+        except AgentHTTPError as e:
+            raise ConnectionError(str(e)) from e
+
+    # -------------------------------------------------- stream reader
+
+    def _read_stream(self, ticket: _RemoteTicket) -> None:
+        """One in-flight request's reader: follow the agent's NDJSON
+        stream, placing token windows by ABSOLUTE offset; on any
+        disconnect, resume at the offset already held (reconnect, not
+        failover) with capped backoff — until the ticket finishes, the
+        epoch moves on, or the replica is declared dead."""
+        attempt = 0
+        while True:
+            with self._cond:
+                if (self._closed or self._dead is not None
+                        or ticket.result is not None
+                        or ticket.epoch != self.epoch
+                        or self._tickets.get(ticket.id) is not ticket):
+                    return
+                offset = len(ticket.tokens)
+            path = (f"/v1/stream/{ticket.id}?offset={offset}"
+                    f"&epoch={ticket.epoch}")
+            try:
+                for doc in self.transport.stream_lines(
+                        path, epoch=ticket.epoch, request=ticket.id):
+                    if doc.get("epoch") != ticket.epoch:
+                        # a revived host talking from another epoch:
+                        # the fence — count and drop the whole stream
+                        with self._stats_lock:
+                            self.stale_epoch_drops += 1
+                        return
+                    if doc.get("keepalive"):
+                        continue
+                    if doc.get("stale"):
+                        with self._stats_lock:
+                            self.stale_epoch_drops += 1
+                        return
+                    if "error" in doc:
+                        # the agent's ENGINE failed under our request:
+                        # same funnel as a dead dispatch
+                        self._note_dead(
+                            f"agent {self.host_addr} reported: "
+                            f"{doc['error']}")
+                        return
+                    if "token_ids" in doc:
+                        self._place(ticket, int(doc["offset"]),
+                                    [int(x) for x in doc["token_ids"]])
+                        attempt = 0  # progress resets the backoff
+                    if doc.get("done"):
+                        with self._cond:
+                            if ticket.epoch == self.epoch:
+                                ticket.result = doc["result"]
+                                self._progress = True
+                                self._cond.notify_all()
+                        return
+                # EOF without a terminal line: mid-stream disconnect
+            except AgentHTTPError as e:
+                if e.status == 409:
+                    with self._stats_lock:
+                        self.stale_epoch_drops += 1
+                    return
+                if e.status == 404:
+                    # the agent no longer knows this ticket: it
+                    # restarted (state gone) — everything it held must
+                    # fail over
+                    self._note_dead(
+                        f"agent {self.host_addr} lost request "
+                        f"{ticket.id!r} (agent restart?)")
+                    return
+                log.warning("agent %s stream error: %s",
+                            self.host_addr, e)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                log.debug("agent %s stream disconnect for %r: %r",
+                          self.host_addr, ticket.id, e)
+            with self._stats_lock:
+                self.reconnects += 1
+            time.sleep(self.transport._backoff(attempt))
+            attempt = min(attempt + 1, 8)
+
+    def _place(self, ticket: _RemoteTicket, offset: int,
+               tokens: list) -> None:
+        """Append the absolute window [offset, offset+len) — overlap
+        with what we already hold is dropped (resumes may re-send),
+        and a gap (can't happen with an honest agent) fails loudly
+        rather than corrupting the stream."""
+        with self._cond:
+            have = len(ticket.tokens)
+            if offset > have:
+                raise RuntimeError(
+                    f"stream gap for {ticket.id!r}: offset {offset} "
+                    f"past {have} tokens held")
+            new = tokens[have - offset:]
+            if new:
+                ticket.tokens.extend(new)
+                self._progress = True
+                self._cond.notify_all()
+
+    # --------------------------------------------------- observability
+
+    def transport_stats(self) -> dict:
+        """The per-replica ``transport`` block (/stats, /metrics):
+        where the time goes between this gateway and that host."""
+        with self._stats_lock:
+            return {
+                "address": self.host_addr,
+                "agent_id": self.agent_id,
+                "rtt_ms": round(self._rtt_ms, 3),
+                "heartbeat_age_s": round(
+                    time.monotonic() - self._last_hb, 3),
+                "lease_s": round(self.lease_s, 3),
+                "reconnects": self.reconnects,
+                "retries": self.transport.retries,
+                "connect_errors": self.transport.connect_errors,
+                "heartbeat_failures": self.heartbeat_failures,
+                "stale_epoch_drops": self.stale_epoch_drops,
+                "lease_expiries": self.lease_expiries,
+            }
+
+    # ------------------------------------------------------- shutdown
+
+    def close(self, drain_agent: bool | None = None,
+              timeout_s: float = 10.0) -> None:
+        """Stop the lease/heartbeat machinery and the readers. With
+        ``drain_agent`` (default: only for agents this stub LAUNCHED)
+        also politely drain the agent and stop its process — the
+        scale-down/deprovision path; attached agents are left running
+        (they belong to whoever started them)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._monitor is not None:
+            self._monitor.stop()
+        with self._cond:
+            self._cond.notify_all()
+        own = self.agent_proc is not None
+        if drain_agent is None:
+            drain_agent = own
+        if drain_agent:
+            try:
+                self.transport.call("POST", "/v1/drain",
+                                    {"timeout_s": timeout_s},
+                                    epoch=self.epoch,
+                                    timeout=timeout_s + 5.0)
+            except (ConnectionError, TimeoutError, OSError,
+                    AgentHTTPError) as e:
+                log.debug("agent %s drain on close failed: %r",
+                          self.host_addr, e)
+        if own:
+            proc = self.agent_proc
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def launch_local_agent(agent_args: list[str], *, port_file: str,
+                       env: dict | None = None,
+                       boot_timeout_s: float = 120.0):
+    """Launch ``python -m tony_tpu.cli.replica`` as a local subprocess
+    and wait for its bound address. The localhost member of the
+    launcher family (coordinator/launcher.py): the provisioned-host
+    story runs the same CLI via the slice's own channel; a
+    StaticProvisioner's localhost "hosts" and the smoke/chaos rounds
+    run it here. Returns ``(proc, "host:port")``; the caller owns the
+    process (hand it to ``RemoteServer(agent_proc=...)`` so close()
+    reaps it)."""
+    import os
+
+    cmd = [sys.executable, "-m", "tony_tpu.cli.replica",
+           *agent_args, "--port-file", port_file]
+    proc = subprocess.Popen(cmd, env=env)
+    deadline = time.monotonic() + boot_timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"replica agent exited {proc.returncode} before "
+                f"binding (cmd: {' '.join(cmd)})")
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                parts = f.read().split()
+            if len(parts) == 2:
+                return proc, f"{parts[0]}:{parts[1]}"
+        time.sleep(0.1)
+    proc.terminate()
+    raise RuntimeError(f"replica agent did not bind within "
+                       f"{boot_timeout_s:.0f}s")

@@ -124,6 +124,32 @@ _REPLICA_GAUGES = (
      "Tokens resident in allocated pages (live slots + prefix store)"),
 )
 
+# the per-replica ``transport`` block (remote replicas only —
+# gateway/remote.RemoteServer): where the network between the gateway
+# and a replica agent spends its time. The rtt field arrives in ms
+# (human units on /stats); the exposition converts to base seconds.
+_TRANSPORT_GAUGES = (
+    ("heartbeat_age_s", "tony_transport_heartbeat_age_seconds",
+     "Seconds since the last successful agent heartbeat"),
+    ("lease_s", "tony_transport_lease_seconds",
+     "The lease horizon: heartbeats missed this long fail the replica"),
+)
+
+_TRANSPORT_COUNTERS = (
+    ("reconnects", "tony_transport_reconnects_total",
+     "Stream reconnects (resume-by-offset; not failovers)"),
+    ("retries", "tony_transport_retries_total",
+     "In-lease connect retries (capped backoff + jitter)"),
+    ("connect_errors", "tony_transport_connect_errors_total",
+     "Transport-level call failures seen (pre-retry)"),
+    ("heartbeat_failures", "tony_transport_heartbeat_failures_total",
+     "Heartbeats that failed or found the agent not serving"),
+    ("stale_epoch_drops", "tony_transport_stale_epoch_drops_total",
+     "Agent responses discarded by the epoch fence"),
+    ("lease_expiries", "tony_transport_lease_expiries_total",
+     "Lease expiries that declared the agent dead"),
+)
+
 _SUPERVISION = (
     ("replicas_added", "tony_replicas_added_total",
      "Replicas added at runtime (autoscaler or operator)"),
@@ -319,6 +345,13 @@ def prometheus_text(gateway) -> str:
     state_fam = MetricFamily(
         "tony_replica_state", "gauge",
         "Breaker state info: the labeled state reads 1")
+    trans_gauge = {name: MetricFamily(name, "gauge", help_text)
+                   for _, name, help_text in _TRANSPORT_GAUGES}
+    trans_counter = {name: MetricFamily(name, "counter", help_text)
+                     for _, name, help_text in _TRANSPORT_COUNTERS}
+    trans_rtt = MetricFamily(
+        "tony_transport_rtt_seconds", "gauge",
+        "Heartbeat round-trip EMA to the replica agent")
     disp = {
         "tony_dispatch_count_total": MetricFamily(
             "tony_dispatch_count_total", "counter",
@@ -374,6 +407,18 @@ def prometheus_text(gateway) -> str:
             if key in row:
                 rep_gauge[name].add(row[key], labels)
         state_fam.add(1, {**labels, "state": str(row.get("state", ""))})
+        tr = row.get("transport")
+        if tr:
+            # remote replica: the host address rides as a label so a
+            # scrape can attribute a bad rtt to a machine directly
+            tl = {**labels, "host": str(tr.get("address", ""))}
+            trans_rtt.add(round(tr.get("rtt_ms", 0.0) / 1e3, 6), tl)
+            for key, name, _ in _TRANSPORT_GAUGES:
+                if key in tr:
+                    trans_gauge[name].add(tr[key], tl)
+            for key, name, _ in _TRANSPORT_COUNTERS:
+                if key in tr:
+                    trans_counter[name].add(tr[key], tl)
         for kind, agg in (row.get("dispatch") or {}).items():
             kl = {**labels, "kind": kind}
             disp["tony_dispatch_count_total"].add(agg["count"], kl)
@@ -392,6 +437,10 @@ def prometheus_text(gateway) -> str:
     fams.extend(rep_counter.values())
     fams.extend(rep_gauge.values())
     fams.append(state_fam)
+    if trans_rtt.samples:
+        fams.append(trans_rtt)
+        fams.extend(trans_gauge.values())
+        fams.extend(trans_counter.values())
     fams.extend(disp.values())
     fams.extend([host_rss, host_hbm, host_util])
 

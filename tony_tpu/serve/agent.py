@@ -1,0 +1,553 @@
+"""The replica agent: one ``serve.Server`` behind a thin HTTP shim.
+
+The remote half of the TonY container story, serving flavor: the
+gateway (the ApplicationMaster analog) acquires hosts through
+``coordinator/provisioner.py`` and the work runs THERE — this module
+is the TaskExecutor it launches on each host (``python -m
+tony_tpu.cli.replica``). It deliberately knows nothing about routing,
+admission tiers, failover or supervision; all of that stays in the
+gateway, which drives the agent through four endpoints (the wire
+behind ``gateway/remote.RemoteServer``):
+
+  POST /v1/submit     one engine request: ``{"id", "prompt": [ids],
+                      "max_new_tokens", "temperature", "top_k",
+                      "seed", "epoch"}``. Engine refusals keep their
+                      types over the wire (``kind`` = "QueueFull" /
+                      "PoolExhausted" / "ValueError") so the stub can
+                      re-raise them and the gateway's admission paths
+                      behave identically local or remote.
+  GET  /v1/stream/<id>?offset=N&epoch=E
+                      resumable NDJSON: ``{"offset", "token_ids",
+                      "epoch"}`` lines at ABSOLUTE token offsets, a
+                      ``{"keepalive": true}`` line at least every
+                      ``keepalive_s`` while idle (so a healthy-but-
+                      quiet stream never trips the client's read
+                      timeout), and a final ``{"done": true,
+                      "result": {...}}`` line. A dropped connection
+                      costs nothing: reconnect with ``offset`` =
+                      tokens already received and the stream resumes
+                      exactly there — reconnect, not failover.
+  POST /v1/reset      ``{"epoch"}``: adopt the (newer) epoch, hard-
+                      reset the engine, drop every ticket — the
+                      gateway's breaker recovery calls this before a
+                      probe, so a wedged-then-revived agent sheds its
+                      ghost requests instead of decoding for tickets
+                      that re-ran elsewhere long ago.
+  POST /v1/drain      stop admitting (submit -> 503), finish every
+                      in-flight and pending request, reply
+                      ``{"drained": true}``. SIGTERM in the CLI takes
+                      this path too — the agent deregisters by
+                      draining, never by vanishing.
+  GET  /healthz       the heartbeat target: engine counters, epoch,
+                      slots, ``ok``/``failed``/``draining`` — one
+                      cheap GET the gateway's lease rides on.
+
+EPOCH FENCE, agent side (the PR-5 fencing token carried over the
+wire): every call carries the gateway's epoch for this replica and
+every response echoes the epoch the agent is on. The agent adopts any
+NEWER epoch it sees and answers 409 to any OLDER one — so once the
+gateway has failed this replica over (bumping the epoch), a revived
+agent's stale submissions are refused and its stale stream lines are
+discarded client-side by the echo check. Neither side ever acts on
+the other's past.
+
+Engine faults (``TONY_SERVE_FAULTS``, serve/faults.py) arm the
+agent's OWN engine via its environment — a ``step()`` that raises
+marks the agent ``failed`` (healthz ok=false, streams end with an
+error line, submits 503) until a reset revives it, which is exactly
+the wedged-replica shape the gateway's breaker knows how to probe.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, unquote
+
+from tony_tpu.serve.engine import Request, Result, Server
+
+log = logging.getLogger(__name__)
+
+# how long a finished ticket's tokens+result stay fetchable, so a
+# client that lost its connection right before the done line can
+# reconnect and still collect the result (resume-by-offset covers the
+# tokens; this covers the terminal line)
+FINISHED_KEEP_S = 60.0
+
+
+class _StaleEpoch(Exception):
+    """A call carried an epoch older than the one this agent adopted."""
+
+
+class _Ticket:
+    """One live-or-recently-finished request's agent-side record."""
+
+    __slots__ = ("id", "tokens", "result", "t_done")
+
+    def __init__(self, request_id):
+        self.id = request_id
+        self.tokens: list[int] = []
+        self.result: dict | None = None
+        self.t_done: float | None = None
+
+
+def result_doc(res: Result) -> dict:
+    """A ``serve.Result`` as its wire form (and back via
+    ``result_from_doc``) — the exact fields the gateway's ``_deliver``
+    reads."""
+    return {
+        "id": res.id,
+        "prompt": list(res.prompt),
+        "tokens": list(res.tokens),
+        "finish_reason": res.finish_reason,
+        "prefix_hit_tokens": res.prefix_hit_tokens,
+        "prefill_tokens_saved": res.prefill_tokens_saved,
+        "drafted": res.drafted,
+        "accepted": res.accepted,
+    }
+
+
+def result_from_doc(doc: dict) -> Result:
+    return Result(
+        id=doc["id"], prompt=list(doc["prompt"]),
+        tokens=list(doc["tokens"]), finish_reason=doc["finish_reason"],
+        prefix_hit_tokens=int(doc.get("prefix_hit_tokens", 0)),
+        prefill_tokens_saved=int(doc.get("prefill_tokens_saved", 0)),
+        drafted=int(doc.get("drafted", 0)),
+        accepted=int(doc.get("accepted", 0)))
+
+
+class ReplicaAgent:
+    """Owns the engine and the ONE thread allowed to ``step()`` it.
+
+    HTTP handler threads only ever call the engine's thread-safe
+    ``submit()``; everything else (step, reset, drain) runs on the
+    stepper thread, fed through a small command list — the same
+    single-owner step contract the in-process ``_Replica`` keeps."""
+
+    def __init__(self, server: Server, *, agent_id: str | None = None,
+                 keepalive_s: float = 0.5):
+        self.server = server
+        self.agent_id = agent_id or f"agent-{uuid.uuid4().hex[:8]}"
+        self.keepalive_s = max(0.05, keepalive_s)
+        self.epoch = 0
+        self.failed: str | None = None
+        self.draining = False
+        self.drained = threading.Event()  # the CLI's exit signal
+        self._tickets: dict = {}
+        self._cmds: list = []  # (kind, done_event) for the stepper
+        # stepper heartbeat: refreshed once per loop iteration (idle
+        # waits included). A dispatch that WEDGES inside step() stops
+        # it — /healthz exposes the age so the gateway's lease can
+        # treat a wedged-but-network-healthy agent as dead for serving
+        self.last_step_beat = time.monotonic()
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="replica-agent-step",
+                                        daemon=True)
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> "ReplicaAgent":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        self._thread.join(timeout=5)
+
+    # ------------------------------------------------------- the wire
+
+    def check_epoch(self, epoch: int) -> None:
+        """Adopt a newer epoch, refuse an older one (409 upstream).
+        Under the condition lock so adopt-vs-adopt can't interleave."""
+        with self._cond:
+            if epoch < self.epoch:
+                raise _StaleEpoch(
+                    f"stale epoch {epoch} (agent is on {self.epoch})")
+            if epoch > self.epoch:
+                log.info("agent %s adopting epoch %d (was %d)",
+                         self.agent_id, epoch, self.epoch)
+                self.epoch = epoch
+
+    def submit(self, doc: dict) -> dict:
+        """POST /v1/submit body -> response doc. Raises the engine's
+        own refusal types (handler maps them to status + ``kind``)."""
+        self.check_epoch(int(doc.get("epoch", 0)))
+        if self.draining:
+            raise RuntimeError("agent is draining")
+        if self.failed is not None:
+            raise RuntimeError(f"agent failed: {self.failed}")
+        req = Request(
+            prompt=[int(t) for t in doc["prompt"]],
+            max_new_tokens=int(doc.get("max_new_tokens", 64)),
+            temperature=float(doc.get("temperature", 0.0)),
+            top_k=int(doc.get("top_k", 0)),
+            seed=int(doc.get("seed", 0)),
+            id=doc.get("id"))
+        with self._cond:
+            # IDEMPOTENT on the request id: the stub retries connect
+            # errors, and a reset that lands after the agent processed
+            # the submit but before the stub read the 200 would
+            # otherwise enqueue the same request twice (double slot +
+            # page consumption under one id)
+            if req.id in self._tickets:
+                return {"ok": True, "id": req.id, "epoch": self.epoch,
+                        "duplicate": True}
+            # ticket registered UNDER the lock before the engine sees
+            # the request: a stream connecting right after the 200 must
+            # find it
+            self.server.submit(req)  # engine submit() is thread-safe;
+            # inside our lock only to pair with the ticket insert
+            self._tickets[req.id] = _Ticket(req.id)
+            self._cond.notify_all()
+        return {"ok": True, "id": req.id, "epoch": self.epoch}
+
+    def reset(self, epoch: int) -> dict:
+        """POST /v1/reset: adopt the epoch, hard-reset the engine on
+        the stepper thread, drop every ticket."""
+        self.check_epoch(int(epoch))
+        done = threading.Event()
+        with self._cond:
+            self._cmds.append(("reset", done))
+            self._cond.notify_all()
+        if not done.wait(timeout=10):
+            raise RuntimeError("reset did not complete in 10s")
+        return {"ok": True, "epoch": self.epoch}
+
+    def drain(self, timeout_s: float = 120.0) -> dict:
+        """POST /v1/drain: stop admitting, finish everything."""
+        self.draining = True
+        with self._cond:
+            self._cond.notify_all()
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._cond:
+            while not self.server.done and self.failed is None \
+                    and time.monotonic() < deadline:
+                self._cond.wait(timeout=0.1)
+            ok = self.server.done
+        self.drained.set()
+        return {"drained": bool(ok), "epoch": self.epoch}
+
+    def healthz(self) -> dict:
+        server = self.server
+        return {
+            "ok": self.failed is None,
+            "failed": self.failed,
+            "draining": self.draining,
+            "agent_id": self.agent_id,
+            "pid": os.getpid(),
+            "epoch": self.epoch,
+            "batch_size": server.slots.batch_size,
+            "max_seq_len": server.model.cfg.max_seq_len,
+            "n_active": server.n_active,
+            "n_pending": server.n_pending,
+            "stepper_age_s": round(
+                time.monotonic() - self.last_step_beat, 3),
+            "paged": bool(server.paged),
+            "speculate_k": server.speculate_k,
+            "prefix": server.prefix is not None,
+            "counters": server.counters(),
+        }
+
+    # -------------------------------------------------------- stepper
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.last_step_beat = time.monotonic()
+            with self._cond:
+                cmds, self._cmds = self._cmds, []
+                busy = bool(self.server.n_active or self.server.n_pending)
+                if not cmds and (not busy or self.failed is not None):
+                    self._cond.wait(timeout=0.05)
+                    continue
+            for kind, done in cmds:
+                if kind == "reset":
+                    try:
+                        self.server.reset()
+                    except Exception:
+                        log.exception("agent engine reset failed")
+                    with self._cond:
+                        self._tickets.clear()
+                        self.failed = None
+                        self._cond.notify_all()
+                    done.set()
+            if self.failed is not None:
+                continue
+            if not (self.server.n_active or self.server.n_pending):
+                continue
+            try:
+                finished = self.server.step()
+                with self._cond:  # snapshot: submits mutate the dict
+                    seen = {t.id: len(t.tokens)
+                            for t in self._tickets.values()
+                            if t.result is None}
+                progress = self.server.live_progress(seen)
+            except Exception as e:  # noqa: BLE001 — an engine failure
+                # (injected or real) must not kill the agent process:
+                # mark failed, end the streams, let the GATEWAY's
+                # supervision decide (its heartbeat sees ok=false, its
+                # breaker revives us through /v1/reset + probe)
+                log.exception("agent engine step failed")
+                try:
+                    self.server.reset()
+                except Exception:
+                    log.exception("agent engine reset after failure")
+                with self._cond:
+                    self.failed = f"{type(e).__name__}: {e}"
+                    self._tickets.clear()
+                    self._cond.notify_all()
+                continue
+            now = time.monotonic()
+            with self._cond:
+                for rid, new in progress.items():
+                    t = self._tickets.get(rid)
+                    # ``new`` is the TAIL past what we already hold
+                    # (live_progress(since=held)): append it — only
+                    # this thread mutates tokens, so held counts taken
+                    # above are still exact here
+                    if t is not None and t.result is None and new:
+                        t.tokens.extend(new)
+                for res in finished:
+                    t = self._tickets.get(res.id)
+                    if t is None:  # e.g. the breaker probe driven by
+                        continue   # run()? every submit makes a ticket
+                    t.tokens = list(res.tokens)
+                    t.result = result_doc(res)
+                    t.t_done = now
+                # prune finished tickets past the reconnect grace
+                for rid in [rid for rid, t in self._tickets.items()
+                            if t.t_done is not None
+                            and now - t.t_done > FINISHED_KEEP_S]:
+                    del self._tickets[rid]
+                self._cond.notify_all()
+
+    # --------------------------------------------------------- streams
+
+    def stream_events(self, request_id, offset: int, epoch: int):
+        """Generator of NDJSON docs for GET /v1/stream/<id>: token
+        windows at absolute offsets from ``offset`` on, keepalives
+        while idle, one terminal doc (done / error), then ends. Runs
+        on the HTTP handler's own thread; only reads agent state under
+        the condition."""
+        self.check_epoch(epoch)
+        offset = max(0, int(offset))
+        last_emit = time.monotonic()
+        while True:
+            with self._cond:
+                t = self._tickets.get(request_id)
+                if t is None:
+                    yield {"error": f"unknown ticket {request_id!r}",
+                           "gone": True, "epoch": self.epoch}
+                    return
+                if self.epoch != epoch:
+                    # the gateway moved on mid-stream (reset/adopt):
+                    # this stream is a previous epoch's — end it
+                    yield {"error": "epoch superseded", "stale": True,
+                           "epoch": self.epoch}
+                    return
+                if self.failed is not None:
+                    yield {"error": self.failed, "failed": True,
+                           "epoch": self.epoch}
+                    return
+                tokens = t.tokens[offset:]
+                result = t.result
+                if not tokens and result is None:
+                    self._cond.wait(timeout=self.keepalive_s)
+                    tokens = t.tokens[offset:]
+                    result = t.result
+            if tokens:
+                yield {"offset": offset, "token_ids": tokens,
+                       "epoch": self.epoch}
+                offset += len(tokens)
+                last_emit = time.monotonic()
+            if result is not None:
+                yield {"done": True, "result": result,
+                       "epoch": self.epoch}
+                return
+            if time.monotonic() - last_emit >= self.keepalive_s:
+                yield {"keepalive": True, "epoch": self.epoch}
+                last_emit = time.monotonic()
+
+
+class AgentHandler(BaseHTTPRequestHandler):
+    agent: ReplicaAgent
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        log.debug(fmt, *args)
+
+    # chaos hook (AgentHTTP.kill): when set, every handler aborts at
+    # its next loop point and the socket dies without an HTTP goodbye —
+    # the network face of SIGKILL, for in-process chaos tests
+    killed = False
+
+    def _check_killed(self) -> None:
+        if type(self).killed:
+            raise ConnectionAbortedError("agent killed")
+
+    def do_GET(self):
+        self._check_killed()
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
+            return self._send(200, self.agent.healthz())
+        if path.startswith("/v1/stream/"):
+            return self._stream(unquote(path[len("/v1/stream/"):]),
+                                dict(parse_qsl(query)))
+        return self._send(404, {"error": "not found"})
+
+    def do_POST(self):
+        self._check_killed()
+        path = self.path.partition("?")[0]
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length)) if length else {}
+            if not isinstance(body, dict):
+                raise ValueError("request must be a JSON object")
+        except (ValueError, TypeError) as e:
+            return self._send(400, {"error": str(e)})
+        if path == "/v1/submit":
+            return self._submit(body)
+        if path == "/v1/reset":
+            try:
+                return self._send(200,
+                                  self.agent.reset(body.get("epoch", 0)))
+            except _StaleEpoch as e:
+                return self._send(409, {"error": str(e),
+                                        "epoch": self.agent.epoch})
+            except (RuntimeError, TypeError, ValueError) as e:
+                return self._send(500, {"error": str(e)})
+        if path == "/v1/drain":
+            timeout = float(body.get("timeout_s", 120.0))
+            return self._send(200, self.agent.drain(timeout))
+        return self._send(404, {"error": "not found"})
+
+    def _submit(self, body: dict) -> None:
+        from tony_tpu.serve.engine import PoolExhausted, QueueFull
+
+        try:
+            return self._send(200, self.agent.submit(body))
+        except _StaleEpoch as e:
+            return self._send(409, {"error": str(e),
+                                    "epoch": self.agent.epoch})
+        except QueueFull as e:
+            return self._send(429, {"error": str(e), "kind": "QueueFull"})
+        except PoolExhausted as e:
+            return self._send(503, {"error": str(e),
+                                    "kind": "PoolExhausted"})
+        except (ValueError, TypeError, KeyError) as e:
+            return self._send(400, {"error": str(e),
+                                    "kind": "ValueError"})
+        except RuntimeError as e:  # draining / failed
+            return self._send(503, {"error": str(e), "kind": "Unavailable"})
+
+    def _stream(self, rid: str, params: dict) -> None:
+        request_id: object = int(rid) if rid.lstrip("-").isdigit() else rid
+        try:
+            offset = int(params.get("offset", 0))
+            epoch = int(params.get("epoch", 0))
+        except ValueError as e:
+            return self._send(400, {"error": str(e)})
+        try:
+            events = self.agent.stream_events(request_id, offset, epoch)
+            first = next(events)
+        except _StaleEpoch as e:
+            return self._send(409, {"error": str(e),
+                                    "epoch": self.agent.epoch})
+        except StopIteration:  # generator contract: never empty
+            return self._send(500, {"error": "empty stream"})
+        # a missing ticket is a clean 404 BEFORE the stream commits:
+        # the stub treats it as "the agent lost my request" (restart)
+        if first.get("gone"):
+            return self._send(404, first)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Cache-Control", "no-store")
+        self.end_headers()
+        self._chunk(first)
+        for doc in events:
+            self._check_killed()
+            self._chunk(doc)
+        self.wfile.write(b"0\r\n\r\n")
+
+    def _chunk(self, doc: dict) -> None:
+        data = (json.dumps(doc) + "\n").encode()
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _send(self, code: int, doc: dict) -> None:
+        data = json.dumps(doc).encode()
+        if code >= 400:
+            self.close_connection = True
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        if code >= 400:
+            self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class _AgentHTTPServer(ThreadingHTTPServer):
+    def handle_error(self, request, client_address):
+        # disconnects (client gone mid-stream) and the kill() chaos
+        # abort are expected request endings, not tracebacks on stderr
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, TimeoutError)):
+            log.debug("agent connection ended: %r", exc)
+            return
+        super().handle_error(request, client_address)
+
+
+class AgentHTTP:
+    """Binds a ReplicaAgent to a ThreadingHTTPServer (start/stop),
+    plus the ``kill()`` chaos helper: from the network's point of view
+    the agent is SIGKILLed — open streams die mid-line, new
+    connections are refused — while the test process lives on."""
+
+    def __init__(self, agent: ReplicaAgent, host: str = "127.0.0.1",
+                 port: int = 0):
+        handler = type("BoundAgentHandler", (AgentHandler,),
+                       {"agent": agent})
+        self._handler = handler
+        self.server = _AgentHTTPServer((host, port), handler)
+        self.server.daemon_threads = True
+        self.host, self.port = self.server.server_address[:2]
+        self.address = f"{self.host}:{self.port}"
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "AgentHTTP":
+        self.agent = self._handler.agent.start()
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        name="replica-agent-http",
+                                        daemon=True)
+        self._thread.start()
+        log.info("replica agent %s at http://%s", self.agent.agent_id,
+                 self.address)
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        self._handler.agent.stop()
+
+    def kill(self) -> None:
+        """Chaos: drop off the network like a SIGKILLed process."""
+        self._handler.killed = True
+        # wake stream handlers parked on the agent condition so they
+        # hit the killed check now, not a keepalive later
+        with self._handler.agent._cond:
+            self._handler.agent._cond.notify_all()
+        self.server.shutdown()
+        self.server.server_close()

@@ -23,23 +23,46 @@ Two delivery routes:
 
 Fault spec fields (JSON object or ``Fault`` kwargs):
 
-  op        "fail" (raise ``InjectedFault``) or "wedge" (sleep —
-            simulates a stalled, not crashed, dispatch; the watchdog's
-            case)
+  op        engine side: "fail" (raise ``InjectedFault``) or "wedge"
+            (sleep — simulates a stalled, not crashed, dispatch; the
+            watchdog's case). Transport side (the remote-replica
+            stub's HTTP layer, gateway/remote.py): "refuse" (instant
+            ``ConnectionRefusedError`` — a dead port), "blackhole"
+            (the connection goes nowhere: optional ``seconds`` delay,
+            then ``TimeoutError`` — a network partition), "delay"
+            (sleep ``seconds``, then proceed — a slow link),
+            "disconnect" (``ConnectionResetError`` mid-stream — the
+            resume-by-offset case), "half_open" (the connection opened
+            but the response body never arrives: fires on stream
+            reads, ``seconds`` delay then ``TimeoutError``)
   dispatch  fire on ``step()`` calls numbered >= this (1-based count
             per engine, probes included)
+  call      fire on gateway->agent transport calls numbered >= this
+            (1-based count per stub; heartbeats, submits and stream
+            connects all count)
   request   fire when this ENGINE request id is admitted (through the
             gateway, engine ids are the replica's own deterministic
             0,1,2... sequence; the breaker probe admits id
-            ``"__probe__"``, so a plan can keep probes failing)
-  seconds   wedge duration
+            ``"__probe__"``, so a plan can keep probes failing) — or,
+            transport side, when the stub submits/streams this id
+  seconds   wedge/delay/black-hole duration
   times     firings before the fault is spent (default 1; -1 = every
-            match — a permanently broken replica)
+            match — a permanently broken replica / partitioned host)
   replica   restrict an env fault to one replica index (None = all)
 
 A fired fault is logged loudly; ``InjectedFault`` subclasses
 ``RuntimeError`` so nothing upstream special-cases it — it takes the
-exact path a real dispatch failure would.
+exact path a real dispatch failure would. Transport faults raise the
+REAL network exception types (``ConnectionRefusedError``,
+``ConnectionResetError``, ``TimeoutError``) for the same reason: the
+stub's retry/backoff/lease machinery must not be able to tell an
+injected partition from a real one.
+
+One ``TONY_SERVE_FAULTS`` value can mix both kinds: the engine arms
+``FaultPlan.from_env`` (engine ops only) and the gateway-side stub
+arms ``FaultPlan.transport_from_env`` (transport ops only), so a
+chaos round can kill replica 0's dispatches AND black-hole replica
+1's network from a single env var.
 """
 
 from __future__ import annotations
@@ -54,6 +77,19 @@ from typing import Any
 log = logging.getLogger(__name__)
 
 ENV_VAR = "TONY_SERVE_FAULTS"
+
+# engine-side ops (hooked at Server.step()/admission) vs transport-side
+# ops (hooked at the remote stub's HTTP layer) — one env var carries
+# both, each consumer arms only its own kind
+ENGINE_OPS = frozenset({"fail", "wedge"})
+TRANSPORT_OPS = frozenset({"refuse", "blackhole", "delay", "disconnect",
+                           "half_open"})
+# transport ops that fire on the per-call hook vs the per-stream-read
+# hook (half_open = the connection opened, the body never arrives).
+# blackhole fires on BOTH: a partitioned host's already-open streams
+# stop delivering exactly like its new connections do.
+_CALL_OPS = frozenset({"refuse", "blackhole", "delay"})
+_STREAM_OPS = frozenset({"disconnect", "half_open", "delay", "blackhole"})
 
 
 class InjectedFault(RuntimeError):
@@ -70,19 +106,31 @@ class Fault:
 
     op: str = "fail"
     dispatch: int | None = None
+    call: int | None = None
     request: Any = None
     seconds: float = 0.0
     times: int = 1
     replica: int | None = None
 
     def __post_init__(self):
-        if self.op not in ("fail", "wedge"):
+        if self.op not in ENGINE_OPS | TRANSPORT_OPS:
             raise ValueError(
-                f"fault op must be 'fail' or 'wedge', got {self.op!r}")
-        if self.dispatch is None and self.request is None:
-            raise ValueError("fault needs a trigger: dispatch or request")
-        if self.op == "wedge" and self.seconds <= 0:
-            raise ValueError("wedge fault needs seconds > 0")
+                f"fault op must be one of "
+                f"{sorted(ENGINE_OPS | TRANSPORT_OPS)}, got {self.op!r}")
+        if self.dispatch is None and self.call is None \
+                and self.request is None:
+            raise ValueError(
+                "fault needs a trigger: dispatch, call or request")
+        if self.op in ENGINE_OPS and self.call is not None:
+            raise ValueError(
+                f"engine fault {self.op!r} cannot use the transport "
+                f"'call' trigger (use 'dispatch' or 'request')")
+        if self.op in TRANSPORT_OPS and self.dispatch is not None:
+            raise ValueError(
+                f"transport fault {self.op!r} cannot use the engine "
+                f"'dispatch' trigger (use 'call' or 'request')")
+        if self.op in ("wedge", "delay") and self.seconds <= 0:
+            raise ValueError(f"{self.op} fault needs seconds > 0")
 
 
 class FaultPlan:
@@ -94,16 +142,20 @@ class FaultPlan:
     def __init__(self, faults):
         self.faults = list(faults)
         self.n_dispatches = 0
+        self.n_calls = 0
         self.fired = 0
 
     # --------------------------------------------------- construction
 
     @classmethod
-    def from_env(cls, replica: int | None = None,
-                 env=None) -> "FaultPlan | None":
+    def from_env(cls, replica: int | None = None, env=None,
+                 ops: frozenset = ENGINE_OPS) -> "FaultPlan | None":
         """Parse ``TONY_SERVE_FAULTS`` (a JSON fault object or list)
         into the plan addressed to ``replica`` — None when the variable
-        is unset/empty or no fault targets this replica. Invalid specs
+        is unset/empty or no fault targets this replica. ``ops``
+        selects the consumer's kind (engine ops by default — the
+        gateway-side stub arms ``transport_from_env``); entries of the
+        other kind are validated but not armed here. Invalid specs
         raise loudly: a chaos run with a silently ignored typo'd fault
         would assert against a fault-free gateway."""
         spec = (os.environ if env is None else env).get(ENV_VAR, "").strip()
@@ -120,9 +172,18 @@ class FaultPlan:
             if not isinstance(d, dict):
                 raise ValueError(f"{ENV_VAR} entries must be objects: {d!r}")
             f = Fault(**d)
+            if f.op not in ops:
+                continue
             if f.replica is None or replica is None or f.replica == replica:
                 faults.append(f)
         return cls(faults) if faults else None
+
+    @classmethod
+    def transport_from_env(cls, replica: int | None = None,
+                           env=None) -> "FaultPlan | None":
+        """The gateway-side arming point: transport faults addressed
+        to ``replica``'s stub (``gateway/remote.RemoteServer``)."""
+        return cls.from_env(replica, env=env, ops=TRANSPORT_OPS)
 
     @classmethod
     def fail_at(cls, dispatch: int, times: int = 1) -> "FaultPlan":
@@ -144,12 +205,25 @@ class FaultPlan:
         if fault.times > 0:
             fault.times -= 1
         self.fired += 1
-        if fault.op == "wedge":
-            log.warning("fault injection: wedging %.2fs at %s",
+        if fault.op in ("wedge", "delay"):
+            log.warning("fault injection: %s %.2fs at %s",
+                        "wedging" if fault.op == "wedge" else "delaying",
                         fault.seconds, what)
             time.sleep(fault.seconds)
             return
-        log.warning("fault injection: failing %s", what)
+        log.warning("fault injection: %s at %s", fault.op, what)
+        if fault.op == "refuse":
+            raise ConnectionRefusedError(
+                f"injected connection refusal at {what}")
+        if fault.op == "disconnect":
+            raise ConnectionResetError(f"injected disconnect at {what}")
+        if fault.op in ("blackhole", "half_open"):
+            # the realistic shape: nothing arrives until the caller's
+            # read timeout — the optional seconds model that wait
+            # without making tests pay a real socket timeout
+            if fault.seconds > 0:
+                time.sleep(fault.seconds)
+            raise TimeoutError(f"injected {fault.op} at {what}")
         raise InjectedFault(f"injected failure at {what}")
 
     def on_dispatch(self) -> None:
@@ -169,3 +243,38 @@ class FaultPlan:
                 continue
             if f.request == request_id:
                 self._fire(f, f"admit of request {request_id!r}")
+
+    # ------------------------------------------------------- transport
+
+    def on_call(self, what: str, request=None) -> None:
+        """Hook before the remote stub issues one HTTP call (submit /
+        stream connect / heartbeat / reset / drain — all count).
+        Fires call-count-triggered refuse/blackhole/delay faults, and
+        request-triggered ones when ``request`` names the engine id
+        the call is about."""
+        self.n_calls += 1
+        for f in self.faults:
+            if f.times == 0 or f.op not in _CALL_OPS:
+                continue
+            if f.call is not None and self.n_calls >= f.call:
+                self._fire(f, f"transport call {self.n_calls} ({what})")
+            elif f.request is not None and request is not None \
+                    and f.request == request:
+                self._fire(f, f"transport call for request {request!r} "
+                              f"({what})")
+
+    def on_stream(self, what: str, request=None) -> None:
+        """Hook per stream READ (one NDJSON line) on the remote stub:
+        disconnect-mid-stream and half-open land here — after the
+        connection succeeded, while the body flows. Shares the call
+        counter's trigger numbering (``call`` = the connect's number,
+        so "disconnect the stream call 3 opened" composes)."""
+        for f in self.faults:
+            if f.times == 0 or f.op not in _STREAM_OPS:
+                continue
+            if f.call is not None and self.n_calls >= f.call:
+                self._fire(f, f"stream read ({what})")
+            elif f.request is not None and request is not None \
+                    and f.request == request:
+                self._fire(f, f"stream read for request {request!r} "
+                              f"({what})")

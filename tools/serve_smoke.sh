@@ -20,12 +20,18 @@
 # routing), serve the whole burst with zero 5xx, and drain back to
 # the one-replica floor once idle.
 #
-# Finally a GOODPUT/ALERTS round (ISSUE-10): a deliberately tiny KV
+# A GOODPUT/ALERTS round (ISSUE-10): a deliberately tiny KV
 # page pool under concurrent load fires a kv_pages_pressure alert
 # (/stats alerts + history alerts.jsonl + the portal's metrics page),
 # resolves after load stops, and /debug/goodput names the largest
 # waste bucket on the live subprocess gateway. The whole script also
 # starts with the `make check` lint gate so smoke fails fast on drift.
+#
+# Finally a REMOTE round (ISSUE-11): two real `python -m
+# tony_tpu.cli.replica` agent subprocesses behind a --agents gateway;
+# concurrent traffic, `kill -9` one agent mid-run -> zero 5xx, every
+# output token-exact vs a local-replica control gateway, the corpse
+# quarantined, the survivor agent SIGTERM-drained clean.
 #
 # Usage: tools/serve_smoke.sh       (repo root; `make serve-smoke`)
 #        SERVE_SMOKE_ROUNDS=chaos tools/serve_smoke.sh
@@ -34,6 +40,8 @@
 #                                   (autoscale round only; `make autoscale-smoke`)
 #        SERVE_SMOKE_ROUNDS=goodput tools/serve_smoke.sh
 #                                   (goodput/alerts round only; `make goodput-smoke`)
+#        SERVE_SMOKE_ROUNDS=remote tools/serve_smoke.sh
+#                                   (remote round only; `make remote-smoke`)
 set -u
 
 PY=${PY:-python}
@@ -46,7 +54,11 @@ PAGED_PID=''
 SCALE_PID=''
 GP_PID=''
 PORTAL_PID=''
-trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
+AGENT0_PID=''
+AGENT1_PID=''
+RGW_PID=''
+RCTRL_PID=''
+trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID $RGW_PID $RCTRL_PID 2>/dev/null; kill -9 $AGENT0_PID $AGENT1_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
 
 fail() { echo "serve-smoke: FAIL: $1" >&2; exit 1; }
 
@@ -140,6 +152,151 @@ EOF
 }
 
 curl_s() { timeout -k 5 "$BOUND" curl -sS -o "$1" -w '%{http_code}' "$2" ${3:+-d "$3"}; }
+
+# ---- remote round (also standalone: SERVE_SMOKE_ROUNDS=remote) -------
+# ISSUE-11: serve ON the provisioned hosts. Two real replica-agent
+# subprocesses (`python -m tony_tpu.cli.replica`) behind an --agents
+# gateway; `kill -9` one agent mid-run. Every request must still
+# answer 200 with outputs token-exact vs a LOCAL-replica control
+# gateway, the corpse must be quarantined, and the survivor agent
+# must SIGTERM-drain clean (the deregister-by-draining story).
+remote_round() {
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.replica --demo-model \
+        --serve-batch 2 --port 0 --port-file "$WORK/agent0.port" \
+        --replica-index 0 --compile-cache '' \
+        >"$WORK/agent0.log" 2>&1 &
+    AGENT0_PID=$!
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.replica --demo-model \
+        --serve-batch 2 --port 0 --port-file "$WORK/agent1.port" \
+        --replica-index 1 --compile-cache '' \
+        >"$WORK/agent1.log" 2>&1 &
+    AGENT1_PID=$!
+    i=0
+    while [ $i -lt $BOUND ]; do
+        [ -f "$WORK/agent0.port" ] && [ -f "$WORK/agent1.port" ] && break
+        kill -0 $AGENT0_PID 2>/dev/null || fail "agent 0 died at boot: $(cat "$WORK/agent0.log")"
+        kill -0 $AGENT1_PID 2>/dev/null || fail "agent 1 died at boot: $(cat "$WORK/agent1.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -f "$WORK/agent0.port" ] && [ -f "$WORK/agent1.port" ] || fail "agents did not bind within ${BOUND}s"
+    A0=$(awk '{print $1 ":" $2}' "$WORK/agent0.port")
+    A1=$(awk '{print $1 ":" $2}' "$WORK/agent1.port")
+    echo "serve-smoke: replica agents at $A0 and $A1"
+
+    # the remote gateway (a pure router: no model in this process) and
+    # the local-replica CONTROL gateway outputs are compared against
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --agents "$A0,$A1" \
+        --serve-batch 2 --port 0 --compile-cache '' \
+        --agent-heartbeat 0.2 --agent-lease-misses 3 \
+        --breaker-base 0.2 --breaker-max 1 --quarantine-after 3 \
+        >"$WORK/remote_boot.log" 2>"$WORK/remote_stderr.log" &
+    RGW_PID=$!
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --replicas 1 --serve-batch 2 --port 0 --compile-cache '' \
+        >"$WORK/rctrl_boot.log" 2>&1 &
+    RCTRL_PID=$!
+    RURL=''; RCTRL_URL=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        RURL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/remote_boot.log")
+        RCTRL_URL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/rctrl_boot.log")
+        [ -n "$RURL" ] && [ -n "$RCTRL_URL" ] && break
+        kill -0 $RGW_PID 2>/dev/null || fail "remote gateway died at boot: $(cat "$WORK/remote_stderr.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$RURL" ] && [ -n "$RCTRL_URL" ] || fail "remote/control gateways did not print URLs within ${BOUND}s"
+    echo "serve-smoke: remote gateway at $RURL (control at $RCTRL_URL)"
+
+    # warm both fleets so the kill lands mid-decode, not mid-compile
+    code=$(curl_s "$WORK/rwarm" "$RURL/v1/generate" '{"token_ids": [9, 9], "max_new_tokens": 2}') || fail "remote warm curl"
+    [ "$code" = 200 ] || fail "remote warm -> $code"
+    curl_s "$WORK/rcwarm" "$RCTRL_URL/v1/generate" '{"token_ids": [9, 9], "max_new_tokens": 2}' >/dev/null || fail "control warm curl"
+
+    REMOTE_PIDS=''
+    n=0
+    while [ $n -lt 8 ]; do
+        curl_s "$WORK/remote_$n" "$RURL/v1/generate" \
+            "{\"token_ids\": [$((1 + n)), 2, 3], \"max_new_tokens\": 48, \"id\": $n}" \
+            >"$WORK/remote_${n}.code" &
+        REMOTE_PIDS="$REMOTE_PIDS $!"
+        n=$((n + 1))
+    done
+    # the headline move: SIGKILL agent 0 while the burst is in flight
+    kill -9 $AGENT0_PID
+    echo "serve-smoke: kill -9 agent 0 ($A0) mid-run"
+    wait $REMOTE_PIDS
+    n=0
+    while [ $n -lt 8 ]; do
+        curl_s "$WORK/rctrl_$n" "$RCTRL_URL/v1/generate" \
+            "{\"token_ids\": [$((1 + n)), 2, 3], \"max_new_tokens\": 48, \"id\": $n}" \
+            >/dev/null || fail "control request $n curl"
+        n=$((n + 1))
+    done
+    n=0
+    while [ $n -lt 8 ]; do
+        # a dead HOST is failover, never a 5xx
+        [ "$(cat "$WORK/remote_${n}.code")" = 200 ] || fail "remote request $n -> $(cat "$WORK/remote_${n}.code") (host kill must fail over, not 5xx)"
+        $PY - "$WORK/remote_$n" "$WORK/rctrl_$n" <<'EOF' || fail "remote request $n: output differs from local-replica control"
+import json, sys
+a = json.load(open(sys.argv[1]))
+b = json.load(open(sys.argv[2]))
+assert a["token_ids"] == b["token_ids"], (a["token_ids"], b["token_ids"])
+EOF
+        n=$((n + 1))
+    done
+
+    # the corpse is quarantined (probes against a dead host keep
+    # failing; --quarantine-after 3) and the stats name the machine
+    i=0
+    while [ $i -lt $BOUND ]; do
+        curl_s "$WORK/remote_stats" "$RURL/stats" >/dev/null 2>&1
+        grep -q '"state": "quarantined"' "$WORK/remote_stats" && break
+        sleep 1; i=$((i + 1))
+    done
+    $PY - "$WORK/remote_stats" "$A0" "$A1" <<'EOF' || fail "remote stats: supervision/transport wrong ($(cat "$WORK/remote_stats"))"
+import json, sys
+stats = json.load(open(sys.argv[1]))
+assert stats["shed"] == {}, stats["shed"]       # zero 5xx, whole round
+assert stats["completed"] >= 9, stats["completed"]
+sup = stats["supervision"]
+assert sup["replica_failures"] >= 1, sup
+rows = {r["replica"]: r for r in stats["replicas"]}
+assert rows[0]["state"] == "quarantined", rows[0]["state"]
+assert rows[0]["transport"]["address"] == sys.argv[2]
+assert rows[1]["state"] == "healthy", rows[1]["state"]
+assert rows[1]["completed"] >= 1, rows[1]["completed"]
+EOF
+    curl_s "$WORK/remote_metrics" "$RURL/metrics" >/dev/null 2>&1
+    grep -q 'tony_transport_rtt_seconds' "$WORK/remote_metrics" || fail "no transport metrics on /metrics"
+
+    # gateway SIGTERM drain (attached agents are left running), then
+    # the survivor agent deregisters by DRAINING on its own SIGTERM
+    kill -TERM $RGW_PID
+    i=0
+    while kill -0 $RGW_PID 2>/dev/null; do
+        [ $i -ge $BOUND ] && fail "remote gateway did not drain within ${BOUND}s"
+        sleep 1; i=$((i + 1))
+    done
+    wait $RGW_PID; rc=$?
+    [ $rc = 0 ] || fail "remote gateway exited $rc after SIGTERM"
+    RGW_PID=''
+    kill -TERM $AGENT1_PID
+    i=0
+    while kill -0 $AGENT1_PID 2>/dev/null; do
+        [ $i -ge $BOUND ] && fail "survivor agent did not drain within ${BOUND}s"
+        sleep 1; i=$((i + 1))
+    done
+    wait $AGENT1_PID; rc=$?
+    [ $rc = 0 ] || fail "survivor agent exited $rc after SIGTERM"
+    grep -q "agent drained clean" "$WORK/agent1.log" || fail "survivor agent did not report a clean drain"
+    AGENT1_PID=''
+    wait $AGENT0_PID 2>/dev/null
+    AGENT0_PID=''
+    kill -TERM $RCTRL_PID
+    wait $RCTRL_PID 2>/dev/null
+    RCTRL_PID=''
+    echo "serve-smoke: remote OK (kill -9 one of 2 agents -> zero 5xx, token-exact vs local control, corpse quarantined, survivor drained clean)"
+}
 
 # ---- autoscale round (also standalone: SERVE_SMOKE_ROUNDS=autoscale) --
 # the elastic loop end-to-end on a real subprocess gateway: burst 16
@@ -378,6 +535,10 @@ if [ "${SERVE_SMOKE_ROUNDS:-all}" = goodput ]; then
 fi
 if [ "${SERVE_SMOKE_ROUNDS:-all}" = chaos ]; then
     chaos_round   # `make chaos-smoke`: just the fault-injection round
+    exit 0
+fi
+if [ "${SERVE_SMOKE_ROUNDS:-all}" = remote ]; then
+    remote_round   # `make remote-smoke`: just the remote-replica round
     exit 0
 fi
 if [ "${SERVE_SMOKE_ROUNDS:-all}" = autoscale ]; then
@@ -721,4 +882,7 @@ autoscale_round
 
 # ---- goodput/alerts round: tiny pool -> alert fires -> resolves ------
 goodput_round
+
+# ---- remote round: agents on "hosts", kill -9 one, keep serving ------
+remote_round
 echo "serve-smoke: ALL OK"
