@@ -49,8 +49,14 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 #                 token-exact vs a local-replica control, the corpse
 #                 quarantined, the survivor SIGTERM-drained clean
 
+#   make disagg-smoke - just the disaggregation round of serve-smoke:
+#     a --roles prefill=1,decode=1 gateway with chunked prefill and a
+#     host-RAM KV page tier under mixed long-prompt/short-chat traffic
+#     -> zero 5xx, token-exact vs a single-pool control, host-tier
+#     page-ins and multi-chunk prefills visible on /stats
+
 .PHONY: lint smoke check test bench serve-smoke chaos-smoke \
-	autoscale-smoke goodput-smoke remote-smoke
+	autoscale-smoke goodput-smoke remote-smoke disagg-smoke
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -86,3 +92,6 @@ goodput-smoke:
 
 remote-smoke:
 	PY=$(PY) SERVE_SMOKE_ROUNDS=remote sh tools/serve_smoke.sh
+
+disagg-smoke:
+	PY=$(PY) SERVE_SMOKE_ROUNDS=disagg sh tools/serve_smoke.sh

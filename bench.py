@@ -1478,6 +1478,124 @@ def bench_paged(on_tpu: bool) -> dict:
     }
 
 
+def bench_disagg(on_tpu: bool) -> dict:
+    """The disaggregation datum (ISSUE-12 acceptance), two claims:
+
+    (a) MIXED-TRAFFIC TTFT: short-chat requests sharing a gateway with
+    long-prompt traffic. Control = two generalist replicas, monolithic
+    prefill (a short request admitted behind a long prompt waits out
+    its whole prefill dispatch); disagg = the same two engines as a
+    prefill=1/decode=1 role split with chunked prefill (the long
+    prompt prefills in bounded chunks, shorts slip between them and
+    decode on the other pool). Outputs are asserted token-identical
+    and zero requests shed — the latency win must not cost exactness
+    or capacity.
+
+    (b) FLEET PREFILL DISPATCHES under a shared system prompt with
+    prefix-affinity routing on vs off: affinity concentrates the
+    shared prefix on the replica that already holds it (one full
+    prefill for the fleet), least-outstanding spreads it (one per
+    replica). Deterministic counter, no clocks."""
+    import numpy as np
+
+    from tony_tpu.gateway import Gateway, GenRequest
+    from tony_tpu.models import Transformer, TransformerConfig
+    from tony_tpu.serve import Server
+
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=128, n_layers=4, n_heads=4, d_ff=256,
+        max_seq_len=512)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    rng = np.random.default_rng(0)
+    longs = [rng.integers(0, cfg.vocab_size, size=440).tolist()
+             for _ in range(2)]
+    shorts = [rng.integers(0, cfg.vocab_size, size=6).tolist()
+              for _ in range(8)]
+
+    def mk(**kw):
+        return Server(model, params, batch_size=4, min_bucket=16,
+                      chunk_steps=2, prefix_cache_mb=64.0, **kw)
+
+    def run_mixed(roles, chunk):
+        servers = [mk(prefill_chunk_tokens=chunk), mk()]
+        gw = Gateway(servers, max_queue=64, roles=roles).start()
+        # longs first, then the shorts they would otherwise starve
+        lt = [gw.submit(GenRequest(list(p), 8, id=f"long{i}"))
+              for i, p in enumerate(longs)]
+        st = [gw.submit(GenRequest(list(p), 8, id=f"short{i}"))
+              for i, p in enumerate(shorts)]
+        outs = {t.request.id: t.result(timeout=600).tokens
+                for t in lt + st}
+        ttfts = sorted(t.metrics["ttft_ms"] for t in st)
+        snap = gw.snapshot()
+        gw.drain(timeout=60)
+        assert snap["shed"] == {}, snap["shed"]
+        return outs, ttfts, snap
+
+    run_mixed(None, 0)  # warm every program off the measured path
+    run_mixed(["prefill", "decode"], 64)
+    ctrl_outs, ctrl_ttft, _ = run_mixed(None, 0)
+    dis_outs, dis_ttft, dis_snap = run_mixed(["prefill", "decode"], 64)
+    assert dis_outs == ctrl_outs, "role split changed outputs"
+
+    def pct(vals, q):
+        return vals[min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))]
+
+    # (b) fleet prefill dispatches, affinity on vs off: warm ONE
+    # replica with the system prompt, then fire the rest concurrently
+    # (half exact repeats). Affinity concentrates them on the warm
+    # store — exact repeats skip their prefill dispatch entirely;
+    # least-outstanding spreads them onto the cold replica, which must
+    # prefill. The counter is deterministic; no clocks.
+    system = rng.integers(0, cfg.vocab_size, size=96).tolist()
+    distinct = [system + rng.integers(0, cfg.vocab_size,
+                                      size=8).tolist()
+                for _ in range(4)]
+    fleet = distinct[1:] + distinct  # 3 fresh tails + 4 exact repeats
+
+    def run_fleet(affinity):
+        servers = [mk(), mk()]
+        gw = Gateway(servers, max_queue=64,
+                     prefix_affinity=affinity).start()
+        outs = [gw.submit(GenRequest(list(distinct[0]), 4, id="warm"))
+                .result(timeout=600).tokens]
+        tickets = [gw.submit(GenRequest(list(p), 4, id=i))
+                   for i, p in enumerate(fleet)]
+        outs.extend(t.result(timeout=600).tokens for t in tickets)
+        prefills = sum(s.prefills for s in servers)
+        snap = gw.snapshot()
+        gw.drain(timeout=60)
+        return outs, prefills, snap
+
+    outs_off, prefills_off, _ = run_fleet(False)
+    outs_on, prefills_on, snap_on = run_fleet(True)
+    assert outs_on == outs_off, "affinity routing changed outputs"
+
+    return {
+        "n_long": len(longs), "n_short": len(shorts),
+        "long_prompt_len": 440, "prefill_chunk_tokens": 64,
+        "short_ttft_ms_control": {"p50": round(pct(ctrl_ttft, 0.5), 3),
+                                  "p99": round(pct(ctrl_ttft, 0.99), 3)},
+        "short_ttft_ms_disagg": {"p50": round(pct(dis_ttft, 0.5), 3),
+                                 "p99": round(pct(dis_ttft, 0.99), 3)},
+        "short_ttft_p50_improvement": round(
+            pct(ctrl_ttft, 0.5) / max(pct(dis_ttft, 0.5), 1e-9), 3),
+        "short_ttft_p99_improvement": round(
+            pct(ctrl_ttft, 0.99) / max(pct(dis_ttft, 0.99), 1e-9), 3),
+        "handoffs": dis_snap["routing"]["handoffs"],
+        "chunk_dispatches":
+            dis_snap["engine"]["prefill_chunks"]["dispatches"],
+        "fleet_prefills_affinity_off": prefills_off,
+        "fleet_prefills_affinity_on": prefills_on,
+        "affinity_prefill_ratio": round(
+            prefills_off / max(prefills_on, 1), 3),
+        "prefix_routed": snap_on["routing"]["prefix_routed"],
+        "outputs_identical": True,
+    }
+
+
 def bench_faults(on_tpu: bool) -> dict:
     """The fault-tolerance datum (ISSUE-5 acceptance): the same
     concurrent workload through a 2-replica gateway twice — fault-free
@@ -2172,6 +2290,11 @@ def _collect_line() -> dict:
         extras["paged"] = bench_paged(on_tpu)
     except Exception as e:
         extras["paged"] = {"error": f"{type(e).__name__}: {e}"}
+    gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
+    try:
+        extras["disagg"] = bench_disagg(on_tpu)
+    except Exception as e:
+        extras["disagg"] = {"error": f"{type(e).__name__}: {e}"}
     gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
     try:
         extras["faults"] = bench_faults(on_tpu)

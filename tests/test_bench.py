@@ -179,6 +179,27 @@ def test_bench_paged_bounds(bench):
 
 
 @pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
+def test_bench_disagg_ttft_and_affinity_bounds(bench):
+    """The extras.disagg acceptance bounds (ISSUE-12): (a) short-chat
+    TTFT p50/p99 with chunked+role-split serving at least matches the
+    interleaved single-pool control under long-prompt co-traffic
+    (measured ~2-4x p99 on the CI box; outputs asserted identical and
+    zero shed inside the bench); (b) prefix-affinity routing runs
+    strictly fewer fleet prefill dispatches than least-outstanding
+    spreading on the shared-system-prompt workload (deterministic
+    counter)."""
+    out = bench.bench_disagg(False)
+    assert out["short_ttft_p50_improvement"] >= 1.0, out
+    assert out["short_ttft_p99_improvement"] >= 1.0, out
+    assert out["handoffs"] == out["n_long"] + out["n_short"], out
+    assert out["chunk_dispatches"] > 0, out
+    assert out["fleet_prefills_affinity_on"] \
+        < out["fleet_prefills_affinity_off"], out
+    assert out["prefix_routed"] > 0, out
+    assert out["outputs_identical"]
+
+
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_bench_goodput_ledger_and_overhead_gate(bench):
     """The extras.goodput acceptance bounds (ISSUE-10): (a) the ledger
     produced by the product sensor is well-formed — bucket fractions

@@ -1,0 +1,405 @@
+"""Disaggregated prefill/decode (ISSUE-12): chunked prefill, the
+prefill/decode role split with page-list handoff (local AND over the
+agent wire), and prefix-affinity routing.
+
+The exactness discipline is the same as test_paged/test_prefix: every
+new path is pinned TOKEN-IDENTICAL to the single-pool interleaved
+control — chunked prefill against monolithic, role-split against a
+generalist gateway (greedy and seeded sampling both), remote handoff
+against local. The scheduling claims (a long prompt no longer starves
+co-tenants; affinity beats least-outstanding to the warm replica) are
+pinned on deterministic counters, not wall clocks. CPU-only.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.gateway import Gateway, GenRequest
+from tony_tpu.models import Transformer, TransformerConfig
+from tony_tpu.serve import Request, Server
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=64,
+                            dtype=jnp.float32,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _prompts(seed=0, sizes=(40, 6, 24, 12)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 64, size=n).tolist() for n in sizes]
+
+
+def _collect(server, reqs):
+    for r in reqs:
+        server.submit(r)
+    out = {}
+    for res in server.run():
+        out[res.id] = res
+    return out
+
+
+# ------------------------------------------------------ chunked prefill
+
+
+@pytest.mark.parametrize("paged", [True, False])
+def test_chunked_prefill_token_parity(tiny, paged):
+    """Greedy outputs are byte-identical chunked vs monolithic, on
+    both cache layouts, and the chunk accounting shows on the Result
+    (a 40-token prompt at a 16-token budget = 3 dispatches)."""
+    model, params = tiny
+    prompts = _prompts()
+
+    def run(chunk):
+        srv = Server(model, params, batch_size=2, paged=paged,
+                     kv_page_size=8, prefill_chunk_tokens=chunk)
+        return _collect(srv, [Request(list(p), 6, id=i)
+                              for i, p in enumerate(prompts)]), srv
+
+    mono, _ = run(0)
+    chunked, srv = run(16)
+    assert {i: r.tokens for i, r in mono.items()} \
+        == {i: r.tokens for i, r in chunked.items()}
+    assert chunked[0].prefill_chunks == 3      # 16 + 16 + final 8
+    assert chunked[1].prefill_chunks == 1      # short prompt: one shot
+    assert srv.prefill_chunk_dispatches >= 3
+    assert srv.prefill_chunked == 2            # the 40- and 24-token
+
+    # sampled requests too: the first-token draw and rng chain must
+    # survive the chunk boundary
+    def run_sampled(chunk):
+        srv = Server(model, params, batch_size=2, paged=paged,
+                     kv_page_size=8, prefill_chunk_tokens=chunk)
+        return _collect(srv, [
+            Request(list(prompts[0]), 6, id=0, temperature=0.8,
+                    top_k=5, seed=3)])
+
+    assert run_sampled(0)[0].tokens == run_sampled(16)[0].tokens
+
+
+def test_chunked_prefill_with_prefix_seed_parity(tiny):
+    """Chunking composes with the prefix store: the second request's
+    suffix prefills in chunks FROM the seeded offset, token-exact vs
+    the store-on monolithic control."""
+    model, params = tiny
+    rng = np.random.default_rng(1)
+    base = rng.integers(1, 64, size=32).tolist()
+    prompts = [base + rng.integers(1, 64, size=8).tolist()
+               for _ in range(2)]
+
+    def run(chunk):
+        srv = Server(model, params, batch_size=2, paged=True,
+                     kv_page_size=8, prefix_cache_mb=2.0,
+                     prefill_chunk_tokens=chunk)
+        outs = {}
+        for i, p in enumerate(prompts):
+            srv.submit(Request(list(p), 5, id=i))
+            outs.update({r.id: r.tokens for r in srv.run()})
+        return outs, srv
+
+    mono, _ = run(0)
+    chunked, srv = run(16)
+    assert mono == chunked
+    assert srv.prefix_hits >= 1  # the seed actually engaged
+
+
+def test_chunked_prefill_interleaves_decode_rounds(tiny):
+    """The starvation cap itself: a short co-tenant FINISHES while the
+    long prompt is still mid-chunked-prefill — under a monolithic
+    admit the short request could not even decode before the long
+    prefill completed its dispatch."""
+    model, params = tiny
+    rng = np.random.default_rng(2)
+    long_p = rng.integers(1, 64, size=40).tolist()
+    srv = Server(model, params, batch_size=2, paged=True,
+                 kv_page_size=8, prefill_chunk_tokens=16)
+    srv.submit(Request(list(long_p), 6, id="long"))
+    srv.submit(Request([9, 9, 9], 2, id="short"))
+    finished = srv.step()  # admits both; long takes chunk 1 only
+    assert srv.n_prefilling == 1
+    assert any(r.id == "short" for r in finished), \
+        "short co-tenant should finish while the long prompt is " \
+        "still prefilling"
+    rest = list(srv.run())
+    assert any(r.id == "long" and r.prefill_chunks == 3 for r in rest)
+
+
+def test_chunked_prefill_reset_releases_pages(tiny):
+    """A reset mid-chunked-prefill hands every page + reservation
+    back (the failover recovery path must not leak the parked
+    slot's pool state)."""
+    model, params = tiny
+    srv = Server(model, params, batch_size=2, paged=True,
+                 kv_page_size=8, prefill_chunk_tokens=16)
+    srv.submit(Request(list(range(1, 41)), 6, id=0))
+    srv.step()
+    assert srv.n_prefilling == 1
+    srv.reset()
+    pool = srv.slots.pool
+    assert srv.n_prefilling == 0 and srv.done
+    assert pool.n_used == 0 and pool.reserved == 0
+
+
+# ------------------------------------------------------ role-split local
+
+
+def _mk_server(tiny, **kw):
+    model, params = tiny
+    kw.setdefault("prefix_cache_mb", 2.0)
+    return Server(model, params, batch_size=2, paged=True,
+                  kv_page_size=8, **kw)
+
+
+def _request_mix(prompts):
+    """Greedy + seeded-sampled requests over the same prompts."""
+    return [
+        GenRequest(list(p), 8, id=f"r{i}", seed=i,
+                   temperature=0.5 if i % 2 else 0.0,
+                   top_k=4 if i % 2 else 0)
+        for i, p in enumerate(prompts)
+    ]
+
+
+def test_role_split_token_parity_and_accounting(tiny):
+    """The headline pin: a prefill=1,decode=1 fleet with chunked
+    prefill produces byte-identical streams (greedy AND seeded
+    sampling) to a generalist single-pool control; the decode pool ran
+    ZERO prefill dispatches and every request crossed as a handoff."""
+    prompts = _prompts(3)
+    gw = Gateway([_mk_server(tiny, prefill_chunk_tokens=16),
+                  _mk_server(tiny)],
+                 roles=["prefill", "decode"]).start()
+    ctrl = Gateway([_mk_server(tiny)]).start()
+    try:
+        tickets = [gw.submit(r) for r in _request_mix(prompts)]
+        outs = {t.request.id[1:]: t.result(timeout=300).tokens
+                for t in tickets}
+        ctl = {r.id[1:]: ctrl.submit(r).result(timeout=300).tokens
+               for r in _request_mix(prompts)}
+        assert outs == ctl
+        snap = gw.snapshot()
+        assert snap["shed"] == {}, snap["shed"]
+        assert snap["routing"]["handoffs"] == len(prompts)
+        assert snap["engine"]["handoffs"]["out"] == len(prompts)
+        assert snap["engine"]["handoffs"]["in"] == len(prompts)
+        rows = {r["replica"]: r for r in snap["replicas"]}
+        assert rows[0]["role"] == "prefill"
+        assert rows[1]["role"] == "decode"
+        assert rows[0]["prefills"] > 0
+        assert rows[1]["prefills"] == 0  # decode pool never prefills
+        assert rows[1]["handoffs_in"] == len(prompts)
+        # the per-request record names both halves
+        meta = tickets[0].metrics
+        assert meta["prefill_replica"] == 0
+        assert meta["replica"] == 1
+        assert meta["prefill_chunks"] == 3  # 40 tokens at 16/chunk
+    finally:
+        gw.drain(timeout=60)
+        ctrl.drain(timeout=60)
+
+
+def test_role_split_hot_prompt_skips_prefill_entirely(tiny):
+    """An exact-repeat prompt on the prefill pool hands off as a pure
+    page gather (no prefill dispatch at all) — the fleet-wide
+    hot-system-prompt story."""
+    prompt = list(range(1, 25))
+    gw = Gateway([_mk_server(tiny), _mk_server(tiny)],
+                 roles=["prefill", "decode"]).start()
+    try:
+        a = gw.submit(GenRequest(list(prompt), 4, id="a"))
+        ra = a.result(timeout=300)
+        before = gw.replicas[0].server.prefills
+        b = gw.submit(GenRequest(list(prompt), 4, id="b"))
+        rb = b.result(timeout=300)
+        assert gw.replicas[0].server.prefills == before
+        assert b.metrics["prefix_hit_tokens"] == len(prompt)
+        assert b.metrics["prefill_chunks"] == 0
+        assert rb.tokens == ra.tokens  # greedy repeat: same stream
+    finally:
+        gw.drain(timeout=60)
+
+
+def test_handoff_geometry_mismatch_refused_at_submit(tiny):
+    """A cross-pool page-geometry mismatch (independently launched
+    agents CAN disagree on --kv-page-size) must be one request's
+    clean ValueError at submit — discovered inside step() it would
+    fail the whole replica and cascade through failover."""
+    model, params = tiny
+    pre = Server(model, params, batch_size=2, paged=True,
+                 kv_page_size=16)
+    dec = Server(model, params, batch_size=2, paged=True,
+                 kv_page_size=4)
+    prompt = list(range(1, 23))
+    pre.submit(Request(list(prompt), 4, id="x", prefill_only=True))
+    (hand,) = pre.run()
+    # 22 tokens: 2 pages of 16 from the prefill pool, but the decode
+    # pool needs 6 pages of 4 — the payload cannot cover the prompt
+    with pytest.raises(ValueError, match="page geometry"):
+        dec.submit(Request(list(prompt), 4, id="x",
+                           handoff=hand.handoff))
+    assert dec.done  # nothing admitted, nothing leaked
+
+
+def test_roles_validation(tiny):
+    model, params = tiny
+    paged = _mk_server(tiny)
+    unpaged = Server(model, params, batch_size=2, paged=False)
+    with pytest.raises(ValueError, match="at least one"):
+        Gateway([paged, _mk_server(tiny)], roles=["prefill", "prefill"])
+    with pytest.raises(ValueError, match="paged"):
+        Gateway([paged, unpaged], roles=["prefill", "decode"])
+    with pytest.raises(ValueError, match="names"):
+        Gateway([paged], roles=["prefill", "decode"])
+    with pytest.raises(ValueError, match="disaggregation"):
+        unpaged.submit(Request([1, 2], 2, prefill_only=True))
+
+
+def test_role_split_decode_failover_reruns_handoff(tiny):
+    """A decode replica failing mid-stream re-runs the ticket — with
+    its handoff payload — on another decode replica, token-exact
+    (the payload is immutable; the retry scatters the same bytes)."""
+    import os
+    from unittest import mock
+
+    fault = '{"op": "fail", "dispatch": 3, "replica": 1}'
+    with mock.patch.dict(os.environ, {"TONY_SERVE_FAULTS": fault}):
+        from tony_tpu.serve import FaultPlan
+
+        servers = [_mk_server(tiny), _mk_server(tiny),
+                   _mk_server(tiny)]
+        servers[1].fault_plan = FaultPlan.from_env(replica=1)
+    gw = Gateway(servers, roles=["prefill", "decode", "decode"],
+                 stall_timeout_s=30.0, breaker_base_s=0.1).start()
+    ctrl = Gateway([_mk_server(tiny)]).start()
+    try:
+        prompts = _prompts(5, sizes=(24, 18))
+        outs = {}
+        for i, p in enumerate(prompts):
+            outs[i] = gw.submit(GenRequest(list(p), 8, id=f"r{i}")) \
+                .result(timeout=300).tokens
+        for i, p in enumerate(prompts):
+            got = ctrl.submit(GenRequest(list(p), 8, id=f"c{i}")) \
+                .result(timeout=300).tokens
+            assert outs[i] == got, i
+        snap = gw.snapshot()
+        assert snap["shed"] == {}, snap["shed"]
+        assert snap["supervision"]["replica_failures"] >= 1
+    finally:
+        gw.drain(timeout=60)
+        ctrl.drain(timeout=60)
+
+
+# ----------------------------------------------------- role-split remote
+
+
+def test_role_split_remote_agents_token_parity(tiny):
+    """The /v1/handoff wire op: both pools behind real agent HTTP
+    shims — the payload crosses the wire base64-encoded in BOTH
+    directions (prefill result -> gateway -> decode submit) and stays
+    token-exact vs a local generalist control."""
+    from tony_tpu.gateway import RemoteServer
+    from tony_tpu.serve.agent import AgentHTTP, ReplicaAgent
+
+    prompts = _prompts(4, sizes=(40, 6, 24))
+    https = [AgentHTTP(ReplicaAgent(_mk_server(
+        tiny, prefill_chunk_tokens=16))).start(),
+        AgentHTTP(ReplicaAgent(_mk_server(tiny))).start()]
+    stubs = [RemoteServer(h.address, heartbeat_interval_s=0.2)
+             for h in https]
+    gw = Gateway(stubs, roles=["prefill", "decode"]).start()
+    ctrl = Gateway([_mk_server(tiny)]).start()
+    try:
+        outs = {r.id: gw.submit(r).result(timeout=300).tokens
+                for r in _request_mix(prompts)}
+        for r in _request_mix(prompts):
+            got = ctrl.submit(
+                GenRequest(list(r.prompt), 8, id=f"c{r.id}",
+                           seed=r.seed, temperature=r.temperature,
+                           top_k=r.top_k)).result(timeout=300).tokens
+            assert outs[r.id] == got, r.id
+        snap = gw.snapshot()
+        assert snap["shed"] == {}, snap["shed"]
+        assert snap["routing"]["handoffs"] == len(prompts)
+    finally:
+        gw.drain(timeout=60)
+        ctrl.drain(timeout=60)
+        for h in https:
+            h.stop()
+
+
+# -------------------------------------------------------- prefix affinity
+
+
+def test_prefix_affinity_routes_to_warm_replica(tiny):
+    """The router sends a shared-prefix request to the replica whose
+    radix tree holds it, even when least-outstanding points the other
+    way — and with affinity OFF (the A/B control) the same skew sends
+    it to the cold replica."""
+    base = list(range(1, 21))
+
+    def run(affinity):
+        gw = Gateway([_mk_server(tiny), _mk_server(tiny)],
+                     prefix_affinity=affinity).start()
+        try:
+            gw.submit(GenRequest(list(base), 4,
+                                 id="warm")).result(timeout=300)
+            # skew load so least-outstanding prefers replica 1
+            gw.replicas[0].outstanding = 500
+            t = gw.submit(GenRequest(list(base) + [7, 8], 4,
+                                     id="probe"))
+            t.result(timeout=300)
+            return t.metrics["replica"], gw.snapshot()["routing"]
+        finally:
+            gw.drain(timeout=60)
+
+    replica, routing = run(True)
+    assert replica == 0 and routing["prefix_routed"] >= 1, routing
+    replica_off, routing_off = run(False)
+    assert replica_off == 1 and routing_off["prefix_routed"] == 0
+
+
+def test_prefix_affinity_ignores_trivial_matches(tiny):
+    """A sub-threshold match (shorter than _AFFINITY_MIN_TOKENS and
+    not the whole prompt) must NOT override load balance."""
+    gw = Gateway([_mk_server(tiny), _mk_server(tiny)]).start()
+    try:
+        gw.submit(GenRequest([5, 6, 7], 3, id="a")).result(timeout=300)
+        gw.replicas[0].outstanding = 500
+        # shares only the 3-token prefix -> below the 8-token floor
+        t = gw.submit(GenRequest([5, 6, 7] + list(range(30, 50)), 3,
+                                 id="b"))
+        t.result(timeout=300)
+        assert t.metrics["replica"] == 1
+    finally:
+        gw.drain(timeout=60)
+
+
+def test_prefix_store_radix_shape_stats(tiny):
+    """Satellite: PrefixStore.stats() carries nodes and max_depth (in
+    tokens), and they track inserts/splits/evictions."""
+    from tony_tpu.serve import PrefixStore
+
+    store = PrefixStore(1 << 20)
+    empty = store.stats()
+    assert empty["nodes"] == 1 and empty["max_depth"] == 0
+    row = {"k": np.zeros((4,), np.float32)}
+    store.insert(np.arange(10, dtype=np.int32), row)
+    st = store.stats()
+    assert st["nodes"] == 2 and st["max_depth"] == 10
+    # shares 4 tokens: the edge splits -> mid node + two leaves
+    seq = np.concatenate([np.arange(4), np.arange(50, 56)]) \
+        .astype(np.int32)
+    store.insert(seq, row)
+    st = store.stats()
+    assert st["nodes"] == 4 and st["max_depth"] == 10
+    assert store.match_len(np.arange(10, dtype=np.int32)) == 10
+    assert store.match_len(np.arange(4, dtype=np.int32)) == 4
+    assert store.has(seq) and not store.has(np.arange(3))

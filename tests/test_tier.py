@@ -1,0 +1,315 @@
+"""Host-RAM KV page tier (serve/tier.py + the engine's spill/page-in).
+
+Two layers of pinning, mirroring test_paged.py's discipline: the
+BITWISE round-trip property — device -> host -> device through
+``gather_pages`` / ``pages_to_host`` / ``pad_host_pages`` /
+``scatter_pages`` preserves every byte across the dtype x scan_layers
+x int8-KV-scale-leaf matrix — and the end-to-end exactness anchor: an
+engine whose prefix store spills to the tier and pages back in on a
+hit produces token-identical greedy output to a no-tier control,
+while actually registering spills, page-ins, and the extra prefix
+hits the tier exists for. Plus the kv_host_thrash alert rule's
+fire-once / resolve-after-2 semantics and its surfaces (alerts.jsonl
+row shape, /metrics presence). CPU-only.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.models import Transformer, TransformerConfig
+from tony_tpu.serve import PagePool, Request, Server
+from tony_tpu.serve.slots import (cache_batch_axis, gather_pages,
+                                  scatter_pages)
+from tony_tpu.serve.tier import (HostPageTier, decode_array,
+                                 decode_payload, encode_array,
+                                 encode_payload, pad_host_pages,
+                                 pages_to_host, payload_pages)
+
+
+def _model(dtype=jnp.float32, scan_layers=False, kv_int8=False):
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=64,
+                            dtype=dtype, scan_layers=scan_layers,
+                            kv_cache_quant=kv_int8,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _randomize_pool(pool: PagePool, seed: int = 0) -> None:
+    """Fill every paged leaf with random values of its own dtype, so
+    the round trip is checked over real bit patterns (int8 quant
+    codes, fp32 scales, bf16 K/V) instead of zeros."""
+    rng = np.random.default_rng(seed)
+
+    def rnd(path, leaf):
+        if cache_batch_axis(path, leaf) is None:
+            return leaf
+        if jnp.issubdtype(leaf.dtype, jnp.integer):
+            vals = rng.integers(-120, 120, size=leaf.shape)
+        else:
+            vals = rng.standard_normal(leaf.shape)
+        return jnp.asarray(vals).astype(leaf.dtype)
+
+    pool.cache = jax.tree_util.tree_map_with_path(rnd, pool.cache)
+
+
+def _page_bytes(tree, idx):
+    """The raw bytes of pages ``idx`` across every paged leaf — the
+    bitwise-comparison form (float views can hide NaN-payload bits;
+    bytes cannot)."""
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        ax = cache_batch_axis(path, leaf)
+        if ax is None:
+            continue
+        a = np.asarray(leaf)
+        out.append(np.take(a, idx, axis=ax).tobytes())
+    return out
+
+
+@pytest.mark.parametrize("dtype,scan_layers,kv_int8", [
+    (jnp.float32, False, False),
+    (jnp.float32, True, False),
+    (jnp.float32, False, True),
+    (jnp.float32, True, True),
+    (jnp.bfloat16, False, False),
+    (jnp.bfloat16, False, True),
+])
+def test_page_roundtrip_bitwise(dtype, scan_layers, kv_int8):
+    """device -> host -> device is BITWISE across the layout matrix:
+    gather three pages, slice to host numpy, zero-pad back to the pow2
+    bucket, scatter onto three OTHER pages — the destination pages'
+    bytes equal the sources' exactly, for every paged leaf (int8 K/V
+    codes and their fp32 scale leaves included)."""
+    model, params = _model(dtype, scan_layers, kv_int8)
+    pool = PagePool(model, params, n_pages=7, page_size=8)
+    _randomize_pool(pool)
+    src, dst = [1, 3, 4], [0, 2, 5]
+    before = _page_bytes(pool.cache, src)
+
+    payload = gather_pages(pool.cache, jnp.asarray(src + [4], jnp.int32))
+    assert payload_pages(payload) == 4
+    host = pages_to_host(payload, 3)          # the tier's stored form
+    padded = pad_host_pages(host, 4)          # back to the pow2 bucket
+    cache2 = scatter_pages(pool.cache, padded,
+                           jnp.asarray(dst + [pool.n_pages], jnp.int32))
+    assert _page_bytes(cache2, dst) == before
+    # the sentinel-padded row dropped: no fourth page was touched
+    untouched = [i for i in range(pool.n_pages) if i not in dst]
+    assert _page_bytes(cache2, untouched) == \
+        _page_bytes(pool.cache, untouched)
+
+
+def test_wire_codec_bitwise_including_bf16():
+    """The /v1/handoff wire form (base64 leaves) is bitwise too —
+    including the ml_dtypes bfloat16 numpy round trip."""
+    model, params = _model(jnp.bfloat16, False, True)
+    pool = PagePool(model, params, n_pages=4, page_size=8)
+    _randomize_pool(pool, seed=1)
+    payload = gather_pages(pool.cache, jnp.asarray([0, 2], jnp.int32))
+    doc = encode_payload(payload)
+    treedef = jax.tree_util.tree_structure(pool.cache)
+    back = decode_payload(doc, treedef)
+    for a, b in zip(jax.tree_util.tree_leaves(payload),
+                    jax.tree_util.tree_leaves(back)):
+        assert str(np.asarray(a).dtype) == str(b.dtype)
+        assert np.asarray(a).tobytes() == b.tobytes()
+    logits = np.asarray(jax.random.normal(jax.random.PRNGKey(0),
+                                          (1, 64)))
+    assert decode_array(encode_array(logits)).tobytes() \
+        == logits.tobytes()
+    # relay passthrough: an already-encoded doc is returned verbatim
+    assert encode_payload(doc) is doc
+
+
+def test_tier_requires_paged_and_prefix_store():
+    model, params = _model()
+    with pytest.raises(ValueError, match="prefix store"):
+        Server(model, params, batch_size=2, kv_host_mb=4.0)
+    with pytest.raises(ValueError, match="paged"):
+        Server(model, params, batch_size=2, paged=False,
+               prefix_cache_mb=2.0, kv_host_mb=4.0)
+
+
+def _run_workload(model, params, prompts, *, kv_host_mb,
+                  prefix_mb=0.025):
+    """Serial workload through one engine: distinct prompts that evict
+    each other out of a deliberately tiny device store, then exact
+    repeats of the first two."""
+    srv = Server(model, params, batch_size=2, paged=True, kv_page_size=8,
+                 prefix_cache_mb=prefix_mb, kv_host_mb=kv_host_mb,
+                 prefix_donate=False)
+    outs = []
+    for i, p in enumerate(prompts):
+        srv.submit(Request(list(p), 4, id=i))
+        for res in srv.run():
+            outs.append(res.tokens)
+    return outs, srv
+
+
+def test_spill_then_prefix_hit_greedy_parity():
+    """The e2e exactness anchor: a store squeezed to ~2 entries spills
+    evictions to the tier; resubmitting the spilled prompts pages them
+    back in (counters prove it) and greedy outputs stay byte-identical
+    to a no-tier control that re-prefilled everything."""
+    model, params = _model()
+    rng = np.random.default_rng(2)
+    distinct = [rng.integers(1, 64, size=24).tolist() for _ in range(3)]
+    workload = distinct + [distinct[0], distinct[1]]
+    outs_off, srv_off = _run_workload(model, params, workload,
+                                      kv_host_mb=0.0)
+    outs_on, srv_on = _run_workload(model, params, workload,
+                                    kv_host_mb=8.0)
+    assert outs_on == outs_off
+    st = srv_on.host_tier.stats()
+    assert st["spills"] >= 2 and st["page_ins"] >= 1, st
+    assert st["bytes_spilled"] > 0 and st["bytes_paged_in"] > 0, st
+    # the tier turned would-be re-prefills into prefix hits
+    assert srv_on.prefix_hit_tokens > srv_off.prefix_hit_tokens
+    counters = srv_on.counters()
+    assert counters["kv_host_page_ins"] == st["page_ins"]
+    assert counters["kv_host_spills"] == st["spills"]
+    # pool conservation still holds after all the page churn
+    pool = srv_on.slots.pool
+    assert pool.n_free + pool.n_used == pool.n_pages
+    assert (pool.refcount >= 0).all()
+
+
+def test_spill_roundtrip_int8_scan_layers_parity():
+    """The same spill-then-hit anchor on the gnarliest layout (int8 KV
+    with fp32 scale leaves + stacked scan_layers axes): the pin that
+    the tier's byte moves respect every leaf's geometry."""
+    model, params = _model(scan_layers=True, kv_int8=True)
+    rng = np.random.default_rng(3)
+    distinct = [rng.integers(1, 64, size=24).tolist() for _ in range(3)]
+    workload = distinct + [distinct[0]]
+    # int8 pages are ~3x smaller: squeeze the device store to ~2
+    # entries so evictions (and thus spills) actually happen
+    outs_off, _ = _run_workload(model, params, workload,
+                                kv_host_mb=0.0, prefix_mb=0.009)
+    outs_on, srv_on = _run_workload(model, params, workload,
+                                    kv_host_mb=8.0, prefix_mb=0.009)
+    assert outs_on == outs_off
+    assert srv_on.host_tier.stats()["page_ins"] >= 1
+
+
+def test_tier_lru_eviction_under_budget():
+    """The tier's own byte budget evicts LRU host entries — host RAM
+    is bounded too, just bigger."""
+    tier = HostPageTier(budget_bytes=2048)
+    a = {"x": np.zeros((1, 8, 2, 16), np.float32)}  # 1024 B
+    assert tier.insert(np.arange(8, dtype=np.int32), a, None)
+    assert tier.insert(np.arange(8, 16, dtype=np.int32), a, None)
+    assert tier.insert(np.arange(16, 24, dtype=np.int32), a, None)
+    st = tier.stats()
+    assert st["entries"] == 2 and st["evictions"] == 1, st
+    assert st["bytes"] <= 2048
+    # the freshest two survived
+    assert tier.match_len(np.arange(16, 24, dtype=np.int32)) == 8
+    assert tier.match_len(np.arange(8, dtype=np.int32)) == 0
+
+
+# ------------------------------------------------- kv_host_thrash alert
+
+
+def _signals(page_in_bytes, free=1, reserved=0, total=20, active=2):
+    return {
+        "kv_host_page_in_bytes": page_in_bytes,
+        "kv_pages_total": total,
+        "kv_pages_free": free,
+        "kv_pages_reserved": reserved,
+        "active_slots": active,
+        "depth": 0,
+        "now": 0.0,
+    }
+
+
+def test_kv_host_thrash_fires_once_and_resolves_after_two():
+    """Restore churn + pool pressure together fire ONCE; either side
+    clearing resolves after the standard 2 clean ticks."""
+    from tony_tpu.obs.alerts import AlertBus, KvHostThrashRule
+
+    bus = AlertBus([KvHostThrashRule(thrash_bytes=1000)])
+    assert bus.evaluate(_signals(0)) == []          # no delta yet
+    events = bus.evaluate(_signals(5000))           # +5000 B, pressured
+    assert [e.state for e in events] == ["firing"]
+    assert events[0].alert == "kv_host_thrash"
+    assert events[0].detail["page_in_bytes_tick"] == 5000
+    assert "free_after_reserve_frac" in events[0].detail
+    # still thrashing: active alert, no re-fire
+    assert bus.evaluate(_signals(10000)) == []
+    # churn continues but the pool is NOT pressured -> not thrash
+    assert bus.evaluate(_signals(15000, free=18)) == []
+    events = bus.evaluate(_signals(20000, free=18))
+    assert [e.state for e in events] == ["resolved"]
+    # pressure without churn never fires it
+    for _ in range(3):
+        assert bus.evaluate(_signals(20000)) == []
+
+
+def test_kv_host_thrash_row_and_metrics_presence(tmp_path):
+    """The alert's two export surfaces: a history alerts.jsonl row
+    with the standard shape, and the rule present in the /metrics
+    fired/resolved families of a LIVE gateway with the tier armed."""
+    import json
+
+    from tony_tpu.gateway import Gateway, GatewayHistory, GenRequest
+    from tony_tpu.obs.alerts import AlertBus, KvHostThrashRule
+    from tony_tpu.obs.export import prometheus_text
+
+    bus = AlertBus([KvHostThrashRule(thrash_bytes=1000)])
+    bus.evaluate(_signals(0), t_wall=100.0)
+    (event,) = bus.evaluate(_signals(5000), t_wall=101.0)
+    history = GatewayHistory(str(tmp_path))
+    history.record_alert(event.to_row())
+    history.close()
+    rows = [json.loads(line) for line in
+            open(history.job_dir + "/metrics/alerts.jsonl")]
+    assert rows[0]["alert"] == "kv_host_thrash"
+    assert rows[0]["state"] == "firing"
+    assert rows[0]["detail_page_in_bytes_tick"] == 5000
+
+    model, params = _model()
+    srv = Server(model, params, batch_size=2, paged=True,
+                 kv_page_size=8, prefix_cache_mb=0.025, kv_host_mb=4.0)
+    gw = Gateway([srv]).start()
+    try:
+        gw.submit(GenRequest([1, 2, 3], 2, id="m")).result(timeout=300)
+        text = prometheus_text(gw)
+        assert 'tony_alerts_fired_total{alert="kv_host_thrash"}' in text
+        assert 'tony_alerts_resolved_total{alert="kv_host_thrash"}' \
+            in text
+        assert "tony_kv_host_enabled 1" in text
+        assert "tony_kv_host_spills_total" in text
+        assert "tony_kv_host_bytes" in text
+    finally:
+        gw.drain(timeout=60)
+
+
+def test_prefix_summary_on_stats_replica_rows():
+    """Satellite: the per-replica radix summary (entries, bytes, the
+    new nodes/max_depth shape fields) exports under
+    /stats replicas[i].prefix, and kv_host rides next to it when the
+    tier is armed."""
+    from tony_tpu.gateway import Gateway, GenRequest
+
+    model, params = _model()
+    srv = Server(model, params, batch_size=2, paged=True,
+                 kv_page_size=8, prefix_cache_mb=1.0, kv_host_mb=4.0)
+    gw = Gateway([srv]).start()
+    try:
+        gw.submit(GenRequest(list(range(1, 20)), 3,
+                             id="p")).result(timeout=300)
+        row = gw.snapshot()["replicas"][0]
+        assert row["prefix"]["entries"] >= 1
+        assert row["prefix"]["nodes"] >= 2  # root + at least one edge
+        assert row["prefix"]["max_depth"] >= 19
+        assert "kv_host" in row and row["kv_host"]["budget_bytes"] > 0
+        assert gw.snapshot()["engine"]["kv_host"]["enabled"]
+    finally:
+        gw.drain(timeout=60)

@@ -101,6 +101,36 @@ def build_parser() -> argparse.ArgumentParser:
                    help="decode micro-steps fused per dispatch; 1 = "
                         "lowest per-token streaming latency, larger = "
                         "higher throughput")
+    p.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                   help="chunked prefill: max prompt tokens one "
+                        "admission dispatch may consume (quantized to "
+                        "the prefill bucket grid); long prompts "
+                        "prefill in chunks interleaved between decode "
+                        "rounds, capping co-tenant TPOT/TTFT "
+                        "starvation. 0 = monolithic (the default)")
+    p.add_argument("--roles", default="",
+                   help="disaggregated prefill/decode: "
+                        "'prefill=N,decode=M' splits the fleet into a "
+                        "prefill pool (admission + chunked prefill "
+                        "only; finished prompts hand off as page "
+                        "lists) and a decode pool (receives handoffs, "
+                        "decodes). Overrides --replicas to N+M; needs "
+                        "the paged KV cache; token-exact vs a "
+                        "generalist fleet (docs/SERVING.md)")
+    p.add_argument("--no-prefix-affinity", action="store_true",
+                   help="disable prefix-affinity routing (requests "
+                        "route to the replica whose radix tree holds "
+                        "their longest cached prefix; this flag is "
+                        "the A/B control — routing degrades to "
+                        "least-outstanding-tokens)")
+    p.add_argument("--kv-host-mb", type=float, default=0.0,
+                   help="host-RAM KV page tier byte budget per "
+                        "replica: evicted prefix-store pages spill "
+                        "device->host and page back in on a prefix "
+                        "hit (bitwise round trip), so prefix reuse "
+                        "stops being bounded by HBM. 0 disables; "
+                        "needs paged KV + a prefix store; traffic "
+                        "shows on /stats under engine.kv_host")
     p.add_argument("--prefix-cache-mb", type=float, default=64.0,
                    help="per-replica byte budget for the prefix "
                         "KV-cache store (radix reuse of shared prompt "
@@ -243,6 +273,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="kv_pages_pressure alert: free-after-"
                         "reservation fraction of the page pool under "
                         "which live load counts as pressure")
+    p.add_argument("--alert-host-thrash-bytes", type=float,
+                   default=float(1 << 20),
+                   help="kv_host_thrash alert: host-tier page-in "
+                        "bytes per evaluation tick that, together "
+                        "with kv_pages_pressure, count as "
+                        "spill/restore churn")
     p.add_argument("--alert-ttft-slo", type=float, default=0.0,
                    help="ttft_slo_burn alert: TTFT SLO in seconds "
                         "(>10%% of a tick's completions over it "
@@ -292,6 +328,16 @@ def server_factory(args, model, params, eos):
     paged_kw = resolve_paged_kv(args, model, args.serve_batch,
                                 n_replicas=ceiling)
 
+    # the host tier spills EVICTED prefix-store entries: with the
+    # store resolved off there is nothing to spill — downgrade loudly
+    # instead of letting Server() refuse the whole boot
+    kv_host_mb = getattr(args, "kv_host_mb", 0.0)
+    if kv_host_mb > 0 and prefix_mb <= 0:
+        logging.getLogger(__name__).warning(
+            "--kv-host-mb ignored: the host page tier needs a prefix "
+            "store (--prefix-cache-mb > 0)")
+        kv_host_mb = 0.0
+
     def make(index: int):
         return Server(model, params, batch_size=args.serve_batch,
                       eos_id=eos, chunk_steps=args.chunk_steps,
@@ -300,9 +346,36 @@ def server_factory(args, model, params, eos):
                       speculate_k=args.speculate_k,
                       fault_plan=FaultPlan.from_env(replica=index),
                       hbm_gbps=getattr(args, "hbm_gbps", 0.0),
+                      prefill_chunk_tokens=getattr(
+                          args, "prefill_chunk_tokens", 0),
+                      kv_host_mb=kv_host_mb,
                       **paged_kw)
 
     return make
+
+
+def parse_roles(spec: str) -> list | None:
+    """``--roles prefill=N,decode=M`` -> the per-replica role list
+    (prefill replicas first — their fleet indices are stable, so
+    TONY_SERVE_FAULTS addressing and log lines stay readable)."""
+    if not spec.strip():
+        return None
+    counts = {"prefill": 0, "decode": 0}
+    for part in spec.split(","):
+        name, sep, n = part.strip().partition("=")
+        if not sep or name not in counts:
+            raise SystemExit(
+                f"--roles expects 'prefill=N,decode=M', got {spec!r}")
+        try:
+            counts[name] = int(n)
+        except ValueError:
+            raise SystemExit(f"--roles count {n!r} is not an integer") \
+                from None
+    if counts["prefill"] < 1 or counts["decode"] < 1:
+        raise SystemExit("--roles needs at least one prefill AND one "
+                         "decode replica")
+    return ["prefill"] * counts["prefill"] \
+        + ["decode"] * counts["decode"]
 
 
 def agent_argv(args, index: int) -> list:
@@ -311,7 +384,10 @@ def agent_argv(args, index: int) -> list:
     exactly like an in-process replica would have been."""
     argv = ["--serve-batch", str(args.serve_batch),
             "--chunk-steps", str(args.chunk_steps),
+            "--prefill-chunk-tokens",
+            str(getattr(args, "prefill_chunk_tokens", 0)),
             "--prefix-cache-mb", str(args.prefix_cache_mb),
+            "--kv-host-mb", str(getattr(args, "kv_host_mb", 0.0)),
             "--speculate-k", str(args.speculate_k),
             "--kv-page-size", str(args.kv_page_size),
             "--kv-pages", str(args.kv_pages),
@@ -401,6 +477,15 @@ def build_gateway(args, model, params, eos, *, metrics_store=None):
 
     agents = [a.strip() for a in getattr(args, "agents", "").split(",")
               if a.strip()]
+    # role split sizes the fleet itself: prefill=N,decode=M means
+    # exactly N+M replicas, whatever --replicas said
+    roles = parse_roles(getattr(args, "roles", ""))
+    if roles:
+        if agents and len(agents) != len(roles):
+            raise SystemExit(
+                f"--roles names {len(roles)} replicas but --agents "
+                f"lists {len(agents)}")
+        args.replicas = len(roles)
     # TONY_SERVE_FAULTS arms deterministic fault injection per replica
     # (serve/faults.py) — the chaos-smoke hook; unset = None = zero cost
     if agents:
@@ -449,7 +534,14 @@ def build_gateway(args, model, params, eos, *, metrics_store=None):
                                                0.15),
                        "ttft_slo_s": getattr(args, "alert_ttft_slo",
                                              0.0),
-                   })
+                       "host_thrash_bytes": getattr(
+                           args, "alert_host_thrash_bytes",
+                           float(1 << 20)),
+                   },
+                   roles=roles,
+                   prefix_affinity=not getattr(args,
+                                               "no_prefix_affinity",
+                                               False))
 
 
 def build_scaler(args, gateway, model, params, eos):
@@ -460,6 +552,13 @@ def build_scaler(args, gateway, model, params, eos):
     max_replicas = getattr(args, "autoscale_max", 0)
     if not max_replicas:
         return None
+    if getattr(args, "roles", "").strip():
+        # a scaler-minted replica would need a role assignment policy
+        # (grow which pool?) this PR does not take a position on —
+        # refuse loudly instead of growing a roleless generalist into
+        # a fleet whose routing would never send it work
+        raise SystemExit("--autoscale-max cannot be combined with "
+                         "--roles (fixed role-split fleets only)")
     from tony_tpu.gateway import AutoScaler, ThreadBackend
 
     boot = max(1, args.replicas)

@@ -45,7 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-batch", type=int, default=4,
                    help="cache slots")
     p.add_argument("--chunk-steps", type=int, default=1)
+    p.add_argument("--prefill-chunk-tokens", type=int, default=0)
     p.add_argument("--prefix-cache-mb", type=float, default=64.0)
+    p.add_argument("--kv-host-mb", type=float, default=0.0)
     p.add_argument("--speculate-k", type=int, default=0)
     p.add_argument("--kv-page-size", type=int, default=0)
     p.add_argument("--kv-pages", type=int, default=0)

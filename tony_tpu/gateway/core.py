@@ -271,6 +271,14 @@ class Ticket:
         self.tier = DEFAULT_TIER
         self.tenant: str | None = None
         self.queue_pos = -1
+        # disaggregation state (roles mode only): ``phase`` routes the
+        # ticket to its pool ("prefill" until the handoff, "decode"
+        # after; None = roleless fleet); ``handoff`` carries the page
+        # payload between pools; ``_prefill_meta`` the prefill half's
+        # stats, merged into the final request metrics
+        self.phase: str | None = None
+        self.handoff: Any = None
+        self._prefill_meta: dict | None = None
         self._wfq_key: tuple | None = None  # set by WFQueue.push
         self.metrics: dict | None = None  # the done-event record
         self.events: queue.Queue = queue.Queue()
@@ -369,6 +377,11 @@ class _Replica:
         self.index = index
         self.server = server
         self.gateway = gateway
+        # disaggregation role (gateway ``roles=``): "any" = generalist
+        # (the default), "prefill" = admission/chunked-prefill only
+        # (requests leave as page-list handoffs), "decode" = receives
+        # handoffs and decodes them
+        self.role = "any"
         # REMOTE replicas (gateway/remote.RemoteServer): the server is
         # a stub over an agent on another host — bind its lease
         # machinery into the gateway's failure funnel, and carry the
@@ -594,14 +607,18 @@ class _Replica:
         server = self.server  # single read vs concurrent retirement
         if server is None:  # retired: engine released
             return False
-        return bool(server.slots.n_active or server.n_pending)
+        # n_active, not slots.n_active: a slot parked mid-chunked-
+        # prefill holds a request the loop must keep driving
+        return bool(server.n_active or server.n_pending)
 
     def _admit_from_queue(self, epoch: int) -> None:
         """Move tickets into the engine, AT MOST as many as there are
         free slots — the deadline check runs at the moment a slot is
         genuinely available, so an expired request is shed having never
         occupied one (and never cost a prefill dispatch)."""
-        free = len(self.server.slots.free_slots()) - self.server.n_pending
+        free = len(self.server.slots.free_slots()) \
+            - self.server.n_pending \
+            - getattr(self.server, "n_prefilling", 0)
         while free > 0:
             with self.cv:
                 ticket = self.queue.pop()  # the WFQ decision: least
@@ -623,7 +640,13 @@ class _Replica:
                 self.server.submit(Request(
                     list(req.prompt), req.max_new_tokens,
                     temperature=req.temperature, top_k=req.top_k,
-                    seed=req.seed, id=engine_id))
+                    seed=req.seed, id=engine_id,
+                    # role-split plumbing: a prefill-pool replica runs
+                    # admission/prefill only (the result is a page
+                    # handoff); a ticket carrying a handoff payload
+                    # admits it instead of prefilling
+                    prefill_only=self.role == "prefill",
+                    handoff=ticket.handoff))
             except QueueFull:
                 # engine bound hit (shouldn't happen: we feed at most
                 # free-slot many) — put it back and stop admitting.
@@ -709,7 +732,9 @@ class _Replica:
             tickets = dict(self._tickets)
         key = (self.index, epoch)
         for rec in new:
-            if rec.kind in ("prefill", "hit_admit", "cow_admit"):
+            if rec.kind in ("prefill", "prefill_chunk", "hit_admit",
+                            "cow_admit", "handoff_admit",
+                            "handoff_out"):
                 targets = [tickets.get(rec.request_id)]
             else:
                 targets = [tickets.get(eid)
@@ -761,6 +786,13 @@ class _Replica:
                     # quarantine_after
             if ticket is None:
                 continue
+            if res.finish_reason == "handoff" \
+                    and getattr(res, "handoff", None) is not None:
+                # the prefill pool's half is done: not a completion —
+                # the ticket moves to a decode replica carrying the
+                # page payload, and the client sees nothing yet
+                self.gateway._relay_handoff(self, ticket, res, now)
+                continue
             # the whole sequence as one absolute window: _emit_tokens
             # dedups past the client's cursor, so this emits exactly
             # the un-streamed tail (all of it, for unary requests)
@@ -773,7 +805,8 @@ class _Replica:
             res = type(res)(ticket.request.id, res.prompt, res.tokens,
                             res.finish_reason, res.prefix_hit_tokens,
                             res.prefill_tokens_saved,
-                            res.drafted, res.accepted)
+                            res.drafted, res.accepted,
+                            getattr(res, "prefill_chunks", 0))
             if ticket.trace is not None:
                 ticket.trace.end_attempt(now, outcome="done")
                 ticket.trace.finish(
@@ -793,7 +826,13 @@ class _Replica:
         ttft = (ticket.t_first - ticket.t_submit) if ticket.t_first else 0.0
         tpot = ((now - ticket.t_first) / (n_out - 1)
                 if n_out > 1 and ticket.t_first else 0.0)
+        # role-split requests: the prefill half's savings/chunk counts
+        # rode over in the handoff relay; the decode-side Result knows
+        # nothing about them
+        meta = ticket._prefill_meta or {}
         return {
+            **({"prefill_replica": meta["prefill_replica"]}
+               if meta else {}),
             "id": ticket.request.id,
             "replica": self.index,
             # WHICH MACHINE served it (agent address for remote
@@ -808,8 +847,12 @@ class _Replica:
             "e2e_ms": round((now - ticket.t_submit) * 1e3, 3),
             "tokens_in": len(res.prompt),
             "tokens_out": n_out,
-            "prefix_hit_tokens": res.prefix_hit_tokens,
-            "prefill_tokens_saved": res.prefill_tokens_saved,
+            "prefix_hit_tokens": meta.get("prefix_hit_tokens",
+                                          res.prefix_hit_tokens),
+            "prefill_tokens_saved": meta.get("prefill_tokens_saved",
+                                             res.prefill_tokens_saved),
+            "prefill_chunks": meta.get(
+                "prefill_chunks", getattr(res, "prefill_chunks", 0)),
             "drafted": res.drafted,
             "accepted": res.accepted,
             "draft_hit_rate": round(res.draft_hit_rate, 4),
@@ -943,6 +986,7 @@ class _Replica:
         # attribute concurrently, and a check-then-access would race
         out = {
             "replica": self.index,
+            "role": self.role,
             "queued": self.n_queued,
             "enqueued": self.enqueued,
             "active_slots": server.slots.n_active
@@ -974,6 +1018,20 @@ class _Replica:
         ts = getattr(server, "transport_stats", None)
         if ts is not None:
             out["transport"] = ts()
+        # the per-replica radix summary (nested — the MetricsStore
+        # numeric filter skips it): entry/byte/shape counts the
+        # affinity router's decisions can be audited against. Behind
+        # include_dispatch like the timeline block: the nodes/depth
+        # walk is O(tree) and must not run on every completion's
+        # metrics push. Remote stubs carry ``prefix = True`` (a
+        # bool), hence the stats() duck check.
+        if include_dispatch and server is not None:
+            prefix = getattr(server, "prefix", None)
+            if prefix is not None and hasattr(prefix, "stats"):
+                out["prefix"] = prefix.stats()
+            tier = getattr(server, "host_tier", None)
+            if tier is not None:
+                out["kv_host"] = tier.stats()
         # per-dispatch timeline aggregates (kind -> count/ms/compile
         # split/tokens) — opt-in: snapshot() wants it, but the
         # per-request MetricsStore push (whose numeric filter would
@@ -1032,6 +1090,10 @@ class _Stats:
         # direct add_replica/remove_replica call)
         self.replicas_added = 0
         self.replicas_removed = 0
+        # disaggregation (ISSUE-12): routing decisions won by the
+        # prefix-affinity probe, and prefill->decode handoffs relayed
+        self.prefix_routed = 0
+        self.handoffs = 0
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -1195,9 +1257,40 @@ class Gateway:
                  tenant_quota_rate: float = 0.0,
                  tenant_quota_burst: float = 0.0,
                  alerts: bool = True, alert_interval_s: float = 1.0,
-                 alert_thresholds: dict | None = None):
+                 alert_thresholds: dict | None = None,
+                 roles: list | None = None,
+                 prefix_affinity: bool = True):
         if not servers:
             raise ValueError("gateway needs at least one replica server")
+        # disaggregated prefill/decode (ISSUE-12): ``roles`` names each
+        # replica's pool ("prefill" runs admission/chunked-prefill only
+        # and hands finished page lists to "decode" replicas). The
+        # handoff unit is a page list, so every role-split replica must
+        # serve the paged cache.
+        self.roles = list(roles) if roles else None
+        if self.roles:
+            if len(self.roles) != len(servers):
+                raise ValueError(
+                    f"roles names {len(self.roles)} replicas, gateway "
+                    f"has {len(servers)}")
+            bad = set(self.roles) - {"prefill", "decode"}
+            if bad:
+                raise ValueError(f"unknown roles {sorted(bad)} "
+                                 "(valid: prefill, decode)")
+            if "prefill" not in self.roles or "decode" not in self.roles:
+                raise ValueError("role split needs at least one "
+                                 "prefill AND one decode replica")
+            unpaged = [i for i, s in enumerate(servers)
+                       if not getattr(s, "paged", False)]
+            if unpaged:
+                raise ValueError(
+                    f"role split needs the paged KV cache on every "
+                    f"replica (unpaged: {unpaged})")
+        # prefix-affinity routing: send a request to the replica whose
+        # radix tree holds its longest cached prefix (generalizes crc32
+        # session affinity; degrades to least-outstanding). Off is the
+        # A/B control for bench extras.disagg.
+        self.prefix_affinity = bool(prefix_affinity)
         # admission tiers + quotas (gateway/admission.py): weights may
         # arrive as the CLI's "name=w,..." spec; quotas default OFF
         if isinstance(tier_weights, str):
@@ -1211,6 +1304,9 @@ class Gateway:
                 f"{DEFAULT_TIER!r} (got {sorted(self.tier_weights)})")
         self.quotas = TenantQuotas(tenant_quota_rate, tenant_quota_burst)
         self.replicas = [_Replica(i, s, self) for i, s in enumerate(servers)]
+        if self.roles:
+            for replica, role in zip(self.replicas, self.roles):
+                replica.role = role
         # model bound captured once: replicas share the model config,
         # and a retired replica's released engine must not be the
         # thing submit() validates against
@@ -1483,6 +1579,11 @@ class Gateway:
                                  for c in counts),
             "kv_pages_reserved": sum(c.get("kv_pages_reserved", 0)
                                      for c in counts),
+            # host-tier restore traffic (cumulative bytes): the
+            # kv_host_thrash alert diffs this per tick against the
+            # pressure condition above
+            "kv_host_page_in_bytes": sum(
+                c.get("kv_host_page_in_bytes", 0) for c in counts),
         }
 
     def alert_signals(self) -> dict:
@@ -1643,6 +1744,9 @@ class Gateway:
             ticket = Ticket(request, ttl, on_event)
             ticket.tier = tier
             ticket.tenant = request.tenant
+            # role-split fleets: every new request enters through the
+            # prefill pool; the handoff relay moves it to decode
+            ticket.phase = "prefill" if self.roles else None
             if self.traces is not None:
                 t0 = request.t_receive if request.t_receive is not None \
                     else ticket.t_submit
@@ -1660,7 +1764,7 @@ class Gateway:
             tried: set[int] = set()
             while True:
                 try:
-                    replica = self._route(request, tried)
+                    replica = self._route(ticket, tried)
                 except NoHealthyReplicas:
                     self.quotas.refund(request.tenant, cost)  # zero
                     # service delivered: the bucket must not pay
@@ -1686,34 +1790,86 @@ class Gateway:
             self.stats.accepted += 1
         return ticket
 
-    def _route(self, request: GenRequest,
+    # a prefix-affinity match shorter than this (and shorter than the
+    # whole prompt) is not worth overriding load balance for: seeding
+    # a few tokens saves less than an imbalanced queue costs
+    _AFFINITY_MIN_TOKENS = 8
+
+    def _route(self, ticket: Ticket,
                excluded: set | frozenset = frozenset()) -> _Replica:
-        """Session affinity when asked (degraded to least-outstanding
-        when the pinned replica is down — affinity is a cache
-        preference, not a correctness requirement); least outstanding
-        tokens otherwise (ties -> lowest index, deterministic). Only
-        HEALTHY replicas outside ``excluded`` are candidates; none left
-        raises ``NoHealthyReplicas`` (503, retriable)."""
+        """Routing, in preference order: (1) the ticket's ROLE pool
+        (role-split fleets: "prefill" tickets only ever land on
+        prefill replicas, handoffs on decode replicas); (2) PREFIX
+        AFFINITY — the replica whose radix tree (device store or host
+        tier) holds the longest cached prefix of this prompt, the
+        generalization of session affinity that makes a fleet-wide hot
+        system prompt prefill ONCE instead of once per replica; (3)
+        crc32 session affinity when the request asks; (4) least
+        outstanding tokens (ties -> lowest index, deterministic).
+        Every preference degrades to the next — affinity is a cache
+        preference, never a correctness requirement. Only HEALTHY
+        replicas outside ``excluded`` are candidates; none left raises
+        ``NoHealthyReplicas`` (503, retriable)."""
+        request, phase = ticket.request, ticket.phase
         healthy = [r for r in self.replicas
                    if r.state == HEALTHY and not r.retiring
-                   and r.index not in excluded]
+                   and r.index not in excluded
+                   and (phase is None or r.role == phase)]
         if not healthy:
+            pool = f"{phase} " if phase else ""
             raise NoHealthyReplicas(
-                "no healthy replica (states: "
+                f"no healthy {pool}replica (states: "
                 + ", ".join(r.state + ("/retiring" if r.retiring else "")
                             for r in self.replicas if not r.retired) + ")")
+        if self.prefix_affinity and phase != "decode":
+            pinned = self._prefix_match(request.prompt, healthy)
+            if pinned is not None:
+                with self.stats.lock:
+                    self.stats.prefix_routed += 1
+                return pinned
         if request.session is not None:
             # affinity hashes over the CURRENT membership (retired
-            # replicas excluded): a scale event remaps sessions — a
-            # cache preference reshuffle, never a correctness issue
+            # replicas excluded; role-split fleets hash within the
+            # ticket's pool): a scale event remaps sessions — a cache
+            # preference reshuffle, never a correctness issue
             candidates = [r for r in self.replicas
-                          if not r.retired and not r.retiring]
+                          if not r.retired and not r.retiring
+                          and (phase is None or r.role == phase)]
             key = zlib.crc32(str(request.session).encode())
             pinned = candidates[key % len(candidates)] if candidates \
                 else None
             if pinned in healthy:
                 return pinned
         return min(healthy, key=lambda r: (r.outstanding, r.index))
+
+    def _prefix_match(self, prompt: list,
+                      healthy: list) -> _Replica | None:
+        """The affinity probe: ask each candidate's engine for its
+        longest cached prefix of ``prompt`` (a lock-protected radix
+        walk, no device work, no counters moved) and pin to the
+        longest match when it is worth it. Ties break by least
+        outstanding work, so two equally-warm replicas still balance.
+        Remote stubs don't expose a local radix (a per-request network
+        probe would cost more than it saves) and simply never win."""
+        best, best_len = None, 0
+        for r in healthy:
+            probe = getattr(r.server, "prefix_match_len", None)
+            if probe is None:
+                continue
+            try:
+                n = probe(prompt)
+            except Exception:
+                log.exception("prefix affinity probe failed on "
+                              "replica %d", r.index)
+                continue
+            if n > best_len or (n == best_len and n > 0
+                                and best is not None
+                                and r.outstanding < best.outstanding):
+                best, best_len = r, n
+        if best is None or best_len < min(len(prompt),
+                                          self._AFFINITY_MIN_TOKENS):
+            return None
+        return best
 
     # ------------------------------------------------------- supervision
 
@@ -1844,8 +2000,7 @@ class Gateway:
         tried: set[int] = set()
         while True:
             try:
-                target = self._route(ticket.request,
-                                     ticket.excluded | tried)
+                target = self._route(ticket, ticket.excluded | tried)
             except NoHealthyReplicas:
                 self._shed_ticket(
                     replica, ticket, 503,
@@ -1859,6 +2014,51 @@ class Gateway:
                 continue
             with self.stats.lock:
                 self.stats.failovers += 1
+            return
+
+    def _relay_handoff(self, replica: _Replica, ticket: Ticket, res,
+                       now: float) -> None:
+        """The disaggregation hinge, run on the PREFILL replica's
+        thread out of ``_deliver``: the prefill half finished (pages +
+        last-position logits in ``res.handoff``), so move the ticket
+        to a decode replica carrying the payload. Not a failover (no
+        attempt charged, no exclusion — the prefill engine did its job)
+        and not a completion (the client has seen nothing). A fleet
+        with no healthy decode replica sheds 503, retriable."""
+        with self.stats.lock:
+            self.stats.handoffs += 1
+        ticket._prefill_meta = {
+            "prefill_replica": replica.index,
+            "prefix_hit_tokens": res.prefix_hit_tokens,
+            "prefill_tokens_saved": res.prefill_tokens_saved,
+            "prefill_chunks": getattr(res, "prefill_chunks", 0),
+        }
+        ticket.handoff = res.handoff
+        ticket.phase = "decode"
+        ticket.state = QUEUED
+        ticket.replica = None
+        if ticket.trace is not None:
+            ticket.trace.end_attempt(now, outcome="handoff")
+            ticket.trace.add("handoff", now, attempt=False,
+                             from_replica=replica.index,
+                             n_tokens=res.handoff.get("n_tokens"))
+        tried: set[int] = set()
+        while True:
+            try:
+                target = self._route(ticket, ticket.excluded | tried)
+            except NoHealthyReplicas:
+                self._shed_ticket(
+                    replica, ticket, 503,
+                    "no healthy decode replica to receive the "
+                    "prefill handoff", exc=NoHealthyReplicas)
+                return
+            try:
+                # force=True: the drain promise covers a request whose
+                # prefill half already ran, same as a stolen ticket
+                target.enqueue(ticket, force=True)
+            except (GatewayClosed, _ReplicaUnhealthy):
+                tried.add(target.index)
+                continue
             return
 
     def _shed_ticket(self, replica: _Replica, ticket: Ticket,
@@ -2078,6 +2278,14 @@ class Gateway:
         out["queue"] = queue
         out["engine"] = self._engine_summary(rows, live)
         with self.stats.lock:
+            out["routing"] = {
+                "prefix_affinity": self.prefix_affinity,
+                "prefix_routed": self.stats.prefix_routed,
+                "handoffs": self.stats.handoffs,
+                "roles": {r.index: r.role for r in live}
+                if self.roles else None,
+            }
+        with self.stats.lock:
             tiers = sorted(set(self.stats.completed_by_tier)
                            | set(self.stats.shed_by_tier)
                            | set(queue["by_tier"]))
@@ -2184,6 +2392,35 @@ class Gateway:
                 "bytes": total("prefix_bytes"),
                 "budget_bytes": total("prefix_budget_bytes"),
                 "evictions": total("prefix_evictions"),
+            },
+            # disaggregation (ISSUE-12): chunked-prefill volume and
+            # prefill->decode handoffs, fleet-wide
+            "prefill_chunks": {
+                "enabled": any(getattr(s, "prefill_chunk", 0) > 0
+                               for s in servers),
+                "dispatches": total("prefill_chunk_dispatches"),
+                "requests": total("prefill_chunked_requests"),
+            },
+            "handoffs": {
+                "out": total("handoffs_out"),
+                "in": total("handoffs_in"),
+            },
+            # the host-RAM page tier (serve/tier.py): spill/restore
+            # volume and residency — page_ins > 0 under prefix traffic
+            # is the tier paying for itself, page_ins high while
+            # kv_pages is pressured is the kv_host_thrash alert
+            "kv_host": {
+                "enabled": any(getattr(s, "host_tier", None) is not None
+                               for s in servers),
+                "entries": total("kv_host_entries"),
+                "bytes": total("kv_host_bytes"),
+                "budget_bytes": total("kv_host_budget_bytes"),
+                "tokens": total("kv_host_tokens"),
+                "spills": total("kv_host_spills"),
+                "page_ins": total("kv_host_page_ins"),
+                "spill_bytes": total("kv_host_spill_bytes"),
+                "page_in_bytes": total("kv_host_page_in_bytes"),
+                "evictions": total("kv_host_evictions"),
             },
             # the paged-KV utilization block (ROADMAP 4's fixed-shape-
             # waste sensor): how many pages exist / hold tokens / are

@@ -422,8 +422,28 @@ class RemoteServer:
             "temperature": request.temperature, "top_k": request.top_k,
             "seed": request.seed, "epoch": self.epoch,
         }
+        path = "/v1/submit"
+        if request.prefill_only:
+            doc["prefill_only"] = True
+        if request.handoff is not None:
+            # the decode pool's remote intake: ship the page payload
+            # base64-leaf-encoded (a pure-router gateway holds it in
+            # wire form already; a local prefill replica's device
+            # pytree is encoded here) — the agent's engine scatters it
+            # into its own pool and the round trip is bitwise
+            from tony_tpu.serve.tier import encode_array, encode_payload
+
+            pages = request.handoff["pages"]
+            logits = request.handoff["logits"]
+            doc["handoff"] = {
+                "n_tokens": int(request.handoff["n_tokens"]),
+                "pages": encode_payload(pages),
+                "logits": logits if isinstance(logits, dict)
+                else encode_array(logits),
+            }
+            path = "/v1/handoff"
         try:
-            resp = self.transport.call("POST", "/v1/submit", doc,
+            resp = self.transport.call("POST", path, doc,
                                        epoch=self.epoch,
                                        request=request.id)
         except AgentHTTPError as e:

@@ -33,6 +33,10 @@ Default rules (thresholds overridable via ``default_rules()``):
 | ``kv_pages_pressure`` | free-after-reservation KV pages under        |
 |                       | ``kv_free_frac`` of the pool while work is   |
 |                       | live/queued                                  |
+| ``kv_host_thrash``    | host-tier page-in bytes per tick over        |
+|                       | ``host_thrash_bytes`` WHILE the pool is also |
+|                       | pressured (spill/restore churn: the HBM pool |
+|                       | is undersized for the prefix working set)    |
 | ``ttft_slo_burn``     | >``burn_frac`` of a tick's completions over  |
 |                       | ``ttft_slo_s`` (histogram delta; off at 0)   |
 | ``breaker_flap``      | >= ``flap_failures`` replica failures inside |
@@ -140,6 +144,43 @@ class KvPagesPressureRule(Rule):
                     "kv_pages_reserved":
                         signals.get("kv_pages_reserved", 0)}
         return None
+
+
+class KvHostThrashRule(Rule):
+    """Host-tier RESTORE churn while the device pool is already under
+    pressure: page-in bytes this tick over ``thrash_bytes`` AND the
+    ``kv_pages_pressure`` condition simultaneously true. Each signal
+    alone is healthy — page-ins are the tier paying for itself, and
+    pressure is the reservation gate doing its job — but together they
+    mean spill -> restore -> spill churn: the HBM pool is undersized
+    for the live prefix working set (raise --kv-pages or lower
+    --prefix-cache-mb). Reuses the pressure rule's own predicate (same
+    thresholds) so this rule and that one can never disagree about
+    what \"pressured\" means."""
+
+    def __init__(self, thrash_bytes: float = float(1 << 20),
+                 kv_free_frac: float = 0.15, **kw):
+        super().__init__("kv_host_thrash",
+                         message="host page tier thrashing", **kw)
+        self.thrash_bytes = thrash_bytes
+        self._pressure = KvPagesPressureRule(kv_free_frac=kv_free_frac)
+        self._prev: float | None = None  # cumulative page-in bytes
+
+    def evaluate(self, signals):
+        total = signals.get("kv_host_page_in_bytes")
+        prev, self._prev = self._prev, total
+        if total is None or prev is None:
+            return None
+        delta = total - prev
+        if delta < self.thrash_bytes:
+            return None
+        pressure = self._pressure.evaluate(signals)
+        if pressure is None:
+            return None
+        return {"page_in_bytes_tick": delta,
+                "threshold_bytes": self.thrash_bytes,
+                "free_after_reserve_frac":
+                    pressure["free_after_reserve_frac"]}
 
 
 class TtftSloBurnRule(Rule):
@@ -294,6 +335,9 @@ def default_rules(thresholds: dict | None = None) -> list[Rule]:
     return [
         QueueAgingRule(queue_wait_s=t.get("queue_wait_s", 5.0)),
         KvPagesPressureRule(kv_free_frac=t.get("kv_free_frac", 0.15)),
+        KvHostThrashRule(
+            thrash_bytes=t.get("host_thrash_bytes", float(1 << 20)),
+            kv_free_frac=t.get("kv_free_frac", 0.15)),
         TtftSloBurnRule(ttft_slo_s=t.get("ttft_slo_s", 0.0),
                         burn_frac=t.get("burn_frac", 0.10)),
         BreakerFlapRule(flap_failures=t.get("flap_failures", 2),

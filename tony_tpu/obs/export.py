@@ -70,6 +70,25 @@ _REPLICA_COUNTERS = (
      "Prompt tokens seeded from the prefix store"),
     ("prefill_tokens_saved", "tony_engine_prefill_tokens_saved_total",
      "Bucketed prefill work skipped via prefix reuse"),
+    ("prefill_chunk_dispatches", "tony_engine_prefill_chunks_total",
+     "Chunked-prefill dispatches run (budget-bounded prompt windows)"),
+    ("prefill_chunked_requests",
+     "tony_engine_prefill_chunked_requests_total",
+     "Requests whose prompt prefilled in more than one chunk"),
+    ("handoffs_out", "tony_engine_handoffs_out_total",
+     "Prefill-pool requests handed off as page lists"),
+    ("handoffs_in", "tony_engine_handoffs_in_total",
+     "Handoff payloads admitted by this (decode-pool) replica"),
+    ("kv_host_spills", "tony_kv_host_spills_total",
+     "Prefix-store entries spilled device->host into the page tier"),
+    ("kv_host_page_ins", "tony_kv_host_page_ins_total",
+     "Host-tier entries restored host->device on a prefix hit"),
+    ("kv_host_spill_bytes", "tony_kv_host_spill_bytes_total",
+     "Bytes copied device->host by tier spills"),
+    ("kv_host_page_in_bytes", "tony_kv_host_page_in_bytes_total",
+     "Bytes restored host->device by tier page-ins"),
+    ("kv_host_evictions", "tony_kv_host_evictions_total",
+     "Host-tier entries evicted by its own byte budget"),
     ("completed", "tony_replica_completed_total",
      "Requests delivered by this replica"),
     ("shed", "tony_replica_shed_total",
@@ -122,6 +141,15 @@ _REPLICA_GAUGES = (
      "Bytes of KV pool resident (allocated pages x page bytes)"),
     ("kv_tokens_resident", "tony_kv_tokens_resident",
      "Tokens resident in allocated pages (live slots + prefix store)"),
+    # host-RAM page tier (absent with --kv-host-mb 0)
+    ("kv_host_entries", "tony_kv_host_entries",
+     "Host page-tier entries resident"),
+    ("kv_host_bytes", "tony_kv_host_bytes",
+     "Host page-tier bytes resident"),
+    ("kv_host_budget_bytes", "tony_kv_host_budget_bytes",
+     "Host page-tier byte budget (--kv-host-mb)"),
+    ("kv_host_tokens", "tony_kv_host_tokens",
+     "Tokens covered by host page-tier entries"),
 )
 
 # the per-replica ``transport`` block (remote replicas only —
@@ -290,6 +318,21 @@ def prometheus_text(gateway) -> str:
           1 if eng["spec"]["enabled"] else 0)
     gauge("tony_kv_paged_enabled", "1 when the paged KV cache is on",
           1 if eng.get("kv_pages", {}).get("enabled") else 0)
+    gauge("tony_kv_host_enabled",
+          "1 when the host-RAM KV page tier is on",
+          1 if eng.get("kv_host", {}).get("enabled") else 0)
+
+    # disaggregated prefill/decode (ISSUE-12): routing + handoff flow
+    routing = snap.get("routing") or {}
+    gauge("tony_prefix_affinity_enabled",
+          "1 when prefix-affinity routing is on",
+          1 if routing.get("prefix_affinity") else 0)
+    counter("tony_prefix_routed_total",
+            "Routing decisions won by the prefix-affinity probe",
+            routing.get("prefix_routed", 0))
+    counter("tony_handoffs_total",
+            "Prefill->decode page-list handoffs relayed",
+            routing.get("handoffs", 0))
 
     # the goodput ledger (obs/goodput.py): fleet wall-clock bucket
     # fractions — sum(tony_goodput_fraction) <= 1 by construction, and

@@ -66,9 +66,18 @@ HBM_BW_TABLE = (
     ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
 )
 
-# ledger bucket names; "useful.<kind>" buckets ride alongside these
+# ledger bucket names; "useful.<kind>" buckets ride alongside these.
+# host_tier is the ISSUE-12 migration bucket: device<->host page moves
+# (spills, page-ins) and handoff scatters — real work, but not token
+# work, so it must neither inflate useful_fraction nor hide in idle
 WASTE_BUCKETS = ("compile", "padding", "overshoot", "spec_rejected",
-                 "idle")
+                 "host_tier", "idle")
+
+# dispatch kinds whose steady time lands in the host_tier bucket:
+# tier spills/restores AND the role-split handoff's gather/scatter —
+# all pure page migration; none of them land tokens a request keeps
+_HOST_TIER_KINDS = ("host_spill", "host_page_in", "handoff_out",
+                    "handoff_admit")
 
 
 _DISCOVERED_NAMES: list | None = None
@@ -218,6 +227,12 @@ class CostModel:
         n_bytes = 2.0 * fork_bytes + 4.0 * self.vocab_size
         return n_bytes, 2.0 * self.vocab_size
 
+    def host_move(self, n_bytes: float) -> tuple:
+        """A page-content migration (host-tier spill/page-in, handoff
+        gather/scatter): a pure copy — ``n_bytes`` moved, zero
+        FLOPs."""
+        return float(n_bytes), 0.0
+
     def utilization(self, n_bytes: float, flops: float,
                     dur_ms: float) -> tuple:
         """(hbm_bw_pct, mfu_pct) for a dispatch that moved ``n_bytes``
@@ -261,16 +276,25 @@ def ledger(summary: dict, wall_ms: float, *, hbm_gbps: float = 0.0,
     otherwise — the CPU contract)."""
     wall_ms = max(0.0, float(wall_ms))
     ms: dict[str, float] = {"compile": 0.0, "padding": 0.0,
-                            "overshoot": 0.0, "spec_rejected": 0.0}
+                            "overshoot": 0.0, "spec_rejected": 0.0,
+                            "host_tier": 0.0}
     kinds: dict[str, dict] = {}
     total_dispatch = 0.0
     for kind, agg in summary.items():
         total_dispatch += agg["ms"]
-        ms[f"useful.{kind}"] = agg.get("useful_ms", 0.0)
-        ms["compile"] += agg.get("compile_ms", 0.0)
-        ms["padding"] += agg.get("padding_ms", 0.0)
-        ms["overshoot"] += agg.get("overshoot_ms", 0.0)
-        ms["spec_rejected"] += agg.get("rejected_ms", 0.0)
+        if kind in _HOST_TIER_KINDS:
+            # migration time is its own bucket: page moves keep the
+            # engine busy without landing tokens, and filing them
+            # under useful.<kind> would let tier churn masquerade as
+            # goodput (compile time still goes to compile)
+            ms["host_tier"] += agg["ms"] - agg.get("compile_ms", 0.0)
+            ms["compile"] += agg.get("compile_ms", 0.0)
+        else:
+            ms[f"useful.{kind}"] = agg.get("useful_ms", 0.0)
+            ms["compile"] += agg.get("compile_ms", 0.0)
+            ms["padding"] += agg.get("padding_ms", 0.0)
+            ms["overshoot"] += agg.get("overshoot_ms", 0.0)
+            ms["spec_rejected"] += agg.get("rejected_ms", 0.0)
         # utilization pairs STEADY cost with STEADY time: a compile
         # record's bytes over a steady denominator would inflate the
         # estimate (or read past 100% on a short run)
@@ -325,7 +349,11 @@ def merge_ledgers(ledgers: list[dict]) -> dict:
     for g in ledgers:
         for k, v in g["ms"].items():
             ms[k] = ms.get(k, 0.0) + v
-    denom = max(wall, dispatch, 1e-9)
+    # the bucket sum itself joins the denominator: per-replica ledgers
+    # export ms ROUNDED to 3 decimals, and summed rounding drift can
+    # push sum(ms) a few 1e-6 past max(wall, dispatch) — the sums-<=1
+    # invariant must hold structurally, not up to rounding luck
+    denom = max(wall, dispatch, sum(ms.values()), 1e-9)
     buckets = {k: _floor6(v / denom) for k, v in ms.items()}
     waste = {k: buckets.get(k, 0.0) for k in WASTE_BUCKETS}
     return {

@@ -128,7 +128,10 @@ class DispatchTimeline:
             # prefill the landed-token count is 1 (the sampled first
             # token) but the useful work is the fed suffix window; the
             # single-position admits are all useful
-            if rec.kind == "prefill":
+            if rec.kind in ("prefill", "prefill_chunk"):
+                # prefill-shaped dispatches: the landed-token count is
+                # 1 (or 0 for an intermediate chunk) but the useful
+                # work is the fed prompt window
                 useful = min(rec.fed, work)
             elif rec.work <= 1:
                 useful = work

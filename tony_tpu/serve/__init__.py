@@ -29,12 +29,15 @@ from tony_tpu.serve.engine import (PoolExhausted, QueueFull, Request,
 from tony_tpu.serve.faults import Fault, FaultPlan, InjectedFault
 from tony_tpu.serve.prefix import PrefixStore, tree_nbytes
 from tony_tpu.serve.slots import (PagePool, SlotCache, cache_batch_axis,
-                                  page_nbytes, paged_cache,
-                                  read_slot_row, write_slot_row)
+                                  gather_pages, page_nbytes,
+                                  paged_cache, read_slot_row,
+                                  scatter_pages, write_slot_row)
+from tony_tpu.serve.tier import HostPageTier
 
 __all__ = [
     "Fault",
     "FaultPlan",
+    "HostPageTier",
     "InjectedFault",
     "PagePool",
     "PoolExhausted",
@@ -46,9 +49,11 @@ __all__ = [
     "SlotCache",
     "bucket_len",
     "cache_batch_axis",
+    "gather_pages",
     "page_nbytes",
     "paged_cache",
     "read_slot_row",
+    "scatter_pages",
     "tree_nbytes",
     "write_slot_row",
 ]

@@ -99,8 +99,10 @@ class _Ticket:
 def result_doc(res: Result) -> dict:
     """A ``serve.Result`` as its wire form (and back via
     ``result_from_doc``) — the exact fields the gateway's ``_deliver``
-    reads."""
-    return {
+    reads. A prefill-pool HANDOFF result additionally carries the page
+    payload + last-position logits, base64-encoded leaf-by-leaf
+    (serve/tier.py codec, bitwise)."""
+    out = {
         "id": res.id,
         "prompt": list(res.prompt),
         "tokens": list(res.tokens),
@@ -109,17 +111,34 @@ def result_doc(res: Result) -> dict:
         "prefill_tokens_saved": res.prefill_tokens_saved,
         "drafted": res.drafted,
         "accepted": res.accepted,
+        "prefill_chunks": res.prefill_chunks,
     }
+    if res.handoff is not None:
+        from tony_tpu.serve.tier import encode_array, encode_payload
+
+        out["handoff"] = {
+            "n_tokens": int(res.handoff["n_tokens"]),
+            "pages": encode_payload(res.handoff["pages"]),
+            "logits": encode_array(res.handoff["logits"]),
+        }
+    return out
 
 
 def result_from_doc(doc: dict) -> Result:
-    return Result(
+    res = Result(
         id=doc["id"], prompt=list(doc["prompt"]),
         tokens=list(doc["tokens"]), finish_reason=doc["finish_reason"],
         prefix_hit_tokens=int(doc.get("prefix_hit_tokens", 0)),
         prefill_tokens_saved=int(doc.get("prefill_tokens_saved", 0)),
         drafted=int(doc.get("drafted", 0)),
-        accepted=int(doc.get("accepted", 0)))
+        accepted=int(doc.get("accepted", 0)),
+        prefill_chunks=int(doc.get("prefill_chunks", 0)))
+    # the payload stays in WIRE form: a pure-router gateway relays it
+    # to the decode replica verbatim, and the receiving ENGINE decodes
+    # against its own cache treedef (local engines take it directly;
+    # remote stubs pass it through /v1/handoff untouched)
+    res.handoff = doc.get("handoff")
+    return res
 
 
 class ReplicaAgent:
@@ -192,7 +211,12 @@ class ReplicaAgent:
             temperature=float(doc.get("temperature", 0.0)),
             top_k=int(doc.get("top_k", 0)),
             seed=int(doc.get("seed", 0)),
-            id=doc.get("id"))
+            id=doc.get("id"),
+            # disaggregation over the wire: prefill_only rides
+            # /v1/submit; a handoff payload arrives via /v1/handoff
+            # (same body + the encoded pages) — the engine decodes it
+            prefill_only=bool(doc.get("prefill_only", False)),
+            handoff=doc.get("handoff"))
         with self._cond:
             # IDEMPOTENT on the request id: the stub retries connect
             # errors, and a reset that lands after the agent processed
@@ -415,6 +439,16 @@ class AgentHandler(BaseHTTPRequestHandler):
         except (ValueError, TypeError) as e:
             return self._send(400, {"error": str(e)})
         if path == "/v1/submit":
+            return self._submit(body)
+        if path == "/v1/handoff":
+            # the decode pool's intake: same contract as /v1/submit
+            # but the body carries a prefill pool's page payload —
+            # separated so an operator's access log tells admission
+            # traffic from page migration, and so the (much larger)
+            # handoff bodies can grow their own limits later
+            if "handoff" not in body:
+                return self._send(400, {"error": "handoff body needs "
+                                        "a 'handoff' payload"})
             return self._submit(body)
         if path == "/v1/reset":
             try:

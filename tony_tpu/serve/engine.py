@@ -59,9 +59,13 @@ from tony_tpu.obs.goodput import (CostModel, detect_hbm_gbps,
 from tony_tpu.obs.timeline import DispatchRecord, DispatchTimeline
 from tony_tpu.serve.faults import FaultPlan
 from tony_tpu.serve.prefix import PrefixStore
-from tony_tpu.serve.slots import (PagePool, SlotCache, _read_slot,
+from tony_tpu.serve.slots import (PagePool, SlotCache, _gather_pages,
+                                  _read_slot, _scatter_pages,
                                   cache_batch_axis, default_page_size,
                                   paged_view, paged_write_back)
+from tony_tpu.serve.tier import (HostPageTier, decode_array,
+                                 decode_payload, pad_host_pages,
+                                 pages_to_host, payload_pages)
 
 log = logging.getLogger(__name__)
 
@@ -213,6 +217,21 @@ def _paged_prefill_admit(model, params, cache, window, positions, length,
     return cache, tok[0].astype(jnp.int32), key[0], last
 
 
+@functools.partial(jax.jit, static_argnames=("model",))
+def _paged_prefill_chunk(model, params, cache, window, positions, table):
+    """One INTERMEDIATE chunk of a chunked prefill: a multi-token
+    window written straight into the slot's pages at absolute
+    ``positions`` — ``_paged_prefill_admit`` minus the first-token
+    sample (only the FINAL chunk holds the real last position, so
+    sampling here would be junk work). Compiles once per chunk bucket
+    x view span — and the chunk budget is quantized to the bucket
+    grid, so in practice ONE chunk program serves a whole serving
+    session."""
+    cache, _ = multi_decode_step(model, params, cache, window,
+                                 positions, page_table=table)
+    return cache
+
+
 @jax.jit
 def _hit_admit(cache, row, slot, logits, temp, top_k, key):
     """Exact-prompt prefix hit: NO prefill at all — copy the stored row
@@ -239,6 +258,17 @@ def _row_nbytes(cache) -> int:
         ax = cache_batch_axis(path, leaf)
         total += nbytes // leaf.shape[ax] if ax is not None else nbytes
     return total
+
+
+def _padded_pages(pages: list, sentinel: int | None = None) -> list:
+    """A page-id list pow2-padded to its gather/scatter bucket — the
+    ONE place the padding convention lives: gathers duplicate the last
+    page (junk rows the consumer slices or the receiving scatter
+    drops), scatters pad with the pool's ``n_pages`` sentinel (writes
+    drop)."""
+    n_pad = _bucket_pow2(max(1, len(pages)))
+    fill = pages[-1] if sentinel is None else sentinel
+    return list(pages) + [fill] * (n_pad - len(pages))
 
 
 def _usable_prefix(off: int, n: int, max_len: int, minimum: int) -> int:
@@ -412,7 +442,18 @@ class PoolExhausted(RuntimeError):
 class Request:
     """One generation request. ``prompt`` is token ids; sampling knobs
     are per-request (greedy default). ``id`` is echoed on the Result
-    (auto-assigned when None)."""
+    (auto-assigned when None).
+
+    The disaggregation fields (both paged-engine-only):
+    ``prefill_only`` makes the engine STOP after prefill — the Result
+    comes back ``finish_reason="handoff"`` carrying the prompt's page
+    content + last-position logits instead of tokens (the prefill
+    pool's half of a role-split fleet). ``handoff`` is the other half:
+    a payload produced by a prefill_only run; admission scatters it
+    into fresh pages, samples the first token from the carried logits
+    with THIS request's knobs/seed, and decodes — token-exact vs a
+    single engine doing both (the first-token draw and every decode
+    step see bitwise the state the donor engine would have had)."""
 
     prompt: list
     max_new_tokens: int
@@ -420,6 +461,8 @@ class Request:
     top_k: int = 0
     seed: int = 0
     id: Any = None
+    prefill_only: bool = False
+    handoff: Any = None
 
 
 @dataclass
@@ -441,6 +484,12 @@ class Result:
     prefill_tokens_saved: int = 0
     drafted: int = 0
     accepted: int = 0
+    # disaggregation surfaces: ``prefill_chunks`` = prefill dispatches
+    # this request's prompt took (>= 2 means chunked; 0 = pure prefix
+    # hit); ``handoff`` (finish_reason "handoff" only) = the page
+    # payload + last-position logits a prefill_only run produced
+    prefill_chunks: int = 0
+    handoff: Any = None
 
     @property
     def draft_hit_rate(self) -> float:
@@ -457,6 +506,22 @@ class _Live:
     prefill_tokens_saved: int = 0
     drafted: int = 0
     accepted: int = 0
+    prefill_chunks: int = 0
+
+
+@dataclass
+class _PrefillState:
+    """A slot mid-CHUNKED-prefill: admitted (reservation + any prefix
+    seed already in place), prompt written up to ``done``, not yet
+    decoding. The slot is excluded from both the free list and the
+    decode batch until the final chunk samples its first token."""
+
+    request: Request
+    done: int       # prompt tokens already written/seeded
+    chunks: int     # prefill dispatches so far (>= 1)
+    hit_tokens: int
+    saved: int
+    row: Any = None  # unpaged: the carried batch-1 suffix-prefill cache
 
 
 class Server:
@@ -521,7 +586,8 @@ class Server:
                  fault_plan: FaultPlan | None = None,
                  timeline: bool = True, paged: bool | None = None,
                  kv_page_size: int = 0, kv_pages: int = 0,
-                 hbm_gbps: float = 0.0):
+                 hbm_gbps: float = 0.0, prefill_chunk_tokens: int = 0,
+                 kv_host_mb: float = 0.0):
         if model.cfg.quantized:
             # nothing structural in the way — the q8 apply is the same
             # model.apply — but untested here; fail loud, not wrong
@@ -664,6 +730,55 @@ class Server:
                 "budget is %.1f MB (raise --prefix-cache-mb)",
                 entry_nbytes / (1 << 20), prefix_cache_mb)
             self.prefix = None
+        # chunked prefill (ISSUE-12): bound how many prompt tokens one
+        # admission dispatch may consume; long prompts prefill in
+        # chunks interleaved between decode rounds, so a 30k-token
+        # prompt stops holding co-tenants' decode hostage for one
+        # monolithic prefill. Quantized DOWN to the bucket grid
+        # (min_bucket * 2^k) so intermediate chunk windows are
+        # pad-free — on the unpaged path, bucket-tail junk between
+        # chunks would otherwise need overwrite proofs per geometry.
+        # 0 = off (the old monolithic behavior).
+        chunk_budget = max(0, int(prefill_chunk_tokens))
+        if chunk_budget:
+            b = min_bucket
+            while b * 2 <= chunk_budget:
+                b *= 2
+            chunk_budget = min(b, model.cfg.max_seq_len)
+        self.prefill_chunk = chunk_budget
+        self._prefilling: dict[int, _PrefillState] = {}
+        self.prefill_chunk_dispatches = 0  # chunk dispatches run
+        self.prefill_chunked = 0           # requests that took >1 chunk
+        self.handoffs_out = 0  # prefill_only requests handed off
+        self.handoffs_in = 0   # handoff admissions (decode pool)
+        self._cache_treedef = jax.tree_util.tree_structure(
+            self.slots.cache)
+        # (flat leaf index, page axis) of the first paged leaf: lets
+        # submit() read a WIRE payload's page count straight off its
+        # carried shapes, before any decoding
+        self._payload_leaf_spec = None
+        if self.paged:
+            flat = jax.tree_util.tree_flatten_with_path(
+                self.slots.cache)[0]
+            for i, (path, leaf) in enumerate(flat):
+                ax = cache_batch_axis(path, leaf)
+                if ax is not None:
+                    self._payload_leaf_spec = (i, ax)
+                    break
+        # host-RAM page tier (serve/tier.py): evicted prefix-store
+        # entries spill device->host instead of vanishing, and page
+        # back in on a prefix hit — million-session reuse bounded by
+        # host RAM, not HBM. Needs page-granular state AND a device
+        # store to feed it, so both are hard requirements.
+        self.host_tier = None
+        if kv_host_mb > 0:
+            if not self.paged or self.prefix is None:
+                raise ValueError(
+                    "kv_host_mb needs the paged KV cache and a prefix "
+                    "store (prefix_cache_mb > 0): the tier holds "
+                    "evicted prefix-store pages")
+            self.host_tier = HostPageTier(int(kv_host_mb * (1 << 20)))
+            self.prefix.on_evict = self._spill_entry
 
     # ----------------------------------------------------- observability
 
@@ -724,14 +839,39 @@ class Server:
                 f"max_seq_len ({max_len})")
         if request.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if request.prefill_only and request.handoff is not None:
+            raise ValueError("prefill_only and handoff are the two "
+                             "HALVES of a disaggregated request — one "
+                             "request cannot be both")
+        if (request.prefill_only or request.handoff is not None) \
+                and not self.paged:
+            raise ValueError(
+                "prefill/decode disaggregation needs the paged KV "
+                "cache (the handoff unit is a page list)")
+        if request.handoff is not None:
+            if int(request.handoff["n_tokens"]) != len(p):
+                raise ValueError(
+                    f"handoff payload covers "
+                    f"{request.handoff['n_tokens']} tokens, prompt "
+                    f"has {len(p)}")
+            # geometry checked HERE, where a mismatch is one request's
+            # clean refusal (the gateway sheds it 400): discovered at
+            # admission inside step() it would instead fail the whole
+            # replica and cascade the crash-reset through every decode
+            # replica the failover retries
+            self._check_handoff_geometry(request.handoff, len(p))
         if request.id is None:
             request.id = next(self._ids)
         request.max_new_tokens = min(request.max_new_tokens,
                                      max_len - len(p))
         if self.paged:
             pool = self.slots.pool
-            worst = -(-(len(p) + request.max_new_tokens)
-                      // pool.page_size)
+            # a prefill_only request never decodes here: its worst
+            # case is the prompt's pages alone (the decode pool pays
+            # for the generation budget)
+            life = len(p) if request.prefill_only \
+                else len(p) + request.max_new_tokens
+            worst = -(-life // pool.page_size)
             if worst > pool.n_pages:
                 # could NEVER be admitted — shedding now (503 at the
                 # gateway) beats wedging the queue head forever
@@ -752,11 +892,37 @@ class Server:
 
     @property
     def n_active(self) -> int:
-        return self.slots.n_active
+        # mid-chunked-prefill slots count: they hold a request the
+        # engine is working on (a busy/done signal that ignored them
+        # would let a front door idle out a half-prefilled prompt)
+        return self.slots.n_active + len(self._prefilling)
+
+    @property
+    def n_prefilling(self) -> int:
+        return len(self._prefilling)
 
     @property
     def done(self) -> bool:
-        return not self.pending and self.slots.n_active == 0
+        return not self.pending and self.slots.n_active == 0 \
+            and not self._prefilling
+
+    def _free_slots(self) -> list[int]:
+        """Slots admittable RIGHT NOW: free on the device AND not
+        parked mid-chunked-prefill."""
+        return [i for i in self.slots.free_slots()
+                if i not in self._prefilling]
+
+    def prefix_match_len(self, tokens) -> int:
+        """Longest prompt prefix this engine could seed without
+        prefill work — the gateway's prefix-affinity routing signal.
+        Device store and host tier both count (a page-in is still far
+        cheaper than a re-prefill); no counters move, so a routing
+        probe cannot skew admission hit rates."""
+        n = self.prefix.match_len(tokens) if self.prefix is not None \
+            else 0
+        if self.host_tier is not None:
+            n = max(n, self.host_tier.match_len(tokens))
+        return n
 
     # --------------------------------------------------------- scheduling
 
@@ -783,7 +949,7 @@ class Server:
         s = self.slots
         p = np.asarray(req.prompt, np.int32)
         max_len = self.model.cfg.max_seq_len
-        slot = s.free_slots()[0]
+        slot = self._free_slots()[0]
         t0 = time.monotonic()  # timeline: the whole admit (lookup +
         occ = s.n_active       # dispatch + first-token sync)
         off, entry = 0, None
@@ -821,6 +987,44 @@ class Server:
                         self.prefix.release(entry)
                         entry = None
                 suffix = p[off:]
+                if self.prefill_chunk \
+                        and len(suffix) > self.prefill_chunk:
+                    # chunked admission: dispatch only the FIRST chunk
+                    # (a suffix prefill into a carried batch-1 row —
+                    # the PR-3 offset machinery) and park the slot
+                    # mid-prefill; step() advances one chunk per
+                    # iteration between decode rounds
+                    take = self.prefill_chunk  # == its own bucket
+                    window = np.asarray(suffix[:take])[None, :]
+                    row, _ = _prefill(
+                        self.model, self.params, jnp.asarray(window),
+                        jnp.int32(take),
+                        jnp.int32(off) if self.prefix is not None
+                        else None,
+                        entry.row if entry is not None else None)
+                    self.prefills += 1
+                    self.prefill_chunk_dispatches += 1
+                    if entry is not None:
+                        hit_tokens = off
+                        saved = full_bucket - bucket_len(
+                            len(suffix), max_len, self.min_bucket)
+                        self.prefix_hits += 1
+                        self.prefix_hit_tokens += hit_tokens
+                        self.prefill_tokens_saved += saved
+                    if self.timeline is not None:
+                        jax.block_until_ready(row)  # close the record
+                        tags = {"prompt_len": len(p), "chunk": 1}
+                        if off:
+                            tags["offset"] = int(off)
+                        self._record_dispatch(
+                            "prefill_chunk", t0,
+                            (time.monotonic() - t0) * 1e3, occ, take,
+                            0, ("prefill_chunk", take),
+                            request_id=req.id, tags=tags, work=take,
+                            fed=take, est=self.cost.prefill(take, off))
+                    self._prefilling[slot] = _PrefillState(
+                        req, off + take, 1, hit_tokens, saved, row=row)
+                    return True
                 lb = bucket_len(len(suffix), max_len, self.min_bucket)
                 padded = np.zeros((1, lb), np.int32)
                 padded[0, :len(suffix)] = suffix
@@ -867,18 +1071,21 @@ class Server:
                 d_kind, t0, (time.monotonic() - t0) * 1e3, occ,
                 d_bucket, 1, (d_kind, d_bucket), request_id=req.id,
                 tags=tags, work=work, fed=fed, est=est)
+        chunks = 0 if d_kind == "hit_admit" else 1
         if tok in self.eos_ids or req.max_new_tokens == 1:
             # the slot row was written but never armed — the next admit
             # simply overwrites it
             reason = "eos" if tok in self.eos_ids else "length"
             finished.append(Result(req.id, list(req.prompt), [tok],
-                                   reason, hit_tokens, saved))
+                                   reason, hit_tokens, saved,
+                                   prefill_chunks=chunks))
             s.cache = cache
             return True
         s.cache = cache
         s.admit(slot, len(p), tok, req.temperature, req.top_k, key)
         self._spec_ema[slot] = 1.0  # new tenant: drafting re-enabled
-        self._live[slot] = _Live(req, [tok], hit_tokens, saved)
+        self._live[slot] = _Live(req, [tok], hit_tokens, saved,
+                                 prefill_chunks=chunks)
         return True
 
     def _admit_one_paged(self, req: Request, finished: list) -> bool:
@@ -899,12 +1106,14 @@ class Server:
         bucketed suffix as one multi-token window writing straight
         into the slot's pages (no row copy — the unpaged path's
         ``write_slot_row`` admission copies are gone)."""
+        if req.handoff is not None:
+            return self._admit_handoff(req, finished)
         s = self.slots
         pool = s.pool
         ps = pool.page_size
         p = np.asarray(req.prompt, np.int32)
         max_len = self.model.cfg.max_seq_len
-        slot = s.free_slots()[0]
+        slot = self._free_slots()[0]
         t0 = time.monotonic()  # timeline: the whole admit
         occ = s.n_active
         off, entry = 0, None
@@ -912,11 +1121,33 @@ class Server:
         if self.prefix is not None:
             self.prefix_lookups += 1
             off, entry = self.prefix.acquire(p)
+            if self.host_tier is not None:
+                # the host tier may hold a LONGER prefix than the
+                # device store: restore it into the pool + store so
+                # the admission below hits it (host->device page-in)
+                off, entry = self._maybe_page_in(p, off, entry)
             lookup_ms = (time.monotonic() - t0) * 1e3
         full_bucket = bucket_len(len(p), max_len, self.min_bucket)
         exact = (entry is not None and off == len(p)
                  and len(entry.tokens) == len(p)
                  and entry.logits is not None)
+        if exact and req.prefill_only:
+            # the fleet hot-prompt fast path: the whole prompt's pages
+            # are already resident with their logits — no reservation,
+            # no writes, no sampling: gather the content and hand off
+            try:
+                if self.fault_plan is not None:
+                    self.fault_plan.on_admit(req.id)
+                self._finish_handoff(req, entry.pages, len(p),
+                                     entry.logits, finished,
+                                     hit_tokens=len(p),
+                                     saved=full_bucket, chunks=0)
+            finally:
+                self.prefix.release(entry)
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += len(p)
+            self.prefill_tokens_saved += full_bucket
+            return True
         if not exact and entry is not None:
             # partial hit (or full-prompt match against a longer /
             # logits-less entry): seed at most len(p)-1 tokens so >= 1
@@ -929,7 +1160,11 @@ class Server:
                 self.prefix.release(entry)
                 off, entry = 0, None
         seed = len(p) if exact else off
-        budget_end = len(p) + req.max_new_tokens  # submit() clamped
+        # prefill_only reserves the PROMPT's pages only — the decode
+        # pool pays for the generation budget (submit() sized the
+        # PoolExhausted check the same way)
+        budget_end = len(p) if req.prefill_only \
+            else len(p) + req.max_new_tokens  # submit() clamped
         worst = -(-budget_end // ps)     # ceil: pages for the whole life
         n_alias = -(-seed // ps)         # pages the entry donates
         fork = 1 if seed % ps else 0     # mid-page boundary: CoW copy
@@ -969,6 +1204,25 @@ class Server:
             forked = s.seed_pages(
                 slot, entry.pages if entry is not None else [], seed,
                 need)
+            if not exact and self.prefill_chunk \
+                    and len(p) - off > self.prefill_chunk:
+                # chunked admission: the reservation and any prefix
+                # seed are in place; dispatch the FIRST chunk straight
+                # into the slot's pages and park the slot mid-prefill
+                # (step() advances one chunk per iteration between
+                # decode rounds)
+                if entry is not None:
+                    hit_tokens = off
+                    saved = full_bucket - bucket_len(
+                        len(p) - off, max_len, self.min_bucket)
+                    self.prefix_hits += 1
+                    self.prefix_hit_tokens += hit_tokens
+                    self.prefill_tokens_saved += saved
+                st = _PrefillState(req, off, 0, hit_tokens, saved)
+                self._prefilling[slot] = st
+                self._prefill_chunk_paged(slot, st, t0=t0, occ=occ,
+                                          forked=forked)
+                return True
             if exact:
                 # the aliasing admit: pages shared host-side, one
                 # [1, V] sampling dispatch — near-free, and bytes
@@ -1048,18 +1302,484 @@ class Server:
                 d_bucket, 1, (d_kind, d_bucket, view_tokens),
                 request_id=req.id, tags=tags, work=work, fed=fed,
                 est=est)
+        chunks = 0 if d_kind == "cow_admit" else 1
+        if req.prefill_only:
+            # the prefill pool's exit: pages + last-position logits
+            # hand off to a decode replica instead of arming the slot
+            self._finish_handoff(req, s.slot_pages(slot, len(p)),
+                                 len(p), last, finished,
+                                 hit_tokens=hit_tokens, saved=saved,
+                                 chunks=chunks)
+            s.release_pages(slot)
+            return True
         if tok in self.eos_ids or req.max_new_tokens == 1:
             # finished before ever decoding: the slot was never armed —
             # hand its page references straight back
             reason = "eos" if tok in self.eos_ids else "length"
             finished.append(Result(req.id, list(req.prompt), [tok],
-                                   reason, hit_tokens, saved))
+                                   reason, hit_tokens, saved,
+                                   prefill_chunks=chunks))
             s.release_pages(slot)
             return True
         s.admit(slot, len(p), tok, req.temperature, req.top_k, key)
         self._spec_ema[slot] = 1.0  # new tenant: drafting re-enabled
-        self._live[slot] = _Live(req, [tok], hit_tokens, saved)
+        self._live[slot] = _Live(req, [tok], hit_tokens, saved,
+                                 prefill_chunks=chunks)
         return True
+
+    # ------------------------------------------------- chunked prefill
+
+    def _advance_prefills(self, finished: list) -> None:
+        """One chunk per mid-prefill slot per scheduler iteration —
+        the starvation cap: between any two chunks of a long prompt,
+        every live slot gets a full decode round, so a 30k-token
+        prompt costs co-tenants one bounded chunk dispatch per round
+        instead of one monolithic prefill."""
+        for slot in sorted(self._prefilling):
+            st = self._prefilling[slot]
+            remaining = len(st.request.prompt) - st.done
+            if remaining > self.prefill_chunk:
+                if self.paged:
+                    self._prefill_chunk_paged(slot, st)
+                else:
+                    self._prefill_chunk_unpaged(slot, st)
+                continue
+            # final chunk: the fused suffix-prefill admit samples the
+            # first token (or hands off) and un-parks the slot
+            del self._prefilling[slot]
+            if self.paged:
+                self._finalize_prefill_paged(slot, st, finished)
+            else:
+                self._finalize_prefill_unpaged(slot, st, finished)
+
+    def _prefill_chunk_paged(self, slot: int, st: _PrefillState, *,
+                             t0: float | None = None, occ: int = 0,
+                             forked: bool = False) -> None:
+        """One INTERMEDIATE chunk straight into the slot's pages:
+        ``prefill_chunk`` tokens at absolute positions from
+        ``st.done`` — a window write with no sampling (only the final
+        chunk holds the prompt's last position)."""
+        s = self.slots
+        ps = s.pool.page_size
+        req = st.request
+        p = np.asarray(req.prompt, np.int32)
+        take = self.prefill_chunk
+        if t0 is None:
+            t0 = time.monotonic()
+            occ = s.n_active
+        s.ensure_pages(slot, st.done + take)
+        window = np.asarray(p[st.done:st.done + take])[None, :]
+        positions = (st.done
+                     + np.arange(take, dtype=np.int32))[None, :]
+        cols = min(_bucket_pow2(-(-(st.done + take) // ps)),
+                   s.max_pages)
+        view_tokens = cols * ps
+        cache = _paged_prefill_chunk(
+            self.model, self.params, s.cache, jnp.asarray(window),
+            jnp.asarray(positions),
+            jnp.asarray(s.page_table[slot:slot + 1, :cols]))
+        s.cache = cache
+        self.prefills += 1
+        self.prefill_chunk_dispatches += 1
+        st.done += take
+        st.chunks += 1
+        if self.timeline is not None:
+            # close the record at a real sync: without it the chunk
+            # would bill its device time to whatever syncs next
+            jax.block_until_ready(cache)
+            tags = {"prompt_len": len(p), "chunk": st.chunks,
+                    "view_tokens": view_tokens}
+            if forked:
+                tags["cow_fork"] = True
+            self._record_dispatch(
+                "prefill_chunk", t0, (time.monotonic() - t0) * 1e3,
+                occ, take, 0, ("prefill_chunk", take, view_tokens),
+                request_id=req.id, tags=tags, work=take, fed=take,
+                est=self.cost.prefill(take, st.done - take,
+                                      view_tokens))
+
+    def _prefill_chunk_unpaged(self, slot: int,
+                               st: _PrefillState) -> None:
+        """The unpaged intermediate chunk: a suffix prefill into the
+        CARRIED batch-1 row (PR-3 offset machinery) — the row only
+        lands in the slot on the final fused admit."""
+        req = st.request
+        p = np.asarray(req.prompt, np.int32)
+        take = self.prefill_chunk
+        t0 = time.monotonic()
+        occ = self.slots.n_active
+        window = np.asarray(p[st.done:st.done + take])[None, :]
+        row, _ = _prefill(self.model, self.params, jnp.asarray(window),
+                          jnp.int32(take), jnp.int32(st.done), st.row)
+        st.row = row
+        self.prefills += 1
+        self.prefill_chunk_dispatches += 1
+        st.done += take
+        st.chunks += 1
+        if self.timeline is not None:
+            jax.block_until_ready(row)
+            self._record_dispatch(
+                "prefill_chunk", t0, (time.monotonic() - t0) * 1e3,
+                occ, take, 0, ("prefill_chunk", take),
+                request_id=req.id,
+                tags={"prompt_len": len(p), "chunk": st.chunks},
+                work=take, fed=take,
+                est=self.cost.prefill(take, st.done - take))
+
+    def _finalize_prefill_paged(self, slot: int, st: _PrefillState,
+                                finished: list) -> None:
+        """The final chunk: the standard fused suffix-prefill admit at
+        offset ``st.done`` — position-exact continuation of the chunks
+        before it, so the armed slot is bit-identical to a monolithic
+        prefill's (the chunked-parity tests pin the token stream)."""
+        s = self.slots
+        ps = s.pool.page_size
+        req = st.request
+        p = np.asarray(req.prompt, np.int32)
+        max_len = self.model.cfg.max_seq_len
+        t0 = time.monotonic()
+        occ = s.n_active
+        off = st.done
+        suffix = p[off:]
+        lb = bucket_len(len(suffix), max_len, self.min_bucket)
+        s.ensure_pages(slot, len(p))
+        window = np.zeros((1, lb), np.int32)
+        window[0, :len(suffix)] = suffix
+        positions = np.full((1, lb), -1, np.int32)
+        positions[0, :len(suffix)] = \
+            off + np.arange(len(suffix), dtype=np.int32)
+        cols = min(_bucket_pow2(-(-len(p) // ps)), s.max_pages)
+        view_tokens = cols * ps
+        cache, tok, key, last = _paged_prefill_admit(
+            self.model, self.params, s.cache, jnp.asarray(window),
+            jnp.asarray(positions), jnp.int32(len(suffix)),
+            jnp.asarray(s.page_table[slot:slot + 1, :cols]),
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jax.random.PRNGKey(req.seed))
+        s.cache = cache
+        self.prefills += 1
+        self.prefill_chunk_dispatches += 1
+        st.chunks += 1
+        self.prefill_chunked += 1
+        if self.prefix is not None:
+            self.prefix.insert(p, pages=s.slot_pages(slot, len(p)),
+                               logits=last)
+        tok = int(tok)
+        if self.timeline is not None:
+            self._record_dispatch(
+                "prefill", t0, (time.monotonic() - t0) * 1e3, occ, lb,
+                1, ("prefill", lb, view_tokens), request_id=req.id,
+                tags={"prompt_len": len(p), "chunk": st.chunks,
+                      "offset": int(off), "view_tokens": view_tokens},
+                work=lb, fed=len(suffix),
+                est=self.cost.prefill(lb, off, view_tokens))
+        if req.prefill_only:
+            self._finish_handoff(req, s.slot_pages(slot, len(p)),
+                                 len(p), last, finished,
+                                 hit_tokens=st.hit_tokens,
+                                 saved=st.saved, chunks=st.chunks)
+            s.release_pages(slot)
+            return
+        if tok in self.eos_ids or req.max_new_tokens == 1:
+            reason = "eos" if tok in self.eos_ids else "length"
+            finished.append(Result(req.id, list(req.prompt), [tok],
+                                   reason, st.hit_tokens, st.saved,
+                                   prefill_chunks=st.chunks))
+            s.release_pages(slot)
+            return
+        s.admit(slot, len(p), tok, req.temperature, req.top_k, key)
+        self._spec_ema[slot] = 1.0
+        self._live[slot] = _Live(req, [tok], st.hit_tokens, st.saved,
+                                 prefill_chunks=st.chunks)
+
+    def _finalize_prefill_unpaged(self, slot: int, st: _PrefillState,
+                                  finished: list) -> None:
+        s = self.slots
+        req = st.request
+        p = np.asarray(req.prompt, np.int32)
+        max_len = self.model.cfg.max_seq_len
+        t0 = time.monotonic()
+        occ = s.n_active
+        # the final window's bucket must still fit the cache row
+        # (dynamic_update_slice would clamp and corrupt positions);
+        # shrinking re-prefills a tail of already-written tokens —
+        # identical values at identical positions, position-exact
+        off = _usable_prefix(st.done, len(p), max_len, self.min_bucket)
+        suffix = p[off:]
+        lb = bucket_len(len(suffix), max_len, self.min_bucket)
+        padded = np.zeros((1, lb), np.int32)
+        padded[0, :len(suffix)] = suffix
+        out = _prefill_admit(
+            self.model, self.params, s.cache, jnp.asarray(padded),
+            jnp.int32(len(suffix)), jnp.int32(slot),
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jax.random.PRNGKey(req.seed), jnp.int32(off), st.row,
+            with_row=self.prefix is not None)
+        self.prefills += 1
+        self.prefill_chunk_dispatches += 1
+        st.chunks += 1
+        self.prefill_chunked += 1
+        if self.prefix is not None:
+            cache, tok, key, row, last = out
+            self.prefix.insert(p, row, last)
+        else:
+            cache, tok, key = out
+        tok = int(tok)
+        if self.timeline is not None:
+            self._record_dispatch(
+                "prefill", t0, (time.monotonic() - t0) * 1e3, occ, lb,
+                1, ("prefill", lb), request_id=req.id,
+                tags={"prompt_len": len(p), "chunk": st.chunks,
+                      "offset": int(off)},
+                work=lb, fed=len(suffix),
+                est=self.cost.prefill(lb, off))
+        s.cache = cache
+        if tok in self.eos_ids or req.max_new_tokens == 1:
+            reason = "eos" if tok in self.eos_ids else "length"
+            finished.append(Result(req.id, list(req.prompt), [tok],
+                                   reason, st.hit_tokens, st.saved,
+                                   prefill_chunks=st.chunks))
+            return
+        s.admit(slot, len(p), tok, req.temperature, req.top_k, key)
+        self._spec_ema[slot] = 1.0
+        self._live[slot] = _Live(req, [tok], st.hit_tokens, st.saved,
+                                 prefill_chunks=st.chunks)
+
+    # ------------------------------------------------ role-split handoff
+
+    def _finish_handoff(self, req: Request, pages: list, n_tok: int,
+                        logits, finished: list, *, hit_tokens: int = 0,
+                        saved: int = 0, chunks: int = 0) -> None:
+        """The prefill pool's exit: stack the prompt's page CONTENT
+        into a portable payload (pow2-padded gather — the padding
+        duplicates the last page and the receiving scatter drops it)
+        plus the last-position logits, and finish the request
+        ``finish_reason="handoff"``. The payload is an immutable
+        device pytree: local decode replicas scatter it straight into
+        their own pool (device->device, no host hop); the agent wire
+        encodes it via serve/tier.py."""
+        pool = self.slots.pool
+        n = len(pages)
+        idx = _padded_pages(pages)
+        n_pad = len(idx)
+        t0 = time.monotonic()
+        occ = self.slots.n_active
+        payload = _gather_pages(self.slots.cache,
+                                jnp.asarray(idx, jnp.int32))
+        res = Result(req.id, list(req.prompt), [], "handoff",
+                     hit_tokens, saved, prefill_chunks=chunks)
+        res.handoff = {"n_tokens": int(n_tok), "pages": payload,
+                       "logits": jnp.asarray(logits)}
+        finished.append(res)
+        self.handoffs_out += 1
+        if self.timeline is not None:
+            jax.block_until_ready(payload)
+            self._record_dispatch(
+                "handoff_out", t0, (time.monotonic() - t0) * 1e3, occ,
+                n_pad, 0, ("handoff_out", n_pad), request_id=req.id,
+                tags={"pages": n, "n_tokens": int(n_tok)}, work=1,
+                fed=1, est=self.cost.host_move(n * pool.page_nbytes))
+
+    def _handoff_page_count(self, doc: dict) -> int:
+        """Page-axis length of a handoff payload, for BOTH forms —
+        wire (shapes carried per leaf) and device pytree — without
+        decoding anything."""
+        pages = doc["pages"]
+        if isinstance(pages, dict) and "leaves" in pages:
+            if len(pages["leaves"]) != self._cache_treedef.num_leaves:
+                raise ValueError(
+                    f"handoff payload carries {len(pages['leaves'])} "
+                    f"leaves, this engine's cache has "
+                    f"{self._cache_treedef.num_leaves} — mismatched "
+                    "model configs between the prefill and decode "
+                    "pools")
+            i, ax = self._payload_leaf_spec
+            return int(pages["leaves"][i]["shape"][ax])
+        return payload_pages(pages)
+
+    def _check_handoff_geometry(self, doc: dict, n_tok: int) -> None:
+        ps = self.slots.pool.page_size
+        need = -(-n_tok // ps)
+        have = self._handoff_page_count(doc)
+        if have < need:
+            raise ValueError(
+                f"handoff payload holds {have} pages, the prompt "
+                f"needs {need} at page_size {ps} — mismatched page "
+                "geometry between the prefill and decode pools")
+
+    def _decode_handoff(self, doc: dict) -> tuple:
+        """A handoff payload's two forms: a device/numpy pytree (local
+        handoff — used as-is) or the agent wire form (base64 leaves —
+        rebuilt against THIS engine's cache treedef)."""
+        pages, logits = doc["pages"], doc["logits"]
+        if isinstance(pages, dict) and "leaves" in pages:
+            pages = decode_payload(pages, self._cache_treedef)
+        if isinstance(logits, dict) and "b64" in logits:
+            logits = decode_array(logits)
+        return pages, logits
+
+    def _admit_handoff(self, req: Request, finished: list) -> bool:
+        """The decode pool's entry: reserve the request's whole-life
+        worst case, scatter the payload into fresh pages, sample the
+        first token from the carried logits with THIS request's
+        knobs/seed, arm the slot. Token-exact vs one engine doing
+        prefill + decode itself: the pages round-trip bitwise and the
+        first-token draw uses the same PRNGKey the fused admit would
+        have."""
+        s = self.slots
+        pool = s.pool
+        ps = pool.page_size
+        p = np.asarray(req.prompt, np.int32)
+        n_tok = int(req.handoff["n_tokens"])
+        worst = -(-(len(p) + req.max_new_tokens) // ps)
+        granted = pool.reserve(worst)
+        while not granted and self.prefix is not None \
+                and self.prefix.evict_one():
+            granted = pool.reserve(worst)
+        if not granted:
+            return False  # transient: stays pending until pages free
+        if self.fault_plan is not None:
+            try:
+                self.fault_plan.on_admit(req.id)
+            except BaseException:
+                pool.cancel(worst)
+                raise
+        slot = self._free_slots()[0]
+        t0 = time.monotonic()
+        occ = s.n_active
+        pages_tree, logits = self._decode_handoff(req.handoff)
+        s.seed_pages(slot, [], 0, worst)
+        s.ensure_pages(slot, n_tok)
+        n = -(-n_tok // ps)
+        n_pad = payload_pages(pages_tree)
+        # submit() already validated the geometry; this guards the
+        # invariant without killing the replica over a caller bug
+        if n_pad < n:
+            s.release_pages(slot)
+            raise ValueError(
+                f"handoff payload holds {n_pad} pages, prompt needs "
+                f"{n} at page_size {ps}")
+        dst = s.page_table[slot, :n].tolist() \
+            + [pool.n_pages] * (n_pad - n)
+        s.cache = _scatter_pages(s.cache, pages_tree,
+                                 jnp.asarray(dst, jnp.int32))
+        tok, key = _sample_first(
+            jnp.asarray(logits), jnp.float32(req.temperature),
+            jnp.int32(req.top_k), jax.random.PRNGKey(req.seed))
+        if self.prefix is not None:
+            # the decode pool learns the prompt too: the next sharer
+            # routed here hits without another handoff
+            self.prefix.insert(p, pages=s.slot_pages(slot, n_tok),
+                               logits=jnp.asarray(logits))
+        self.handoffs_in += 1
+        tok = int(tok)
+        if self.timeline is not None:
+            self._record_dispatch(
+                "handoff_admit", t0, (time.monotonic() - t0) * 1e3,
+                occ, n_pad, 1, ("handoff_admit", n_pad),
+                request_id=req.id,
+                tags={"prompt_len": len(p), "pages": n}, work=1, fed=1,
+                est=self.cost.host_move(n * pool.page_nbytes))
+        if tok in self.eos_ids or req.max_new_tokens == 1:
+            reason = "eos" if tok in self.eos_ids else "length"
+            finished.append(Result(req.id, list(req.prompt), [tok],
+                                   reason))
+            s.release_pages(slot)
+            return True
+        s.admit(slot, len(p), tok, req.temperature, req.top_k, key)
+        self._spec_ema[slot] = 1.0
+        self._live[slot] = _Live(req, [tok])
+        return True
+
+    # --------------------------------------------------- host page tier
+
+    def _spill_entry(self, entry) -> None:
+        """``PrefixStore.on_evict`` hook: before a dying entry's pages
+        are unpinned, copy their content device->host into the tier —
+        eviction stops meaning re-prefill. Entries already resident in
+        the tier only refresh LRU (zero device work)."""
+        if entry.pages is None:
+            return  # unpaged store entry: the tier is paged-only
+        tier = self.host_tier
+        tokens = entry.tokens
+        if tier.has(tokens):
+            tier.touch(tokens)
+            return
+        pool = self.slots.pool
+        n = -(-int(tokens.size) // pool.page_size)
+        pages = list(entry.pages[:n])
+        idx = _padded_pages(pages)
+        n_pad = len(idx)
+        t0 = time.monotonic()
+        payload = _gather_pages(self.slots.cache,
+                                jnp.asarray(idx, jnp.int32))
+        host = pages_to_host(payload, n)  # syncs; bitwise
+        logits = np.asarray(entry.logits) \
+            if entry.logits is not None else None
+        ok = tier.insert(tokens, host, logits)
+        if self.timeline is not None:
+            tags = {"pages": n, "tokens": int(tokens.size)}
+            if not ok:
+                tags["rejected"] = True
+            self._record_dispatch(
+                "host_spill", t0, (time.monotonic() - t0) * 1e3,
+                self.slots.n_active, n_pad, 0, ("host_spill", n_pad),
+                tags=tags, work=1, fed=1,
+                est=self.cost.host_move(n * pool.page_nbytes))
+
+    def _maybe_page_in(self, p: np.ndarray, off: int, entry):
+        """When the host tier holds a strictly longer prefix of ``p``
+        than the device store matched, restore that tier entry into
+        the pool + device store (host->device scatter) and re-run the
+        device lookup — the admission that follows then hits it like
+        it never left. Degrades silently when the pool cannot afford
+        the pages (after squeezing the device store's LRU)."""
+        tier = self.host_tier
+        t_off, t_entry = tier.acquire(p)
+        if t_entry is None or t_off <= off:
+            if t_entry is not None:
+                tier.release(t_entry)
+            return off, entry
+        pool = self.slots.pool
+        n = -(-int(t_entry.tokens.size) // pool.page_size)
+        try:
+            while pool.available() < n and self.prefix.evict_one():
+                pass
+            if pool.available() < n:
+                return off, entry
+            t0 = time.monotonic()
+            pages = pool.alloc(n)
+            idx = _padded_pages(pages, sentinel=pool.n_pages)
+            n_pad = len(idx)
+            payload = pad_host_pages(t_entry.row, n_pad)
+            self.slots.cache = _scatter_pages(
+                self.slots.cache, payload,
+                jnp.asarray(idx, jnp.int32))
+            logits = jnp.asarray(t_entry.logits) \
+                if t_entry.logits is not None else None
+            ok = self.prefix.insert(t_entry.tokens, pages=pages,
+                                    logits=logits)
+            # the store holds its own pins now (or, refused, nobody
+            # does and the pages go straight back to the free list)
+            pool.unref(pages)
+            tier.note_page_in(n * pool.page_nbytes)
+            if self.timeline is not None:
+                self._record_dispatch(
+                    "host_page_in", t0,
+                    (time.monotonic() - t0) * 1e3,
+                    self.slots.n_active, n_pad, 0,
+                    ("host_page_in", n_pad),
+                    tags={"pages": n,
+                          "tokens": int(t_entry.tokens.size)},
+                    work=1, fed=1,
+                    est=self.cost.host_move(n * pool.page_nbytes))
+            if not ok:
+                return off, entry
+        finally:
+            tier.release(t_entry)
+        if entry is not None:
+            self.prefix.release(entry)
+        return self.prefix.acquire(p)
 
     def _chunk_size(self) -> int:
         """Decode micro-steps for this iteration: enough for the
@@ -1081,7 +1801,7 @@ class Server:
         if self.fault_plan is not None:
             self.fault_plan.on_dispatch()
         finished: list[Result] = []
-        while self.slots.free_slots():
+        while self._free_slots():
             with self._pending_lock:
                 if not self.pending:
                     break
@@ -1093,9 +1813,12 @@ class Server:
                 with self._pending_lock:
                     self.pending.appendleft(req)
                 break
-        if self.slots.n_active == 0:
-            return finished
-        finished.extend(self._decode_round())
+        # mid-prefill slots advance ONE chunk, then every live slot
+        # gets its decode round — the interleave that keeps a long
+        # prompt from starving co-tenants' TPOT
+        self._advance_prefills(finished)
+        if self.slots.n_active:
+            finished.extend(self._decode_round())
         return finished
 
     def _decode_round(self) -> list[Result]:
@@ -1193,7 +1916,8 @@ class Server:
                                    live.generated, reason,
                                    live.prefix_hit_tokens,
                                    live.prefill_tokens_saved,
-                                   live.drafted, live.accepted))
+                                   live.drafted, live.accepted,
+                                   live.prefill_chunks))
             if self.prefix is not None and self.prefix_donate:
                 self._donate(live, slot)
             self._live[slot] = None
@@ -1400,7 +2124,8 @@ class Server:
                                    live.generated, reason,
                                    live.prefix_hit_tokens,
                                    live.prefill_tokens_saved,
-                                   live.drafted, live.accepted))
+                                   live.drafted, live.accepted,
+                                   live.prefill_chunks))
             if self.prefix is not None and self.prefix_donate:
                 # the donated sequence prompt+generated[:-1] spans
                 # [0, len(prompt) + consumed - 1 + generated_prev)
@@ -1460,8 +2185,10 @@ class Server:
         stops feeding, calls drain(), and every request that already
         holds a slot completes instead of being dropped mid-decode."""
         finished: list[Result] = []
-        while self.slots.n_active:
-            finished.extend(self._decode_round())
+        while self.slots.n_active or self._prefilling:
+            self._advance_prefills(finished)
+            if self.slots.n_active:
+                finished.extend(self._decode_round())
         return finished
 
     def live_progress(self, since: dict | None = None) -> dict:
@@ -1495,7 +2222,22 @@ class Server:
             "prefix_hits": self.prefix_hits,
             "prefix_hit_tokens": self.prefix_hit_tokens,
             "prefill_tokens_saved": self.prefill_tokens_saved,
+            "prefill_chunk_dispatches": self.prefill_chunk_dispatches,
+            "prefill_chunked_requests": self.prefill_chunked,
+            "handoffs_out": self.handoffs_out,
+            "handoffs_in": self.handoffs_in,
         }
+        if self.host_tier is not None:
+            hs = self.host_tier.stats()
+            out["kv_host_entries"] = hs["entries"]
+            out["kv_host_bytes"] = hs["bytes"]
+            out["kv_host_budget_bytes"] = hs["budget_bytes"]
+            out["kv_host_tokens"] = hs["tokens"]
+            out["kv_host_spills"] = hs["spills"]
+            out["kv_host_page_ins"] = hs["page_ins"]
+            out["kv_host_spill_bytes"] = hs["bytes_spilled"]
+            out["kv_host_page_in_bytes"] = hs["bytes_paged_in"]
+            out["kv_host_evictions"] = hs["evictions"]
         if self.prefix is not None:
             st = self.prefix.stats()
             out["prefix_entries"] = st["entries"]
@@ -1536,6 +2278,9 @@ class Server:
         with self._pending_lock:
             self.pending.clear()
         self._live = [None] * self.slots.batch_size
+        # mid-chunked-prefill slots drop with their requests; their
+        # page reservations are returned by slots.reset()'s evicts
+        self._prefilling.clear()
         self.slots.reset()
 
     def run(self, requests: Iterable[Request] = ()) -> Iterator[Result]:

@@ -41,11 +41,14 @@ Bookkeeping contract:
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 from typing import Any
 
 import jax
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 
 def tree_nbytes(tree: Any) -> int:
@@ -120,6 +123,11 @@ class PrefixStore:
         self.budget_bytes = max(0, int(budget_bytes))
         self.bytes_used = 0
         self.pool = pool
+        # eviction hook (serve/tier.py): called with the dying entry
+        # BEFORE its pages are unpinned, so a host-RAM tier can copy
+        # the content out. Runs under this store's lock on the owning
+        # engine thread; a raising hook must never break the eviction.
+        self.on_evict = None
         self._page_refs: dict[int, int] = {}  # page -> #entries holding
         self.tokens_stored = 0
         self.root = _Node(np.empty(0, np.int32), None)
@@ -159,6 +167,23 @@ class PrefixStore:
             if entry.refcount <= 0:
                 raise ValueError("release() without matching acquire()")
             entry.refcount -= 1
+
+    def match_len(self, tokens) -> int:
+        """Longest stored prefix of ``tokens`` WITHOUT pinning the
+        entry or moving the lookup counters — the gateway's
+        prefix-affinity routing probe (a routing decision must not
+        skew this replica's admission hit rate)."""
+        tokens = np.asarray(tokens, np.int32)
+        with self._lock:
+            hit = self._lookup(tokens)
+            return 0 if hit is None else hit[0]
+
+    def has(self, tokens) -> bool:
+        """Whether this exact sequence is stored (the host tier's
+        skip-the-copy check before a spill)."""
+        key = np.asarray(tokens, np.int32).tobytes()
+        with self._lock:
+            return key in self._entries
 
     def _lookup(self, tokens: np.ndarray) -> tuple[int, _Entry] | None:
         node, consumed = self.root, 0
@@ -340,6 +365,13 @@ class PrefixStore:
         return True
 
     def _evict(self, entry: _Entry) -> None:
+        if self.on_evict is not None:
+            # before any unpinning: the hook may still read the
+            # entry's pages/row off the device
+            try:
+                self.on_evict(entry)
+            except Exception:
+                log.exception("prefix on_evict hook failed")
         del self._entries[entry.tokens.tobytes()]
         if entry.pages is not None:
             # release the entry's page pins; only pages no OTHER entry
@@ -383,11 +415,25 @@ class PrefixStore:
 
     def stats(self) -> dict:
         with self._lock:
+            # radix shape (root included; depth in TOKENS): what the
+            # gateway's affinity router exports per replica — a tree
+            # whose max_depth dwarfs its entry count is one long
+            # conversation, a bushy shallow tree is a shared preamble
+            nodes, max_depth = 0, 0
+            stack: list[tuple[_Node, int]] = [(self.root, 0)]
+            while stack:
+                node, depth = stack.pop()
+                nodes += 1
+                max_depth = max(max_depth, depth)
+                for child in node.children.values():
+                    stack.append((child, depth + len(child.edge)))
             return {
                 "entries": len(self._entries),
                 "bytes": self.bytes_used,
                 "budget_bytes": self.budget_bytes,
                 "tokens": self.tokens_stored,
+                "nodes": nodes,
+                "max_depth": max_depth,
                 "lookups": self.lookups,
                 "matched": self.matched,
                 "inserts": self.inserts,

@@ -174,6 +174,60 @@ def _copy_page(cache: Any, src, dst) -> Any:
     return copy_page(cache, src, dst)
 
 
+def gather_pages(cache: Any, idx) -> Any:
+    """Stack the CONTENT of pool pages ``idx`` ([n] int32) into a
+    standalone pytree: every paged leaf ``[.., n_pages, ps, ..]``
+    becomes ``[.., n, ps, ..]`` — the portable form of a page list,
+    shared by the role-split handoff (device->device between two
+    replicas' pools, or over the agent wire) and the host-RAM tier
+    (device->host spill). Out-of-range entries clamp (padding rows
+    carry junk the consumer drops); non-paged leaves (the shared
+    counters) pass through so the tree STRUCTURE round-trips."""
+    def g(path, leaf):
+        ax = cache_batch_axis(path, leaf)
+        if ax is None:
+            return leaf
+        safe = jnp.clip(idx, 0, leaf.shape[ax] - 1)
+        return jnp.take(leaf, safe, axis=ax)
+
+    return jax.tree_util.tree_map_with_path(g, cache)
+
+
+@jax.jit
+def _gather_pages(cache: Any, idx) -> Any:
+    """Jitted ``gather_pages``; ``idx`` is traced, so one program
+    compiles per (pow2-bucketed) page count."""
+    return gather_pages(cache, idx)
+
+
+def scatter_pages(cache: Any, payload: Any, idx) -> Any:
+    """Inverse of ``gather_pages``: write ``payload``'s page rows onto
+    pool pages ``idx`` of ``cache``. Sentinel entries (``>= n_pages``)
+    DROP — the bucket-padding discipline every paged scatter here
+    follows — so a pow2-padded payload lands exactly its real pages.
+    The round trip gather -> (optional host hop) -> scatter is
+    bitwise: both directions are pure copies, no arithmetic touches
+    the values (tests/test_tier.py pins it across dtype x scan_layers
+    x int8-KV scale leaves)."""
+    def sc(path, leaf, pleaf):
+        ax = cache_batch_axis(path, leaf)
+        if ax is None:
+            return leaf  # dest counters win; payload's ride-alongs drop
+        p2 = jnp.moveaxis(leaf, ax, 0)
+        v2 = jnp.moveaxis(jnp.asarray(pleaf).astype(leaf.dtype), ax, 0)
+        p2 = p2.at[idx].set(v2, mode="drop")
+        return jnp.moveaxis(p2, 0, ax)
+
+    return jax.tree_util.tree_map_with_path(sc, cache, payload)
+
+
+@jax.jit
+def _scatter_pages(cache: Any, payload: Any, idx) -> Any:
+    """Jitted ``scatter_pages``; ``idx`` traced — one program per
+    page-count bucket."""
+    return scatter_pages(cache, payload, idx)
+
+
 def paged_view(cache: Any, table, max_len: int) -> Any:
     """Gather each slot's pages into an UNPAGED-looking cache: every
     pool leaf ``[.., n_pages, ps, ..]`` becomes ``[.., b, span, ..]``

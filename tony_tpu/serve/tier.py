@@ -1,0 +1,219 @@
+"""HostPageTier: a host-RAM second tier under the device prefix store.
+
+The paged prefix store (serve/prefix.py over serve/slots.PagePool)
+made shared-prompt reuse cheap — but its working set is bounded by
+HBM: a fleet serving millions of sessions evicts a conversation's
+pages minutes before its next turn arrives, and the next turn pays a
+full re-prefill. This module adds the tier below: when the device
+store evicts an entry (LRU churn, or the engine's pool-pressure
+squeeze), the entry's page CONTENT is copied device->host into this
+tier (the ``PrefixStore.on_evict`` hook fires before the pages are
+unpinned); when a later prompt's longest cached prefix lives here
+rather than on the device, the engine pages it back in — allocate
+pool pages, scatter the host bytes, re-insert into the device store —
+and the admission that follows hits it exactly as if it had never
+left. Million-session prefix reuse stops being bounded by HBM; it is
+bounded by host RAM (``--kv-host-mb``).
+
+Exactness: the spill and the page-in are the ``gather_pages`` /
+``scatter_pages`` pair from serve/slots.py — pure copies, no
+arithmetic — so a device->host->device round trip is BITWISE
+identical (tests/test_tier.py pins it across dtype x scan_layers x
+int8-KV scale leaves), and a prefix hit served through the tier
+produces byte-identical tokens to a no-tier engine that never evicted
+(the greedy-parity anchor).
+
+The tier's index IS a ``PrefixStore`` (no pool): entries keep the
+host payload as their ``row``, so the radix lookup, LRU, byte budget,
+refcount pinning and eviction discipline are all the ones already
+pinned by tests/test_prefix.py. Payloads are stored UNPADDED (the
+pow2 gather bucket's junk tail is sliced off host-side) so the budget
+charges real pages only.
+
+This module also owns the WIRE codec for page payloads (base64 over
+the leaves of the gathered pytree, dtype/shape carried per leaf) —
+the ``/v1/handoff`` agent op and the host tier move the same object,
+so one encoder serves both.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any
+
+import jax
+import numpy as np
+
+from tony_tpu.serve.prefix import PrefixStore, tree_nbytes
+from tony_tpu.serve.slots import cache_batch_axis
+
+
+# ------------------------------------------------------ payload shaping
+
+
+def payload_pages(tree: Any) -> int:
+    """Page-axis length of a gathered payload (the pow2 bucket the
+    gather was padded to) — what a scatter's destination index list
+    must match."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        ax = cache_batch_axis(path, leaf)
+        if ax is not None:
+            return int(leaf.shape[ax])
+    raise ValueError("payload holds no paged leaves")
+
+
+def pages_to_host(tree: Any, n: int) -> Any:
+    """Device payload -> host numpy, sliced to its ``n`` REAL pages
+    (the gather's pow2 padding is junk — storing it would double the
+    tier's byte charge for nothing). ``np.asarray`` is the device
+    sync; values are untouched, so the hop is bitwise."""
+    def s(path, leaf):
+        a = np.asarray(leaf)
+        ax = cache_batch_axis(path, leaf)
+        if ax is None:
+            return a.copy()
+        sl = [slice(None)] * a.ndim
+        sl[ax] = slice(0, n)
+        return a[tuple(sl)].copy()
+
+    return jax.tree_util.tree_map_with_path(s, tree)
+
+
+def pad_host_pages(tree: Any, n_pad: int) -> Any:
+    """Host payload zero-padded back up to the ``n_pad`` pow2 bucket a
+    scatter program expects — the padding rows land on the sentinel
+    index and DROP, so their values never matter."""
+    def p(path, leaf):
+        ax = cache_batch_axis(path, leaf)
+        if ax is None or leaf.shape[ax] >= n_pad:
+            return leaf
+        width = [(0, 0)] * leaf.ndim
+        width[ax] = (0, n_pad - leaf.shape[ax])
+        return np.pad(leaf, width)
+
+    return jax.tree_util.tree_map_with_path(p, tree)
+
+
+# ----------------------------------------------------------- wire codec
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """dtype from its string name, including the ml_dtypes extras
+    (bfloat16 and friends) a bare ``np.dtype`` does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_array(arr) -> dict:
+    """One array as its wire form: dtype name + shape + base64 raw
+    bytes (bitwise; no float round trip through text)."""
+    a = np.ascontiguousarray(np.asarray(arr))
+    return {"dtype": str(a.dtype), "shape": list(a.shape),
+            "b64": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def decode_array(doc: dict) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(doc["b64"]),
+        dtype=_np_dtype(doc["dtype"])).reshape(doc["shape"])
+
+
+def encode_payload(tree: Any) -> dict:
+    """A gathered page payload as JSON-safe wire form. Leaves ride in
+    ``tree_flatten`` order; the receiver unflattens against its OWN
+    cache treedef — both sides run the same model config, so the
+    structures agree (``decode_payload`` checks the leaf count)."""
+    if isinstance(tree, dict) and "leaves" in tree:
+        return tree  # already wire form (a pure-router gateway relays)
+    return {"leaves": [encode_array(leaf)
+                       for leaf in jax.tree_util.tree_leaves(tree)]}
+
+
+def decode_payload(doc: dict, treedef) -> Any:
+    leaves = [decode_array(d) for d in doc["leaves"]]
+    if treedef.num_leaves != len(leaves):
+        raise ValueError(
+            f"handoff payload carries {len(leaves)} leaves, this "
+            f"engine's cache has {treedef.num_leaves} — mismatched "
+            "model configs between the prefill and decode pools")
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ------------------------------------------------------------- the tier
+
+
+class HostPageTier:
+    """Host-RAM KV pages under an explicit byte budget.
+
+    The engine drives it single-threaded (its own scheduler thread);
+    the inner ``PrefixStore``'s lock keeps cross-thread STAT reads
+    (gateway /stats) consistent, same contract as the device store.
+    """
+
+    def __init__(self, budget_bytes: int):
+        self.store = PrefixStore(max(0, int(budget_bytes)))
+        self.spills = 0          # entries copied device -> host
+        self.page_ins = 0        # entries restored host -> device
+        self.bytes_spilled = 0   # payload bytes copied out, lifetime
+        self.bytes_paged_in = 0  # payload bytes restored, lifetime
+
+    # ------------------------------------------------------------ index
+
+    def has(self, tokens) -> bool:
+        return self.store.has(tokens)
+
+    def touch(self, tokens) -> None:
+        """Refresh an EXISTING sequence's LRU position (the caller
+        checked ``has()``): a re-evicted device entry whose content
+        already lives here skips the device->host copy entirely."""
+        self.store.insert(tokens, row=None)
+
+    def match_len(self, tokens) -> int:
+        return self.store.match_len(tokens)
+
+    def acquire(self, tokens):
+        return self.store.acquire(tokens)
+
+    def release(self, entry) -> None:
+        self.store.release(entry)
+
+    # ------------------------------------------------------------ moves
+
+    def insert(self, tokens, payload: Any, logits) -> bool:
+        """One spill: store the host ``payload`` (numpy pytree of the
+        sequence's real pages) + optional last-position logits.
+        Returns False when the budget refuses it (payload alone over
+        budget, or everything resident is pinned)."""
+        ok = self.store.insert(tokens, row=payload, logits=logits)
+        if ok:
+            self.spills += 1
+            self.bytes_spilled += tree_nbytes(payload) + (
+                tree_nbytes(logits) if logits is not None else 0)
+        return ok
+
+    def note_page_in(self, n_bytes: int) -> None:
+        self.page_ins += 1
+        self.bytes_paged_in += int(n_bytes)
+
+    # ------------------------------------------------------------ stats
+
+    def stats(self) -> dict:
+        st = self.store.stats()
+        return {
+            "entries": st["entries"],
+            "bytes": st["bytes"],
+            "budget_bytes": st["budget_bytes"],
+            "tokens": st["tokens"],
+            "nodes": st["nodes"],
+            "max_depth": st["max_depth"],
+            "evictions": st["evictions"],
+            "rejected": st["rejected"],
+            "spills": self.spills,
+            "page_ins": self.page_ins,
+            "bytes_spilled": self.bytes_spilled,
+            "bytes_paged_in": self.bytes_paged_in,
+        }

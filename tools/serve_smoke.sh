@@ -58,7 +58,9 @@ AGENT0_PID=''
 AGENT1_PID=''
 RGW_PID=''
 RCTRL_PID=''
-trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID $RGW_PID $RCTRL_PID 2>/dev/null; kill -9 $AGENT0_PID $AGENT1_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
+DGW_PID=''
+DCTRL_PID=''
+trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID $RGW_PID $RCTRL_PID $DGW_PID $DCTRL_PID 2>/dev/null; kill -9 $AGENT0_PID $AGENT1_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
 
 fail() { echo "serve-smoke: FAIL: $1" >&2; exit 1; }
 
@@ -529,6 +531,132 @@ EOF
     echo "serve-smoke: goodput OK (kv_pages_pressure fired + resolved, alerts.jsonl + portal render, /debug/goodput names largest waste)"
 }
 
+# ---- disagg round (also standalone: SERVE_SMOKE_ROUNDS=disagg) -------
+# ISSUE-12: disaggregated prefill/decode end-to-end on a real
+# subprocess gateway. --roles prefill=1,decode=1 with chunked prefill
+# (16-token budget vs a 40-token prompt -> 3 chunks), a deliberately
+# tiny per-replica prefix store (~2 entries) so distinct prompts evict
+# each other into the --kv-host-mb host tier, and exact repeats page
+# back in. Mixed long-prompt/short-chat traffic: zero 5xx, every
+# output token-exact vs a single-pool control gateway, /stats shows
+# kv_host.page_ins > 0 and at least one multi-chunk prefill.
+disagg_round() {
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --roles prefill=1,decode=1 --prefill-chunk-tokens 16 \
+        --kv-page-size 8 --prefix-cache-mb 0.03 --kv-host-mb 4 \
+        --port 0 --compile-cache '' \
+        >"$WORK/disagg_boot.log" 2>"$WORK/disagg_stderr.log" &
+    DGW_PID=$!
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --replicas 1 --port 0 --compile-cache '' --kv-page-size 8 \
+        >"$WORK/dctrl_boot.log" 2>"$WORK/dctrl_stderr.log" &
+    DCTRL_PID=$!
+    DURL=''; DCTRL_URL=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        DURL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/disagg_boot.log")
+        DCTRL_URL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/dctrl_boot.log")
+        [ -n "$DURL" ] && [ -n "$DCTRL_URL" ] && break
+        kill -0 $DGW_PID 2>/dev/null || fail "disagg gateway died at boot: $(cat "$WORK/disagg_stderr.log")"
+        kill -0 $DCTRL_PID 2>/dev/null || fail "disagg control died at boot: $(cat "$WORK/dctrl_stderr.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$DURL" ] && [ -n "$DCTRL_URL" ] || fail "disagg gateways did not print URLs within ${BOUND}s"
+    echo "serve-smoke: disagg gateway at $DURL (prefill=1,decode=1, chunk 16, host tier 4 MB; control at $DCTRL_URL)"
+
+    # mixed traffic, CONCURRENT against the disagg gateway: one long
+    # prompt (3 chunks), short chats riding between its chunks, three
+    # distinct shared-shape prompts that churn the tiny store into the
+    # host tier, then exact repeats that page back in
+    LONG='1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18, 19, 20, 21, 22, 23, 24, 25, 26, 27, 28, 29, 30, 31, 32, 33, 34, 35, 36, 37, 38, 39, 40'
+    P1='41, 42, 43, 44, 45, 46, 47, 48, 49, 50, 51, 52, 53, 54, 55, 56, 41, 42, 43, 44, 45, 46, 47, 48'
+    P2='2, 4, 6, 8, 10, 12, 14, 16, 18, 20, 22, 24, 26, 28, 30, 32, 34, 36, 38, 40, 42, 44, 46, 48'
+    P3='3, 5, 7, 9, 11, 13, 15, 17, 19, 21, 23, 25, 27, 29, 31, 33, 35, 37, 39, 41, 43, 45, 47, 49'
+    SHORT1='61, 62, 63'
+    SHORT2='9, 8, 7'
+    n=0
+    DISAGG_PIDS=''
+    for BODY in "$LONG" "$SHORT1" "$SHORT2"; do
+        curl_s "$WORK/disagg_$n" "$DURL/v1/generate" \
+            "{\"token_ids\": [$BODY], \"max_new_tokens\": 6, \"id\": $n}" \
+            >"$WORK/disagg_${n}.code" &
+        DISAGG_PIDS="$DISAGG_PIDS $!"
+        n=$((n + 1))
+    done
+    wait $DISAGG_PIDS
+    # store-churn phase, sequential (deterministic spill/page-in)
+    for BODY in "$P1" "$P2" "$P3" "$P1" "$P2"; do
+        code=$(curl_s "$WORK/disagg_$n" "$DURL/v1/generate" \
+            "{\"token_ids\": [$BODY], \"max_new_tokens\": 6, \"id\": $n}") \
+            || fail "disagg request $n curl"
+        [ "$code" = 200 ] || fail "disagg request $n -> $code"
+        n=$((n + 1))
+    done
+    N_REQ=$n
+    n=0
+    for BODY in "$LONG" "$SHORT1" "$SHORT2" "$P1" "$P2" "$P3" "$P1" "$P2"; do
+        [ -f "$WORK/disagg_${n}.code" ] && \
+            { [ "$(cat "$WORK/disagg_${n}.code")" = 200 ] || fail "disagg request $n -> $(cat "$WORK/disagg_${n}.code")"; }
+        code=$(curl_s "$WORK/dctrl_$n" "$DCTRL_URL/v1/generate" \
+            "{\"token_ids\": [$BODY], \"max_new_tokens\": 6, \"id\": $n}") \
+            || fail "disagg control $n curl"
+        [ "$code" = 200 ] || fail "disagg control $n -> $code"
+        $PY - "$WORK/disagg_$n" "$WORK/dctrl_$n" <<'EOF' || fail "disagg request $n: output differs from single-pool control"
+import json, sys
+a = json.load(open(sys.argv[1]))
+b = json.load(open(sys.argv[2]))
+assert a["token_ids"] == b["token_ids"], (a["token_ids"], b["token_ids"])
+EOF
+        n=$((n + 1))
+    done
+
+    code=$(curl_s "$WORK/disagg_stats" "$DURL/stats") || fail "disagg stats curl"
+    [ "$code" = 200 ] || fail "disagg stats -> $code"
+    $PY - "$WORK/disagg_stats" "$N_REQ" <<'EOF' || fail "disagg stats wrong: $(cat "$WORK/disagg_stats")"
+import json, sys
+stats = json.load(open(sys.argv[1]))
+n = int(sys.argv[2])
+assert stats["completed"] == n, stats["completed"]
+assert stats["shed"] == {}, stats["shed"]          # zero 5xx
+routing = stats["routing"]
+assert routing["handoffs"] == n, routing           # every request crossed pools
+assert routing["roles"] == {"0": "prefill", "1": "decode"}, routing
+eng = stats["engine"]
+assert eng["prefill_chunks"]["enabled"], eng["prefill_chunks"]
+assert eng["prefill_chunks"]["requests"] >= 1, eng["prefill_chunks"]
+assert eng["prefill_chunks"]["dispatches"] >= 2, eng["prefill_chunks"]
+kvh = eng["kv_host"]
+assert kvh["enabled"], kvh
+assert kvh["spills"] > 0, kvh                      # store churned into the tier
+assert kvh["page_ins"] > 0, kvh                    # repeats paged back in
+rows = {r["replica"]: r for r in stats["replicas"]}
+assert rows[1]["prefills"] == 0, rows[1]           # decode pool never prefills
+assert rows[0]["handoffs_out"] == n and rows[1]["handoffs_in"] == n, rows
+assert "prefix" in rows[0] and rows[0]["prefix"]["nodes"] >= 1, rows[0]
+EOF
+    curl_s "$WORK/disagg_metrics" "$DURL/metrics" >/dev/null 2>&1
+    grep -q 'tony_kv_host_page_ins_total' "$WORK/disagg_metrics" || fail "no tony_kv_host_* on /metrics"
+    grep -q 'tony_handoffs_total' "$WORK/disagg_metrics" || fail "no tony_handoffs_total on /metrics"
+
+    kill -TERM $DGW_PID $DCTRL_PID
+    for P in $DGW_PID $DCTRL_PID; do
+        i=0
+        while kill -0 $P 2>/dev/null; do
+            [ $i -ge $BOUND ] && fail "disagg gateway did not drain within ${BOUND}s of SIGTERM"
+            sleep 1; i=$((i + 1))
+        done
+    done
+    wait $DGW_PID; rc=$?
+    [ $rc = 0 ] || fail "disagg gateway exited $rc after SIGTERM"
+    DGW_PID=''
+    DCTRL_PID=''
+    echo "serve-smoke: disagg OK (role split + chunked prefill + host tier, zero 5xx, token-exact vs single-pool control)"
+}
+
+if [ "${SERVE_SMOKE_ROUNDS:-all}" = disagg ]; then
+    disagg_round   # `make disagg-smoke`: just the disaggregation round
+    exit 0
+fi
 if [ "${SERVE_SMOKE_ROUNDS:-all}" = goodput ]; then
     goodput_round   # `make goodput-smoke`: just the goodput/alerts round
     exit 0
@@ -882,6 +1010,9 @@ autoscale_round
 
 # ---- goodput/alerts round: tiny pool -> alert fires -> resolves ------
 goodput_round
+
+# ---- disagg round: role split + chunked prefill + host page tier -----
+disagg_round
 
 # ---- remote round: agents on "hosts", kill -9 one, keep serving ------
 remote_round
