@@ -55,8 +55,17 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 #     -> zero 5xx, token-exact vs a single-pool control, host-tier
 #     page-ins and multi-chunk prefills visible on /stats
 
+#   make autotune-smoke - just the shape-controller round of
+#     serve-smoke: an --autotune gateway booted at chunk-steps 1 under
+#     mixed traffic must actuate (grow chunk depth off the goodput
+#     ledger), stay token-exact vs a static control gateway with zero
+#     5xx, converge once idle, and land the decision in /stats
+#     engine.autotune + tony_autotune_* metrics + history
+#     metrics/autotune.jsonl
+
 .PHONY: lint smoke check test bench serve-smoke chaos-smoke \
-	autoscale-smoke goodput-smoke remote-smoke disagg-smoke
+	autoscale-smoke goodput-smoke remote-smoke disagg-smoke \
+	autotune-smoke
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -95,3 +104,6 @@ remote-smoke:
 
 disagg-smoke:
 	PY=$(PY) SERVE_SMOKE_ROUNDS=disagg sh tools/serve_smoke.sh
+
+autotune-smoke:
+	PY=$(PY) SERVE_SMOKE_ROUNDS=autotune sh tools/serve_smoke.sh

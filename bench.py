@@ -714,6 +714,114 @@ def bench_long_seq(on_tpu: bool) -> dict:
 # --------------------------------------------------------------- decode
 
 
+def _bench_eos_refill(model, params, cfg, batch) -> dict:
+    """The ISSUE-13 tentpole datum: in-dispatch EOS/refill lets
+    chunk_steps grow without the overshoot bucket eating the win.
+    Control = the pre-freeze engine at chunk 4 (the old sweet spot —
+    deeper chunks lost their gain to trimmed overshoot); treatment =
+    the frozen engine at chunk 16. Same mixed-budget greedy workload,
+    outputs asserted identical; reports tok/s, decode dispatches per
+    1k tokens, and the goodput-ledger decomposition
+    (useful/padding/overshoot/spec_rejected fractions of steady
+    decode+verify time) for BOTH arms, so every future BENCH_r
+    artifact decomposes the roofline gap instead of only quoting a
+    tok/s."""
+    import numpy as np
+
+    from tony_tpu.serve import Request, Server
+
+    rng = np.random.default_rng(7)
+    max_len = cfg.max_seq_len
+    p_len = min(16, max_len // 4)
+    head = max(4, min(64, max_len - p_len - 1))
+    budgets = [max(3, int(b)) for b in
+               rng.integers(head // 3, head, size=batch * 2)]
+    prompts = [rng.integers(1, cfg.vocab_size - 1,
+                            size=p_len).tolist()
+               for _ in range(batch * 2)]
+
+    def run(in_eos: bool, chunk: int):
+        server = Server(model, params, batch_size=batch, eos_id=-1,
+                        chunk_steps=chunk, in_dispatch_eos=in_eos)
+
+        def reqs():
+            return [Request(list(p), n, id=i) for i, (p, n)
+                    in enumerate(zip(prompts, budgets))]
+
+        list(server.run(reqs()))   # warm pass: pays every compile
+        d0 = server.dispatches
+        t0 = time.perf_counter()
+        out = {r.id: r.tokens for r in server.run(reqs())}
+        dt = time.perf_counter() - t0
+        toks = sum(len(v) for v in out.values())
+        summ = server.timeline.summary()
+        steady = useful = padding = overshoot = rejected = 0.0
+        for kind in ("decode", "verify"):
+            a = summ.get(kind)
+            if not a:
+                continue
+            steady += a["ms"] - a["compile_ms"]
+            useful += a["useful_ms"]
+            padding += a["padding_ms"]
+            overshoot += a["overshoot_ms"]
+            rejected += a["rejected_ms"]
+        steady = max(steady, 1e-9)
+        return out, {
+            "chunk_steps": chunk,
+            "tok_s": round(toks / dt, 1),
+            "decode_dispatches": server.dispatches - d0,
+            "dispatches_per_1k_tokens": round(
+                1e3 * (server.dispatches - d0) / max(1, toks), 2),
+            "wasted_steps": server.wasted_steps,
+            "frozen_steps": server.frozen_steps,
+            "ledger": {
+                "useful": round(useful / steady, 4),
+                "padding": round(padding / steady, 4),
+                "overshoot": round(overshoot / steady, 4),
+                "spec_rejected": round(rejected / steady, 4),
+            },
+        }
+
+    out_c, control = run(False, 4)
+    out_t, treat = run(True, 16)
+    return {
+        "control": control,
+        "treatment": treat,
+        "outputs_identical": out_c == out_t,
+        "tok_s_ratio": round(treat["tok_s"]
+                             / max(control["tok_s"], 1e-9), 3),
+        "dispatch_ratio": round(
+            control["dispatches_per_1k_tokens"]
+            / max(treat["dispatches_per_1k_tokens"], 1e-9), 3),
+    }
+
+
+def _int8_kv_flash_bytes(cfg, params, batch, cache_tokens) -> dict:
+    """The bytes side of the 0.54x ``int8_kv_flash_speedup``
+    regression (ISSUE-13 satellite; open since BENCH_LKG): per decode
+    step, the int8-KV flash arm re-reads every parameter byte plus the
+    int8 cache + fp32 scales where the bf16-einsum base reads the
+    full-precision cache — the analytic ratio says whether the
+    measured slowdown CAN be a bytes problem at all. Measured at the
+    bench shape the ratio is < 1 (int8 strictly shrinks the step's
+    read set), so the regression is a dispatch/kernel-shape problem —
+    docs/PERF.md carries the verdict and the next-attempt notes."""
+    param_bytes = sum(x.size * x.dtype.itemsize
+                      for x in jax.tree.leaves(params))
+    kvh = cfg.kv_heads
+    dh = cfg.head_dim
+    item = jnp.dtype(cfg.dtype).itemsize
+    base_kv = 2.0 * batch * cache_tokens * kvh * dh * item
+    q8_kv = 2.0 * batch * cache_tokens * kvh * dh \
+        + 2.0 * batch * cache_tokens * kvh * 4  # int8 + fp32 scales
+    ratio = (param_bytes + q8_kv) / (param_bytes + base_kv)
+    return {
+        "int8_kv_flash_bytes_ratio": round(ratio, 4),
+        "int8_kv_flash_verdict": "dispatch" if ratio <= 1.0
+        else "bytes",
+    }
+
+
 def bench_decode(on_tpu: bool) -> dict:
     """KV-cache autoregressive decode throughput on the flagship decoder
     (the serving path: prefill + lax.scan decode under one jit).
@@ -769,6 +877,17 @@ def bench_decode(on_tpu: bool) -> dict:
         "per_token_latency_ms": round(dt / new * 1e3, 3),
         "batch": batch, "new_tokens": new,
     }
+    # ISSUE-13 satellites: (a) the serving-engine in-dispatch-EOS A/B
+    # with the goodput-ledger decomposition every future BENCH_r
+    # artifact carries, (b) the analytic bytes side of the 0.54x
+    # int8_kv_flash regression (bytes-vs-dispatch verdict)
+    try:
+        result["eos_refill"] = _bench_eos_refill(model, params, cfg,
+                                                 batch)
+    except Exception as e:  # noqa: BLE001 — keep the core datum alive
+        result["eos_refill"] = {"error": f"{type(e).__name__}: {e}"}
+    result.update(_int8_kv_flash_bytes(cfg, params, batch,
+                                       prompt_len + new // 2))
     bw = hbm_bw_per_chip() if on_tpu else 0.0
     if bw:
         # decode roofline: each step re-reads every parameter byte once

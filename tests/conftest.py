@@ -24,6 +24,27 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite is compile-dominated (tiny
+# models, many distinct program shapes), and identical programs recompile
+# on every pytest invocation. Caching them across runs keeps the tier-1
+# wall clock well inside its budget on a warm box and costs a cold run
+# only the cache writes (measured ~2.5x faster warm on this suite's
+# serving tests). Keys include jax/XLA versions and compile options, so a
+# toolchain bump simply misses. JAX_COMPILATION_CACHE_DIR, when set,
+# wins — jax reads it natively before this config is consulted. The
+# path is per-user: a fixed world-shared /tmp name would be silently
+# unwritable for the second user on a shared box (and a cache-
+# poisoning surface — entries deserialize as compiled executables).
+if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    import getpass
+    import tempfile
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(tempfile.gettempdir(),
+                     f"tony-tpu-jax-cache-{getpass.getuser()}"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 

@@ -1,0 +1,379 @@
+"""Adaptive shape controller (serve/autotune.py, ISSUE-13).
+
+Unit-tests the controller against fake engines (hysteresis, bounds,
+pow2 grid, never-actuates-when-idle, per-rule signals, new-compile
+receipts, convergence), then pins the gateway integration live: an
+--autotune gateway under traffic actuates at least once, stays
+token-exact vs a static control gateway, surfaces every decision in
+/stats + /metrics + history metrics/autotune.jsonl, and goes quiet
+(converged) once traffic stops.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tony_tpu.serve.autotune import AutotuneController, KnobBounds
+
+
+class _FakeTimeline:
+    def __init__(self):
+        self.summ = {}
+
+    def summary(self):
+        return {k: dict(v) for k, v in self.summ.items()}
+
+
+class _FakeServer:
+    """The attribute surface the controller reads/writes."""
+
+    def __init__(self, chunk_steps=4, speculate_k=0, prefill_chunk=0):
+        self.chunk_steps = chunk_steps
+        self.speculate_k = speculate_k
+        self.prefill_chunk = prefill_chunk
+        self.min_bucket = 16
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.timeline = _FakeTimeline()
+        self._compiled = set()
+
+    def feed(self, kind, *, count, ms, useful=0.0, padding=0.0,
+             overshoot=0.0, rejected=0.0, tokens=0, compile_ms=0.0):
+        """Advance the fake cumulative aggregates by one tick's
+        worth of traffic."""
+        a = self.timeline.summ.setdefault(kind, {
+            "count": 0, "ms": 0.0, "compile_ms": 0.0, "tokens": 0,
+            "useful_ms": 0.0, "padding_ms": 0.0, "overshoot_ms": 0.0,
+            "rejected_ms": 0.0})
+        a["count"] += count
+        a["ms"] += ms
+        a["compile_ms"] += compile_ms
+        a["tokens"] += tokens
+        a["useful_ms"] += useful
+        a["padding_ms"] += padding
+        a["overshoot_ms"] += overshoot
+        a["rejected_ms"] += rejected
+
+
+def _ctl(**kw):
+    base = dict(chunk_bounds=(1, 16), spec_bounds=(0, 8),
+                prefill_bounds=(0, 0), hold_ticks=1, cooldown_ticks=0,
+                min_dispatches=2)
+    base.update(kw)
+    return AutotuneController(**base)
+
+
+def _busy_clean(srv, n=8):
+    """One tick's worth of healthy decode traffic: no overshoot, low
+    padding — the grow-chunk condition."""
+    srv.feed("decode", count=n, ms=80.0, useful=76.0, padding=4.0,
+             tokens=n * srv.chunk_steps)
+
+
+def test_knob_bounds_clamp():
+    b = KnobBounds(2, 16)
+    assert b.clamp(1) == 2 and b.clamp(64) == 16 and b.clamp(8) == 8
+
+
+def test_never_actuates_when_idle():
+    ctl = _ctl()
+    srv = _FakeServer(chunk_steps=4)
+    _busy_clean(srv)
+    assert ctl.tick([(0, srv)]) == []  # baseline tick
+    # idle ticks forever after: no deltas, no actuations — and the
+    # busy tick's pending streak must not survive the idle gap
+    for _ in range(10):
+        assert ctl.tick([(0, srv)]) == []
+    assert srv.chunk_steps == 4
+    assert ctl.snapshot()["actuations_total"] == 0
+    assert ctl.idle_ticks > 0
+
+
+def test_grow_shrink_on_pow2_grid_within_bounds():
+    ctl = _ctl()
+    srv = _FakeServer(chunk_steps=4)
+    _busy_clean(srv)
+    ctl.tick([(0, srv)])  # baseline
+    seen = []
+    for _ in range(6):
+        _busy_clean(srv)
+        ctl.tick([(0, srv)])
+        seen.append(srv.chunk_steps)
+    # monotone pow2 growth, capped at the bound, then quiet
+    assert seen == [8, 16, 16, 16, 16, 16]
+    assert ctl.snapshot()["actuations"]["chunk_steps"] == 2
+    # heavy overshoot shrinks, one pow2 step per actuation
+    srv.feed("decode", count=8, ms=80.0, useful=40.0, overshoot=40.0)
+    ctl.tick([(0, srv)])
+    assert srv.chunk_steps == 8
+    row = ctl.recent[-1]
+    assert row["reason"] == "overshoot" and row["from"] == 16
+    assert row["signals"]["overshoot_frac"] > 0.4
+
+
+def test_hysteresis_holds_for_n_ticks():
+    ctl = _ctl(hold_ticks=3)
+    srv = _FakeServer(chunk_steps=4)
+    _busy_clean(srv)
+    ctl.tick([(0, srv)])  # baseline
+    for i in range(2):
+        _busy_clean(srv)
+        assert ctl.tick([(0, srv)]) == []  # streak 1, 2: held
+        assert srv.chunk_steps == 4
+    _busy_clean(srv)
+    assert len(ctl.tick([(0, srv)])) == 1  # streak 3: actuates
+    assert srv.chunk_steps == 8
+    # an idle tick resets the streak — 2 busy + idle + 2 busy never
+    # reaches 3 consecutive
+    ctl2 = _ctl(hold_ticks=3)
+    srv2 = _FakeServer(chunk_steps=4)
+    _busy_clean(srv2)
+    ctl2.tick([(0, srv2)])
+    for _ in range(2):
+        _busy_clean(srv2)
+        ctl2.tick([(0, srv2)])
+    ctl2.tick([(0, srv2)])  # idle
+    for _ in range(2):
+        _busy_clean(srv2)
+        ctl2.tick([(0, srv2)])
+    assert srv2.chunk_steps == 4
+
+
+def test_cooldown_blocks_rejudging():
+    ctl = _ctl(cooldown_ticks=3)
+    srv = _FakeServer(chunk_steps=4)
+    _busy_clean(srv)
+    ctl.tick([(0, srv)])  # baseline
+    _busy_clean(srv)
+    ctl.tick([(0, srv)])
+    assert srv.chunk_steps == 8
+    for _ in range(3):  # cooldown: proposals ignored
+        _busy_clean(srv)
+        ctl.tick([(0, srv)])
+        assert srv.chunk_steps == 8
+    _busy_clean(srv)
+    ctl.tick([(0, srv)])
+    assert srv.chunk_steps == 16
+
+
+def test_speculate_k_rules_never_rearm_from_zero():
+    ctl = _ctl()
+    # rejection-heavy drafting halves k; k=0 never re-arms
+    srv = _FakeServer(chunk_steps=4, speculate_k=8)
+    srv.feed("verify", count=8, ms=80.0, useful=60.0, rejected=20.0)
+    srv.spec_drafted, srv.spec_accepted = 40, 10
+    ctl.tick([(0, srv)])  # baseline
+    srv.feed("verify", count=8, ms=80.0, useful=60.0, rejected=20.0)
+    srv.spec_drafted += 40
+    srv.spec_accepted += 10  # 75% rejected this tick
+    ctl.tick([(0, srv)])
+    assert srv.speculate_k == 4
+    assert ctl.recent[-1]["reason"] == "spec_rejected"
+    # high acceptance grows k (fresh controller: no cooldown state)
+    ctl2 = _ctl()
+    srv2 = _FakeServer(chunk_steps=4, speculate_k=2)
+    srv2.feed("verify", count=8, ms=80.0, useful=78.0)
+    srv2.spec_drafted, srv2.spec_accepted = 40, 38
+    ctl2.tick([(0, srv2)])
+    srv2.feed("verify", count=8, ms=80.0, useful=78.0)
+    srv2.spec_drafted += 40
+    srv2.spec_accepted += 38
+    ctl2.tick([(0, srv2)])
+    assert srv2.speculate_k == 4
+    # disabled speculation produces no draft data -> never re-armed
+    ctl3 = _ctl()
+    srv3 = _FakeServer(chunk_steps=16, speculate_k=0)
+    _busy_clean(srv3)
+    ctl3.tick([(0, srv3)])
+    _busy_clean(srv3)
+    ctl3.tick([(0, srv3)])
+    assert srv3.speculate_k == 0
+
+
+def test_prefill_chunk_rules():
+    ctl = _ctl(prefill_bounds=(0, 512))
+    srv = _FakeServer(chunk_steps=16, prefill_chunk=128)
+    srv.feed("prefill_chunk", count=4, ms=40.0, useful=10.0,
+             padding=30.0)
+    ctl.tick([(0, srv)])  # baseline
+    srv.feed("prefill_chunk", count=4, ms=40.0, useful=10.0,
+             padding=30.0)  # 75% padding: windows wider than prompts
+    ctl.tick([(0, srv)])
+    assert srv.prefill_chunk == 64
+    assert ctl.recent[-1]["reason"] == "prefill_padding"
+    # pad-free chunked prefill grows the budget back toward the bound
+    srv.feed("prefill_chunk", count=8, ms=80.0, useful=80.0)
+    ctl.tick([(0, srv)])
+    assert srv.prefill_chunk == 128
+    assert ctl.recent[-1]["reason"] == "prefill_interleave"
+    # the floor is the engine's bucket minimum, never below
+    srv2 = _FakeServer(chunk_steps=16, prefill_chunk=16)
+    ctl2 = _ctl(prefill_bounds=(0, 512))
+    srv2.feed("prefill_chunk", count=4, ms=40.0, padding=40.0)
+    ctl2.tick([(0, srv2)])
+    srv2.feed("prefill_chunk", count=4, ms=40.0, padding=40.0)
+    ctl2.tick([(0, srv2)])
+    assert srv2.prefill_chunk == 16
+
+
+def test_new_compile_receipt():
+    ctl = _ctl()
+    srv = _FakeServer(chunk_steps=4)
+    srv._compiled = {("decode", 8, 0), ("decode", 4, 0)}
+    _busy_clean(srv)
+    ctl.tick([(0, srv)])  # baseline
+    _busy_clean(srv)
+    ctl.tick([(0, srv)])
+    assert srv.chunk_steps == 8
+    assert ctl.recent[-1]["new_compile"] is False  # bucket pre-warmed
+    _busy_clean(srv)
+    ctl.tick([(0, srv)])
+    assert srv.chunk_steps == 16
+    assert ctl.recent[-1]["new_compile"] is True  # deliberate, logged
+    assert ctl.snapshot()["new_compiles"] == 1
+
+
+def test_convergence_on_steady_traffic():
+    """The acceptance pin: actuations STOP within a bounded number of
+    ticks on steady traffic — the knob reaches its bound (or dead
+    zone) and the controller reports converged."""
+    ctl = _ctl()
+    srv = _FakeServer(chunk_steps=1)
+    _busy_clean(srv)
+    ctl.tick([(0, srv)])  # baseline
+    for _ in range(12):
+        _busy_clean(srv)
+        ctl.tick([(0, srv)])
+    assert srv.chunk_steps == 16  # at the bound
+    last = ctl.last_actuation_tick
+    for _ in range(6):
+        _busy_clean(srv)
+        ctl.tick([(0, srv)])
+    assert ctl.last_actuation_tick == last  # quiet ever since
+    assert ctl.snapshot()["converged"] is True
+
+
+def test_replicas_without_timeline_are_skipped():
+    class Remote:  # a RemoteServer stub has no local timeline
+        chunk_steps = 4
+        timeline = None
+
+    ctl = _ctl()
+    assert ctl.tick([(0, Remote()), (1, None)]) == []
+    assert ctl.snapshot()["actuations_total"] == 0
+
+
+# ------------------------------------------------- gateway integration
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from tony_tpu.models import Transformer, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=64,
+                            dtype=jnp.float32,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def test_gateway_autotune_actuates_token_exact(tiny, tmp_path):
+    """The live pin: an --autotune gateway under steady traffic
+    actuates at least once (chunk grows off the ledger's clean
+    overshoot signal), every output stays byte-identical to a static
+    control gateway, the decisions land in /stats engine.autotune and
+    history metrics/autotune.jsonl, and the controller converges
+    (goes quiet) when traffic stops."""
+    from tony_tpu.gateway import Gateway, GatewayHistory, GenRequest
+    from tony_tpu.models import Transformer  # noqa: F401 — fixture dep
+    from tony_tpu.serve import Server
+
+    model, params = tiny
+
+    def traffic(gw):
+        outs = {}
+        for wave in range(4):
+            ts = [gw.submit(GenRequest([1 + i + wave, 2, 3],
+                                       max_new_tokens=14,
+                                       id=f"{wave}-{i}"))
+                  for i in range(3)]
+            for t in ts:
+                outs[t.request.id] = t.result(timeout=120).tokens
+        return outs
+
+    control = Gateway([Server(model, params, batch_size=2, eos_id=-1,
+                              chunk_steps=1, min_bucket=8)],
+                      alerts=False).start()
+    try:
+        expect = traffic(control)
+    finally:
+        assert control.drain(timeout=120)
+
+    hist = GatewayHistory(str(tmp_path))
+    srv = Server(model, params, batch_size=2, eos_id=-1,
+                 chunk_steps=1, min_bucket=8)
+    gw = Gateway([srv], alerts=False, history=hist, autotune=True,
+                 autotune_interval_s=0.05,
+                 # hi=4 keeps the actuation ladder to at most two new
+                 # chunk programs — the pin is >=1 actuation +
+                 # token-exactness, not how far the knob can climb
+                 autotune_config={"chunk_bounds": (1, 4),
+                                  "hold_ticks": 1, "cooldown_ticks": 0,
+                                  "min_dispatches": 2}).start()
+    try:
+        got = traffic(gw)
+        assert got == expect  # actuations never change outputs
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = gw.snapshot()["engine"]["autotune"]
+            if snap["actuations_total"] >= 1:
+                break
+            time.sleep(0.02)
+        assert snap["actuations_total"] >= 1, snap
+        assert snap["enabled"] and snap["replicas"][0]["chunk_steps"] > 1
+        assert snap["recent"][-1]["knob"] == "chunk_steps"
+        # idle: the controller goes quiet and reports convergence.
+        # Settle first: the last wave's deltas may still be one tick
+        # away from judgment when the actuation above lands.
+        time.sleep(0.3)
+        before = gw.snapshot()["engine"]["autotune"]["actuations_total"]
+        time.sleep(0.4)
+        snap2 = gw.snapshot()["engine"]["autotune"]
+        assert snap2["actuations_total"] == before
+        assert snap2["converged"] is True
+        # /metrics carries the same numbers
+        from tony_tpu.obs.export import prometheus_text
+
+        text = prometheus_text(gw)
+        assert "tony_autotune_enabled 1" in text
+        assert 'tony_autotune_knob{replica="0",knob="chunk_steps"}' \
+            in text
+    finally:
+        assert gw.drain(timeout=120)
+    rows = [json.loads(ln) for ln in open(hist._autotune_path)
+            if ln.strip()]
+    assert rows and rows[0]["knob"] == "chunk_steps"
+    assert {"from", "to", "reason", "signals", "new_compile"} \
+        <= set(rows[0])
+
+
+def test_gateway_without_autotune_reports_disabled(tiny):
+    from tony_tpu.gateway import Gateway, GenRequest
+    from tony_tpu.serve import Server
+
+    model, params = tiny
+    gw = Gateway([Server(model, params, batch_size=2, eos_id=-1,
+                         min_bucket=8)], alerts=False).start()
+    try:
+        gw.submit(GenRequest([1, 2, 3], max_new_tokens=3,
+                             id="x")).result(timeout=60)
+        assert gw.snapshot()["engine"]["autotune"] == {
+            "enabled": False}
+    finally:
+        assert gw.drain(timeout=60)

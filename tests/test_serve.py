@@ -108,6 +108,110 @@ def test_chunk_size_does_not_change_results(tiny):
     assert out[1] == out[8]
 
 
+@pytest.mark.parametrize(
+    "paged",
+    # the unpaged cell rides the slow lane: unpaged frozen behavior is
+    # already pinned tier-1 by the mid-chunk-EOS/refill and
+    # overshoot-zero tests, and the paged cell compiles a superset of
+    # the machinery (paged_view/write_back under freeze)
+    [pytest.param(False, marks=pytest.mark.slow), True])
+def test_frozen_chunk_invariance_1_vs_16(tiny, paged):
+    """The ISSUE-13 chunk-invariance pin, extended to the frozen-slot
+    variant: with in-dispatch EOS a chunk_steps=16 engine — deeper
+    than every request's budget, so EVERY finishing slot freezes
+    mid-chunk — is token-exact vs chunk_steps=1, across mixed EOS and
+    budget finishes, paged and unpaged, with zero overshoot and the
+    trim walk clean (freeze_faults == 0). Sampled co-tenants pin that
+    frozen rows stop advancing rng without moving live draw chains."""
+    model, params = tiny
+    probe = [17, 46, 10, 20, 62, 26]
+    solo = _solo(model, params, probe, 8)
+    eos = next(t for i, t in enumerate(solo)
+               if i > 0 and t not in solo[:i])
+    reqs = [Request(probe, max_new_tokens=8, id="a"),
+            Request([5, 9], max_new_tokens=13, id="b"),
+            Request([3, 3, 3, 3], max_new_tokens=5, id="c"),
+            Request([9, 9, 2], max_new_tokens=7, temperature=0.9,
+                    top_k=8, seed=5, id="s")]
+    import copy
+
+    out, servers = {}, {}
+    for chunk in (1, 16):
+        server = Server(model, params, batch_size=2, eos_id=eos,
+                        min_bucket=8, chunk_steps=chunk, paged=paged)
+        out[chunk] = {r.id: (r.tokens, r.finish_reason)
+                      for r in server.run(copy.deepcopy(reqs))}
+        servers[chunk] = server
+    assert out[1] == out[16]
+    deep = servers[16]
+    assert deep.wasted_steps == 0
+    assert deep.frozen_steps > 0  # budget-5 slot froze inside k=16...
+    assert deep.freeze_faults == 0  # ...and re-emitted only its final
+
+
+@pytest.mark.slow  # two scan_layers+int8 engine compiles; slow lane
+def test_frozen_decode_scan_layers_int8(tiny):
+    """The remaining cells of the ISSUE-13 overshoot-zero matrix:
+    in-dispatch EOS over a scan_layers + int8-KV engine (stacked
+    [n_layers] cache counters broadcast the frozen sentinel writes,
+    scale leaves drop them too) with speculation riding along —
+    token-exact vs the legacy engine, zero wasted steps, trim walk
+    clean."""
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=64,
+                            dtype=jnp.float32,
+                            attention_backend="reference",
+                            scan_layers=True, kv_cache_quant=True)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    reqs = [Request([1, 2, 3, 4] * 3, max_new_tokens=11, id="rep"),
+            Request([7, 9, 11], max_new_tokens=4, id="short")]
+    import copy
+
+    out = {}
+    for freeze in (False, True):
+        # paged auto-downgrades nothing here (no sliding window):
+        # exercise the paged default
+        server = Server(model, params, batch_size=2, eos_id=-1,
+                        min_bucket=8, chunk_steps=8, speculate_k=3,
+                        in_dispatch_eos=freeze)
+        out[freeze] = {r.id: (r.tokens, r.finish_reason)
+                      for r in server.run(copy.deepcopy(reqs))}
+        if freeze:
+            assert server.wasted_steps == server.spec_drafted \
+                - server.spec_accepted  # only rejected drafts remain
+            assert server.freeze_faults == 0
+    assert out[True] == out[False]
+
+
+def test_mid_chunk_eos_refill_parity(tiny):
+    """A slot that samples EOS mid-chunk freezes in-dispatch, is
+    evicted by the trim walk, and its slot refills from the queue the
+    same scheduler round — the waiting request's output must be
+    token-exact vs a solo generate() (stale frozen re-emits must never
+    leak into the next tenant), with zero wasted steps end to end."""
+    model, params = tiny
+    probe = [17, 46, 10, 20, 62, 26]
+    solo = _solo(model, params, probe, 8)
+    eos, idx = next((t, i) for i, t in enumerate(solo)
+                    if i > 0 and t not in solo[:i])
+    followers = [[7, 2, 5, 11, 4], [1, 6, 3], [44, 2, 9, 13]]
+    server = Server(model, params, batch_size=2, eos_id=eos,
+                    min_bucket=8, chunk_steps=8)
+    reqs = [Request(probe, max_new_tokens=8, id="eos-mid")] + [
+        Request(f, max_new_tokens=6, id=f"f{i}")
+        for i, f in enumerate(followers)]
+    res = {r.id: r for r in server.run(reqs)}
+    assert res["eos-mid"].tokens == solo[:idx + 1]
+    assert res["eos-mid"].finish_reason == "eos"
+    for i, f in enumerate(followers):
+        assert res[f"f{i}"].tokens == _solo_trimmed(
+            model, params, f, 6, (eos,)), f
+    assert server.wasted_steps == 0
+    assert server.freeze_faults == 0
+
+
 def test_admit_evict_scheduler_invariants(tiny):
     """More requests than slots: occupancy never exceeds batch_size, a
     slot never hosts two live requests, every request finishes exactly

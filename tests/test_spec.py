@@ -451,21 +451,38 @@ def test_counters_and_result_fields(tiny):
 
 
 def test_wasted_steps_counts_chunk_overshoot(tiny):
-    """The decode-step utilization satellite: a slot finishing mid-
-    chunk decodes garbage until the chunk ends; the trimmed slot-steps
-    surface in counters(). (A SOLO short request never overshoots —
+    """The decode-step utilization satellite, both modes: with
+    in-dispatch EOS OFF (the pre-ISSUE-13 control) a slot finishing
+    mid-chunk decodes garbage until the chunk ends and the trimmed
+    slot-steps surface in counters(); with it ON (the default) the
+    same workload freezes the slot in-dispatch — zero wasted_steps,
+    the trailing positions counted as frozen re-emits instead, and
+    identical outputs. (A SOLO short request never overshoots —
     _chunk_size bounds the chunk by the max remaining budget — so the
     waste needs a mixed-budget batch.)"""
     model, params = tiny
-    # budgets 3 and 10, chunk 8: the long slot forces k=8; the short
-    # one consumes 2 decode tokens (1 came at admit) and trims 6
-    server, res = _run(model, params,
-                       [Request([1, 2, 3], max_new_tokens=3, id="w"),
-                        Request([5, 9], max_new_tokens=10, id="l")],
-                       batch_size=2, chunk_steps=8)
-    assert len(res) == 2
-    assert server.wasted_steps == 6
-    assert server.counters()["wasted_steps"] == 6
+
+    def reqs():
+        # budgets 3 and 10, chunk 8: the long slot forces k=8; the
+        # short one consumes 2 decode tokens (1 came at admit) and
+        # trims/freezes 6
+        return [Request([1, 2, 3], max_new_tokens=3, id="w"),
+                Request([5, 9], max_new_tokens=10, id="l")]
+
+    legacy, res_legacy = _run(model, params, reqs(), batch_size=2,
+                              chunk_steps=8, in_dispatch_eos=False)
+    assert len(res_legacy) == 2
+    assert legacy.wasted_steps == 6
+    assert legacy.counters()["wasted_steps"] == 6
+    assert legacy.frozen_steps == 0
+
+    frozen, res_frozen = _run(model, params, reqs(), batch_size=2,
+                              chunk_steps=8)
+    assert res_frozen == res_legacy
+    assert frozen.wasted_steps == 0
+    assert frozen.frozen_steps == 6
+    assert frozen.freeze_faults == 0
+    assert frozen.counters()["frozen_steps"] == 6
 
 
 def test_wasted_steps_counts_rejected_drafts(tiny, monkeypatch):
@@ -490,12 +507,15 @@ def test_wasted_steps_counts_rejected_drafts(tiny, monkeypatch):
 
 def test_batch_drag_gate_prefers_chunks(tiny):
     """A lone drafter must not drag a mixed batch to one token per
-    dispatch: at chunk_steps=8 the expected verify yield (2 slots + a
-    4-token draft) never beats the 16-token chunk dispatch, so the
-    gate keeps every round on the chunk path — speculation-on costs
-    exactly speculation-off plus the host-side lookups. The co-tenant
-    is SAMPLED (greedy cycles of the tiny model would start hitting
-    the lookup and make it a second drafter)."""
+    dispatch in the UNFUSED (in_dispatch_eos=False) path: at
+    chunk_steps=8 the expected verify yield (2 slots + a 4-token
+    draft) never beats the 16-token chunk dispatch, so the gate keeps
+    every round on the chunk path — speculation-on costs exactly
+    speculation-off plus the host-side lookups. The co-tenant is
+    SAMPLED (greedy cycles of the tiny model would start hitting the
+    lookup and make it a second drafter). The fused default needs no
+    gate — every slot decodes the full chunk inside the verify
+    dispatch — which test_fused_round_never_drags pins."""
     model, params = tiny
 
     def reqs():
@@ -505,12 +525,41 @@ def test_batch_drag_gate_prefers_chunks(tiny):
                 Request([7, 9, 11], max_new_tokens=17, temperature=0.8,
                         top_k=8, seed=3, id="samp")]
 
+    off, ro = _run(model, params, reqs(), batch_size=2, chunk_steps=8,
+                   in_dispatch_eos=False)
+    on, rn = _run(model, params, reqs(), batch_size=2, chunk_steps=8,
+                  speculate_k=4, in_dispatch_eos=False)
+    assert rn == ro
+    assert on.spec_rounds == 0
+    assert on.dispatches == off.dispatches
+
+
+def test_fused_round_never_drags(tiny):
+    """The ISSUE-13 fused speculation round replaces the drag gate:
+    the same lone-drafter mixed batch now SPECULATES — the sampled
+    co-tenant decodes its full chunk inside the fused dispatch, so
+    speculation-on needs no more dispatches than speculation-off (and
+    strictly fewer whenever drafts land), with outputs identical."""
+    model, params = tiny
+
+    def reqs():
+        return [Request(list(REP), max_new_tokens=17, id="rep"),
+                Request([7, 9, 11], max_new_tokens=17, temperature=0.8,
+                        top_k=8, seed=3, id="samp")]
+
     off, ro = _run(model, params, reqs(), batch_size=2, chunk_steps=8)
     on, rn = _run(model, params, reqs(), batch_size=2, chunk_steps=8,
                   speculate_k=4)
     assert rn == ro
-    assert on.spec_rounds == 0
-    assert on.dispatches == off.dispatches
+    assert on.spec_rounds > 0  # the gate is gone: drafts verify
+    # every fused round lands >= 1 + chunk tokens per live slot where
+    # a chunk round lands exactly chunk — so dispatches never grow by
+    # more than the one tail round the accepted drafts can desync off
+    # the pow2 budget grid (the chunk_steps=1 dispatch-cut claim is
+    # test_spec_reduces_dispatches_and_is_exact's)
+    assert on.dispatches <= off.dispatches + 1
+    assert on.spec_accepted > 0
+    assert on.freeze_faults == 0
 
 
 @pytest.mark.slow  # gateway plumbing; the engine-level counters test

@@ -253,6 +253,37 @@ def build_parser() -> argparse.ArgumentParser:
                    help="lockout after a scale-up (s)")
     p.add_argument("--autoscale-cooldown-down", type=float, default=30.0,
                    help="lockout after a scale-down (s)")
+    p.add_argument("--no-in-dispatch-eos", action="store_true",
+                   help="disable the in-dispatch EOS/refill freeze "
+                   "(ISSUE-13) and fused speculation rounds — the "
+                   "pre-freeze engine behavior, kept as an A/B "
+                   "control; costs chunk overshoot at depth")
+    p.add_argument("--autotune", action="store_true",
+                   help="arm the ledger-driven adaptive shape "
+                   "controller (serve/autotune.py): steers "
+                   "chunk-steps / speculate-k / prefill-chunk per "
+                   "replica from the goodput ledger, within the "
+                   "--autotune-* bounds; decisions go to /stats "
+                   "engine.autotune, tony_autotune_* metrics, and "
+                   "history metrics/autotune.jsonl")
+    p.add_argument("--autotune-interval", type=float, default=1.0,
+                   help="seconds between controller ticks")
+    p.add_argument("--autotune-chunk-min", type=int, default=1,
+                   help="chunk-steps floor the controller may steer to")
+    p.add_argument("--autotune-chunk-max", type=int, default=32,
+                   help="chunk-steps ceiling (0 pins chunk-steps)")
+    p.add_argument("--autotune-spec-max", type=int, default=16,
+                   help="speculate-k ceiling (0 pins speculate-k; the "
+                   "controller never re-arms speculation from 0)")
+    p.add_argument("--autotune-prefill-max", type=int, default=0,
+                   help="prefill-chunk-tokens ceiling (0 = leave the "
+                   "prefill chunk budget alone)")
+    p.add_argument("--autotune-hold", type=int, default=2,
+                   help="consecutive same-direction ticks before an "
+                   "actuation (hysteresis)")
+    p.add_argument("--autotune-cooldown", type=int, default=3,
+                   help="ticks after an actuation during which the "
+                   "knob is not re-judged")
     p.add_argument("--hbm-gbps", type=float, default=0.0,
                    help="peak HBM bandwidth reference in GB/s for the "
                         "goodput ledger's per-dispatch HBM-BW%% / MFU "
@@ -349,6 +380,8 @@ def server_factory(args, model, params, eos):
                       prefill_chunk_tokens=getattr(
                           args, "prefill_chunk_tokens", 0),
                       kv_host_mb=kv_host_mb,
+                      in_dispatch_eos=not getattr(
+                          args, "no_in_dispatch_eos", False),
                       **paged_kw)
 
     return make
@@ -404,6 +437,8 @@ def agent_argv(args, index: int) -> list:
             "--port", "0"]
     if args.no_paged_kv:
         argv.append("--no-paged-kv")
+    if getattr(args, "no_in_dispatch_eos", False):
+        argv.append("--no-in-dispatch-eos")
     if args.demo_model:
         argv.append("--demo-model")
     else:
@@ -541,7 +576,25 @@ def build_gateway(args, model, params, eos, *, metrics_store=None):
                    roles=roles,
                    prefix_affinity=not getattr(args,
                                                "no_prefix_affinity",
-                                               False))
+                                               False),
+                   autotune=getattr(args, "autotune", False),
+                   autotune_interval_s=getattr(args,
+                                               "autotune_interval",
+                                               1.0),
+                   autotune_config={
+                       "chunk_bounds": (
+                           max(1, getattr(args, "autotune_chunk_min",
+                                          1)),
+                           getattr(args, "autotune_chunk_max", 32)),
+                       "spec_bounds": (
+                           0, getattr(args, "autotune_spec_max", 16)),
+                       "prefill_bounds": (
+                           0, getattr(args, "autotune_prefill_max",
+                                      0)),
+                       "hold_ticks": getattr(args, "autotune_hold", 2),
+                       "cooldown_ticks": getattr(
+                           args, "autotune_cooldown", 3),
+                   } if getattr(args, "autotune", False) else None)
 
 
 def build_scaler(args, gateway, model, params, eos):

@@ -1152,6 +1152,8 @@ class GatewayHistory:
                                           "scaling.jsonl")
         self._alerts_path = os.path.join(self.job_dir, "metrics",
                                          "alerts.jsonl")
+        self._autotune_path = os.path.join(self.job_dir, "metrics",
+                                           "autotune.jsonl")
 
     def _append_event(self, event) -> None:
         with self._lock, open(self.jhist, "a") as f:
@@ -1182,6 +1184,14 @@ class GatewayHistory:
         it next to requests/scaling, so "what was alerting at 14:02"
         is answerable from the job history."""
         with self._lock, open(self._alerts_path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    def record_autotune(self, row: dict) -> None:
+        """One shape-controller actuation (knob, from -> to, the
+        ledger signals that justified it, whether it paid a new
+        compile) in ``metrics/autotune.jsonl`` — "why did chunk depth
+        change at 14:02" is answerable from the job history."""
+        with self._lock, open(self._autotune_path, "a") as f:
             f.write(json.dumps(row) + "\n")
 
     def close(self, status: str = "SUCCEEDED",
@@ -1235,6 +1245,41 @@ class _AlertLoop(threading.Thread):
                         log.exception("history alert write failed")
 
 
+class _AutotuneLoop(threading.Thread):
+    """The adaptive shape controller's cadence (serve/autotune.py):
+    one ``AutotuneController.tick()`` per interval over the LIVE local
+    replicas, actuations logged and appended to history
+    ``metrics/autotune.jsonl``. Daemon + stop-event, stopped by
+    drain() before the fleet join — an actuation mid-shutdown would
+    only churn compile state the process is about to drop."""
+
+    def __init__(self, gateway: "Gateway", interval_s: float):
+        super().__init__(name="gateway-autotune", daemon=True)
+        self.gateway = gateway
+        self.interval_s = max(0.05, interval_s)
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        gw = self.gateway
+        while not self._stop.wait(self.interval_s):
+            try:
+                replicas = [(r.index, r.server)
+                            for r in gw.live_replicas]
+                decisions = gw.autotune.tick(replicas)
+            except Exception:
+                log.exception("autotune tick failed")
+                continue
+            for row in decisions:
+                if gw.history is not None:
+                    try:
+                        gw.history.record_autotune(row)
+                    except Exception:
+                        log.exception("history autotune write failed")
+
+
 class Gateway:
     """The front door over N replica servers. See the module docstring
     for the full story; the API surface:
@@ -1259,7 +1304,10 @@ class Gateway:
                  alerts: bool = True, alert_interval_s: float = 1.0,
                  alert_thresholds: dict | None = None,
                  roles: list | None = None,
-                 prefix_affinity: bool = True):
+                 prefix_affinity: bool = True,
+                 autotune: bool = False,
+                 autotune_interval_s: float = 1.0,
+                 autotune_config: dict | None = None):
         if not servers:
             raise ValueError("gateway needs at least one replica server")
         # disaggregated prefill/decode (ISSUE-12): ``roles`` names each
@@ -1362,6 +1410,18 @@ class Gateway:
             if alerts else None
         self._alert_loop = _AlertLoop(self, alert_interval_s) \
             if alerts else None
+        # the adaptive shape controller (serve/autotune.py, ISSUE-13):
+        # samples each local replica's goodput/timeline deltas and
+        # steers chunk_steps / speculate_k / prefill_chunk within
+        # bounds. Off by default — it is the --autotune opt-in; every
+        # decision lands in /stats engine.autotune, tony_autotune_*
+        # metrics, and history metrics/autotune.jsonl.
+        from tony_tpu.serve.autotune import AutotuneController
+
+        self.autotune = AutotuneController(**(autotune_config or {})) \
+            if autotune else None
+        self._autotune_loop = _AutotuneLoop(self, autotune_interval_s) \
+            if autotune else None
 
     # --------------------------------------------------------- lifecycle
 
@@ -1380,6 +1440,8 @@ class Gateway:
             r.start()
         if self._alert_loop is not None:
             self._alert_loop.start()
+        if self._autotune_loop is not None:
+            self._autotune_loop.start()
         self._started = True
         return self
 
@@ -1408,6 +1470,9 @@ class Gateway:
             # same reasoning: an alert evaluated over a half-joined
             # fleet is noise, and the history file is about to close
             self._alert_loop.stop()
+        if self._autotune_loop is not None:
+            # actuating shapes on a fleet about to join is pure churn
+            self._autotune_loop.stop()
         with self._drain_lock:
             if self._drain_done is not None:
                 return self._drain_done
@@ -2328,6 +2393,15 @@ class Gateway:
         # the rows above already computed (wall-clock weighted)
         out["engine"]["goodput"] = merge_ledgers(
             [row.get("goodput") for row in rows])
+        # the adaptive shape controller (serve/autotune.py): status +
+        # the live knob values it steers, per replica
+        if self.autotune is not None:
+            auto = self.autotune.snapshot()
+            auto["replicas"] = self.autotune.knob_values(
+                [(r.index, r.server) for r in live])
+            out["engine"]["autotune"] = auto
+        else:
+            out["engine"]["autotune"] = {"enabled": False}
         if self.alerts is not None:
             out["alerts"] = {"enabled": True, **self.alerts.snapshot()}
         else:

@@ -54,6 +54,9 @@ _REPLICA_COUNTERS = (
      "Decode dispatch depth, summed (chunk k / verify window)"),
     ("dispatches", "tony_engine_dispatches_total",
      "Decode dispatches (chunk + verify)"),
+    ("frozen_steps", "tony_engine_frozen_steps_total",
+     "Decode/verify positions a finished slot spent frozen "
+     "(in-dispatch EOS re-emits: no KV writes, padding not overshoot)"),
     ("wasted_steps", "tony_engine_wasted_steps_total",
      "Per-slot token positions decoded and thrown away"),
     ("spec_rounds", "tony_engine_spec_rounds_total",
@@ -353,6 +356,37 @@ def prometheus_text(gateway) -> str:
         gauge("tony_goodput_wall_seconds",
               "Wall clock attributed by the goodput ledger, summed "
               "across replicas", round(gp.get("wall_ms", 0.0) / 1e3, 3))
+
+    # the adaptive shape controller (serve/autotune.py, ISSUE-13):
+    # actuation counters per knob, convergence state, and the live
+    # knob values per replica — the same numbers /stats
+    # engine.autotune carries
+    auto = eng.get("autotune") or {}
+    gauge("tony_autotune_enabled", "1 when the shape controller is on",
+          1 if auto.get("enabled") else 0)
+    if auto.get("enabled"):
+        counter("tony_autotune_ticks_total",
+                "Shape-controller evaluation ticks", auto["ticks"])
+        counter("tony_autotune_new_compiles_total",
+                "Actuations that paid a new program compile",
+                auto.get("new_compiles", 0))
+        gauge("tony_autotune_converged",
+              "1 when no actuation fired for a full hysteresis+"
+              "cooldown horizon", 1 if auto.get("converged") else 0)
+        acts = MetricFamily(
+            "tony_autotune_actuations_total", "counter",
+            "Shape-controller actuations, by knob")
+        for knob in ("chunk_steps", "speculate_k", "prefill_chunk"):
+            acts.add(auto.get("actuations", {}).get(knob, 0),
+                     {"knob": knob})
+        fams.append(acts)
+        knobs = MetricFamily(
+            "tony_autotune_knob", "gauge",
+            "Live engine shape-knob values under autotune control")
+        for rep, vals in sorted(auto.get("replicas", {}).items()):
+            for knob, v in sorted(vals.items()):
+                knobs.add(v, {"replica": str(rep), "knob": knob})
+        fams.append(knobs)
 
     # alert bus (obs/alerts.py): active alerts as an info-style gauge
     # plus lifetime fire/resolve counters per rule

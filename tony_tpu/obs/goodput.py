@@ -262,10 +262,15 @@ def ledger(summary: dict, wall_ms: float, *, hbm_gbps: float = 0.0,
     - ``compile`` — first-call (compile / cache-load) dispatch time;
     - ``padding`` — pow2 bucket/view/batch-shape positions the program
       computed but nobody fed (empty slots, prefill bucket tails,
-      verify window padding): the fixed-shape-waste bucket;
+      verify window padding, and — under in-dispatch EOS — a finished
+      slot's FROZEN re-emit positions, which write no KV and feed
+      nothing): the fixed-shape-waste bucket;
     - ``overshoot`` — positions fed real work whose output was trimmed
       (chunk overshoot past EOS/budget, verify bonus past a finish):
-      the ``wasted_steps`` counter, as time;
+      the ``wasted_steps`` counter, as time. Structurally 0 with
+      in-dispatch EOS on (ISSUE-13) — nonzero overshoot on a frozen
+      engine means an accounting bug, which the reconciliation tests
+      would catch;
     - ``spec_rejected`` — rejected speculative-draft positions;
     - ``idle`` — wall clock the engine never dispatched in (queue
       gaps, host scheduling, admission lulls).

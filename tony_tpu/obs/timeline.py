@@ -66,6 +66,12 @@ class DispatchRecord:
     # speculative-draft positions the verify pass refused. The
     # duration split the ledger uses is exact by construction:
     # useful + padding + overshoot + rejected positions == work.
+    # In-dispatch-EOS engines (ISSUE-13) count a finished slot's
+    # FROZEN positions (re-emits, no KV writes) as not-fed, so
+    # fed == tokens on every decode record and the overshoot bucket
+    # is structurally 0 — the frozen tail lands in padding next to
+    # the empty-slot positions it behaves like (the record's
+    # ``frozen`` tag carries the count).
     # ``est_bytes``/``est_flops`` are the CostModel's analytic program
     # cost (0 when no cost model is attached).
     work: int = 0

@@ -24,6 +24,7 @@ prompt-lookup drafting + one batched multi-token verify dispatch
 (``Server(speculate_k=...)``), greedy outputs unchanged.
 """
 
+from tony_tpu.serve.autotune import AutotuneController, KnobBounds
 from tony_tpu.serve.engine import (PoolExhausted, QueueFull, Request,
                                    Result, Server, bucket_len)
 from tony_tpu.serve.faults import Fault, FaultPlan, InjectedFault
@@ -35,8 +36,10 @@ from tony_tpu.serve.slots import (PagePool, SlotCache, cache_batch_axis,
 from tony_tpu.serve.tier import HostPageTier
 
 __all__ = [
+    "AutotuneController",
     "Fault",
     "FaultPlan",
+    "KnobBounds",
     "HostPageTier",
     "InjectedFault",
     "PagePool",

@@ -51,7 +51,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tony_tpu.models.generate import (init_cache, multi_decode_step,
+from tony_tpu.models.generate import (_is_eos, init_cache,
+                                      multi_decode_step,
                                       normalize_eos_ids,
                                       single_decode_step)
 from tony_tpu.obs.goodput import (CostModel, detect_hbm_gbps,
@@ -325,9 +326,41 @@ def _sample_rows(logits, rngs, temps, top_ks):
                         lambda _: (greedy, rngs), None)
 
 
-@functools.partial(jax.jit, static_argnames=("model", "n_steps"))
+def _frozen_body(model, params, temps, top_ks, eos_ids: tuple):
+    """The in-dispatch-EOS decode micro-step (ISSUE-13): the scan body
+    shared by ``_decode_chunk`` (freeze mode) and ``_verify_chunk``'s
+    fused continuation. Carry is ``(cache, tok, positions, rngs, done,
+    rem)``; a row whose emitted token hit EOS — or whose remaining
+    budget ``rem`` ran out — FREEZES: its later micro-steps write to
+    the dropped sentinel position (no KV bytes land), take the greedy
+    sampling path (no rng advance — a frozen sampled row must not
+    move any draw chain), and re-emit the frozen token, so the host's
+    trim walk degenerates to a consistency check and the trailing
+    positions land as padding, not overshoot. A row that never
+    freezes runs EXACTLY the pre-freeze body (every ``where`` is
+    identity), which is what keeps chunk-invariance bitwise."""
+    def body(carry, _):
+        cache, tok, positions, rngs, done, rem = carry
+        eff_pos = jnp.where(done, -1, positions)
+        cache, last = single_decode_step(model, params, cache, tok,
+                                         positions=eff_pos)
+        nxt, rngs = _sample_rows(last, rngs,
+                                 jnp.where(done, 0.0, temps), top_ks)
+        nxt = jnp.where(done, tok, nxt.astype(jnp.int32))
+        positions = jnp.where(done | (positions < 0), positions,
+                              positions + 1)
+        rem = jnp.where(done, rem, rem - 1)
+        done = done | _is_eos(nxt, eos_ids) | (rem <= 0)
+        return (cache, nxt, positions, rngs, done, rem), nxt
+
+    return body
+
+
+@functools.partial(jax.jit, static_argnames=("model", "n_steps",
+                                             "eos_ids", "freeze"))
 def _decode_chunk(model, params, cache, tok, positions, temps, top_ks,
-                  rngs, table=None, *, n_steps: int):
+                  rngs, rem=None, table=None, *, n_steps: int,
+                  eos_ids: tuple = (), freeze: bool = False):
     """The resident serving step: ``n_steps`` decode micro-steps for
     EVERY slot as one lax.scan dispatch (empty slots compute garbage
     that nothing reads — the price of a never-recompiled static shape).
@@ -336,46 +369,65 @@ def _decode_chunk(model, params, cache, tok, positions, temps, top_ks,
     scheduler quantizes it to powers of two, so at most
     log2(chunk_steps)+1 programs ever compile).
 
+    ``freeze`` (the ISSUE-13 in-dispatch EOS mode, the engine default)
+    threads a per-slot ``done`` flag + remaining budget ``rem`` [b]
+    through the scan (``_frozen_body``): a slot that samples EOS or
+    exhausts its budget mid-chunk stops writing K/V (sentinel
+    position), stops advancing rng, and re-emits its final token — so
+    ``chunk_steps`` can grow without the trailing positions becoming
+    the ``overshoot`` waste bucket, and the host trim becomes a
+    consistency check. ``eos_ids`` is static per engine (one compile).
+
     ``table`` [b, max_pages] switches to the paged cache layout — but
     NOT by gathering inside every micro-step: the slot view is
     gathered from the pools ONCE (``paged_view``), the whole scan runs
     the plain unpaged per-slot program against it (bitwise-identical
     math, and the gather cost amortizes over the chunk depth), and
     only the chunk's ``b x n_steps`` new K/V entries scatter back to
-    their pages at the end (``paged_write_back``). The table is fixed
-    across the chunk, so the host pre-extends it to cover every
-    position the chunk will write (engine ``_decode_round``)."""
+    their pages at the end (``paged_write_back``; a frozen row's
+    unwritten tail positions copy their own gathered content back —
+    an identity write). The table is fixed across the chunk, so the
+    host pre-extends it to cover every position the chunk will write
+    (engine ``_decode_round``)."""
     max_len = model.cfg.max_seq_len
     pool_cache, start = cache, positions
     if table is not None:
         cache = paged_view(cache, table, max_len)
 
-    def body(carry, _):
-        cache, tok, positions, rngs = carry
-        cache, last = single_decode_step(model, params, cache, tok,
-                                         positions=positions)
-        nxt, rngs = _sample_rows(last, rngs, temps, top_ks)
-        nxt = nxt.astype(jnp.int32)
-        positions = jnp.where(positions >= 0, positions + 1, positions)
-        return (cache, nxt, positions, rngs), nxt
+    if freeze:
+        body = _frozen_body(model, params, temps, top_ks, eos_ids)
+        carry = (cache, tok, positions, rngs,
+                 positions < 0, jnp.asarray(rem, jnp.int32))
+    else:
+        def body(carry, _):
+            cache, tok, positions, rngs = carry
+            cache, last = single_decode_step(model, params, cache, tok,
+                                             positions=positions)
+            nxt, rngs = _sample_rows(last, rngs, temps, top_ks)
+            nxt = nxt.astype(jnp.int32)
+            positions = jnp.where(positions >= 0, positions + 1,
+                                  positions)
+            return (cache, nxt, positions, rngs), nxt
 
-    carry = (cache, tok, positions, rngs)
+        carry = (cache, tok, positions, rngs)
     if n_steps > 1:
         carry, toks = jax.lax.scan(body, carry, None, length=n_steps)
         toks = jnp.moveaxis(toks, 0, 1)  # [steps, b] -> [b, steps]
     else:
         carry, tok1 = body(carry, None)
         toks = tok1[:, None]
-    cache, _, _, rngs = carry
+    cache, rngs = carry[0], carry[3]
     if table is not None:
         cache = paged_write_back(pool_cache, cache, table, start,
                                  n_steps, max_len)
     return cache, toks, rngs
 
 
-@functools.partial(jax.jit, static_argnames=("model", "window"))
+@functools.partial(jax.jit, static_argnames=("model", "window",
+                                             "n_steps", "eos_ids"))
 def _verify_chunk(model, params, cache, toks, positions, draft_len,
-                  temps, top_ks, rngs, table=None, *, window: int):
+                  temps, top_ks, rngs, rem=None, table=None, *,
+                  window: int, n_steps: int = 0, eos_ids: tuple = ()):
     """The speculative verify dispatch: score ``window`` positions for
     EVERY slot in one batched multi-token pass (multi_decode_step) and
     judge each row's draft against its own greedy verdicts — the
@@ -405,9 +457,30 @@ def _verify_chunk(model, params, cache, toks, positions, draft_len,
     ``window`` is static and power-of-two-plus-one bucketed, so at most
     log2(speculate_k)+1 verify programs ever compile. ``table``
     [b, max_pages] switches to the paged cache layout (pre-extended by
-    the host to cover the window's writes)."""
+    the host to cover the window's writes).
+
+    ``n_steps`` > 0 is the FUSED speculation round (ISSUE-13): the
+    same dispatch (a) caps ``accepted`` at the first emitted stop
+    token, so a mid-window EOS costs zero bonus-past-finish waste,
+    and (b) runs ``n_steps`` ``_frozen_body`` decode micro-steps
+    CONTINUING from each row's own bonus verdict — the chunk dispatch
+    that used to follow every verify round rides inside it, so a
+    speculating round costs ONE dispatch for accepted+1+n_steps
+    tokens instead of two dispatches. Paged mode then works like the
+    chunk path: ONE ``paged_view`` gather feeds both the window pass
+    and the continuation scan, and ``paged_write_back`` returns the
+    whole written span (positions the row never wrote copy their own
+    gathered content back — identity). Returns ``(cache, emit,
+    accepted, cont [b, n_steps], rngs)``."""
+    max_len = model.cfg.max_seq_len
+    pool_cache, start = cache, positions[:, 0]
+    if n_steps > 0 and table is not None:
+        cache = paged_view(cache, table, max_len)
+        step_table = None
+    else:
+        step_table = table
     cache, logits = multi_decode_step(model, params, cache, toks,
-                                      positions, page_table=table)
+                                      positions, page_table=step_table)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [b, w]
     tok0, rngs = _sample_rows(logits[:, 0], rngs, temps, top_ks)
     emit = jnp.concatenate([tok0[:, None].astype(jnp.int32),
@@ -416,7 +489,43 @@ def _verify_chunk(model, params, cache, toks, positions, draft_len,
     match = (toks[:, 1:] == greedy[:, :-1]) & (j < draft_len[:, None])
     accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
                        axis=1)
-    return cache, emit, accepted, rngs
+    if n_steps == 0:
+        return cache, emit, accepted, rngs
+    # EOS-capped acceptance: the host appends emit[:accepted + 1] and
+    # stops at the first stop token — capping accepted AT that index
+    # makes the device and host agree that nothing past it was ever
+    # accepted (the "verify bonus past EOS" waste bucket goes to zero;
+    # the consumed token run is unchanged, so outputs are identical)
+    if eos_ids:
+        idx = jnp.arange(window)[None, :]
+        first_stop = jnp.min(jnp.where(_is_eos(emit, eos_ids), idx,
+                                       window), axis=1)
+        accepted = jnp.minimum(accepted, first_stop)
+    # fused continuation: each row resumes from its own bonus verdict
+    # at its own position, with the frozen-body discipline bounding
+    # EOS/budget — live rows decode n_steps more real tokens in THIS
+    # dispatch, so non-drafting co-tenants are never dragged to one
+    # token per round (the old batch-drag-gate failure mode)
+    rows = jnp.arange(toks.shape[0])
+    bonus = emit[rows, accepted]
+    live = start >= 0
+    consumed = accepted + 1
+    rem_c = jnp.where(live, jnp.asarray(rem, jnp.int32) - consumed, 0)
+    done = ~live | _is_eos(bonus, eos_ids) | (rem_c <= 0)
+    cont_pos = jnp.where(live, start + consumed, -1)
+    body = _frozen_body(model, params, temps, top_ks, eos_ids)
+    carry = (cache, bonus, cont_pos, rngs, done, rem_c)
+    if n_steps > 1:
+        carry, cont = jax.lax.scan(body, carry, None, length=n_steps)
+        cont = jnp.moveaxis(cont, 0, 1)  # [steps, b] -> [b, steps]
+    else:
+        carry, c1 = body(carry, None)
+        cont = c1[:, None]
+    cache, rngs = carry[0], carry[3]
+    if table is not None:
+        cache = paged_write_back(pool_cache, cache, table, start,
+                                 window + n_steps, max_len)
+    return cache, emit, accepted, cont, rngs
 
 
 class QueueFull(RuntimeError):
@@ -587,7 +696,7 @@ class Server:
                  timeline: bool = True, paged: bool | None = None,
                  kv_page_size: int = 0, kv_pages: int = 0,
                  hbm_gbps: float = 0.0, prefill_chunk_tokens: int = 0,
-                 kv_host_mb: float = 0.0):
+                 kv_host_mb: float = 0.0, in_dispatch_eos: bool = True):
         if model.cfg.quantized:
             # nothing structural in the way — the q8 apply is the same
             # model.apply — but untested here; fail loud, not wrong
@@ -615,6 +724,22 @@ class Server:
         self.fault_plan = fault_plan
         self.eos_ids = normalize_eos_ids(eos_id)
         self.min_bucket = min_bucket
+        # in-dispatch EOS/refill (ISSUE-13, default ON): the decode
+        # chunk and the verify round carry a per-slot ``done`` flag so
+        # a slot finishing mid-dispatch freezes instead of decoding
+        # trimmed overshoot — chunk_steps can grow without feeding the
+        # ``overshoot`` waste bucket, the speculation path fuses its
+        # follow-up chunk into the verify dispatch, and the host trim
+        # walk becomes a consistency check. False = the pre-ISSUE-13
+        # behavior, kept as the bench/regression A/B control.
+        self.in_dispatch_eos = bool(in_dispatch_eos)
+        self.frozen_steps = 0  # decode/verify positions spent frozen
+        #                        (re-emitting a finished slot's token);
+        #                        they cost no KV writes and land in the
+        #                        ledger's padding bucket, not overshoot
+        self.freeze_faults = 0  # frozen-tail consistency violations
+        #                         (must stay 0; the old host trim, as
+        #                         a check)
         # upper bound on decode micro-steps fused into one dispatch;
         # 1 = token-at-a-time (lowest latency to each token, highest
         # per-token dispatch cost — the right setting for streaming)
@@ -653,12 +778,17 @@ class Server:
         self.dispatches = 0  # decode dispatches (chunk + verify)
         self.prefills = 0    # prefill dispatches (exact hits skip one)
         self.wasted_steps = 0  # PER-SLOT token positions decoded and
-        #                       thrown away: chunk overshoot past a
-        #                       finish, verify bonus past EOS/budget,
-        #                       rejected draft positions. Different
-        #                       unit from `steps` — compare against
-        #                       emitted tokens for utilization, the
-        #                       pairing bench.py reports
+        #                       thrown away. With in-dispatch EOS on
+        #                       (the default) only REJECTED DRAFT
+        #                       positions remain — chunk overshoot and
+        #                       verify bonus past a finish are frozen
+        #                       in-dispatch (frozen_steps) instead of
+        #                       decoded and trimmed. The legacy
+        #                       in_dispatch_eos=False engine still
+        #                       counts all three. Different unit from
+        #                       `steps` — compare against emitted
+        #                       tokens for utilization, the pairing
+        #                       bench.py reports
         # per-dispatch timeline (obs/timeline.py): one record per
         # prefill / hit-admit / decode / verify dispatch with host-wall
         # duration and a first-call compile flag; False = off, for the
@@ -1858,6 +1988,17 @@ class Server:
                        s.max_pages)
             table = jnp.asarray(s.page_table[:, :cols])
         view_tokens = cols * s.pool.page_size if self.paged else 0
+        freeze = self.in_dispatch_eos
+        rem = None
+        if freeze:
+            # per-slot remaining budgets: the device freezes a slot the
+            # moment it samples EOS or exhausts this, so every emitted
+            # (non-frozen) position is a token the request keeps
+            rem = np.zeros(s.batch_size, np.int32)
+            for slot, live in enumerate(self._live):
+                if live is not None:
+                    rem[slot] = live.request.max_new_tokens \
+                        - len(live.generated)
         if self.timeline is not None:
             t0 = time.monotonic()
             occ = s.n_active
@@ -1866,7 +2007,10 @@ class Server:
             self.model, self.params, s.cache,
             jnp.asarray(s.last_token), jnp.asarray(s.positions()),
             jnp.asarray(s.temperature), jnp.asarray(s.top_k),
-            jnp.asarray(s.rng), table, n_steps=k)
+            jnp.asarray(s.rng),
+            jnp.asarray(rem) if rem is not None else None, table,
+            n_steps=k, eos_ids=self.eos_ids if freeze else (),
+            freeze=freeze)
         self.steps += k
         self.dispatches += 1
         s.cache = cache
@@ -1879,7 +2023,7 @@ class Server:
             # latency a request actually experienced; tokens landed are
             # counted below once the EOS/budget walk trims overshoot
             dur_ms = (time.monotonic() - t0) * 1e3
-            landed = 0
+        landed = 0
 
         for slot in range(s.batch_size):
             live = self._live[slot]
@@ -1895,23 +2039,36 @@ class Server:
                 elif len(live.generated) >= req.max_new_tokens:
                     reason = "length"
                 if reason:
-                    # tokens past this point are chunk overshoot: the
-                    # slot kept decoding garbage into its own (about to
-                    # be evicted) row — trimmed, never reported
+                    # tokens past this point were frozen in-dispatch
+                    # (re-emitted finals, no KV writes) — or, with
+                    # freeze off, chunk overshoot: decoded garbage the
+                    # host trims. Either way never reported.
                     break
             if reason is None:
                 # the chunk wrote k tokens at advancing positions; the
                 # slot's visible cache grew by k
                 s.lengths[slot] += k
                 s.last_token[slot] = int(toks[slot, k - 1])
-                if self.timeline is not None:
-                    landed += k
+                landed += k
                 continue
-            # tokens past the finish are chunk overshoot the host
-            # trimmed: decoded, paid for, never reported
-            self.wasted_steps += k - (j + 1)
-            if self.timeline is not None:
-                landed += j + 1
+            if freeze:
+                # in-dispatch EOS: the trailing positions were frozen
+                # re-emits, not overshoot — the trim is a consistency
+                # check now, and the waste counter stays put
+                self.frozen_steps += k - (j + 1)
+                if j + 1 < k and not (toks[slot, j + 1:]
+                                      == toks[slot, j]).all():
+                    self.freeze_faults += 1
+                    log.warning(
+                        "frozen slot %d re-emitted a different token "
+                        "(%s after %d) — in-dispatch EOS consistency "
+                        "violation", slot, toks[slot, j + 1:].tolist(),
+                        int(toks[slot, j]))
+            else:
+                # tokens past the finish are chunk overshoot the host
+                # trimmed: decoded, paid for, never reported
+                self.wasted_steps += k - (j + 1)
+            landed += j + 1
             finished.append(Result(req.id, list(req.prompt),
                                    live.generated, reason,
                                    live.prefix_hit_tokens,
@@ -1927,10 +2084,19 @@ class Server:
             if view_tokens:
                 tags["view_tokens"] = view_tokens
             view = view_tokens or self.model.cfg.max_seq_len
+            # position accounting: with freeze on, every fed position
+            # landed a kept token (fed == landed -> the ledger's
+            # overshoot bucket is structurally 0; frozen tails join
+            # the empty-slot positions in padding). Freeze off keeps
+            # the old fed = depth x occupancy, whose excess over
+            # landed IS the overshoot bucket.
+            fed = landed if freeze else k * occ
+            if freeze:
+                tags["frozen"] = k * occ - landed
             self._record_dispatch(
                 "decode", t0, dur_ms, occ, k, landed,
                 ("decode", k, view_tokens), tags=tags,
-                work=k * s.batch_size, fed=k * occ,
+                work=k * s.batch_size, fed=fed,
                 est=self.cost.decode(k, s.batch_size, view))
         return finished
 
@@ -1962,12 +2128,21 @@ class Server:
         it is provably going to refuse skip the n-gram scans
         altogether — an ineligible slot can't start drafting and the
         EMA only moves in verify rounds, so a permanently gated batch
-        pays nothing per round, not one scan per greedy slot."""
+        pays nothing per round, not one scan per greedy slot.
+
+        With in-dispatch EOS on, the verify round FUSES its follow-up
+        chunk (``_verify_chunk(n_steps=...)``): every live slot —
+        drafting or not — decodes the full chunk depth inside the same
+        dispatch, so there is no batch to drag and the gate is
+        structurally unnecessary; any proposed draft is pure upside
+        (accepted tokens on top of the chunk's) minus one window pass.
+        The EMA still silences hopeless drafters."""
         out: list = [None] * self.slots.batch_size
         n_live = 0
         all_eligible = True
         bound = 0.0  # upper bound on the verify round's token yield
         eligible: list = []  # (slot, live, d_cap)
+        fused = self.in_dispatch_eos
         for slot, live in enumerate(self._live):
             if live is None:
                 continue
@@ -1987,7 +2162,8 @@ class Server:
             bound += self._spec_ema[slot] * d_cap
         if not eligible:
             return None
-        if not all_eligible and bound < self._chunk_size() * n_live:
+        if not fused and not all_eligible \
+                and bound < self._chunk_size() * n_live:
             return None  # gate precheck: refuses before any lookup
         any_draft = False
         expected = float(n_live)  # actual-proposal yield estimate
@@ -2002,7 +2178,7 @@ class Server:
         if not any_draft:
             return None
         drafting = sum(d is not None for d in out)
-        if drafting < n_live and \
+        if not fused and drafting < n_live and \
                 expected < self._chunk_size() * n_live:
             return None  # batch-drag gate: the chunk dispatch yields more
         return out
@@ -2018,20 +2194,33 @@ class Server:
         overwritten as the slot decodes on (the prefix-store masked-
         visibility exactness argument). Mid-window EOS/budget trims
         exactly like chunk overshoot; donation reads the row whose
-        [0, len) span covers only fed, accepted tokens."""
+        [0, len) span covers only fed, accepted tokens.
+
+        With in-dispatch EOS on this is the FUSED speculation round:
+        the dispatch continues every row ``chunk_size`` frozen-body
+        micro-steps past its own bonus verdict, so the chunk dispatch
+        that used to follow each verify round is gone — a speculating
+        round lands accepted + 1 + chunk tokens per slot in ONE
+        dispatch, and non-drafting co-tenants keep their full chunk
+        cadence (no batch drag, no gate)."""
         finished: list[Result] = []
         s = self.slots
         b = s.batch_size
+        fused = self.in_dispatch_eos
+        k_cont = self._chunk_size() if fused else 0
         window = _bucket_pow2(max(d.size for d in drafts
                                   if d is not None)) + 1
         toks = np.zeros((b, window), np.int32)
         positions = np.full((b, window), -1, np.int32)
         draft_len = np.zeros(b, np.int32)
+        rem = np.zeros(b, np.int32)
         for slot, live in enumerate(self._live):
             if live is None:
                 continue
             toks[slot, 0] = s.last_token[slot]
             positions[slot, 0] = s.lengths[slot]
+            rem[slot] = live.request.max_new_tokens \
+                - len(live.generated)
             d = drafts[slot]
             if d is not None:
                 toks[slot, 1:1 + d.size] = d
@@ -2042,16 +2231,19 @@ class Server:
         if self.paged:
             # window row i writes positions [lengths, lengths + d_i]
             # (last_token + its drafts) — always within the slot's
-            # budget (drafts are clamped to remaining - 1), so the
-            # reservation covers it. Column-sliced like the chunk path:
-            # the verify gather reads O(live extent)
+            # budget (drafts are clamped to remaining - 1) — plus, in
+            # the fused round, up to k_cont continuation positions
+            # (budget overshoot there writes through the sentinel and
+            # drops; ensure_pages never grows past the reservation).
+            # Column-sliced like the chunk path: the verify gather
+            # reads O(live extent)
             hi = 0
             for slot, live in enumerate(self._live):
                 if live is not None:
-                    s.ensure_pages(slot, int(s.lengths[slot])
-                                   + int(draft_len[slot]) + 1)
-                    hi = max(hi, int(s.lengths[slot])
-                             + int(draft_len[slot]) + 1)
+                    upto = int(s.lengths[slot]) \
+                        + int(draft_len[slot]) + 1 + k_cont
+                    s.ensure_pages(slot, upto)
+                    hi = max(hi, upto)
             cols = min(_bucket_pow2(-(-hi // s.pool.page_size)),
                        s.max_pages)
             table = jnp.asarray(s.page_table[:, :cols])
@@ -2061,12 +2253,20 @@ class Server:
             occ = s.n_active
             riders = [lv.request.id for lv in self._live
                       if lv is not None]
-        cache, emit, accepted, rng = _verify_chunk(
+        out = _verify_chunk(
             self.model, self.params, s.cache, jnp.asarray(toks),
             jnp.asarray(positions), jnp.asarray(draft_len),
             jnp.asarray(s.temperature), jnp.asarray(s.top_k),
-            jnp.asarray(s.rng), table, window=window)
-        self.steps += window
+            jnp.asarray(s.rng), jnp.asarray(rem) if fused else None,
+            table, window=window, n_steps=k_cont,
+            eos_ids=self.eos_ids if fused else ())
+        if fused:
+            cache, emit, accepted, cont, rng = out
+            cont = np.asarray(cont)
+        else:
+            cache, emit, accepted, rng = out
+            cont = None
+        self.steps += window + k_cont
         self.dispatches += 1
         self.spec_rounds += 1
         s.cache = cache
@@ -2075,7 +2275,8 @@ class Server:
         s.rng = np.array(rng, np.uint32)
         if self.timeline is not None:
             dur_ms = (time.monotonic() - t0) * 1e3  # closes at the sync
-            landed = 0
+        landed = 0
+        cont_fed = 0  # live (non-frozen) continuation positions
 
         for slot in range(b):
             live = self._live[slot]
@@ -2091,7 +2292,9 @@ class Server:
                 self.spec_accepted += a
                 # rejected drafts were scored and thrown away — the
                 # speculation-side waste the utilization counter reports
-                # next to chunk overshoot
+                # next to chunk overshoot (in the fused round the EOS
+                # cap folds accepted-but-discarded drafts past a stop
+                # token in here too)
                 self.wasted_steps += d - a
                 self._spec_ema[slot] = (
                     self.SPEC_EMA_DECAY * self._spec_ema[slot]
@@ -2111,13 +2314,47 @@ class Server:
                     reason = "length"
                 if reason:
                     break
-            if self.timeline is not None:
-                landed += consumed
+            landed += consumed
+            cont_consumed = 0
+            if fused:
+                if reason is None:
+                    # the fused continuation: this slot's chunk
+                    # tokens, same EOS/budget walk; frozen tails
+                    # re-emit
+                    for jj in range(k_cont):
+                        tok = int(cont[slot, jj])
+                        live.generated.append(tok)
+                        cont_consumed += 1
+                        if tok in self.eos_ids:
+                            reason = "eos"
+                        elif len(live.generated) >= req.max_new_tokens:
+                            reason = "length"
+                        if reason:
+                            break
+                    if reason is not None \
+                            and cont_consumed < k_cont \
+                            and not (cont[slot, cont_consumed:]
+                                     == cont[slot,
+                                             cont_consumed - 1]).all():
+                        self.freeze_faults += 1
+                        log.warning(
+                            "frozen slot %d re-emitted a different "
+                            "token in a fused verify round — "
+                            "in-dispatch EOS consistency violation",
+                            slot)
+                # a slot that finished inside the window froze for the
+                # whole continuation; mid-continuation finishes freeze
+                # the tail — either way those positions are padding
+                self.frozen_steps += k_cont - cont_consumed
+                landed += cont_consumed
+                cont_fed += cont_consumed
             if reason is None:
-                # fed last_token + a accepted drafts: the slot's
-                # position-exact span grew by accepted + 1
-                s.lengths[slot] += a + 1
-                s.last_token[slot] = int(emit[slot, a])
+                # fed last_token + a accepted drafts (+ the fused
+                # continuation): the slot's position-exact span grew
+                # by accepted + 1 + cont_consumed
+                s.lengths[slot] += a + 1 + cont_consumed
+                s.last_token[slot] = int(cont[slot, k_cont - 1]) \
+                    if fused else int(emit[slot, a])
                 continue
             self.wasted_steps += (a + 1) - consumed
             finished.append(Result(req.id, list(req.prompt),
@@ -2137,17 +2374,29 @@ class Server:
             s.evict(slot)
         if self.timeline is not None:
             drafted_n = int(draft_len.sum())
+            accepted_n = int(accepted.sum())
             tags = {"requests": riders, "drafted": drafted_n,
-                    "accepted": int(accepted.sum())}
+                    "accepted": accepted_n}
             if view_tokens:
                 tags["view_tokens"] = view_tokens
+            if fused:
+                tags["cont_steps"] = k_cont
             view = view_tokens or self.model.cfg.max_seq_len
+            # fused round: fed = one seed token per live slot + every
+            # draft + the live continuation positions; landed is the
+            # same minus the rejected drafts, so fed - landed ==
+            # rejected and the ledger's overshoot bucket stays 0
+            fed = occ + drafted_n + cont_fed
+            est = self.cost.verify(window, b, view)
+            if fused:
+                dec = self.cost.decode(k_cont, b, view)
+                est = (est[0] + dec[0], est[1] + dec[1])
             self._record_dispatch(
                 "verify", t0, dur_ms, occ, window, landed,
-                ("verify", window, view_tokens), tags=tags,
-                work=window * b, fed=occ + drafted_n,
-                rejected=drafted_n - int(accepted.sum()),
-                est=self.cost.verify(window, b, view))
+                ("verify", window, k_cont, view_tokens), tags=tags,
+                work=(window + k_cont) * b, fed=fed,
+                rejected=drafted_n - accepted_n,
+                est=est)
         return finished
 
     def _donate(self, live: _Live, slot: int) -> None:
@@ -2215,6 +2464,12 @@ class Server:
             "decode_steps": self.steps,
             "dispatches": self.dispatches,
             "wasted_steps": self.wasted_steps,
+            # in-dispatch EOS (ISSUE-13): positions a finished slot
+            # spent frozen (re-emits, no KV writes — padding, not
+            # overshoot) and the trim-walk consistency violations
+            # (must stay 0)
+            "frozen_steps": self.frozen_steps,
+            "freeze_faults": self.freeze_faults,
             "spec_rounds": self.spec_rounds,
             "spec_drafted": self.spec_drafted,
             "spec_accepted": self.spec_accepted,

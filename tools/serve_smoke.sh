@@ -60,7 +60,9 @@ RGW_PID=''
 RCTRL_PID=''
 DGW_PID=''
 DCTRL_PID=''
-trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID $RGW_PID $RCTRL_PID $DGW_PID $DCTRL_PID 2>/dev/null; kill -9 $AGENT0_PID $AGENT1_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
+AT_PID=''
+ATCTRL_PID=''
+trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID $RGW_PID $RCTRL_PID $DGW_PID $DCTRL_PID $AT_PID $ATCTRL_PID 2>/dev/null; kill -9 $AGENT0_PID $AGENT1_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
 
 fail() { echo "serve-smoke: FAIL: $1" >&2; exit 1; }
 
@@ -653,6 +655,128 @@ EOF
     echo "serve-smoke: disagg OK (role split + chunked prefill + host tier, zero 5xx, token-exact vs single-pool control)"
 }
 
+# ---- autotune round (also standalone: SERVE_SMOKE_ROUNDS=autotune) ---
+# ISSUE-13: the ledger-driven adaptive shape controller on a real
+# subprocess gateway. Boots with chunk-steps 1 (the streaming floor)
+# and --autotune at a fast tick; mixed traffic gives the controller a
+# clean-overshoot ledger, so it must grow chunk_steps (>= 1
+# actuation), with zero 5xx, every output token-exact vs a static
+# control gateway, the decision visible in /stats engine.autotune and
+# history metrics/autotune.jsonl, and the controller CONVERGED by the
+# time traffic stops.
+autotune_round() {
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --replicas 1 --chunk-steps 1 --autotune \
+        --autotune-interval 0.1 --autotune-hold 1 \
+        --autotune-cooldown 0 --autotune-chunk-max 16 \
+        --history "$WORK/at_history" \
+        --port 0 --compile-cache '' \
+        >"$WORK/at_boot.log" 2>"$WORK/at_stderr.log" &
+    AT_PID=$!
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --replicas 1 --chunk-steps 1 --port 0 --compile-cache '' \
+        >"$WORK/atctrl_boot.log" 2>"$WORK/atctrl_stderr.log" &
+    ATCTRL_PID=$!
+    ATURL=''; ATCTRL_URL=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        ATURL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/at_boot.log")
+        ATCTRL_URL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/atctrl_boot.log")
+        [ -n "$ATURL" ] && [ -n "$ATCTRL_URL" ] && break
+        kill -0 $AT_PID 2>/dev/null || fail "autotune gateway died at boot: $(cat "$WORK/at_stderr.log")"
+        kill -0 $ATCTRL_PID 2>/dev/null || fail "autotune control died at boot: $(cat "$WORK/atctrl_stderr.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$ATURL" ] && [ -n "$ATCTRL_URL" ] || fail "autotune gateways did not print URLs within ${BOUND}s"
+    echo "serve-smoke: autotune gateway at $ATURL (chunk-steps 1, controller armed; control at $ATCTRL_URL)"
+
+    # mixed greedy traffic in waves: enough steady decode rounds for
+    # the controller to judge and actuate between waves
+    n=0
+    wave=0
+    while [ $wave -lt 6 ]; do
+        for BODY in "1, 2, 3, $wave" "5, 9, $wave" "17, 46, 10, 20, $wave"; do
+            code=$(curl_s "$WORK/at_$n" "$ATURL/v1/generate" \
+                "{\"token_ids\": [$BODY], \"max_new_tokens\": 24, \"id\": $n}") \
+                || fail "autotune request $n curl"
+            [ "$code" = 200 ] || fail "autotune request $n -> $code"
+            n=$((n + 1))
+        done
+        wave=$((wave + 1))
+    done
+    N_REQ=$n
+    # token-exactness vs the static control: an actuation must never
+    # change a single output token
+    n=0
+    wave=0
+    while [ $wave -lt 6 ]; do
+        for BODY in "1, 2, 3, $wave" "5, 9, $wave" "17, 46, 10, 20, $wave"; do
+            code=$(curl_s "$WORK/atctrl_$n" "$ATCTRL_URL/v1/generate" \
+                "{\"token_ids\": [$BODY], \"max_new_tokens\": 24, \"id\": $n}") \
+                || fail "autotune control $n curl"
+            [ "$code" = 200 ] || fail "autotune control $n -> $code"
+            $PY - "$WORK/at_$n" "$WORK/atctrl_$n" <<'EOF' || fail "autotune request $n: output differs from static control"
+import json, sys
+a = json.load(open(sys.argv[1]))
+b = json.load(open(sys.argv[2]))
+assert a["token_ids"] == b["token_ids"], (a["token_ids"], b["token_ids"])
+EOF
+            n=$((n + 1))
+        done
+        wave=$((wave + 1))
+    done
+
+    # give the controller a few idle ticks to settle, then assert
+    sleep 2
+    code=$(curl_s "$WORK/at_stats" "$ATURL/stats") || fail "autotune stats curl"
+    [ "$code" = 200 ] || fail "autotune stats -> $code"
+    $PY - "$WORK/at_stats" "$N_REQ" <<'EOF' || fail "autotune stats wrong: $(cat "$WORK/at_stats")"
+import json, sys
+stats = json.load(open(sys.argv[1]))
+n = int(sys.argv[2])
+assert stats["completed"] == n, stats["completed"]
+assert stats["shed"] == {}, stats["shed"]          # zero 5xx
+auto = stats["engine"]["autotune"]
+assert auto["enabled"], auto
+assert auto["actuations_total"] >= 1, auto         # the controller acted
+assert auto["actuations"].get("chunk_steps", 0) >= 1, auto
+assert auto["replicas"]["0"]["chunk_steps"] > 1, auto
+assert auto["converged"], auto                     # and went quiet
+row = auto["recent"][-1]
+assert {"knob", "from", "to", "reason", "new_compile"} <= set(row), row
+EOF
+    curl_s "$WORK/at_metrics" "$ATURL/metrics" >/dev/null 2>&1
+    grep -q 'tony_autotune_enabled 1' "$WORK/at_metrics" || fail "no tony_autotune_enabled on /metrics"
+    grep -q 'tony_autotune_actuations_total{knob="chunk_steps"}' "$WORK/at_metrics" || fail "no tony_autotune_actuations_total on /metrics"
+    AT_JSONL=$(find "$WORK/at_history" -name autotune.jsonl | head -1)
+    [ -n "$AT_JSONL" ] || fail "no metrics/autotune.jsonl in the history dir"
+    $PY - "$AT_JSONL" <<'EOF' || fail "autotune.jsonl malformed: $(cat "$AT_JSONL")"
+import json, sys
+rows = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+assert rows, "no actuation rows"
+assert rows[0]["knob"] == "chunk_steps", rows[0]
+assert {"from", "to", "reason", "signals", "new_compile"} <= set(rows[0])
+EOF
+
+    kill -TERM $AT_PID $ATCTRL_PID
+    for P in $AT_PID $ATCTRL_PID; do
+        i=0
+        while kill -0 $P 2>/dev/null; do
+            [ $i -ge $BOUND ] && fail "autotune gateway did not drain within ${BOUND}s of SIGTERM"
+            sleep 1; i=$((i + 1))
+        done
+    done
+    wait $AT_PID; rc=$?
+    [ $rc = 0 ] || fail "autotune gateway exited $rc after SIGTERM"
+    AT_PID=''
+    ATCTRL_PID=''
+    echo "serve-smoke: autotune OK (>=1 actuation, converged, zero 5xx, token-exact vs static control)"
+}
+
+if [ "${SERVE_SMOKE_ROUNDS:-all}" = autotune ]; then
+    autotune_round   # `make autotune-smoke`: just the shape-controller round
+    exit 0
+fi
 if [ "${SERVE_SMOKE_ROUNDS:-all}" = disagg ]; then
     disagg_round   # `make disagg-smoke`: just the disaggregation round
     exit 0
@@ -1013,6 +1137,9 @@ goodput_round
 
 # ---- disagg round: role split + chunked prefill + host page tier -----
 disagg_round
+
+# ---- autotune round: shape controller actuates, stays token-exact ----
+autotune_round
 
 # ---- remote round: agents on "hosts", kill -9 one, keep serving ------
 remote_round
