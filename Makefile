@@ -63,9 +63,16 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 #     engine.autotune + tony_autotune_* metrics + history
 #     metrics/autotune.jsonl
 
+#   make shard-smoke - just the sharded-replica round of serve-smoke:
+#     a --mesh 4 gateway on 4 virtual CPU devices (params sharded on
+#     output dims, KV page pools sharded 4-way on the kv-head axis)
+#     under greedy/sampled/prefix/streaming traffic, byte-identical
+#     outputs vs a single-device control gateway, mesh topology +
+#     per-chip pricing on /stats engine.mesh + tony_mesh_* metrics
+
 .PHONY: lint smoke check test bench serve-smoke chaos-smoke \
 	autoscale-smoke goodput-smoke remote-smoke disagg-smoke \
-	autotune-smoke
+	autotune-smoke shard-smoke
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -107,3 +114,6 @@ disagg-smoke:
 
 autotune-smoke:
 	PY=$(PY) SERVE_SMOKE_ROUNDS=autotune sh tools/serve_smoke.sh
+
+shard-smoke:
+	PY=$(PY) SERVE_SMOKE_ROUNDS=shard sh tools/serve_smoke.sh

@@ -229,6 +229,33 @@ def test_flash_decode_mha_windowed_int8(qkv_mha):
     np.testing.assert_allclose(np.asarray(out), ref_q, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("h", [12, 16])
+def test_flash_decode_mha_head_count_branches(h):
+    """The tile-legality rule (r14): 16 MHA heads take the
+    head-blocked kernel with hb=8 (a sublane multiple); 12 heads have
+    no legal head block (12 % 8 != 0) and fall back to the GQA
+    kernel. Both paths must match the reference, int8 included (the
+    MHA path folds the transposed scale tiles onto scores/probs)."""
+    b, s, d = 2, 64, 16
+    q = jax.random.normal(jax.random.PRNGKey(20), (b, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(21), (b, s, h, d))
+    v = jax.random.normal(jax.random.PRNGKey(22), (b, s, h, d))
+    length = jnp.asarray([33, 64], jnp.int32)
+    out = flash_decode(q, k, v, length, block_k=16)
+    ref = _ref_decode(q, k, v, length)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5,
+                               rtol=2e-5)
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    out_q = flash_decode(q, kq, vq, length, block_k=16, k_scale=ks,
+                         v_scale=vs)
+    ref_q = _ref_decode(q, dequantize_kv(kq, ks).astype(jnp.float32),
+                        dequantize_kv(vq, vs).astype(jnp.float32),
+                        length)
+    np.testing.assert_allclose(np.asarray(out_q), ref_q, atol=2e-5,
+                               rtol=2e-5)
+
+
 def test_flash_decode_mha_zero_length_row():
     """A zero-length row sharing an 8-row MHA block with live rows (an
     empty continuous-batching slot) must emit 0, exactly like the GQA

@@ -160,6 +160,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve fixed-shape per-slot cache rows instead "
                         "of the paged pool (A/B escape hatch; "
                         "sliding-window models downgrade automatically)")
+    p.add_argument("--mesh", default="",
+                   help="sharded replicas (ISSUE-14): devices per "
+                        "replica as a bare count (tensor-parallel, "
+                        "'--mesh 4') or an axis spec "
+                        "('tensor=4,expert=2'). Params shard on "
+                        "output dims, KV page pools on the kv-head "
+                        "axis; streams are byte-identical to a "
+                        "single-chip replica. '' = single-chip (the "
+                        "default); topology shows on /stats under "
+                        "engine.mesh")
+    p.add_argument("--shard-rules", default="serve",
+                   help="parallel.sharding rule preset for --mesh "
+                        "(default 'serve' — the only preset with the "
+                        "token-exactness contract)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000,
                    help="0 picks an ephemeral port")
@@ -329,7 +343,10 @@ def demo_model():
 
     from tony_tpu.models import Transformer, TransformerConfig
 
-    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+    # 4 heads so a --mesh 4 tensor axis divides the kv-head dim (the
+    # shard-smoke round serves this model 4-way sharded); outputs are
+    # only ever compared control-vs-treatment within one boot
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
                             n_layers=2, d_ff=64, max_seq_len=64,
                             dtype=jnp.float32,
                             attention_backend="reference")
@@ -337,6 +354,51 @@ def demo_model():
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
     return model, params
+
+
+def parse_mesh(spec: str):
+    """``--mesh`` -> a ``jax.sharding.Mesh`` over the FIRST N local
+    devices, or None for single-chip. A bare count means pure tensor
+    parallelism (``--mesh 4`` == ``tensor=4``); an axis spec names
+    sizes per ``parallel.mesh`` axis (``tensor=4,expert=2`` -> 8
+    devices/replica). Built once per process — every replica shares
+    the mesh (its own params/pools, the same chips), exactly like
+    the single-chip fleet shares the host."""
+    s = spec.strip()
+    if not s:
+        return None
+    import jax
+
+    from tony_tpu.parallel.mesh import ALL_AXES, MeshSpec, make_mesh
+
+    sizes = {}
+    if s.isdigit():
+        sizes["tensor"] = int(s)
+    else:
+        for part in s.split(","):
+            name, sep, val = part.strip().partition("=")
+            if not sep or name not in ALL_AXES:
+                raise SystemExit(
+                    f"--mesh expects a device count or 'axis=N,...' "
+                    f"over {ALL_AXES}, got {spec!r}")
+            try:
+                sizes[name] = int(val)
+            except ValueError:
+                raise SystemExit(
+                    f"--mesh size {val!r} is not an integer") from None
+    n = 1
+    for v in sizes.values():
+        if v < 1:
+            raise SystemExit(f"--mesh sizes must be >= 1, got {spec!r}")
+        n *= v
+    devices = jax.devices()
+    if n > len(devices):
+        raise SystemExit(
+            f"--mesh {spec!r} needs {n} devices, "
+            f"{len(devices)} visible")
+    kwargs = {a: 1 for a in ALL_AXES}
+    kwargs.update(sizes)
+    return make_mesh(MeshSpec(**kwargs), devices=devices[:n])
 
 
 def server_factory(args, model, params, eos):
@@ -358,6 +420,10 @@ def server_factory(args, model, params, eos):
                   getattr(args, "autoscale_max", 0) or 0)
     paged_kw = resolve_paged_kv(args, model, args.serve_batch,
                                 n_replicas=ceiling)
+    # one mesh per process, shared by every replica this factory mints
+    # (including autoscaler-grown ones): each gets its own sharded
+    # params/pools over the same chips
+    mesh = parse_mesh(getattr(args, "mesh", ""))
 
     # the host tier spills EVICTED prefix-store entries: with the
     # store resolved off there is nothing to spill — downgrade loudly
@@ -382,6 +448,8 @@ def server_factory(args, model, params, eos):
                       kv_host_mb=kv_host_mb,
                       in_dispatch_eos=not getattr(
                           args, "no_in_dispatch_eos", False),
+                      mesh=mesh,
+                      shard_rules=getattr(args, "shard_rules", "serve"),
                       **paged_kw)
 
     return make
@@ -435,6 +503,9 @@ def agent_argv(args, index: int) -> list:
                                     getattr(args, "autoscale_max", 0)
                                     or 0)),
             "--port", "0"]
+    if getattr(args, "mesh", "").strip():
+        argv += ["--mesh", args.mesh,
+                 "--shard-rules", getattr(args, "shard_rules", "serve")]
     if args.no_paged_kv:
         argv.append("--no-paged-kv")
     if getattr(args, "no_in_dispatch_eos", False):

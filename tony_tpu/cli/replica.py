@@ -52,6 +52,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-page-size", type=int, default=0)
     p.add_argument("--kv-pages", type=int, default=0)
     p.add_argument("--no-paged-kv", action="store_true")
+    p.add_argument("--mesh", default="",
+                   help="sharded replica: devices for THIS agent's "
+                        "engine (count or 'tensor=N,expert=M'; see "
+                        "cli.gateway --mesh)")
+    p.add_argument("--shard-rules", default="serve")
     p.add_argument("--no-in-dispatch-eos", action="store_true")
     p.add_argument("--max-pending", type=int, default=1024)
     p.add_argument("--eos-id", type=int, default=-1)

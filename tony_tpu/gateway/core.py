@@ -1018,6 +1018,16 @@ class _Replica:
         ts = getattr(server, "transport_stats", None)
         if ts is not None:
             out["transport"] = ts()
+        # sharded replicas (ISSUE-14): mesh topology + per-chip
+        # residency — nested, so the MetricsStore numeric filter skips
+        # it while /stats carries it (the flat mesh_* counters above
+        # feed MetricsStore). Remote stubs have no mesh_info; their
+        # agents' counters carry the flat twins over the wire.
+        mi = getattr(server, "mesh_info", None)
+        if callable(mi):
+            m = mi()
+            if m is not None:
+                out["mesh"] = m
         # the per-replica radix summary (nested — the MetricsStore
         # numeric filter skips it): entry/byte/shape counts the
         # affinity router's decisions can be audited against. Behind
@@ -2478,6 +2488,24 @@ class Gateway:
             "handoffs": {
                 "out": total("handoffs_out"),
                 "in": total("handoffs_in"),
+            },
+            # sharded replicas (ISSUE-14): mesh topology rollup —
+            # device/shard counts ride the flat counters (so remote
+            # agents report too); the axis layout comes from the first
+            # local sharded engine
+            "mesh": {
+                "enabled": any("mesh_devices" in c for c in counts),
+                "devices": max((c.get("mesh_devices", 1)
+                                for c in counts), default=1),
+                "kv_shards": max((c.get("mesh_kv_shards", 1)
+                                  for c in counts), default=1),
+                "param_bytes_per_chip": max(
+                    (c.get("mesh_param_bytes_per_chip", 0)
+                     for c in counts), default=0),
+                "topology": next(
+                    (s.mesh_info()["axes"] for s in servers
+                     if callable(getattr(s, "mesh_info", None))
+                     and getattr(s, "mesh", None) is not None), {}),
             },
             # the host-RAM page tier (serve/tier.py): spill/restore
             # volume and residency — page_ins > 0 under prefix traffic

@@ -143,6 +143,19 @@ class TransformerConfig:
     # stacked "layers" dim (shardable over the pipe axis). Uniform layers
     # only (incompatible with moe_every, which alternates block types).
     scan_layers: bool = False
+    # SHARDED SERVING (ISSUE-14; needs cfg.mesh): pin activations
+    # replicated at the row-parallel boundaries — the attention output
+    # entering the o projection, o's output, the MLP hidden entering
+    # wo, and wo's output. Under the parallel.sharding "serve" preset
+    # (weights sharded on OUTPUT dims only) these four constraints
+    # force GSPMD to all-gather activations BEFORE any matmul whose
+    # contraction dim they shard, so every float reduction runs whole
+    # on one chip in the single-chip order and all cross-chip ICI
+    # traffic is pure data movement — the structural argument behind
+    # the serving engine's mesh=1 == mesh=N byte-identical-streams
+    # contract. Training presets (dp/fsdp/tp) must leave this False:
+    # a replicate pin would all-gather batch-sharded activations.
+    shard_activations: bool = False
 
     def __post_init__(self):
         # invalid knob combinations fail at construction, not first apply
@@ -169,6 +182,20 @@ class TransformerConfig:
                 f"n_kv_heads={kv} must be positive and divide "
                 f"n_heads={self.n_heads}")
         return kv
+
+
+def _serve_replicate(cfg: TransformerConfig, x):
+    """The sharded-serving replicate pin (``cfg.shard_activations``):
+    constrain ``x`` fully replicated so the matmul consuming it next
+    contracts over whole operands (see the config field comment). A
+    no-op without a mesh or with the flag off — training paths never
+    pay the gather."""
+    if cfg.mesh is None or not cfg.shard_activations:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(cfg.mesh, PartitionSpec()))
 
 
 def _attention(cfg: TransformerConfig, q, k, v, segment_ids=None):
@@ -357,6 +384,11 @@ class Attention(nn.Module):
         v = dense("v", (cfg.kv_heads, cfg.head_dim), qkv_bias)(x)
         if decode:
             out = self._decode_attention(q, k, v, positions, page_table)
+            # serve-shard pin: attn out is kv-head-sharded (it read the
+            # sharded KV pools locally); the o projection contracts
+            # over heads, so gather it whole first — exact data
+            # movement, not a partial-sum psum
+            out = _serve_replicate(cfg, out)
         else:
             if cfg.positional == "rope":
                 positions = jnp.arange(l)
@@ -376,6 +408,10 @@ class Attention(nn.Module):
                 k = jnp.repeat(k, group, axis=2)
                 v = jnp.repeat(v, group, axis=2)
             out = _attention(cfg, q, k, v, segment_ids)
+            # serving never takes this branch (every engine dispatch
+            # runs decode=True), but the pin completes the contract
+            # for any non-decode apply of a shard_activations model
+            out = _serve_replicate(cfg, out)
         if cfg.quantized:
             out = QuantDense((cfg.d_model,), in_axes=2,
                              use_bias=cfg.use_bias, dtype=cfg.dtype,
@@ -386,7 +422,10 @@ class Attention(nn.Module):
                 cfg.d_model, axis=(-2, -1), use_bias=cfg.use_bias,
                 dtype=cfg.dtype, param_dtype=jnp.float32, name="o",
                 kernel_init=nn.initializers.normal(0.02))(out)
-        return out
+        # serve-shard pin: o's output is embed-sharded (the serve
+        # preset's row-parallel flip); the residual add and the next
+        # norm's mean/rsqrt must see it whole
+        return _serve_replicate(cfg, out)
 
     def _decode_attention(self, q, k, v, positions=None, page_table=None):
         """Incremental attention over a fixed-size KV cache.
@@ -804,7 +843,11 @@ class MLP(nn.Module):
             # SwiGLU: the gate rides the same [B,L,ff] tile as wi's output,
             # so XLA fuses the elementwise product into the matmul epilogue
             h = h * dense("wi", cfg.d_ff)(x)
-        return dense("wo", cfg.d_model)(h)
+        # serve-shard pins: wo contracts over the mlp dim h is sharded
+        # on — gather h whole first; wo's output is embed-sharded (the
+        # row-parallel flip) — gather it before the residual/norm
+        h = _serve_replicate(cfg, h)
+        return _serve_replicate(cfg, dense("wo", cfg.d_model)(h))
 
 
 class MoEMLP(nn.Module):
@@ -877,7 +920,12 @@ class MoEMLP(nn.Module):
             # params (and would double-count: apply(mutable=["losses"])
             # seeds the collection from the input before sow appends)
             self.sow("losses", "moe_aux", aux.astype(jnp.float32))
-        return out.astype(cfg.dtype)
+        # serve-shard pin (the dense-MLP wo rule, MoE flavor). NOTE:
+        # the expert-parallel combine itself sums expert outputs across
+        # the expert axis, so MoE serving under expert>1 is exact-
+        # correct but NOT pinned bitwise vs single-chip — the dense
+        # transformer is (docs/SERVING.md).
+        return _serve_replicate(cfg, out.astype(cfg.dtype))
 
 
 class Block(nn.Module):

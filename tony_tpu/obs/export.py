@@ -325,6 +325,22 @@ def prometheus_text(gateway) -> str:
           "1 when the host-RAM KV page tier is on",
           1 if eng.get("kv_host", {}).get("enabled") else 0)
 
+    # sharded replicas (ISSUE-14): mesh topology — devices per replica
+    # and how many ways the KV pools split on the kv-head axis
+    mesh = eng.get("mesh") or {}
+    gauge("tony_mesh_enabled",
+          "1 when replicas are tensor/expert-sharded over a mesh",
+          1 if mesh.get("enabled") else 0)
+    if mesh.get("enabled"):
+        gauge("tony_mesh_devices", "Devices per sharded replica",
+              mesh.get("devices", 1))
+        gauge("tony_mesh_kv_shards",
+              "KV page-pool shards on the kv-head axis",
+              mesh.get("kv_shards", 1))
+        gauge("tony_mesh_param_bytes_per_chip",
+              "Per-chip parameter residency under the serving "
+              "shardings", mesh.get("param_bytes_per_chip", 0))
+
     # disaggregated prefill/decode (ISSUE-12): routing + handoff flow
     routing = snap.get("routing") or {}
     gauge("tony_prefix_affinity_enabled",

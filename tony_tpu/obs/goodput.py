@@ -153,7 +153,18 @@ class CostModel:
     dimensions from the config. All estimates model the COMPILED
     program's static read/write set: a fixed-shape decode step reads
     the whole ``[batch, view]`` cache buffer whether slots are live or
-    not — which is exactly why the ledger's padding bucket exists."""
+    not — which is exactly why the ledger's padding bucket exists.
+
+    PER-CHIP contract (ISSUE-14): on a sharded replica the caller
+    constructs this model with PER-CHIP quantities — ``param_bytes``/
+    ``param_count`` summed from the actual shardings (replicated
+    leaves whole), ``kv_token_bytes`` divided by the pool's kv-head
+    shard count, ``n_heads`` the per-chip head count — while
+    ``hbm_gbps``/``peak_flops`` stay the SINGLE-chip roofline. Pricing
+    total mesh bytes against one chip's roofline would push HBM-BW%
+    past 100% and permanently mask a goodput collapse;
+    ``serve.Server.__init__`` owns the division (it has the
+    shardings), this class stays pure arithmetic."""
 
     def __init__(self, *, param_bytes: int, param_count: int,
                  kv_token_bytes: float, n_heads: int, head_dim: int,

@@ -4,11 +4,17 @@ The decode hot loop is HBM-bound (docs/PERF.md "Decode roofline"): every
 generated token re-reads the whole KV cache once. This kernel is the
 cache-side counterpart of the int8 weight path (ops/quant.py):
 
-- one grid step per (batch x kv_head, kv block): K/V tiles are DMA'd
-  HBM->VMEM once and consumed by an online-softmax accumulation held in
-  VMEM scratch — no [S] score tensor round-trips to HBM, and the
-  softmax/weighted-sum fuse into the tile pass (XLA's decode attention
-  materializes scores + probabilities in HBM at small batch);
+- one grid step per (batch, kv_head, kv block): K/V tiles are DMA'd
+  HBM->VMEM once — sliced straight out of the cache's NATIVE
+  [B, S, KVH, D] layout by the BlockSpec index maps (the r13 relayout
+  fix: the old path materialized transposed copies of the FULL cache
+  before every call; the only relayout left is the GQA int8 path's
+  scale tensors, 4/D of the cache bytes, kept so each head instance
+  reads an exact per-head tile) — and consumed by an online-softmax
+  accumulation held in VMEM scratch: no [S] score tensor round-trips
+  to HBM, and the softmax/weighted-sum fuse into the tile pass (XLA's
+  decode attention materializes scores + probabilities in HBM at
+  small batch);
 - the cache may be stored **int8 with per-(position, head) scales**
   (quantize-on-write in models/transformer._decode_attention): tiles
   cross HBM as int8 — HALF the cache traffic of bf16, the dominant
@@ -63,13 +69,22 @@ def _vmem(shape):
 
 def _decode_kernel(q_ref, k_ref, v_ref, len_ref, *rest,
                    block_k: int, scale: float, window: int,
-                   quant: bool, kvh: int):
+                   quant: bool):
+    """Grid (batch, kv_head, kv_block); K/V arrive in their NATIVE
+    [B, S, KVH, D] cache layout — the BlockSpec index maps slice one
+    head's [block_k, D] tile per instance (the r13 relayout fix: no
+    materialized cache-sized transpose). The int8 scales DO arrive
+    pre-transposed [B, KVH, S] (tiny — 4/D of the cache bytes): a
+    native-layout scale tile would carry ALL kvh lane columns and be
+    re-fetched once per head instance, a kvh-fold tax on the
+    hot-loop's HBM reads, where the transpose hands every instance an
+    exact (1, 1, block_k) per-head tile."""
     if quant:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
         o_ref, m_scr, l_scr, acc_scr = rest
-    ki = pl.program_id(1)
-    nk = pl.num_programs(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
 
     @pl.when(ki == 0)
     def _init():
@@ -80,13 +95,13 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, *rest,
     # lengths live whole in SMEM (scalars don't tile: a (1, 1) VMEM
     # block of an [B, 1] array fails Mosaic's sublane rule on-chip);
     # indexed dynamically per grid row instead of via BlockSpec
-    length = len_ref[pl.program_id(0) // kvh, 0]
+    length = len_ref[pl.program_id(0), 0]
     start = jnp.maximum(length - window, 0) if window > 0 else 0
 
     def _body():
-        q = q_ref[0]  # [Gp, D]
-        k = k_ref[0]  # [block_k, D] (int8 when quant)
-        v = v_ref[0]
+        q = q_ref[0, 0]        # [Gp, D]
+        k = k_ref[0, :, 0, :]  # [block_k, D] (int8 when quant)
+        v = v_ref[0, :, 0, :]
         if quant:
             kf = k.astype(jnp.float32) * ks_ref[0, 0][:, None]
             vf = v.astype(jnp.float32) * vs_ref[0, 0][:, None]
@@ -125,32 +140,45 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, *rest,
     @pl.when(ki == nk - 1)
     def _finalize():
         l_safe = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
 
 
 def _decode_kernel_mha(q_ref, k_ref, v_ref, len_ref, *rest,
                        block_k: int, scale: float, window: int,
-                       quant: bool, kvh: int, bh_blk: int):
-    """Batched-rows variant for MHA decode (group == 1).
+                       quant: bool, hb: int):
+    """Head-blocked variant for MHA decode (group == 1).
 
     The GQA kernel pads each kv head's single query row to 8 sublanes
-    and runs one grid instance per (batch x head) — at short cache that
-    is b*h tiny instances whose fixed cost (DMA setup, grid step) beats
-    the useful work, exactly where the XLA einsum used to win
-    (VERDICT r4 #1/#4: 0.89x at cache 512). Here ``bh_blk`` (batch x
-    head) rows ride ONE instance: 8 real query rows fill the sublanes
-    that padding wasted, DMA tiles are 8x larger, and the instance count
-    drops 8x. The score/value contractions become VPU
-    multiply-reductions (each row has its own K/V — there is no shared
-    matmul), which decode can afford: it is bandwidth-bound, and the VPU
-    work is microseconds against the cache-read time.
-    """
+    and runs one grid instance per (batch x head) — at short cache
+    that is b*h tiny instances whose fixed cost (DMA setup, grid step)
+    beats the useful work, exactly where the XLA einsum used to win
+    (VERDICT r4 #1/#4: 0.89x at cache 512). Here ``hb`` HEADS of one
+    batch ride one instance (grid = (batch, kvh/hb, kv_block)): real
+    query rows fill the sublanes padding wasted, K/V tiles arrive in
+    their NATIVE [B, S, KVH, D] layout as one [block_k, hb, D] DMA
+    (the r13 relayout fix — no materialized transpose), and the
+    instance count drops hb-fold. All rows share the batch, so ONE
+    SMEM length serves the whole instance (the old flattened-row
+    variant assembled per-row length columns). Per-head score/value
+    contractions are statically unrolled plain 2-D dots — no batched
+    dot_general, no in-VMEM transpose, Mosaic-safe by construction.
+
+    Tile legality: ``hb`` is either the FULL kvh dim (kvh <= 8; a
+    full-dim block is always legal) or 8 (a sublane multiple) — the
+    caller falls back to the GQA kernel for any other head count (a
+    partial sublane tile only compiles in the CPU interpreter). int8
+    scales arrive pre-transposed ``[B, KVH, S]`` as ``(1, hb, bk)``
+    tiles (sublane hb, lane bk — legal by the same rule) and FOLD
+    onto the score/probability rows instead of dequantizing tiles:
+    the per-(position, head) scale distributes over the
+    d-contraction, exactly the einsum path's trick
+    (models/transformer._decode_attention)."""
     if quant:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
         o_ref, m_scr, l_scr, acc_scr = rest
-    ki = pl.program_id(1)
-    nk = pl.num_programs(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
 
     @pl.when(ki == 0)
     def _init():
@@ -158,31 +186,34 @@ def _decode_kernel_mha(q_ref, k_ref, v_ref, len_ref, *rest,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # per-row cache lengths: rows of this block may span batches; SMEM
-    # scalar reads (unrolled: bh_blk is static) assemble the column
-    row0 = pl.program_id(0) * bh_blk
-    lens = jnp.stack([len_ref[(row0 + i) // kvh, 0]
-                      for i in range(bh_blk)]).reshape(bh_blk, 1)
-    maxlen = jnp.max(lens)
+    length = len_ref[pl.program_id(0), 0]
+    start = jnp.maximum(length - window, 0) if window > 0 else 0
 
     def _body():
-        q = q_ref[:].astype(jnp.float32)          # [bh, D]
-        k = k_ref[:]                              # [bh, block_k, D]
-        v = v_ref[:]
-        if quant:
-            kf = k.astype(jnp.float32) * ks_ref[:, 0, :][:, :, None]
-            vf = v.astype(jnp.float32) * vs_ref[:, 0, :][:, :, None]
-        else:
-            kf = k.astype(jnp.float32)
-            vf = v.astype(jnp.float32)
-        # each row contracts against its own K tile: VPU mul-reduce over
-        # D (lane dim), not a matmul
-        s = jnp.sum(q[:, None, :] * kf, axis=2) * scale  # [bh, block_k]
+        q = q_ref[0].astype(jnp.float32)  # [hb, D]
+        k = k_ref[0]                      # [block_k, hb, D]
+        v = v_ref[0]
+        # statically unrolled per head (hb <= 8): each head's score is
+        # a plain [1, D] x [D, block_k] dot against its own K tile —
+        # same per-element reduction as the GQA kernel
+        rows = []
+        for hh in range(hb):
+            s_h = jax.lax.dot_general(
+                q[hh:hh + 1, :], k[:, hh, :].astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if quant:
+                # fold the K scale onto the lane-major score row (it
+                # distributes over the d-contraction) — no
+                # sublane-major scale column is ever needed
+                s_h = s_h * ks_ref[0, hh:hh + 1, :]
+            rows.append(s_h)
+        s = jnp.concatenate(rows, axis=0) * scale  # [hb, block_k]
         pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
-        visible = pos < lens
+        visible = pos < length
         if window > 0:
-            visible = visible & (pos >= jnp.maximum(lens - window, 0))
+            visible = visible & (pos >= start)
         s = jnp.where(visible, s, NEG_INF)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -190,14 +221,21 @@ def _decode_kernel_mha(q_ref, k_ref, v_ref, len_ref, *rest,
         corr = jnp.exp(m_prev - m_new)
         l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         m_scr[:] = m_new
-        acc_scr[:] = acc_scr[:] * corr + jnp.sum(
-            p[:, :, None] * vf, axis=1)  # [bh, D]
+        pv = []
+        for hh in range(hb):
+            p_h = p[hh:hh + 1, :]
+            if quant:
+                # likewise fold the V scale into the probabilities
+                p_h = p_h * vs_ref[0, hh:hh + 1, :]
+            pv.append(jax.lax.dot_general(
+                p_h, v[:, hh, :].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        acc_scr[:] = acc_scr[:] * corr + jnp.concatenate(pv, axis=0)
 
-    in_range = ki * block_k < maxlen
+    in_range = ki * block_k < length
     if window > 0:
-        # conservative: any row's window may reach into this block
-        in_range = in_range & (ki * block_k + block_k
-                               > jnp.min(jnp.maximum(lens - window, 0)))
+        in_range = in_range & (ki * block_k + block_k > start)
 
     @pl.when(in_range)
     def _run():
@@ -206,16 +244,11 @@ def _decode_kernel_mha(q_ref, k_ref, v_ref, len_ref, *rest,
     @pl.when(ki == nk - 1)
     def _finalize():
         l_safe = jnp.maximum(l_scr[:], 1e-30)
-        # rows with NO visible position ever (length 0, or a window
-        # past every block — e.g. an empty continuous-batching slot
-        # sharing this 8-row block with live rows) never raise m above
-        # NEG_INF: their p = exp(s - m) degenerated to 1 and acc holds
-        # a sum of V tiles — mask them to the 0 the GQA kernel (whose
-        # per-row gate never runs such rows) and the reference emit.
-        # Rows whose first visible block comes late self-heal: the
-        # correction factor exp(NEG_INF - m_new) wipes the pollution.
+        # a length-0 batch (an empty continuous-batching slot) never
+        # runs _body: m stays NEG_INF and the mask pins its rows to
+        # the exact zeros the reference path emits
         valid = m_scr[:] > NEG_INF * 0.5
-        o_ref[:] = jnp.where(valid, acc_scr[:] / l_safe,
+        o_ref[0] = jnp.where(valid, acc_scr[:] / l_safe,
                              0.0).astype(o_ref.dtype)
 
 
@@ -275,79 +308,104 @@ def flash_decode(q, k, v, length, *, window: int = 0, block_k: int = 512,
 
     from jax.experimental.pallas import tpu as pltpu
 
-    # [B, S, KVH, D] -> [B*KVH, S, D]
-    kr = k.transpose(0, 2, 1, 3).reshape(b * kvh, s, d)
-    vr = v.transpose(0, 2, 1, 3).reshape(b * kvh, s, d)
+    # K/V feed the kernels in their NATIVE [B, S, KVH, D] cache
+    # layout: the BlockSpec index maps slice per-(batch, head, block)
+    # tiles straight out of HBM — the r13 relayout fix (the old path
+    # materialized two transposed copies of the FULL cache per call,
+    # per layer, per token). Only the GQA path's int8 scales (4/D of
+    # the cache bytes) still pre-transpose — see that branch.
     len2 = jnp.broadcast_to(jnp.asarray(length, jnp.int32).reshape(-1, 1),
                             (b, 1))  # scalar length broadcasts per batch
-    if quant:
-        # [B, S, KVH] -> [B*KVH, 1, S]: lane-dim S keeps (1, bk) legal
-        ksr = k_scale.transpose(0, 2, 1).reshape(b * kvh, 1, s)
-        vsr = v_scale.transpose(0, 2, 1).reshape(b * kvh, 1, s)
 
-    bh_blk = 8
-    if group == 1 and (b * kvh) % bh_blk == 0:
-        # MHA: 8 (batch x head) rows per instance — fills the sublanes
-        # the GQA kernel padded, 8x fewer instances, 8x larger DMA tiles
-        # (the short-cache regime where per-instance cost dominated)
-        qr = q.reshape(b * kvh, d)
+    if group == 1 and (kvh <= 8 or kvh % 8 == 0):
+        # MHA: hb heads of one batch per instance — real query rows
+        # fill the sublanes the GQA kernel pads, instances drop
+        # hb-fold, and one [block_k, hb, D] DMA feeds hb heads (the
+        # short-cache regime where per-instance cost dominated).
+        # hb is the FULL head dim (kvh <= 8: a full-dim block is
+        # always tile-legal) or 8 (a sublane multiple); other head
+        # counts (e.g. 12) fall through to the GQA kernel — their
+        # partial sublane tile only compiles in the CPU interpreter.
+        hb = kvh if kvh <= 8 else 8
         kernel = functools.partial(
             _decode_kernel_mha, block_k=bk, scale=scale, window=window,
-            quant=quant, kvh=kvh, bh_blk=bh_blk)
+            quant=quant, hb=hb)
         in_specs = [
-            pl.BlockSpec((bh_blk, d), lambda bh, ki: (bh, 0)),
-            pl.BlockSpec((bh_blk, bk, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((bh_blk, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, hb, d), lambda bi, hi, ki: (bi, hi, 0)),
+            pl.BlockSpec((1, bk, hb, d),
+                         lambda bi, hi, ki: (bi, ki, hi, 0)),
+            pl.BlockSpec((1, bk, hb, d),
+                         lambda bi, hi, ki: (bi, ki, hi, 0)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ]
-        operands = [qr, kr, vr, len2]
+        operands = [q, k, v, len2]
         if quant:
+            # scales pre-transpose [B, S, KVH] -> [B, KVH, S] (tiny —
+            # 4/D of the cache bytes) so the tile is (1, hb, bk):
+            # sublane hb (full dim or 8), lane bk — Mosaic-legal at
+            # every head count this branch accepts. The kernel folds
+            # them onto scores/probabilities.
             in_specs += [
-                pl.BlockSpec((bh_blk, 1, bk), lambda bh, ki: (bh, 0, ki)),
-                pl.BlockSpec((bh_blk, 1, bk), lambda bh, ki: (bh, 0, ki)),
+                pl.BlockSpec((1, hb, bk),
+                             lambda bi, hi, ki: (bi, hi, ki)),
+                pl.BlockSpec((1, hb, bk),
+                             lambda bi, hi, ki: (bi, hi, ki)),
             ]
-            operands += [ksr, vsr]
+            operands += [k_scale.transpose(0, 2, 1),
+                         v_scale.transpose(0, 2, 1)]
         out = pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((b * kvh, d), q.dtype),
-            grid=(b * kvh // bh_blk, s // bk),
+            out_shape=jax.ShapeDtypeStruct((b, kvh, d), q.dtype),
+            grid=(b, kvh // hb, s // bk),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((bh_blk, d), lambda bh, ki: (bh, 0)),
-            scratch_shapes=[_vmem((bh_blk, 1)), _vmem((bh_blk, 1)),
-                            _vmem((bh_blk, d))],
+            out_specs=pl.BlockSpec((1, hb, d),
+                                   lambda bi, hi, ki: (bi, hi, 0)),
+            scratch_shapes=[_vmem((hb, 1)), _vmem((hb, 1)),
+                            _vmem((hb, d))],
             interpret=interpret,
         )(*operands)
-        return out.reshape(b, h, d)
+        return out  # [B, KVH, D] == [B, H, D] under MHA
 
-    # [B, H, D] -> [B*KVH, Gp, D] (group-major per kv head)
+    # GQA — and the MHA head counts with no tile-legal head block
+    # (kvh > 8, kvh % 8 != 0): [B, H, D] -> [B, KVH, Gp, D] (a pure
+    # reshape + a tiny pad of the single-token q — no cache-sized
+    # relayout)
     qr = q.reshape(b, kvh, group, d)
     if gp != group:
         qr = jnp.pad(qr, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
-    qr = qr.reshape(b * kvh, gp, d)
 
     kernel = functools.partial(_decode_kernel, block_k=bk, scale=scale,
-                               window=window, quant=quant, kvh=kvh)
+                               window=window, quant=quant)
     in_specs = [
-        pl.BlockSpec((1, gp, d), lambda bh, ki: (bh, 0, 0)),
-        pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
-        pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, 1, gp, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+        pl.BlockSpec((1, bk, 1, d), lambda bi, hi, ki: (bi, ki, hi, 0)),
+        pl.BlockSpec((1, bk, 1, d), lambda bi, hi, ki: (bi, ki, hi, 0)),
         pl.BlockSpec(memory_space=pltpu.SMEM),
     ]
-    operands = [qr, kr, vr, len2]
+    operands = [qr, k, v, len2]
     if quant:
+        # the ONE remaining relayout, scales only (tiny — 4/D of the
+        # cache bytes): [B, S, KVH] -> [B, KVH, S] hands each head
+        # instance an exact per-head (1, 1, bk) tile; native-layout
+        # scales would be re-fetched kvh times per block (see the
+        # kernel docstring). S in the lane dim also keeps the tile
+        # Mosaic-legal, the pre-r14 layout's argument.
+        ksr = k_scale.transpose(0, 2, 1)
+        vsr = v_scale.transpose(0, 2, 1)
         in_specs += [
-            pl.BlockSpec((1, 1, bk), lambda bh, ki: (bh, 0, ki)),
-            pl.BlockSpec((1, 1, bk), lambda bh, ki: (bh, 0, ki)),
+            pl.BlockSpec((1, 1, bk), lambda bi, hi, ki: (bi, hi, ki)),
+            pl.BlockSpec((1, 1, bk), lambda bi, hi, ki: (bi, hi, ki)),
         ]
         operands += [ksr, vsr]
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * kvh, gp, d), q.dtype),
-        grid=(b * kvh, s // bk),
+        out_shape=jax.ShapeDtypeStruct((b, kvh, gp, d), q.dtype),
+        grid=(b, kvh, s // bk),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, gp, d), lambda bh, ki: (bh, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, gp, d),
+                               lambda bi, hi, ki: (bi, hi, 0, 0)),
         scratch_shapes=[_vmem((gp, 1)), _vmem((gp, 1)), _vmem((gp, d))],
         interpret=interpret,
     )(*operands)
-    out = out.reshape(b, kvh, gp, d)[:, :, :group]
+    out = out[:, :, :group]
     return out.reshape(b, h, d)

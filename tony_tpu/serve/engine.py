@@ -679,6 +679,22 @@ class Server:
     ``SPEC_EMA_DISABLE``) stops drafting for requests whose proposals
     keep getting rejected, so the worst case is the plain chunked path
     plus one host-side numpy scan per round.
+
+    ``mesh`` (a ``jax.sharding.Mesh``, ISSUE-14) makes this replica a
+    MULTI-CHIP tensor/expert-sharded engine: params place under the
+    ``parallel.sharding`` serving preset (``shard_rules``, default
+    "serve" — output-dim sharding with the row-parallel flip), KV page
+    pools shard on the kv-head axis, and every dispatch runs GSPMD-
+    partitioned with XLA-inserted ICI collectives — same dispatch
+    count per token, no new host syncs, and byte-identical greedy +
+    seeded streams vs mesh=1 (all cross-chip traffic is all-gather;
+    every float reduction runs whole on one chip). Page tables and the
+    free-list allocator stay host-side and unchanged; prefix-store
+    entries, CoW pages, handoff payloads and host-tier spills become
+    sharded pytrees transparently. The goodput ledger prices sharded
+    dispatches PER CHIP (bytes/FLOPs over the shard counts against
+    the single-chip roofline). ``decode_attention="flash"`` is
+    refused (GSPMD cannot partition a pallas_call).
     """
 
     # speculative-decoding gate: a slot drafts while its acceptance EMA
@@ -696,7 +712,8 @@ class Server:
                  timeline: bool = True, paged: bool | None = None,
                  kv_page_size: int = 0, kv_pages: int = 0,
                  hbm_gbps: float = 0.0, prefill_chunk_tokens: int = 0,
-                 kv_host_mb: float = 0.0, in_dispatch_eos: bool = True):
+                 kv_host_mb: float = 0.0, in_dispatch_eos: bool = True,
+                 mesh=None, shard_rules: str = "serve"):
         if model.cfg.quantized:
             # nothing structural in the way — the q8 apply is the same
             # model.apply — but untested here; fail loud, not wrong
@@ -716,6 +733,42 @@ class Server:
             # the None default (and the CLIs) downgrade to unpaged
             raise NotImplementedError(
                 "paged KV cache over sliding-window models is untested")
+        # SHARDED replica (ISSUE-14): with a ``mesh``, the param tree
+        # and the KV page pools are placed as NamedShardings under the
+        # parallel.sharding serving preset — params shard on their
+        # output dims (the row-parallel flip keeps every float
+        # reduction whole on one chip), KV pools shard on the kv-head
+        # axis, and EVERY dispatch below runs GSPMD-partitioned with
+        # XLA-inserted ICI collectives. The page tables, free-list
+        # allocator and reservation ledger stay host-side and
+        # unchanged (a page id means the same thing on every chip);
+        # dispatch counts per token are identical to single-chip — no
+        # new host syncs. Greedy AND seeded streams are byte-identical
+        # to mesh=1 (tests/test_shard_serve.py pins the matrix).
+        self.mesh = mesh
+        self.shard_rules = shard_rules
+        self.kv_shards = 1
+        self._param_shardings = None
+        if mesh is not None:
+            if model.cfg.decode_attention == "flash":
+                # GSPMD cannot partition a pallas_call: the kernel
+                # would be silently all-gathered per step. Fail loud.
+                raise NotImplementedError(
+                    "sharded serving over the pallas flash-decode "
+                    "kernel is untested; use decode_attention='einsum'")
+            import dataclasses
+
+            from tony_tpu.parallel.sharding import serving_shardings
+
+            # re-cfg the model with the mesh + the replicate pins that
+            # make sharded math reduction-order-identical (a distinct
+            # static jit key, so sharded and unsharded servers in one
+            # process never share a miscompiled program)
+            model = model.__class__(dataclasses.replace(
+                model.cfg, mesh=mesh, shard_activations=True))
+            self._param_shardings = serving_shardings(mesh, params,
+                                                      shard_rules)
+            params = jax.device_put(params, self._param_shardings)
         self.model = model
         self.params = params
         # deterministic fault injection (serve/faults.py); None = off,
@@ -764,10 +817,37 @@ class Server:
             # kv_pages grows the batch into the same HBM or shrinks
             # the footprint for short-sequence traffic
             n_pages = int(kv_pages) or batch_size * max_pages
-            pool = PagePool(model, params, n_pages, ps)
+            # mesh: the pool allocates DIRECTLY under its kv-head
+            # shardings (slots._alloc_sharded) — a dense-then-reshard
+            # order would transiently hold the whole pool on one chip
+            # and OOM exactly the configurations the mesh unlocks
+            pool = PagePool(model, params, n_pages, ps, mesh=mesh)
             self.slots = SlotCache(model, params, batch_size, pool=pool)
         else:
-            self.slots = SlotCache(model, params, batch_size)
+            self.slots = SlotCache(model, params, batch_size, mesh=mesh)
+        cache_leaves = jax.tree_util.tree_leaves(self.slots.cache)
+        self._kv_bytes_total = sum(
+            int(np.prod(x.shape)) * x.dtype.itemsize for x in cache_leaves)
+        self._kv_bytes_chip = self._kv_bytes_total
+        if mesh is not None:
+            # the cache (page pools, or fixed-shape rows) was ALLOCATED
+            # under its kv-head shardings above; this block only does
+            # the accounting — shard count + per-chip bytes — off the
+            # same rule, so the two can never disagree. Host-side
+            # tables and the allocator never see the difference.
+            from tony_tpu.parallel.sharding import (kv_cache_shardings,
+                                                    kv_shard_count,
+                                                    tree_shard_bytes)
+
+            csh = kv_cache_shardings(mesh, self.slots.cache)
+            self.kv_shards = kv_shard_count(mesh, self.slots.cache)
+            self._kv_bytes_chip = tree_shard_bytes(self.slots.cache, csh)
+            if self.kv_shards == 1 and mesh.size > 1:
+                log.warning(
+                    "KV pools replicated on the %d-device mesh: the "
+                    "tensor axis does not divide kv_heads=%d — params "
+                    "still shard, KV capacity does not",
+                    mesh.size, model.cfg.kv_heads)
         self.pending: deque[Request] = deque()
         self._pending_lock = threading.Lock()
         self._live: list[_Live | None] = [None] * batch_size
@@ -806,12 +886,26 @@ class Server:
         self.hbm_gbps = float(hbm_gbps) if hbm_gbps > 0 \
             else detect_hbm_gbps()
         self.peak_flops = detect_peak_flops()
+        leaves = jax.tree_util.tree_leaves(params)
+        self._param_bytes_total = sum(
+            int(np.prod(x.shape)) * x.dtype.itemsize for x in leaves)
+        param_count_total = sum(int(np.prod(x.shape)) for x in leaves)
+        if mesh is not None:
+            # per-chip param residency under the actual shardings
+            # (replicated leaves count whole) — what one chip's HBM
+            # holds and re-reads per decode micro-step
+            from tony_tpu.parallel.sharding import (tree_shard_bytes,
+                                                    tree_shard_count)
+
+            self._param_bytes_chip = tree_shard_bytes(
+                params, self._param_shardings)
+            self._param_count_chip = tree_shard_count(
+                params, self._param_shardings)
+        else:
+            self._param_bytes_chip = self._param_bytes_total
+            self._param_count_chip = param_count_total
         self.cost = None
         if self.timeline is not None:
-            leaves = jax.tree_util.tree_leaves(params)
-            param_bytes = sum(
-                int(np.prod(x.shape)) * x.dtype.itemsize for x in leaves)
-            param_count = sum(int(np.prod(x.shape)) for x in leaves)
             cfg = model.cfg
             if self.paged:
                 pool = self.slots.pool
@@ -821,9 +915,20 @@ class Server:
                     / max(1, cfg.max_seq_len)
             head_dim = cfg.explicit_head_dim \
                 or cfg.d_model // cfg.n_heads
+            # sharded replicas price dispatches PER CHIP (the ISSUE-14
+            # goodput rule): each chip reads its param shard and its
+            # kv-head slice of the pools, so bytes/FLOPs divide by the
+            # shard counts while hbm_gbps/peak_flops stay the SINGLE-
+            # chip roofline — HBM-BW% stays a per-chip percentage
+            # instead of reading >100% on a mesh. Attention work
+            # splits with the kv pools (kv_shards divides kv_heads
+            # divides n_heads); a replicated-pool fallback prices
+            # attention unsharded, conservatively.
             self.cost = CostModel(
-                param_bytes=param_bytes, param_count=param_count,
-                kv_token_bytes=kv_tok, n_heads=cfg.n_heads,
+                param_bytes=self._param_bytes_chip,
+                param_count=self._param_count_chip,
+                kv_token_bytes=kv_tok / max(1, self.kv_shards),
+                n_heads=cfg.n_heads // max(1, self.kv_shards),
                 head_dim=head_dim, vocab_size=cfg.vocab_size,
                 hbm_gbps=self.hbm_gbps, peak_flops=self.peak_flops)
         # speculative decoding (0 = off: zero overhead, no new programs)
@@ -950,6 +1055,27 @@ class Server:
         return ledger(self.timeline.summary(), wall_ms,
                       hbm_gbps=self.hbm_gbps,
                       peak_flops=self.peak_flops)
+
+    def mesh_info(self) -> dict | None:
+        """Sharded-replica topology + per-chip residency (None on a
+        single-chip engine): mesh axes, how many ways the KV pools
+        split, and the per-chip vs total param/KV bytes — the numbers
+        behind /stats ``engine.mesh`` and the capacity-unlock math
+        (a model whose total footprint exceeds one chip serves when
+        the per-chip numbers fit)."""
+        if self.mesh is None:
+            return None
+        return {
+            "devices": int(self.mesh.size),
+            "axes": {str(k): int(v) for k, v in self.mesh.shape.items()
+                     if int(v) > 1},
+            "preset": self.shard_rules,
+            "kv_shards": int(self.kv_shards),
+            "param_bytes_total": int(self._param_bytes_total),
+            "param_bytes_per_chip": int(self._param_bytes_chip),
+            "kv_bytes_total": int(self._kv_bytes_total),
+            "kv_bytes_per_chip": int(self._kv_bytes_chip),
+        }
 
     # ------------------------------------------------------------ intake
 
@@ -2482,6 +2608,13 @@ class Server:
             "handoffs_out": self.handoffs_out,
             "handoffs_in": self.handoffs_in,
         }
+        if self.mesh is not None:
+            # flat numeric twins of mesh_info() so MetricsStore and
+            # the remote agent's counters wire carry the topology
+            out["mesh_devices"] = int(self.mesh.size)
+            out["mesh_kv_shards"] = int(self.kv_shards)
+            out["mesh_param_bytes_per_chip"] = int(self._param_bytes_chip)
+            out["mesh_kv_bytes_per_chip"] = int(self._kv_bytes_chip)
         if self.host_tier is not None:
             hs = self.host_tier.stats()
             out["kv_host_entries"] = hs["entries"]

@@ -91,7 +91,26 @@ def _read_slot(cache: Any, slot) -> Any:
 # --------------------------------------------------------- paged cache
 
 
-def paged_cache(model, params, n_pages: int, page_size: int) -> Any:
+def _alloc_sharded(structs: Any, mesh) -> Any:
+    """Allocate a cache pytree of zeros DIRECTLY under its kv-head
+    shardings (``parallel.sharding.kv_cache_shardings``): one jitted
+    nullary program with out_shardings, so each chip only ever
+    materializes its own shard. The naive order — allocate dense,
+    then ``device_put`` to the shardings — holds the WHOLE pool on
+    one chip transiently at boot, which OOMs exactly the
+    bigger-than-one-chip configurations the mesh exists to serve."""
+    from tony_tpu.parallel.sharding import kv_cache_shardings
+
+    shardings = kv_cache_shardings(mesh, structs)
+    make = jax.jit(
+        lambda: jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                             structs),
+        out_shardings=shardings)
+    return make()
+
+
+def paged_cache(model, params, n_pages: int, page_size: int,
+                mesh=None) -> Any:
     """A PAGED cache pytree: every batched leaf of a batch-1
     ``init_cache`` tree — KV buffers ``[.., 1, max_len, kvh, dh]``,
     int8 scales ``[.., 1, max_len, kvh]`` — becomes a page POOL with
@@ -102,13 +121,31 @@ def paged_cache(model, params, n_pages: int, page_size: int) -> Any:
     value — the declared init shape only matters on the init pass),
     and scan_layers' stacked ``[n_layers, ...]`` leading axis is
     preserved by the same from-the-right axis arithmetic
-    ``cache_batch_axis`` uses."""
+    ``cache_batch_axis`` uses.
+
+    ``mesh`` (sharded serving, ISSUE-14): the pool allocates DIRECTLY
+    under its kv-head shardings — shapes come from ``eval_shape`` (no
+    dense batch-1 init pass materializes either), so one chip never
+    holds more than its shard (``_alloc_sharded``)."""
     def remap(path, leaf):
         ax = cache_batch_axis(path, leaf)
         if ax is None:
             return leaf
         shape = leaf.shape[:ax] + (n_pages, page_size) + leaf.shape[ax + 2:]
         return jnp.zeros(shape, leaf.dtype)
+
+    if mesh is not None:
+        base = jax.eval_shape(lambda p: init_cache(model, p, 1), params)
+
+        def remap_struct(path, leaf):
+            ax = cache_batch_axis(path, leaf)
+            shape = leaf.shape if ax is None else \
+                leaf.shape[:ax] + (n_pages, page_size) \
+                + leaf.shape[ax + 2:]
+            return jax.ShapeDtypeStruct(shape, leaf.dtype)
+
+        return _alloc_sharded(
+            jax.tree_util.tree_map_with_path(remap_struct, base), mesh)
 
     return jax.tree_util.tree_map_with_path(
         remap, init_cache(model, params, 1))
@@ -326,12 +363,14 @@ class PagePool:
     never kills an in-flight request.
     """
 
-    def __init__(self, model, params, n_pages: int, page_size: int):
+    def __init__(self, model, params, n_pages: int, page_size: int,
+                 mesh=None):
         if n_pages < 1 or page_size < 1:
             raise ValueError("n_pages and page_size must be >= 1")
         self.n_pages = int(n_pages)
         self.page_size = int(page_size)
-        self.cache = paged_cache(model, params, n_pages, page_size)
+        self.cache = paged_cache(model, params, n_pages, page_size,
+                                 mesh=mesh)
         self.page_nbytes = page_nbytes(self.cache)
         self.refcount = np.zeros(self.n_pages, np.int32)
         # LIFO free list: recently freed pages are re-issued first
@@ -460,7 +499,7 @@ class SlotCache:
     """
 
     def __init__(self, model, params, batch_size: int,
-                 pool: PagePool | None = None):
+                 pool: PagePool | None = None, mesh=None):
         self.batch_size = batch_size
         self.max_seq_len = model.cfg.max_seq_len
         self.pool = pool
@@ -476,6 +515,14 @@ class SlotCache:
                                       pool.n_pages, np.int32)
             self.n_slot_pages = np.zeros(batch_size, np.int32)
             self.reserve_left = np.zeros(batch_size, np.int32)
+        elif mesh is not None:
+            # fixed-shape rows, sharded serving: allocate the cache
+            # directly under its kv-head shardings (see _alloc_sharded
+            # — no dense transient on one chip)
+            self.cache = _alloc_sharded(
+                jax.eval_shape(
+                    lambda p: init_cache(model, p, batch_size), params),
+                mesh)
         else:
             self.cache = init_cache(model, params, batch_size)
         self.lengths = np.zeros(batch_size, np.int32)

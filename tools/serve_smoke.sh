@@ -42,6 +42,8 @@
 #                                   (goodput/alerts round only; `make goodput-smoke`)
 #        SERVE_SMOKE_ROUNDS=remote tools/serve_smoke.sh
 #                                   (remote round only; `make remote-smoke`)
+#        SERVE_SMOKE_ROUNDS=shard tools/serve_smoke.sh
+#                                   (sharded-replica round only; `make shard-smoke`)
 set -u
 
 PY=${PY:-python}
@@ -62,7 +64,9 @@ DGW_PID=''
 DCTRL_PID=''
 AT_PID=''
 ATCTRL_PID=''
-trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID $RGW_PID $RCTRL_PID $DGW_PID $DCTRL_PID $AT_PID $ATCTRL_PID 2>/dev/null; kill -9 $AGENT0_PID $AGENT1_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
+SHGW_PID=''
+SHCTRL_PID=''
+trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID $RGW_PID $RCTRL_PID $DGW_PID $DCTRL_PID $AT_PID $ATCTRL_PID $SHGW_PID $SHCTRL_PID 2>/dev/null; kill -9 $AGENT0_PID $AGENT1_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
 
 fail() { echo "serve-smoke: FAIL: $1" >&2; exit 1; }
 
@@ -773,6 +777,124 @@ EOF
     echo "serve-smoke: autotune OK (>=1 actuation, converged, zero 5xx, token-exact vs static control)"
 }
 
+# ---- shard round (also standalone: SERVE_SMOKE_ROUNDS=shard) ---------
+# ISSUE-14: tensor-sharded replicas. A --mesh 4 gateway on 4 virtual
+# CPU devices (demo model: 4 heads -> params shard on output dims, KV
+# page pools shard 4-way on the kv-head axis) under mixed greedy /
+# sampled / prefix-repeat / streaming traffic must produce
+# byte-identical outputs to a single-device control gateway, report
+# the mesh topology + per-chip pricing on /stats engine.mesh, and
+# export tony_mesh_* on /metrics.
+shard_round() {
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --replicas 1 --mesh 4 --speculate-k 4 --prefix-cache-mb 1 \
+        --port 0 --compile-cache '' \
+        >"$WORK/shard_boot.log" 2>"$WORK/shard_stderr.log" &
+    SHGW_PID=$!
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --replicas 1 --speculate-k 4 --prefix-cache-mb 1 \
+        --port 0 --compile-cache '' \
+        >"$WORK/shctrl_boot.log" 2>"$WORK/shctrl_stderr.log" &
+    SHCTRL_PID=$!
+    SHURL=''; SHCTRL_URL=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        SHURL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/shard_boot.log")
+        SHCTRL_URL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/shctrl_boot.log")
+        [ -n "$SHURL" ] && [ -n "$SHCTRL_URL" ] && break
+        kill -0 $SHGW_PID 2>/dev/null || fail "shard gateway died at boot: $(cat "$WORK/shard_stderr.log")"
+        kill -0 $SHCTRL_PID 2>/dev/null || fail "shard control died at boot: $(cat "$WORK/shctrl_stderr.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$SHURL" ] && [ -n "$SHCTRL_URL" ] || fail "shard gateways did not print URLs within ${BOUND}s"
+    echo "serve-smoke: shard gateway at $SHURL (mesh 4 over virtual devices; control at $SHCTRL_URL)"
+
+    # mixed traffic against BOTH gateways: greedy, seeded sampling, a
+    # repeat that must hit the prefix store, a repetitive prompt the
+    # drafter speculates on — every output must be byte-identical
+    REQ0='{"token_ids": [1, 2, 3, 4, 5], "max_new_tokens": 12, "id": 0}'
+    REQ1='{"token_ids": [3, 1, 4, 1, 5, 9], "max_new_tokens": 10, "temperature": 0.8, "top_k": 8, "seed": 123, "id": 1}'
+    REQ2='{"token_ids": [1, 2, 3, 4, 5], "max_new_tokens": 12, "id": 2}'
+    REQ3='{"token_ids": [7, 8, 9, 7, 8, 9, 7, 8, 9, 7, 8], "max_new_tokens": 10, "id": 3}'
+    n=0
+    for BODY in "$REQ0" "$REQ1" "$REQ2" "$REQ3"; do
+        code=$(curl_s "$WORK/shard_$n" "$SHURL/v1/generate" "$BODY") \
+            || fail "shard request $n curl"
+        [ "$code" = 200 ] || fail "shard request $n -> $code"
+        code=$(curl_s "$WORK/shctrl_$n" "$SHCTRL_URL/v1/generate" "$BODY") \
+            || fail "shard control $n curl"
+        [ "$code" = 200 ] || fail "shard control $n -> $code"
+        $PY - "$WORK/shard_$n" "$WORK/shctrl_$n" <<'EOF' || fail "shard request $n: output differs from single-device control"
+import json, sys
+a = json.load(open(sys.argv[1]))
+b = json.load(open(sys.argv[2]))
+assert a["token_ids"] == b["token_ids"], (a["token_ids"], b["token_ids"])
+EOF
+        n=$((n + 1))
+    done
+    N_REQ=$n
+    # one streamed request: the NDJSON deltas must reassemble to the
+    # same token stream on both gateways
+    STREAM_REQ='{"token_ids": [9, 8, 7, 6], "max_new_tokens": 8, "stream": true, "id": 9}'
+    code=$(curl_s "$WORK/shard_stream" "$SHURL/v1/generate" "$STREAM_REQ") || fail "shard stream curl"
+    [ "$code" = 200 ] || fail "shard stream -> $code"
+    code=$(curl_s "$WORK/shctrl_stream" "$SHCTRL_URL/v1/generate" "$STREAM_REQ") || fail "shard control stream curl"
+    [ "$code" = 200 ] || fail "shard control stream -> $code"
+    $PY - "$WORK/shard_stream" "$WORK/shctrl_stream" <<'EOF' || fail "shard stream differs from single-device control"
+import json, sys
+def toks(path):
+    out = []
+    for ln in open(path):
+        if ln.strip():
+            out.extend(json.loads(ln).get("token_ids", []))
+    return out
+a, b = toks(sys.argv[1]), toks(sys.argv[2])
+assert a and a == b, (a, b)
+EOF
+
+    code=$(curl_s "$WORK/shard_stats" "$SHURL/stats") || fail "shard stats curl"
+    [ "$code" = 200 ] || fail "shard stats -> $code"
+    $PY - "$WORK/shard_stats" "$N_REQ" <<'EOF' || fail "shard stats wrong: $(cat "$WORK/shard_stats")"
+import json, sys
+stats = json.load(open(sys.argv[1]))
+assert stats["completed"] == int(sys.argv[2]) + 1, stats["completed"]
+assert stats["shed"] == {}, stats["shed"]          # zero 5xx
+mesh = stats["engine"]["mesh"]
+assert mesh["enabled"], mesh
+assert mesh["devices"] == 4, mesh
+assert mesh["kv_shards"] == 4, mesh                # pools split 4-way
+assert mesh["topology"] == {"tensor": 4}, mesh
+assert mesh["param_bytes_per_chip"] > 0, mesh
+row = stats["replicas"][0]
+assert row["mesh"]["param_bytes_per_chip"] \
+    < row["mesh"]["param_bytes_total"], row["mesh"]  # per-chip pricing
+assert row["prefix_hits"] >= 1, row                # the repeat hit
+EOF
+    curl_s "$WORK/shard_metrics" "$SHURL/metrics" >/dev/null 2>&1
+    grep -q 'tony_mesh_enabled 1' "$WORK/shard_metrics" || fail "no tony_mesh_enabled on /metrics"
+    grep -q 'tony_mesh_devices 4' "$WORK/shard_metrics" || fail "no tony_mesh_devices on /metrics"
+    grep -q 'tony_mesh_kv_shards 4' "$WORK/shard_metrics" || fail "no tony_mesh_kv_shards on /metrics"
+
+    kill -TERM $SHGW_PID $SHCTRL_PID
+    for P in $SHGW_PID $SHCTRL_PID; do
+        i=0
+        while kill -0 $P 2>/dev/null; do
+            [ $i -ge $BOUND ] && fail "shard gateway did not drain within ${BOUND}s of SIGTERM"
+            sleep 1; i=$((i + 1))
+        done
+    done
+    wait $SHGW_PID; rc=$?
+    [ $rc = 0 ] || fail "shard gateway exited $rc after SIGTERM"
+    SHGW_PID=''
+    SHCTRL_PID=''
+    echo "serve-smoke: shard OK (mesh=4 replica byte-identical to single-device control, topology + per-chip pricing on /stats)"
+}
+
+if [ "${SERVE_SMOKE_ROUNDS:-all}" = shard ]; then
+    shard_round   # `make shard-smoke`: just the sharded-replica round
+    exit 0
+fi
 if [ "${SERVE_SMOKE_ROUNDS:-all}" = autotune ]; then
     autotune_round   # `make autotune-smoke`: just the shape-controller round
     exit 0
@@ -1140,6 +1262,9 @@ disagg_round
 
 # ---- autotune round: shape controller actuates, stays token-exact ----
 autotune_round
+
+# ---- shard round: mesh=4 replica byte-identical to single-device -----
+shard_round
 
 # ---- remote round: agents on "hosts", kill -9 one, keep serving ------
 remote_round
