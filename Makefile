@@ -47,7 +47,19 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 #                 2 replica-agent subprocesses behind an --agents
 #                 gateway; kill -9 one mid-run -> zero 5xx, outputs
 #                 token-exact vs a local-replica control, the corpse
-#                 quarantined, the survivor SIGTERM-drained clean
+#                 quarantined, the survivor SIGTERM-drained clean;
+#                 plus (ISSUE-15) the survivor's dispatch counts and a
+#                 non-null merged goodput block on /stats,
+#                 tony_goodput_fraction + tony_transport_clock_offset_ms
+#                 on /metrics, and a /debug/profile fan-out capture on
+#                 the survivor agent
+#   make bundle-smoke - just the flight-recorder round of serve-smoke:
+#                 a live subprocess gateway with --history and a
+#                 synthetic queue_aging alert must dump one
+#                 self-contained debug bundle (alerts, traces,
+#                 per-replica dispatch/goodput blocks, signals) into
+#                 <job dir>/bundles/, validated as JSON; GET
+#                 /debug/bundle must serve the same document shape
 
 #   make disagg-smoke - just the disaggregation round of serve-smoke:
 #     a --roles prefill=1,decode=1 gateway with chunked prefill and a
@@ -72,7 +84,7 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 
 .PHONY: lint smoke check test bench serve-smoke chaos-smoke \
 	autoscale-smoke goodput-smoke remote-smoke disagg-smoke \
-	autotune-smoke shard-smoke
+	autotune-smoke shard-smoke bundle-smoke
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -117,3 +129,6 @@ autotune-smoke:
 
 shard-smoke:
 	PY=$(PY) SERVE_SMOKE_ROUNDS=shard sh tools/serve_smoke.sh
+
+bundle-smoke:
+	PY=$(PY) SERVE_SMOKE_ROUNDS=bundle sh tools/serve_smoke.sh

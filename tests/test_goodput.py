@@ -261,6 +261,80 @@ def test_explicit_hbm_reference_prices_utilization(tiny):
                         if not r.compile)
 
 
+def test_merged_local_plus_remote_ledger_pins(tiny):
+    """ISSUE-15: the sums-<=1 and fed==landed reconciliation pins,
+    extended to a MERGED local+remote fleet — a gateway over one
+    in-process engine and one remote stub whose ledger/timeline
+    arrive over the obs-pull channel. The merged engine.dispatch
+    block must keep the position-accounting identities (in-dispatch
+    EOS: fed == tokens on decode, fleet wasted_steps == 0) and the
+    merged ledger must keep its structural invariant with the pulled
+    remote ledger included."""
+    import time as _time
+
+    from tony_tpu.gateway.remote import RemoteServer
+    from tony_tpu.serve.agent import AgentHTTP, ReplicaAgent
+
+    model, params = tiny
+    agent = AgentHTTP(ReplicaAgent(Server(
+        model, params, batch_size=2, eos_id=-1))).start()
+    stub = RemoteServer(agent.address, heartbeat_interval_s=0.1,
+                        lease_misses=3, boot_timeout_s=20.0)
+    local = Server(model, params, batch_size=2, eos_id=-1)
+    gw = Gateway([local, stub], max_queue=32, max_attempts=3,
+                 stall_timeout_s=10.0, breaker_base_s=0.05,
+                 breaker_max_s=0.2).start()
+    try:
+        n, budget = 6, 8
+        tickets = [gw.submit(GenRequest([1 + i, 2, 3],
+                                        max_new_tokens=budget,
+                                        id=i)) for i in range(n)]
+        for t in tickets:
+            t.result(timeout=120)
+        # both replicas actually served (least-outstanding spread)
+        hosts = {t.metrics["host"] for t in tickets}
+        assert hosts == {"local", agent.address}, hosts
+        remote_tokens = sum(budget for t in tickets
+                            if t.metrics["host"] == agent.address)
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            summ = stub.timeline.summary()
+            if summ and sum(a["tokens"] for a in summ.values()) \
+                    >= remote_tokens:
+                break
+            _time.sleep(0.02)
+        stub._obs_pull = False  # freeze the pulled state
+        snap = gw.snapshot()
+        disp = snap["engine"]["dispatch"]
+        # landed tokens reconcile across BOTH replicas' timelines
+        assert sum(a["tokens"] for a in disp.values()) == n * budget
+        # in-dispatch EOS identity survives the merge: every decode
+        # position fed landed a kept token, fleet-wide
+        assert disp["decode"]["fed"] == disp["decode"]["tokens"]
+        assert snap["engine"]["wasted_steps"] == 0
+        # the merged ledger: local + pulled-remote, sums <= 1, and
+        # both constituent ledgers were real
+        rows = {r["replica"]: r for r in snap["replicas"]}
+        assert rows[0]["goodput"] is not None  # local
+        assert rows[1]["goodput"] is not None  # pulled remote
+        for row in rows.values():
+            assert sum(row["goodput"]["buckets"].values()) <= 1 + 1e-6
+        fleet = snap["engine"]["goodput"]
+        assert fleet and sum(fleet["buckets"].values()) <= 1 + 1e-6
+        assert fleet["wall_ms"] > max(
+            rows[0]["goodput"]["wall_ms"],
+            rows[1]["goodput"]["wall_ms"])  # both walls summed
+        assert fleet["useful_fraction"] > 0
+        assert fleet["largest_waste"] in WASTE_BUCKETS
+        # /debug/goodput's report shape holds over the mixed fleet
+        report = gw.goodput_report()
+        assert report["enabled"]
+        assert {r["replica"] for r in report["replicas"]} == {0, 1}
+    finally:
+        gw.drain(timeout=60)
+        agent.stop()
+
+
 def test_goodput_none_with_timeline_off(tiny):
     model, params = tiny
     server = Server(model, params, batch_size=2, eos_id=-1,

@@ -636,6 +636,80 @@ def test_metrics_exposition_format_and_stats_consistency(tiny):
         assert gw.drain(timeout=60)
 
 
+def test_metrics_exposition_consistency_with_remote_stub(tiny):
+    """ISSUE-15: the exposition-consistency contract extended to a
+    fleet with one REMOTE replica — dispatch families, goodput
+    fractions, and the new clock-offset/obs-channel series must agree
+    between /metrics and /stats. The obs-puller is frozen once the
+    pulled timeline accounts for every landed token, so the two
+    surfaces render the IDENTICAL pulled state and the comparison is
+    exact, not tolerance-based."""
+    import time as _time
+
+    from tony_tpu.gateway.remote import RemoteServer
+    from tony_tpu.serve.agent import AgentHTTP, ReplicaAgent
+
+    model, params = tiny
+    agent = AgentHTTP(ReplicaAgent(Server(
+        model, params, batch_size=2, min_bucket=8))).start()
+    stub = RemoteServer(agent.address, heartbeat_interval_s=0.1,
+                        lease_misses=3, boot_timeout_s=20.0)
+    gw = Gateway([stub], max_queue=32, max_attempts=3,
+                 stall_timeout_s=10.0, breaker_base_s=0.05,
+                 breaker_max_s=0.2).start()
+    try:
+        n, budget = 4, 4
+        for i in range(n):
+            gw.submit(GenRequest([1 + i, 2, 3], max_new_tokens=budget,
+                                 id=f"rm{i}")).result(timeout=120)
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            summ = stub.timeline.summary()
+            if summ and sum(a["tokens"] for a in summ.values()) \
+                    >= n * budget:
+                break
+            _time.sleep(0.02)
+        stub._obs_pull = False  # freeze: exact two-surface comparison
+        text = prometheus_text(gw)
+        _validate_exposition(text)
+        snap = gw.snapshot()
+        row = snap["replicas"][0]
+        # dispatch families agree with the (pulled) /stats block
+        for kind, agg in row["dispatch"].items():
+            assert (f'tony_dispatch_count_total{{replica="0"'
+                    f',kind="{kind}"}} {agg["count"]}') in text
+            assert (f'tony_dispatch_tokens_total{{replica="0"'
+                    f',kind="{kind}"}} {agg["tokens"]}') in text
+        assert row["dispatch"]["prefill"]["count"] == n
+        # goodput fractions: both surfaces render the same frozen
+        # pulled ledger — exact equality per bucket
+        gp = snap["engine"]["goodput"]
+        assert gp["buckets"] and sum(gp["buckets"].values()) <= 1 + 1e-6
+        exported = {
+            m.group(1): float(m.group(2)) for m in re.finditer(
+                r'tony_goodput_fraction\{bucket="([^"]+)"\} (\S+)',
+                text)}
+        assert exported == {k: pytest.approx(v)
+                            for k, v in gp["buckets"].items()}
+        # the clock-offset series agrees with the transport block
+        tr = row["transport"]
+        m = re.search(r'tony_transport_clock_offset_ms\{[^}]*\} (\S+)',
+                      text)
+        assert m is not None
+        assert float(m.group(1)) == pytest.approx(
+            tr["clock_offset_ms"], abs=1.0)
+        assert "tony_transport_clock_offset_unc_ms{" in text
+        # the obs channel's health series agree with the row's block
+        obs = row["obs"]
+        assert (f'tony_transport_obs_pulls_total{{replica="0",'
+                f'host="{agent.address}"}} {obs["pulls"]}') in text
+        assert (f'tony_transport_obs_pull_errors_total{{replica="0",'
+                f'host="{agent.address}"}} 0') in text
+    finally:
+        gw.drain(timeout=60)
+        agent.stop()
+
+
 # ------------------------------------------------------ HTTP endpoints
 
 
@@ -749,8 +823,12 @@ def test_obs_overhead_gate(tiny):
     """The always-on-cheap contract: TPOT with tracing + dispatch
     timeline enabled within 1.1x of fully disabled, on the serving
     workload shape bench extras.obs records. Min-of-rounds per arm so
-    a CI scheduler hiccup cannot fail the gate spuriously."""
+    a CI scheduler hiccup cannot fail the gate spuriously. ISSUE-15
+    extends the gate to the fleet channel: the same bound with the
+    obs-puller + span fragments + alerts + bundle recorder armed
+    against a REMOTE replica vs the channel fully off."""
     from bench import bench_obs
 
     out = bench_obs(on_tpu=False)
     assert out["tpot_ratio_on_off"] <= 1.1, out
+    assert out["remote_tpot_ratio_obs_on_off"] <= 1.1, out

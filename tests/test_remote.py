@@ -342,6 +342,37 @@ class TestAgentProtocol:
         assert ei.value.status == 400
         assert ei.value.doc["kind"] == "ValueError"
 
+    def test_obs_channel_cursor_semantics(self, agent, demo):
+        """GET /v1/obs (ISSUE-15): records are cursor-incremental,
+        the summary is lifetime, the goodput ledger rides along, and
+        timestamps are the AGENT's monotonic clock (t_mono brackets
+        them)."""
+        t = self.transport(agent)
+        t.call("POST", "/v1/submit", {"id": 20, "prompt": [1, 2],
+                                      "max_new_tokens": 6, "epoch": 0})
+        wait_for(lambda: agent.agent._tickets[20].result is not None,
+                 msg="result")
+        doc = t.call("GET", "/v1/obs?cursor=0")
+        assert doc["cursor"] > 0
+        kinds = {r["kind"] for r in doc["records"]}
+        assert "prefill" in kinds and "decode" in kinds
+        prefills = [r for r in doc["records"] if r["kind"] == "prefill"]
+        assert prefills[0]["request_id"] == 20
+        decodes = [r for r in doc["records"] if r["kind"] == "decode"]
+        assert all(20 in r["tags"]["requests"] for r in decodes)
+        # timestamps live in the agent's monotonic clock
+        assert all(0 < r["t0"] <= doc["t_mono"] for r in doc["records"])
+        assert doc["summary"]["prefill"]["count"] >= 1
+        assert doc["goodput"] is not None
+        assert sum(doc["goodput"]["buckets"].values()) <= 1.0 + 1e-6
+        # incremental: re-reading at the cursor returns nothing new,
+        # but the lifetime summary stays
+        doc2 = t.call("GET", f"/v1/obs?cursor={doc['cursor']}")
+        assert doc2["records"] == []
+        assert doc2["cursor"] == doc["cursor"]
+        assert doc2["summary"]["prefill"]["count"] \
+            == doc["summary"]["prefill"]["count"]
+
     def test_drain_finishes_then_refuses(self, agent):
         from tony_tpu.gateway.remote import AgentHTTPError
 
@@ -507,6 +538,251 @@ class TestRemoteGateway:
 
 
 # --------------------------------------------------------------------
+# the fleet observability plane (ISSUE-15)
+# --------------------------------------------------------------------
+
+
+def wait_obs_settled(stub, expect_tokens, timeout=30.0):
+    """Wait until the stub's pulled timeline accounts for
+    ``expect_tokens`` landed tokens, then FREEZE the puller so the
+    caller can compare surfaces exactly (no pull can land between two
+    snapshots)."""
+    def settled():
+        summ = stub.timeline.summary()
+        return summ and sum(a["tokens"] for a in summ.values()) \
+            >= expect_tokens
+    wait_for(settled, timeout=timeout, msg="obs pull settled")
+    stub._obs_pull = False
+
+
+class TestRemoteObservability:
+    def test_dispatch_goodput_and_trace_spans_merged(self, demo):
+        """The tentpole pin: a remote replica's dispatch timeline,
+        goodput ledger, and per-request dispatch spans land in the
+        gateway's surfaces exactly like a local engine's — merged
+        engine.dispatch, a non-null per-replica goodput block, an
+        explicit obs health block, and trace spans grafted into the
+        attempt tree carrying host + clock-offset tags."""
+        from tony_tpu.gateway.core import GenRequest
+        from tony_tpu.obs.trace import check_invariants
+
+        agent = start_agent(demo)
+        stub = make_stub(agent.address)
+        gw = make_gateway([stub])
+        try:
+            n, budget = 3, 10
+            tickets = [gw.submit(GenRequest([1 + i, 2, 3],
+                                            max_new_tokens=budget,
+                                            id=f"ob{i}"))
+                       for i in range(n)]
+            for t in tickets:
+                t.result(timeout=120)
+            wait_obs_settled(stub, n * budget)
+            snap = gw.snapshot()
+            row = snap["replicas"][0]
+            # the pulled timeline IS the replica's dispatch block, and
+            # it agrees with the agent's own engine exactly
+            agent_summ = agent.agent.server.timeline.summary()
+            assert row["dispatch"] == agent_summ
+            assert row["dispatch"]["prefill"]["count"] == n
+            # ...and the fleet merge carries it
+            eng = snap["engine"]["dispatch"]
+            assert eng["prefill"]["count"] == n
+            assert eng["decode"]["tokens"] > 0
+            # the pulled ledger is a real merged-able goodput block
+            assert row["goodput"] is not None
+            assert sum(row["goodput"]["buckets"].values()) <= 1 + 1e-6
+            fleet = snap["engine"]["goodput"]
+            assert fleet and sum(fleet["buckets"].values()) <= 1 + 1e-6
+            # the obs health block: pulls counted, lag fresh, errors 0
+            obs = row["obs"]
+            assert obs["enabled"] and obs["pulls"] >= 1
+            assert obs["pull_errors"] == 0
+            assert obs["cursor"] > 0 and obs["lag_s"] is not None
+            # remote dispatch spans grafted into the attempt tree,
+            # offset-corrected and tagged with the host + the offset
+            # and its uncertainty
+            tr = gw.traces.get("ob0")
+            assert tr is not None and check_invariants(tr) == []
+            attempts = [c for c in tr.root.children
+                        if c.name.startswith("attempt-")]
+            assert attempts[0].tags["host"] == agent.address
+            remote_spans = [c for c in attempts[0].children
+                            if c.tags.get("host") == agent.address]
+            assert remote_spans, [c.name for c in attempts[0].children]
+            assert any(s.name in ("prefill", "decode")
+                       for s in remote_spans)
+            for s in remote_spans:
+                assert "clock_offset_ms" in s.tags
+                assert "clock_offset_unc_ms" in s.tags
+            # the Chrome export names the process after the host, and
+            # /debug/traces summaries carry the host column
+            doc = tr.to_chrome()
+            procs = [e for e in doc["traceEvents"]
+                     if e.get("name") == "process_name"]
+            assert any(agent.address in e["args"]["name"]
+                       for e in procs)
+            rows = {r["request_id"]: r
+                    for r in gw.traces.summaries()}
+            assert rows["ob0"]["host"] == agent.address
+        finally:
+            gw.drain(timeout=60)
+            agent.stop()
+
+    def test_local_replica_traces_name_host_local(self, demo):
+        from tony_tpu.gateway.core import GenRequest
+
+        gw = make_gateway([make_server(demo)])
+        try:
+            gw.submit(GenRequest([4, 2], max_new_tokens=3,
+                                 id="loc")).result(timeout=60)
+            rows = {r["request_id"]: r for r in gw.traces.summaries()}
+            assert rows["loc"]["host"] == "local"
+        finally:
+            gw.drain(timeout=60)
+
+    def test_obs_pull_failure_degrades_to_staleness(self, demo):
+        """The acceptance pin's graceful-degrade half: obs pulls that
+        fail (here: an agent without the channel — 404s) count
+        pull_errors and leave lag_s stale, but the replica stays
+        HEALTHY, keeps serving with zero 5xx, and its /stats row says
+        explicitly that it is unobserved (goodput null) rather than
+        silently omitting the keys."""
+        from tony_tpu.gateway.core import GenRequest
+
+        agent = start_agent(demo)
+        stub = make_stub(agent.address)
+        stub._OBS_PATH = "/v1/obs-not-there"  # a pre-ISSUE-15 agent
+        gw = make_gateway([stub])
+        try:
+            t = gw.submit(GenRequest([5, 1], max_new_tokens=6,
+                                     id="deg"))
+            res = t.result(timeout=120)
+            assert len(res.tokens) == 6
+            wait_for(lambda: stub.obs_stats()["pull_errors"] >= 2,
+                     msg="pull errors counted")
+            snap = gw.snapshot()
+            assert snap["shed"] == {}          # never a 5xx
+            row = snap["replicas"][0]
+            assert row["state"] == "healthy"   # never a failure
+            obs = row["obs"]
+            assert obs["pulls"] == 0 and obs["pull_errors"] >= 2
+            assert obs["lag_s"] is None        # never pulled: stale
+            # explicit "unobserved", not a silently missing key
+            assert "goodput" in row and row["goodput"] is None
+            assert row["dispatch"] == {}
+        finally:
+            gw.drain(timeout=60)
+            agent.stop()
+
+    def test_profile_fanout_arms_agents(self, demo):
+        """POST /debug/profile's remote half: the gateway fans the
+        capture request to each agent's /v1/profile and reports
+        per-host armed/error — a busy agent's 409 never blocks the
+        rest. (The real jax capture path is exercised by the smoke's
+        remote round; here the agent profilers are recorders, so the
+        fast tier never pays start_trace's >10 s first-call.)"""
+        class FakeProfiler:
+            def __init__(self, busy=False):
+                self.busy_ = busy
+                self.requests = []
+
+            def request(self, steps, logdir=None):
+                if self.busy_:
+                    raise RuntimeError("a profile capture is already "
+                                       "pending or active")
+                self.requests.append(steps)
+                return "/on/agent/profiles/profile-1"
+
+            def status(self):
+                return {"active": bool(self.requests),
+                        "captures": 0}
+
+            def close(self):
+                pass
+
+        agents = [start_agent(demo) for _ in range(2)]
+        agents[0].agent.profiler = FakeProfiler()
+        agents[1].agent.profiler = FakeProfiler(busy=True)
+        stubs = [make_stub(a.address) for a in agents]
+        gw = make_gateway(stubs)
+        try:
+            out = gw.arm_remote_profiles(3)
+            assert out[agents[0].address]["armed"] is True
+            assert out[agents[0].address]["logdir"] \
+                == "/on/agent/profiles/profile-1"
+            assert agents[0].agent.profiler.requests == [3]
+            assert out[agents[1].address]["armed"] is False
+            assert out[agents[1].address]["status"] == 409
+            status = gw.remote_profile_status()
+            assert status[agents[0].address]["active"] is True
+        finally:
+            gw.drain(timeout=60)
+            for a in agents:
+                a.stop()
+
+    def test_autotune_never_samples_remote_stubs(self, demo):
+        """Regression pin: the shape controller's 'remote stubs are
+        never actuated' gate used to key on ``timeline is None`` —
+        ISSUE-15 gave stubs a real (pulled) timeline, but their shape
+        knobs still live on the AGENT's engine, so the gate must key
+        on the transport instead."""
+        from tony_tpu.serve.autotune import AutotuneController
+
+        agent = start_agent(demo)
+        stub = make_stub(agent.address)
+        try:
+            assert stub.timeline is not None  # the ISSUE-15 change
+            assert AutotuneController()._sample(stub) is None
+        finally:
+            stub.close()
+            agent.stop()
+
+    def test_local_arm_does_not_block_remote_fanout(self, demo):
+        """Mixed local+remote fleet: jax's one-global-session rule is
+        PER PROCESS, so a pending gateway-local capture (armed, idle
+        fleet — never burns down) must not 409 the agent fan-out. The
+        POST reports the local refusal in ``local_error`` and still
+        arms the agents; a LOCAL-only fleet keeps the 409 contract
+        (pinned by test_http_profile_endpoint_real_capture)."""
+        import json as _json
+        import urllib.request
+
+        from tony_tpu.gateway import GatewayHTTP
+
+        class FakeProfiler:
+            def request(self, steps, logdir=None):
+                return "/on/agent/profiles/profile-x"
+
+            def status(self):
+                return {"active": True, "captures": 0}
+
+            def close(self):
+                pass
+
+        agent = start_agent(demo)
+        agent.agent.profiler = FakeProfiler()
+        gw = make_gateway([make_server(demo),
+                           make_stub(agent.address)])
+        http = GatewayHTTP(gw, port=0).start()
+        url = f"http://{http.host}:{http.port}"
+        try:
+            gw.profiler.request(5)  # pending local capture, idle fleet
+            req = urllib.request.Request(url + "/debug/profile?steps=2",
+                                         data=b"", method="POST")
+            doc = _json.loads(
+                urllib.request.urlopen(req, timeout=60).read())
+            assert doc["remote"][agent.address]["armed"] is True
+            assert doc["logdir"] is None
+            assert "already" in doc["local_error"]
+            assert doc["armed"] is True  # the fleet IS capturing
+        finally:
+            http.stop()
+            gw.drain(timeout=60)
+            agent.stop()
+
+
+# --------------------------------------------------------------------
 # epoch fence pins
 # --------------------------------------------------------------------
 
@@ -570,8 +846,15 @@ class TestRemoteChaos:
         streams are disconnected mid-read by injected transport faults
         (resume path) -> zero 5xx, byte-identical outputs, survivor
         keeps serving WITHOUT ever being failed, and a restarted agent
-        0 rejoins through the probe path."""
+        0 rejoins through the probe path.
+
+        ISSUE-15 extension: after the kill + failover, a victim's
+        SINGLE trace carries attempt spans from BOTH hosts — the dead
+        host's attempt holding offset-corrected remote dispatch spans
+        pulled before it died — and the fleet goodput merge still
+        sums <= 1 with the survivor's remote ledger included."""
         from tony_tpu.gateway.core import GenRequest
+        from tony_tpu.obs.trace import check_invariants
 
         agents = [start_agent(demo) for _ in range(2)]
         stubs = [make_stub(a.address) for a in agents]
@@ -593,12 +876,38 @@ class TestRemoteChaos:
                 list(r.prompt), max_new_tokens=r.max_new_tokens,
                 id=r.id)) for r in reqs]
             wait_for(lambda: stubs[0].n_active > 0, msg="r0 active")
+
+            # the kill must land AFTER at least one of the doomed
+            # host's dispatch spans was pulled and grafted — that is
+            # exactly the record the flight-recorder story needs to
+            # survive the host's death
+            a0 = agents[0].address
+
+            def r0_span_attached():
+                for t in tickets:
+                    tr = t.trace
+                    if tr is None:
+                        continue
+                    for att in tr.root.children:
+                        if att.name.startswith("attempt-") \
+                                and att.tags.get("host") == a0 \
+                                and any(c.tags.get("host") == a0
+                                        for c in att.children):
+                            return True
+                return False
+
+            wait_for(r0_span_attached, msg="r0 dispatch span grafted")
             agents[0].kill()  # SIGKILL, as the network sees it
 
             for r, t in zip(reqs, tickets):
                 res = t.result(timeout=180)
                 assert list(res.tokens) == ctrl[r.id], \
                     f"request {r.id} diverged after chaos"
+            # the lease is the death authority; the re-runs can finish
+            # FASTER than the lease horizon on a warm engine, so wait
+            # for the expiry rather than racing it
+            wait_for(lambda: stubs[0].lease_expiries >= 1,
+                     timeout=30, msg="lease expiry")
             snap = gw.snapshot()
             assert snap["shed"] == {}  # zero 5xx
             assert snap["supervision"]["replica_failures"] >= 1
@@ -610,6 +919,39 @@ class TestRemoteChaos:
             assert rows[1]["completed"] >= 1
             assert rows[0]["transport"]["lease_expiries"] >= 1
 
+            # ISSUE-15: ONE trace spans both hosts of the failover
+            victims = [t for t in tickets
+                       if t.metrics and t.metrics["attempts"] >= 1]
+            assert victims, "no ticket was failed over"
+            both_hosts_seen = False
+            for t in victims:
+                tr = gw.traces.get(t.request.id)
+                assert tr is not None and tr.n_attempts >= 2
+                assert check_invariants(tr) == []
+                hosts = [a.tags.get("host") for a in tr.root.children
+                         if a.name.startswith("attempt-")]
+                if {agents[0].address, agents[1].address} \
+                        <= set(hosts):
+                    both_hosts_seen = True
+                # the dead host's attempt kept its pulled dispatch
+                # spans, offset-corrected (the fence dropped only
+                # what arrived AFTER the steal)
+                for att in tr.root.children:
+                    if not att.name.startswith("attempt-") \
+                            or att.tags.get("host") != a0:
+                        continue
+                    spans = [c for c in att.children
+                             if c.tags.get("host") == a0]
+                    if spans:
+                        assert all("clock_offset_ms" in c.tags
+                                   for c in spans)
+            assert both_hosts_seen
+            # ...and the merged fleet ledger still holds its invariant
+            # with the survivor's remote ledger included
+            assert rows[1]["goodput"] is not None
+            fleet = snap["engine"]["goodput"]
+            assert fleet and sum(fleet["buckets"].values()) <= 1 + 1e-6
+
             # restart agent 0 on the SAME port: the breaker's probe
             # path must rejoin it without operator action
             host, port = agents[0].address.split(":")
@@ -617,8 +959,12 @@ class TestRemoteChaos:
             wait_for(lambda: gw.replicas[0].state == "healthy",
                      timeout=60, msg="rejoin via probe")
             assert gw.snapshot()["supervision"]["rejoins"] >= 1
+            # post-chaos, the rejoined host's obs channel works: a new
+            # request's trace grafts dispatch spans from the restarted
+            # agent (same address, fresh agent-side timeline)
             t = gw.submit(GenRequest([3, 3, 3], max_new_tokens=6,
-                                     id="post-rejoin"))
+                                     id="post-rejoin",
+                                     session="pin0"))
             assert len(t.result(timeout=120).tokens) == 6
         finally:
             gw.drain(timeout=60)

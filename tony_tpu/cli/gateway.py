@@ -328,6 +328,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ttft_slo_burn alert: TTFT SLO in seconds "
                         "(>10%% of a tick's completions over it "
                         "fires; 0 disables the rule)")
+    p.add_argument("--no-alert-bundles", action="store_true",
+                   help="disable the flight recorder: by default a "
+                        "FIRING alert dumps one self-contained debug "
+                        "bundle (active alerts, recent traces incl. "
+                        "remote spans, per-replica dispatch/goodput/"
+                        "transport blocks, scale signals) into "
+                        "<history job dir>/bundles/ — needs --history "
+                        "for a place to land; GET /debug/bundle "
+                        "serves the same document on demand either "
+                        "way")
     p.add_argument("--compile-cache",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "tony_tpu", "compile-cache"),
@@ -506,6 +516,11 @@ def agent_argv(args, index: int) -> list:
     if getattr(args, "mesh", "").strip():
         argv += ["--mesh", args.mesh,
                  "--shard-rules", getattr(args, "shard_rules", "serve")]
+    if getattr(args, "profile_dir", ""):
+        # launched agents share THIS host: their /v1/profile captures
+        # land under the gateway's profile dir, one subdir per agent
+        argv += ["--profile-dir",
+                 os.path.join(args.profile_dir, f"agent-{index}")]
     if args.no_paged_kv:
         argv.append("--no-paged-kv")
     if getattr(args, "no_in_dispatch_eos", False):
@@ -644,6 +659,8 @@ def build_gateway(args, model, params, eos, *, metrics_store=None):
                            args, "alert_host_thrash_bytes",
                            float(1 << 20)),
                    },
+                   bundle_on_alert=not getattr(args, "no_alert_bundles",
+                                               False),
                    roles=roles,
                    prefix_affinity=not getattr(args,
                                                "no_prefix_affinity",

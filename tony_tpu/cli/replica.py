@@ -80,6 +80,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--agent-id", default="",
                    help="stable id reported on /healthz (default: "
                         "a generated one)")
+    p.add_argument("--profile-dir", default="",
+                   help="where POST /v1/profile (the gateway's "
+                        "/debug/profile fan-out) drops THIS host's "
+                        "xplane captures (default: "
+                        "$TONY_PROFILE_DIR or ./profiles)")
     p.add_argument("--drain-timeout", type=float, default=120.0,
                    help="max seconds to finish in-flight work on "
                         "SIGTERM")
@@ -135,7 +140,8 @@ def main(argv=None) -> int:
         logging.getLogger(__name__).warning(
             "engine fault injection ARMED on this agent (replica %d) "
             "via TONY_SERVE_FAULTS", args.replica_index)
-    agent = ReplicaAgent(server, agent_id=args.agent_id or None)
+    agent = ReplicaAgent(server, agent_id=args.agent_id or None,
+                         profile_dir=args.profile_dir or None)
     http = AgentHTTP(agent, host=args.host, port=args.port).start()
     if args.port_file:
         tmp = args.port_file + ".tmp"

@@ -453,9 +453,12 @@ class _Replica:
             ticket.t_queued = time.monotonic()
             if ticket.trace is not None:
                 # one attempt span per placement on a replica; its
-                # epoch is the fencing tag the failover story pivots on
+                # epoch is the fencing tag the failover story pivots
+                # on, its host names the machine (agent address |
+                # "local") — the Chrome export's process row
                 ticket.trace.begin_attempt(self.index, self.epoch,
-                                           t0=ticket.t_queued)
+                                           t0=ticket.t_queued,
+                                           host=self.host)
             ticket.queue_pos = self.queue.push(ticket)
             self.enqueued += 1
             self._enq_times.append(ticket.t_queued)
@@ -721,7 +724,17 @@ class _Replica:
         the open attempt's (replica, epoch) tags atomically under the
         trace lock, so even a steal + re-placement racing this snapshot
         cannot mis-attribute a dead replica's dispatch to the
-        survivor's attempt."""
+        survivor's attempt.
+
+        REMOTE replicas take this exact path (ISSUE-15): the stub's
+        obs-puller lands the agent's dispatch records — offset-
+        corrected to this gateway's clock, tagged with the host and
+        the offset±uncertainty — in a ``RemoteTimeline`` whose
+        ``take_new`` this method drains like any local ring, so one
+        trace spans both hosts of a remote failover with zero special
+        casing here. Spans attach CLAMPED: the offset correction is an
+        estimate, and a few ms of clock error must bend into the
+        attempt window rather than corrupt the trace invariants."""
         tl = self.server.timeline
         if tl is None or self.gateway.traces is None:
             return
@@ -748,7 +761,8 @@ class _Replica:
             for ticket in targets:
                 if ticket is not None and ticket.trace is not None:
                     ticket.trace.add(rec.kind, rec.t0, t1,
-                                     attempt_key=key, **tags)
+                                     attempt_key=key, clamp=True,
+                                     **tags)
 
     def _stream_deltas(self, now: float, epoch: int) -> None:
         with self.cv:
@@ -1018,6 +1032,12 @@ class _Replica:
         ts = getattr(server, "transport_stats", None)
         if ts is not None:
             out["transport"] = ts()
+            # the obs-pull channel's health (remote stubs only) — an
+            # EXPLICIT block, so "idle replica" and "unobserved
+            # replica" are distinguishable from a dashboard
+            obs = getattr(server, "obs_stats", None)
+            if callable(obs):
+                out["obs"] = obs()
         # sharded replicas (ISSUE-14): mesh topology + per-chip
         # residency — nested, so the MetricsStore numeric filter skips
         # it while /stats carries it (the flat mesh_* counters above
@@ -1104,6 +1124,10 @@ class _Stats:
         # prefix-affinity probe, and prefill->decode handoffs relayed
         self.prefix_routed = 0
         self.handoffs = 0
+        # the flight recorder (ISSUE-15): alert-triggered debug
+        # bundles dumped into the history job dir
+        self.bundles_written = 0
+        self.last_bundle = ""
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -1164,6 +1188,8 @@ class GatewayHistory:
                                          "alerts.jsonl")
         self._autotune_path = os.path.join(self.job_dir, "metrics",
                                            "autotune.jsonl")
+        self._bundles_path = os.path.join(self.job_dir, "metrics",
+                                          "bundles.jsonl")
 
     def _append_event(self, event) -> None:
         with self._lock, open(self.jhist, "a") as f:
@@ -1203,6 +1229,48 @@ class GatewayHistory:
         change at 14:02" is answerable from the job history."""
         with self._lock, open(self._autotune_path, "a") as f:
             f.write(json.dumps(row) + "\n")
+
+    def write_bundle(self, doc: dict) -> str:
+        """One debug bundle (the ISSUE-15 flight recorder: active
+        alerts, recent traces incl. remote spans, per-replica
+        dispatch/goodput/transport/obs blocks, scale signals) as a
+        SINGLE self-contained JSON file under ``<job dir>/bundles/``
+        — the TonY job-history story at incident granularity: a 3 a.m.
+        alert leaves a record the portal (or plain jq) can browse
+        after the fleet is long gone. Named by wall-clock ms + the
+        triggering alerts, written atomically (tmp + rename) so a
+        reader never sees a torn bundle."""
+        bundles = os.path.join(self.job_dir, "bundles")
+        os.makedirs(bundles, exist_ok=True)
+        slug = "-".join(str(t) for t in doc.get("trigger") or ()) \
+            or doc.get("reason", "manual")
+        slug = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in slug)[:64]
+        path = os.path.join(
+            bundles, f"bundle-{int(time.time() * 1000)}-{slug}.json")
+        tmp = path + ".tmp"
+        with self._lock:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        # one POINTER row in metrics/bundles.jsonl per dump: the
+        # portal's metrics page renders metrics/*.jsonl with zero
+        # portal changes (the alerts.jsonl pattern), so the 3 a.m.
+        # incident shows up in the job's browsable history with its
+        # trigger, headline numbers, and the bundle file to open
+        alerts = doc.get("alerts") or {}
+        with self._lock, open(self._bundles_path, "a") as f:
+            f.write(json.dumps({
+                "t": doc.get("t_wall"),
+                "reason": doc.get("reason"),
+                "trigger": ",".join(str(t) for t in
+                                    doc.get("trigger") or ()),
+                "active_alerts": len(alerts.get("active") or ()),
+                "replicas": len(doc.get("replicas") or ()),
+                "traces": (doc.get("traces") or {}).get("count", 0),
+                "path": path,
+            }) + "\n")
+        return path
 
     def close(self, status: str = "SUCCEEDED",
               metrics: dict | None = None) -> None:
@@ -1253,6 +1321,14 @@ class _AlertLoop(threading.Thread):
                         gw.history.record_alert(ev.to_row())
                     except Exception:
                         log.exception("history alert write failed")
+            # the flight recorder (ISSUE-15): a FIRING transition dumps
+            # one self-contained debug bundle into the history job dir
+            # — the bus's fire-once dedup is the debounce (no re-dump
+            # while the alert stays active), and dump failures are
+            # logged, never allowed to take the alert loop down
+            firing = [ev.alert for ev in events if ev.state == "firing"]
+            if firing and gw.bundle_on_alert:
+                gw.dump_bundle(reason="alert", trigger=firing)
 
 
 class _AutotuneLoop(threading.Thread):
@@ -1313,6 +1389,7 @@ class Gateway:
                  tenant_quota_burst: float = 0.0,
                  alerts: bool = True, alert_interval_s: float = 1.0,
                  alert_thresholds: dict | None = None,
+                 bundle_on_alert: bool = True,
                  roles: list | None = None,
                  prefix_affinity: bool = True,
                  autotune: bool = False,
@@ -1420,6 +1497,10 @@ class Gateway:
             if alerts else None
         self._alert_loop = _AlertLoop(self, alert_interval_s) \
             if alerts else None
+        # the flight recorder (ISSUE-15): a firing alert dumps one
+        # debug bundle into the history job dir (needs history for a
+        # place to land; GET /debug/bundle works regardless)
+        self.bundle_on_alert = bool(bundle_on_alert)
         # the adaptive shape controller (serve/autotune.py, ISSUE-13):
         # samples each local replica's goodput/timeline deltas and
         # steers chunk_steps / speculate_k / prefill_chunk within
@@ -1725,6 +1806,179 @@ class Gateway:
             "largest_waste": fleet.get("largest_waste"),
             "replicas": per_replica,
         }
+
+    # --------------------------------------- fleet observability (15)
+
+    @property
+    def has_local_replicas(self) -> bool:
+        """True when any live replica's engine runs IN THIS process —
+        the gate for arming the gateway's own ``ServeProfiler``: a
+        pure-router fleet (every replica remote) has no local jax work
+        worth capturing, and a stuck local arm must not be able to
+        409-block the remote fan-out forever."""
+        return any(getattr(r.server, "transport", None) is None
+                   for r in self.live_replicas if r.server is not None)
+
+    @property
+    def has_remote_replicas(self) -> bool:
+        return any(getattr(r.server, "transport", None) is not None
+                   for r in self.live_replicas)
+
+    def _remote_profile_fanout(self, call) -> dict:
+        """Run ``call(server) -> dict`` against every remote replica
+        CONCURRENTLY (each call handles its own errors): the per-host
+        results are independent, and N sequential timeouts against a
+        half-dead fleet — exactly when an operator profiles — would
+        tie a gateway handler thread up for N x timeout."""
+        import concurrent.futures
+
+        targets = [r.server for r in self.live_replicas
+                   if getattr(r.server, "transport", None) is not None]
+        if not targets:
+            return {}
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(targets))) as pool:
+            futures = [(s.host_addr, pool.submit(call, s))
+                       for s in targets]
+            return {addr: fut.result() for addr, fut in futures}
+
+    def arm_remote_profiles(self, steps: int) -> dict:
+        """The remote half of ``POST /debug/profile`` (ISSUE-15): fan
+        the capture request out to every remote replica's agent
+        (``POST /v1/profile``), so one operator curl profiles the
+        WHOLE fleet — local replicas through this process's
+        ``ServeProfiler``, each agent host through its own (xplane
+        files land on that host, under the agent's profile dir).
+        Best-effort per host: an unreachable or already-capturing
+        agent reports its error in the returned map and never blocks
+        the rest. Empty map = no remote replicas."""
+        from tony_tpu.gateway.remote import AgentHTTPError
+
+        def arm(server) -> dict:
+            try:
+                doc = server.transport.call(
+                    "POST", "/v1/profile", {"steps": int(steps)},
+                    epoch=server.epoch, timeout=3.0)
+                return {"armed": True, "logdir": doc.get("logdir")}
+            except AgentHTTPError as e:
+                return {"armed": False, "status": e.status,
+                        "error": e.doc.get("error", str(e))}
+            except Exception as e:  # noqa: BLE001 — best-effort PER
+                # HOST is the contract: json.loads ValueErrors,
+                # http.client garbled-response exceptions, anything —
+                # one bad agent reports its error, never 500s the
+                # whole fan-out
+                return {"armed": False,
+                        "error": f"{type(e).__name__}: {e}"}
+
+        return self._remote_profile_fanout(arm)
+
+    def remote_profile_status(self) -> dict:
+        """Per-agent ``GET /v1/profile`` statuses for the fleet view
+        behind ``GET /debug/profile`` — best-effort (a debug read
+        must not 5xx because one host is down)."""
+        from tony_tpu.gateway.remote import AgentHTTPError
+
+        def status(server) -> dict:
+            try:
+                return server.transport.call(
+                    "GET", "/v1/profile", epoch=server.epoch,
+                    timeout=3.0)
+            except Exception as e:  # noqa: BLE001 — see arm(): a
+                # debug read is best-effort per host, never a 5xx
+                return {"error": f"{type(e).__name__}: {e}"}
+
+        return self._remote_profile_fanout(status)
+
+    def debug_bundle(self, reason: str = "manual",
+                     trigger: list | None = None,
+                     trace_limit: int = 8) -> dict:
+        """The flight recorder's payload (``GET /debug/bundle``, and
+        what a firing alert dumps to disk): ONE self-contained JSON
+        document an operator can read after the incident — active +
+        recent alerts, the signal snapshot the rules judged, the
+        fleet/per-replica goodput report, every replica's stats row
+        (dispatch timeline, transport + obs blocks for remote hosts),
+        supervision counters, the autoscaler's status, and the most
+        recent request traces (full Chrome docs for the last
+        ``trace_limit``, summaries for the rest) — remote spans, with
+        their clock-offset tags, included."""
+        live = [r for r in self.replicas if not r.retired]
+        replicas = []
+        for r in live:
+            row = r.stats(include_dispatch=True)
+            server = r.server
+            if server is not None:
+                row["goodput"] = server.goodput()
+            replicas.append(row)
+        traces: dict = {"count": 0, "summaries": [], "recent": []}
+        if self.traces is not None:
+            traces["summaries"] = self.traces.summaries()
+            traces["count"] = len(traces["summaries"])
+            recent_ids = self.traces.ids()[-trace_limit:] \
+                if trace_limit > 0 else []  # [-0:] would mean ALL
+            for rid in recent_ids:
+                tr = self.traces.get(rid)
+                if tr is not None:
+                    traces["recent"].append(tr.to_chrome())
+        try:
+            signals = self.alert_signals()
+        except Exception:  # noqa: BLE001 — a half-drained fleet must
+            # still bundle what it can, not crash the recorder
+            log.exception("bundle signal read failed")
+            signals = {}
+        with self.stats.lock:
+            supervision = {
+                "replica_failures": self.stats.replica_failures,
+                "failovers": self.stats.failovers,
+                "retries": self.stats.retries,
+                "probes": self.stats.probes,
+                "rejoins": self.stats.rejoins,
+                "quarantines": self.stats.quarantines,
+                "replicas_added": self.stats.replicas_added,
+                "replicas_removed": self.stats.replicas_removed,
+            }
+            bundles = {"written": self.stats.bundles_written,
+                       "last_path": self.stats.last_bundle}
+        scaler = self.scaler
+        return {
+            "t_wall": round(time.time(), 3),
+            "reason": reason,
+            "trigger": list(trigger) if trigger else [],
+            "app_id": self.history.app_id
+            if self.history is not None else None,
+            "alerts": {"enabled": True, **self.alerts.snapshot()}
+            if self.alerts is not None else {"enabled": False},
+            "signals": signals,
+            "goodput": self.goodput_report(),
+            "supervision": supervision,
+            "replicas": replicas,
+            "scaler": scaler.status() if scaler is not None else None,
+            "traces": traces,
+            "bundles": bundles,
+        }
+
+    def dump_bundle(self, reason: str = "manual",
+                    trigger: list | None = None) -> str | None:
+        """Write ``debug_bundle()`` into the history job dir. Returns
+        the path, or None when there is no history (nowhere to land)
+        or the write failed — the recorder degrades, it never raises
+        into its caller (the alert loop)."""
+        history = self.history
+        if history is None:
+            return None
+        try:
+            path = history.write_bundle(
+                self.debug_bundle(reason=reason, trigger=trigger))
+        except Exception:
+            log.exception("debug bundle dump failed")
+            return None
+        with self.stats.lock:
+            self.stats.bundles_written += 1
+            self.stats.last_bundle = path
+        log.warning("debug bundle (%s: %s) -> %s", reason,
+                    ",".join(trigger) if trigger else "-", path)
+        return path
 
     def _queue_block(self, replicas: list[_Replica], now: float) -> dict:
         """The queue-pressure block, ONE implementation for both
@@ -2343,6 +2597,12 @@ class Gateway:
                 g = server.goodput()
                 if g is not None:
                     row["goodput"] = g
+                elif hasattr(server, "transport_stats"):
+                    # a remote replica whose ledger has not been
+                    # pulled yet reports an EXPLICIT null — silently
+                    # omitting the key made "unobserved" look like a
+                    # local engine with the timeline off
+                    row["goodput"] = None
             rows.append(row)
         out["replicas"] = rows
         out["queued"] = queue["depth"]
@@ -2398,6 +2658,14 @@ class Gateway:
                 "probes": self.stats.probes,
                 "rejoins": self.stats.rejoins,
                 "quarantines": self.stats.quarantines,
+            }
+            # the flight recorder's own trail: how many alert-triggered
+            # bundles landed, and where the latest one is
+            out["bundles"] = {
+                "on_alert": self.bundle_on_alert
+                and self.history is not None,
+                "written": self.stats.bundles_written,
+                "last_path": self.stats.last_bundle,
             }
         # fleet goodput ledger, merged from the per-replica ledgers
         # the rows above already computed (wall-clock weighted)

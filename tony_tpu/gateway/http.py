@@ -44,9 +44,24 @@ admission queue. Endpoints:
   POST /debug/profile?steps=N  arm a jax.profiler capture of the fleet's
                       next N working scheduler iterations; returns the
                       logdir the xplane files land in (409 while a
-                      capture is already pending/active)
+                      capture is already pending/active). With remote
+                      replicas the request FANS OUT: each agent host
+                      arms its own capture (POST /v1/profile; xplane
+                      files land on that host) and the response's
+                      "remote" map reports per-host armed/logdir/error
   GET  /debug/profile capture status (active/steps_left/captures/
-                      last_logdir/last_error)
+                      last_logdir/last_error), plus a per-agent
+                      "remote" status map when the fleet has remote
+                      replicas
+  GET  /debug/bundle  the flight recorder (ISSUE-15): one self-
+                      contained JSON debug bundle — active/recent
+                      alerts, the judged signal snapshot, fleet +
+                      per-replica goodput, per-replica stats rows
+                      (dispatch timeline; transport/obs blocks for
+                      remote hosts), supervision counters, recent
+                      traces (remote spans included). The same
+                      document a FIRING alert dumps automatically
+                      into the history job dir (bundles/*.json)
 
 Multi-tenant admission fields on POST /v1/generate (docs/SERVING.md):
 ``priority`` names a weighted-fair-queuing tier (``interactive`` /
@@ -146,7 +161,13 @@ class GatewayHandler(BaseHTTPRequestHandler):
                                         f"{self.gateway.traces.capacity})"})
             return self._send(200, trace.to_chrome())
         if path == "/debug/profile":
-            return self._send(200, self.gateway.profiler.status())
+            status = self.gateway.profiler.status()
+            remote = self.gateway.remote_profile_status()
+            if remote:
+                status["remote"] = remote
+            return self._send(200, status)
+        if path == "/debug/bundle":
+            return self._send(200, self.gateway.debug_bundle())
         return self._send(404, {"error": "not found"})
 
     # ------------------------------------------------------------ POST
@@ -224,13 +245,44 @@ class GatewayHandler(BaseHTTPRequestHandler):
                                   f"profile-{int(time.time() * 1000)}")
         try:
             steps = int(params.get("steps", 10))
-            logdir = self.gateway.profiler.request(steps, logdir)
+            if steps < 1:
+                raise ValueError("steps must be >= 1")
         except ValueError as e:
             return self._send(400, {"error": str(e)})
-        except RuntimeError as e:  # a capture is already in flight
-            return self._send(409, {"error": str(e)})
-        return self._send(200, {"armed": True, "steps": steps,
-                                "logdir": logdir})
+        has_remote = self.gateway.has_remote_replicas
+        local_error = None
+        armed_logdir = None
+        if self.gateway.has_local_replicas:
+            # mixed/local fleets arm this process's profiler too; a
+            # PURE-ROUTER fleet skips it — there is no local jax work
+            # worth capturing. jax's one-global-session constraint is
+            # PER PROCESS, so a local capture already in flight must
+            # not block arming the agents (separate processes): on a
+            # fleet with remotes the local refusal is reported in the
+            # response instead of 409ing the whole fan-out; a
+            # local-only fleet keeps the 409 contract.
+            try:
+                armed_logdir = self.gateway.profiler.request(steps,
+                                                             logdir)
+            except RuntimeError as e:  # a capture is already in flight
+                if not has_remote:
+                    return self._send(409, {"error": str(e)})
+                local_error = str(e)
+            except ValueError as e:
+                return self._send(400, {"error": str(e)})
+        out = {"armed": armed_logdir is not None, "steps": steps,
+               "logdir": armed_logdir}
+        if local_error is not None:
+            out["local_error"] = local_error
+        # remote replicas: fan the capture out to every agent host
+        # (ISSUE-15) — best-effort per host, reported per host; the
+        # xplane files land on each agent's own machine
+        remote = self.gateway.arm_remote_profiles(steps)
+        if remote:
+            out["remote"] = remote
+            out["armed"] = out["armed"] or any(
+                v.get("armed") for v in remote.values())
+        return self._send(200, out)
 
     def _read_body(self) -> dict:
         length = int(self.headers.get("Content-Length") or 0)

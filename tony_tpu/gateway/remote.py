@@ -38,6 +38,24 @@ changes is only what a network adds:
   and the stub re-raises the real types (``QueueFull``,
   ``PoolExhausted``, ``ValueError``), so the gateway's admission
   paths cannot tell local from remote.
+- **The observability plane, pulled over the wire** (ISSUE-15): an
+  obs-puller rides the heartbeat cadence — after each successful
+  ``/healthz`` it GETs ``/v1/obs?cursor=`` and lands the agent's
+  incremental dispatch-timeline records, lifetime per-kind summary,
+  and goodput ledger into a ``RemoteTimeline``/``goodput()`` that
+  present the exact ``server.timeline``/``server.goodput()`` surface
+  a local engine has, so ``/stats engine.dispatch``, the fleet
+  goodput rollup, ``/debug/goodput``, the ``goodput_collapse`` alert,
+  and per-request trace grafting work UNCHANGED over a remote
+  replica. Record timestamps arrive in the AGENT's monotonic clock
+  and are corrected by an RTT-midpoint offset estimate (EWMA over
+  heartbeats: ``offset = agent_t_mono - heartbeat midpoint``,
+  uncertainty = RTT/2) — honest-but-uncertain, so the offset AND its
+  uncertainty ride every grafted span and export as
+  ``tony_transport_clock_offset_ms``. A pull that fails degrades to
+  staleness (``obs.lag_s`` grows, ``pull_errors`` counts), never to a
+  replica failure: observability must not be able to take serving
+  down.
 
 Transport fault injection (``serve/faults.py`` transport ops, armed
 via ``TONY_SERVE_FAULTS`` -> ``FaultPlan.transport_from_env``) hooks
@@ -57,8 +75,10 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from types import SimpleNamespace
 
+from tony_tpu.obs.timeline import record_from_doc
 from tony_tpu.serve.agent import result_from_doc
 from tony_tpu.serve.engine import PoolExhausted, QueueFull, Request
 
@@ -208,6 +228,52 @@ class AgentTransport:
             conn.close()
 
 
+class RemoteTimeline:
+    """The pulled twin of ``obs.timeline.DispatchTimeline``: holds the
+    agent's timeline as the obs-puller lands it, presenting the two
+    methods the gateway reads — ``take_new`` (the replica thread's
+    trace attacher drains pulled records exactly like a local ring)
+    and ``summary`` (the agent's LIFETIME per-kind aggregates,
+    relayed verbatim so ``/stats`` dispatch blocks and the
+    ``DispatchTimeline.merge`` fleet rollup cannot tell local from
+    remote). Sequence numbers are LOCAL (assigned at push): the
+    agent's own seq space restarts when the agent does, and the
+    consumer-side cursor must never rewind."""
+
+    def __init__(self, pending_capacity: int = 4096):
+        self._lock = threading.Lock()
+        # BOUNDED like the local ring: the consumer (the replica
+        # thread's trace attacher) never drains when gateway tracing
+        # is off (--trace-capacity 0) or while the replica is parked
+        # broken, and an unbounded pending queue would turn the obs
+        # puller into a slow memory leak. Overflow drops the OLDEST
+        # records — lost debug spans, never lost memory.
+        self._pending: deque = deque(maxlen=max(1, pending_capacity))
+        self._summary: dict = {}
+        self._seq = 0
+
+    def push(self, records: list, summary: dict) -> None:
+        """Obs-puller entry: append offset-corrected records, adopt
+        the newest lifetime summary."""
+        with self._lock:
+            for rec in records:
+                self._seq += 1
+                rec.seq = self._seq
+                self._pending.append(rec)
+            if summary:
+                self._summary = summary
+
+    def take_new(self, cursor: int) -> tuple[list, int]:
+        with self._lock:
+            new = [r for r in self._pending if r.seq > cursor]
+            self._pending.clear()
+            return new, self._seq
+
+    def summary(self) -> dict:
+        with self._lock:
+            return dict(self._summary)
+
+
 class _RemoteTicket:
     """One in-flight request's stub-side record: the absolute token
     sequence received so far plus the terminal result doc."""
@@ -244,13 +310,17 @@ class RemoteServer:
     exactly like a local engine."""
 
     # surface parity with serve.Server attributes the gateway reads
-    timeline = None
     fault_plan = None  # engine faults live on the AGENT's engine
+
+    # the obs channel's path — an attribute so tests (and an operator
+    # against a pre-ISSUE-15 agent) can point it at nothing and watch
+    # the degrade-to-staleness contract instead of a failure
+    _OBS_PATH = "/v1/obs"
 
     def __init__(self, address: str, *, heartbeat_interval_s: float = 1.0,
                  lease_misses: int = 5, connect_timeout_s: float = 2.0,
                  read_timeout_s: float = 5.0, boot_timeout_s: float = 60.0,
-                 stall_timeout_s: float = 30.0,
+                 stall_timeout_s: float = 30.0, obs_pull: bool = True,
                  transport_faults=None, agent_proc=None):
         self.transport = AgentTransport(
             address, connect_timeout_s=connect_timeout_s,
@@ -278,6 +348,29 @@ class RemoteServer:
         self.heartbeat_failures = 0
         self._rtt_ms = 0.0  # EMA over heartbeat round trips
         self._last_hb = time.monotonic()
+        # fleet observability (ISSUE-15): the pulled timeline/ledger +
+        # the clock-offset model. offset = agent monotonic - gateway
+        # monotonic, EWMA'd over heartbeat RTT midpoints; uncertainty
+        # is the EWMA'd half-RTT — the honest error bar every grafted
+        # span carries.
+        self.timeline = RemoteTimeline()
+        # _obs_enabled is the configuration (what obs_stats reports);
+        # _obs_pull is the live gate (tests freeze it to compare the
+        # two scrape surfaces against one immutable pulled state)
+        self._obs_enabled = bool(obs_pull)
+        self._obs_pull = bool(obs_pull)
+        self._obs_cursor = 0
+        # agent seqs landed via stream terminal lines (pruned to
+        # > cursor at every successful pull): the dedup between the
+        # two record paths — cursor pulls and per-request fragments
+        self._obs_stream_seen: set[int] = set()
+        self.obs_pulls = 0
+        self.obs_pull_errors = 0
+        self._last_obs: float | None = None
+        self._obs_goodput: dict | None = None
+        self._clock_off_ms = 0.0
+        self._clock_unc_ms = 0.0
+        self._clock_samples = 0
         info = self._wait_ready(boot_timeout_s)
         self.agent_id = info.get("agent_id", "?")
         self.model = SimpleNamespace(cfg=SimpleNamespace(
@@ -340,15 +433,37 @@ class RemoteServer:
     def _hb_loop(self) -> None:
         while not self._closed:
             t0 = time.monotonic()
+            reachable = False
             try:
                 doc = self.transport.call(
                     "GET", "/healthz", epoch=self.epoch,
                     timeout=max(self.heartbeat_interval_s, 2.0))
+                t1 = time.monotonic()
+                reachable = True
+                # clock-offset model: the agent read its monotonic
+                # clock somewhere inside [t0, t1]; the midpoint is the
+                # unbiased estimate and half the RTT bounds the error.
+                # EWMA'd like the rtt so one jittery round trip cannot
+                # whipsaw every span correction.
+                agent_t = doc.get("t_mono")
+                if isinstance(agent_t, (int, float)):
+                    off_ms = (float(agent_t) - (t0 + t1) / 2.0) * 1e3
+                    unc_ms = (t1 - t0) / 2.0 * 1e3
+                    with self._stats_lock:
+                        if self._clock_samples == 0:
+                            self._clock_off_ms = off_ms
+                            self._clock_unc_ms = unc_ms
+                        else:
+                            self._clock_off_ms = 0.8 * self._clock_off_ms \
+                                + 0.2 * off_ms
+                            self._clock_unc_ms = 0.8 * self._clock_unc_ms \
+                                + 0.2 * unc_ms
+                        self._clock_samples += 1
                 busy = doc.get("n_active", 0) or doc.get("n_pending", 0)
                 wedged = bool(busy) and \
                     doc.get("stepper_age_s", 0.0) > self.stall_timeout_s
                 if doc.get("ok") and not wedged:
-                    rtt_ms = (time.monotonic() - t0) * 1e3
+                    rtt_ms = (t1 - t0) * 1e3
                     with self._stats_lock:
                         self._rtt_ms = rtt_ms if self._rtt_ms == 0.0 \
                             else 0.8 * self._rtt_ms + 0.2 * rtt_ms
@@ -369,12 +484,131 @@ class RemoteServer:
                     with self._stats_lock:
                         self.heartbeat_failures += 1
             except (ConnectionError, TimeoutError, OSError,
-                    AgentHTTPError, ValueError):
+                    AgentHTTPError, ValueError,
+                    http.client.HTTPException):
                 with self._stats_lock:
                     self.heartbeat_failures += 1
+            if reachable and self._obs_pull:
+                # the obs-puller rides the heartbeat cadence, but only
+                # when the host just answered: an unreachable host
+                # must cost ONE timeout per beat, not two. Pulled even
+                # when the engine is failed/draining — a failing agent
+                # is the one whose timeline an operator wants most.
+                # Belt-and-braces except: ANY escape here would kill
+                # the heartbeat thread and fail a healthy replica via
+                # lease expiry — the exact inversion of the channel's
+                # degrade-to-staleness contract.
+                try:
+                    self._pull_obs()
+                except Exception:  # noqa: BLE001 — see above
+                    log.exception("obs pull failed unexpectedly")
+                    with self._stats_lock:
+                        self.obs_pull_errors += 1
             left = self.heartbeat_interval_s - (time.monotonic() - t0)
             if left > 0:
                 time.sleep(left)
+
+    def _pull_obs(self) -> None:
+        """One incremental observability pull (see the module
+        docstring). ANY failure degrades to staleness — counted in
+        ``pull_errors``, visible as a growing ``obs.lag_s`` — and
+        never touches the lease or the dead marker: the obs channel
+        must not be able to fail a serving replica."""
+        try:
+            # timeout bounded by the LEASE SLACK, not the read
+            # timeout: the pull shares the heartbeat thread, and an
+            # agent that answers /healthz promptly but stalls on
+            # /v1/obs must not delay the next lease ping past the
+            # horizon — a slow obs channel degrades to a failed pull,
+            # never to a false lease expiry on a healthy replica
+            doc = self.transport.call(
+                "GET", f"{self._OBS_PATH}?cursor={self._obs_cursor}",
+                epoch=self.epoch,
+                timeout=max(0.1, min(max(self.heartbeat_interval_s,
+                                         2.0), self.lease_s / 3.0)))
+        except (ConnectionError, TimeoutError, OSError,
+                AgentHTTPError, ValueError,
+                http.client.HTTPException):
+            # HTTPException too: a garbled response (BadStatusLine,
+            # IncompleteRead mid-restart) is neither an OSError nor a
+            # ValueError, and it must degrade like any other bad pull
+            with self._stats_lock:
+                self.obs_pull_errors += 1
+            return
+        try:
+            cursor = int(doc.get("cursor", self._obs_cursor))
+        except (TypeError, ValueError):
+            cursor = self._obs_cursor
+        summary = doc.get("summary")
+        self._ingest_obs_records(doc.get("records") or (),
+                                 new_cursor=cursor,
+                                 summary=summary
+                                 if isinstance(summary, dict) else {})
+        goodput = doc.get("goodput")
+        with self._stats_lock:
+            if isinstance(goodput, dict):
+                self._obs_goodput = goodput
+            self.obs_pulls += 1
+            self._last_obs = time.monotonic()
+
+    def _ingest_obs_records(self, docs, *, new_cursor: int | None = None,
+                            summary: dict | None = None) -> None:
+        """Convert wire record docs to gateway-clock ``DispatchRecord``s
+        and land them in the ``RemoteTimeline``. Two producers feed
+        this — the cursor pull (``new_cursor`` set) and a stream's
+        terminal-line fragments (``new_cursor`` None) — deduplicated
+        by AGENT sequence number: fragments remember their seqs in
+        ``_obs_stream_seen`` until a pull's cursor passes them; pulls
+        skip seqs a fragment already landed. An agent restart (cursor
+        regression) resets the seq space."""
+        with self._stats_lock:
+            if new_cursor is not None and new_cursor < self._obs_cursor:
+                # agent restarted: its seq space began again — and so,
+                # possibly, did its CLOCK (a host reboot restarts
+                # CLOCK_MONOTONIC): the offset EWMA re-seeds from the
+                # next heartbeat (samples==0 assigns directly) instead
+                # of blending a wildly stale correction 20% at a time.
+                # This batch lands offset-0 (uncorrected); the trace
+                # clamp keeps it well-formed.
+                self._obs_stream_seen.clear()
+                self._clock_off_ms = 0.0
+                self._clock_unc_ms = 0.0
+                self._clock_samples = 0
+            off_s = self._clock_off_ms / 1e3
+            off_ms = round(self._clock_off_ms, 3)
+            unc_ms = round(self._clock_unc_ms, 3)
+            records = []
+            for rd in docs:
+                try:
+                    rec = record_from_doc(rd)
+                except (TypeError, ValueError):
+                    continue  # one malformed record must not drop all
+                if rec.seq in self._obs_stream_seen:
+                    continue  # pulled twin of a landed fragment
+                if new_cursor is None:
+                    if rec.seq <= self._obs_cursor:
+                        continue  # the puller already landed it
+                    self._obs_stream_seen.add(rec.seq)
+                # agent monotonic -> gateway monotonic, with the
+                # honest error bar stamped on the record (and thus on
+                # any trace span grafted from it)
+                rec.t0 -= off_s
+                rec.tags.setdefault("host", self.host_addr)
+                rec.tags["clock_offset_ms"] = off_ms
+                rec.tags["clock_offset_unc_ms"] = unc_ms
+                records.append(rec)
+            if new_cursor is not None:
+                self._obs_cursor = new_cursor
+                self._obs_stream_seen = {
+                    s for s in self._obs_stream_seen if s > new_cursor}
+            elif len(self._obs_stream_seen) > 65536:
+                # pulls failing for a long time (degraded channel)
+                # must not grow the dedup set without bound: keep the
+                # most recent window — worst case a long-dead seq
+                # re-lands as a duplicate span in a debug trace
+                self._obs_stream_seen = set(sorted(
+                    self._obs_stream_seen)[-4096:])
+        self.timeline.push(records, summary or {})
 
     def _lease_expired(self, task_id: str) -> None:
         reason = (f"agent {self.host_addr} lease expired: no heartbeat "
@@ -502,7 +736,13 @@ class RemoteServer:
         return dict(self._counters)
 
     def goodput(self):
-        return None  # the agent's engine owns its timeline/ledger
+        """The agent engine's goodput ledger, as of the last obs pull
+        (None until one lands — an UNOBSERVED replica, distinct from
+        an idle one). A copy: ``goodput_report`` annotates rows in
+        place and must not mutate the pulled snapshot."""
+        with self._stats_lock:
+            g = self._obs_goodput
+        return dict(g) if g is not None else None
 
     def reset(self) -> None:
         """The breaker's recovery step, remote flavor: bump the epoch
@@ -574,6 +814,15 @@ class RemoteServer:
                                     [int(x) for x in doc["token_ids"]])
                         attempt = 0  # progress resets the backoff
                     if doc.get("done"):
+                        # the terminal line's per-request dispatch
+                        # fragments land BEFORE the result becomes
+                        # visible: the scheduler iteration that
+                        # delivers this request grafts them first, so
+                        # even a shorter-than-one-heartbeat request
+                        # finishes with its complete span set
+                        obs = doc.get("obs")
+                        if obs and self._obs_enabled:
+                            self._ingest_obs_records(obs)
                         with self._cond:
                             if ticket.epoch == self.epoch:
                                 ticket.result = doc["result"]
@@ -641,6 +890,26 @@ class RemoteServer:
                 "heartbeat_failures": self.heartbeat_failures,
                 "stale_epoch_drops": self.stale_epoch_drops,
                 "lease_expiries": self.lease_expiries,
+                # the clock-offset model (ISSUE-15): what remote span
+                # timestamps were corrected by, and how far off that
+                # correction could honestly be
+                "clock_offset_ms": round(self._clock_off_ms, 3),
+                "clock_offset_unc_ms": round(self._clock_unc_ms, 3),
+            }
+
+    def obs_stats(self) -> dict:
+        """The per-replica ``obs`` block: the pull channel's health —
+        an explicit surface, so a dashboard can tell an IDLE remote
+        replica (fresh lag, zero counts) from an UNOBSERVED one
+        (growing lag / pull errors / ``lag_s: null`` never pulled)."""
+        with self._stats_lock:
+            return {
+                "enabled": self._obs_enabled,
+                "cursor": self._obs_cursor,
+                "pulls": self.obs_pulls,
+                "pull_errors": self.obs_pull_errors,
+                "lag_s": round(time.monotonic() - self._last_obs, 3)
+                if self._last_obs is not None else None,
             }
 
     # ------------------------------------------------------- shutdown

@@ -164,6 +164,32 @@ _TRANSPORT_GAUGES = (
      "Seconds since the last successful agent heartbeat"),
     ("lease_s", "tony_transport_lease_seconds",
      "The lease horizon: heartbeats missed this long fail the replica"),
+    # the clock-offset model (ISSUE-15): ms deliberately — the value
+    # is a CORRECTION term read next to span timestamps (which render
+    # in ms), not a duration to rate()
+    ("clock_offset_ms", "tony_transport_clock_offset_ms",
+     "Agent-minus-gateway monotonic clock offset (RTT-midpoint EWMA) "
+     "applied to remote dispatch spans"),
+    ("clock_offset_unc_ms", "tony_transport_clock_offset_unc_ms",
+     "Half-RTT EWMA: the honest error bar on the clock offset"),
+)
+
+# the obs-pull channel (remote replicas, ISSUE-15): the surface that
+# distinguishes an idle remote replica from an UNOBSERVED one
+_OBS_GAUGES = (
+    ("lag_s", "tony_transport_obs_lag_seconds",
+     "Seconds since the last successful observability pull from the "
+     "agent (absent until one lands)"),
+    ("cursor", "tony_transport_obs_cursor",
+     "Dispatch-timeline cursor position on the agent's obs channel"),
+)
+
+_OBS_COUNTERS = (
+    ("pulls", "tony_transport_obs_pulls_total",
+     "Successful observability pulls from the agent"),
+    ("pull_errors", "tony_transport_obs_pull_errors_total",
+     "Observability pulls that failed (the channel degrades to "
+     "staleness, never to a replica failure)"),
 )
 
 _TRANSPORT_COUNTERS = (
@@ -257,6 +283,12 @@ def prometheus_text(gateway) -> str:
     gauge("tony_queue_max", "Admission queue bound", snap["max_queue"])
     gauge("tony_gateway_ready", "1 while accepting (0 = draining)",
           1 if snap["ready"] else 0)
+    bundles = snap.get("bundles") or {}
+    if bundles:
+        counter("tony_debug_bundles_total",
+                "Alert-triggered debug bundles written to the history "
+                "job dir (the ISSUE-15 flight recorder)",
+                bundles.get("written", 0))
 
     # the queue block (ISSUE-9): the autoscaler's primary sensor,
     # scrapable standalone
@@ -442,6 +474,10 @@ def prometheus_text(gateway) -> str:
                    for _, name, help_text in _TRANSPORT_GAUGES}
     trans_counter = {name: MetricFamily(name, "counter", help_text)
                      for _, name, help_text in _TRANSPORT_COUNTERS}
+    obs_gauge = {name: MetricFamily(name, "gauge", help_text)
+                 for _, name, help_text in _OBS_GAUGES}
+    obs_counter = {name: MetricFamily(name, "counter", help_text)
+                   for _, name, help_text in _OBS_COUNTERS}
     trans_rtt = MetricFamily(
         "tony_transport_rtt_seconds", "gauge",
         "Heartbeat round-trip EMA to the replica agent")
@@ -512,6 +548,13 @@ def prometheus_text(gateway) -> str:
             for key, name, _ in _TRANSPORT_COUNTERS:
                 if key in tr:
                     trans_counter[name].add(tr[key], tl)
+            ob = row.get("obs") or {}
+            for key, name, _ in _OBS_GAUGES:
+                if ob.get(key) is not None:  # lag absent until a pull
+                    obs_gauge[name].add(ob[key], tl)
+            for key, name, _ in _OBS_COUNTERS:
+                if key in ob:
+                    obs_counter[name].add(ob[key], tl)
         for kind, agg in (row.get("dispatch") or {}).items():
             kl = {**labels, "kind": kind}
             disp["tony_dispatch_count_total"].add(agg["count"], kl)
@@ -534,6 +577,8 @@ def prometheus_text(gateway) -> str:
         fams.append(trans_rtt)
         fams.extend(trans_gauge.values())
         fams.extend(trans_counter.values())
+        fams.extend(f for f in obs_gauge.values() if f.samples)
+        fams.extend(f for f in obs_counter.values() if f.samples)
     fams.extend(disp.values())
     fams.extend([host_rss, host_hbm, host_util])
 

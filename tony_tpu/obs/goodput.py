@@ -331,7 +331,13 @@ def ledger(summary: dict, wall_ms: float, *, hbm_gbps: float = 0.0,
             "mfu_pct": mfu,
         }
     ms["idle"] = max(0.0, wall_ms - total_dispatch)
-    denom = max(wall_ms, total_dispatch, 1e-9)
+    # the bucket sum itself joins the denominator (mirroring
+    # merge_ledgers): the summary's per-kind splits arrive ROUNDED to
+    # 3 decimals, and their rounding excess — up to ~0.5 us per split
+    # key — can push sum(ms) a hair past the wall clock on a short,
+    # warm-cache run; the sums-<=1 invariant must hold structurally,
+    # not up to rounding luck
+    denom = max(wall_ms, total_dispatch, sum(ms.values()), 1e-9)
     buckets = {k: _floor6(v / denom) for k, v in ms.items()}
     waste = {k: buckets.get(k, 0.0) for k in WASTE_BUCKETS}
     largest = max(waste, key=waste.get) if waste else None

@@ -81,6 +81,41 @@ class DispatchRecord:
     est_flops: float = 0.0
 
 
+# wire codec (the agent's ``GET /v1/obs`` channel): every field a
+# DispatchRecord carries, JSON-shaped. ``t0`` stays in the RECORDING
+# process's monotonic clock — the puller owns the clock-offset
+# correction (gateway/remote.py), because only it can estimate the
+# offset (RTT-midpoint over its own heartbeats).
+def record_doc(rec: DispatchRecord) -> dict:
+    return {
+        "seq": rec.seq, "kind": rec.kind, "t0": rec.t0,
+        "dur_ms": rec.dur_ms, "occupancy": rec.occupancy,
+        "bucket": rec.bucket, "tokens": rec.tokens,
+        "compile": rec.compile, "request_id": rec.request_id,
+        "tags": dict(rec.tags), "work": rec.work, "fed": rec.fed,
+        "rejected": rec.rejected, "est_bytes": rec.est_bytes,
+        "est_flops": rec.est_flops,
+    }
+
+
+def record_from_doc(doc: dict) -> DispatchRecord:
+    rec = DispatchRecord(
+        kind=str(doc.get("kind", "?")), t0=float(doc.get("t0", 0.0)),
+        dur_ms=float(doc.get("dur_ms", 0.0)),
+        occupancy=int(doc.get("occupancy", 0)),
+        bucket=int(doc.get("bucket", 0)),
+        tokens=int(doc.get("tokens", 0)),
+        compile=bool(doc.get("compile", False)),
+        request_id=doc.get("request_id"),
+        tags=dict(doc.get("tags") or {}),
+        work=int(doc.get("work", 0)), fed=int(doc.get("fed", 0)),
+        rejected=int(doc.get("rejected", 0)),
+        est_bytes=float(doc.get("est_bytes", 0.0)),
+        est_flops=float(doc.get("est_flops", 0.0)))
+    rec.seq = int(doc.get("seq", 0))
+    return rec
+
+
 class DispatchTimeline:
     """Ring of recent ``DispatchRecord``s + lifetime per-kind
     aggregates. Thread-safe; the engine records from its owner thread,
@@ -169,6 +204,31 @@ class DispatchTimeline:
                 new.append(rec)
             new.reverse()
             return new, self._seq
+
+    @property
+    def seq(self) -> int:
+        """The last assigned sequence number (a ``since()``/cursor
+        anchor for callers that will later want 'records after now')."""
+        with self._lock:
+            return self._seq
+
+    def since(self, seq: int) -> list[DispatchRecord]:
+        """Records with ``seq > seq`` still in the ring — the
+        NON-destructive cousin of ``take_new`` (no cursor owned): the
+        agent's per-request fragment gather anchors at the request's
+        submit-time seq, so a finished request scans only its own
+        lifetime's tail instead of the whole ring. O(new), same
+        reverse-iterate-and-break as take_new."""
+        with self._lock:
+            if self._seq <= seq:
+                return []
+            out = []
+            for rec in reversed(self._ring):
+                if rec.seq <= seq:
+                    break
+                out.append(rec)
+            out.reverse()
+            return out
 
     def recent(self, n: int = 64) -> list[DispatchRecord]:
         with self._lock:

@@ -95,7 +95,8 @@ class RequestTrace:
 
     def add(self, name: str, t0: float, t1: float | None = None,
             *, attempt: bool | None = None,
-            attempt_key: tuple | None = None, **tags) -> None:
+            attempt_key: tuple | None = None, clamp: bool = False,
+            **tags) -> None:
         """Append a span. ``attempt=True`` targets the OPEN attempt
         (dropped when none is open — a stale owner's late record);
         default targets the open attempt when one exists, else the
@@ -106,7 +107,16 @@ class RequestTrace:
         exactly those tags, checked ATOMICALLY under the trace lock —
         a stale owner whose snapshot raced a steal + re-placement
         (attempt already re-opened on the survivor) is dropped instead
-        of mis-attributed to the new attempt."""
+        of mis-attributed to the new attempt.
+
+        ``clamp=True`` clamps the span into its parent's window and
+        behind the previous sibling's start, preserving the structural
+        invariants (children nest, siblings monotonic) for timestamps
+        that arrive from ANOTHER CLOCK: remote dispatch records are
+        offset-corrected by an RTT-midpoint estimate whose error can
+        legitimately place a span a few ms outside the attempt — the
+        correction is honest-but-uncertain, and a debug surface must
+        stay well-formed under that uncertainty."""
         span = Span(name, t0, t0 if t1 is None else t1, tags)
         with self._lock:
             if self.done:
@@ -131,27 +141,49 @@ class RequestTrace:
             if self._n_spans >= self.max_spans:
                 self.truncated += 1
                 return
+            if clamp:
+                lo = parent.t0
+                if parent.children:
+                    lo = max(lo, parent.children[-1].t0)
+                span.t0 = max(span.t0, lo)
+                span.t1 = max(span.t1, span.t0)
+                if parent.t1 is not None:
+                    span.t0 = min(span.t0, parent.t1)
+                    span.t1 = min(span.t1, parent.t1)
             self._n_spans += 1
             parent.children.append(span)
 
     def begin_attempt(self, replica: int, epoch: int,
-                      t0: float | None = None) -> None:
+                      t0: float | None = None, **tags) -> None:
         """Open attempt N on ``replica`` (its epoch is the fencing tag
-        the failover story revolves around). An attempt already open is
-        ended first — belt and braces; the supervisor normally ends it
-        at the steal."""
+        the failover story revolves around). Extra ``tags`` (the
+        placement's ``host``, say) ride on the attempt span. An
+        attempt already open is ended first — belt and braces; the
+        supervisor normally ends it at the steal."""
         t0 = time.monotonic() if t0 is None else t0
         with self._lock:
             if self.done:
                 self.dropped += 1
                 return
             if self._attempt is not None and self._attempt.t1 is None:
-                self._attempt.t1 = t0
+                self._attempt.t1 = self._cover(self._attempt, t0)
             self.n_attempts += 1
             span = Span(f"attempt-{self.n_attempts}", t0,
-                        tags={"replica": replica, "epoch": epoch})
+                        tags={"replica": replica, "epoch": epoch,
+                              **tags})
             self.root.children.append(span)
             self._attempt = span
+
+    @staticmethod
+    def _cover(span: Span, t1: float) -> float:
+        """A close time that COVERS the span's children: remote spans
+        carry offset-corrected timestamps whose estimation error can
+        place a dispatch's end a fraction of a ms past the gateway's
+        own delivery instant — the attempt genuinely covered that
+        dispatch, so the close extends rather than orphaning it."""
+        for c in span.children:
+            t1 = max(t1, c.t0 if c.t1 is None else c.t1)
+        return t1
 
     def end_attempt(self, t1: float | None = None, **tags) -> None:
         """Close the open attempt (delivery, shed, or the supervisor's
@@ -159,7 +191,7 @@ class RequestTrace:
         t1 = time.monotonic() if t1 is None else t1
         with self._lock:
             if self._attempt is not None and self._attempt.t1 is None:
-                self._attempt.t1 = t1
+                self._attempt.t1 = self._cover(self._attempt, t1)
                 self._attempt.tags.update(tags)
             self._attempt = None
 
@@ -172,9 +204,9 @@ class RequestTrace:
             if self.done:
                 return
             if self._attempt is not None and self._attempt.t1 is None:
-                self._attempt.t1 = t1
+                self._attempt.t1 = self._cover(self._attempt, t1)
             self._attempt = None
-            self.root.t1 = t1
+            self.root.t1 = self._cover(self.root, t1)
             self.root.tags.update(tags)
             self.done = True
 
@@ -222,11 +254,18 @@ class RequestTrace:
                 "dur": max(0.0, (root_end - self.root.t0) * 1e6),
                 "pid": -1, "tid": 0, "args": dict(self.root.tags),
             })
+            hosts: dict[int, str] = {-1: "gateway"}
             for child in walk_children:
                 if child.name.startswith("attempt-"):
                     tid += 1
                     pid = int(child.tags.get("replica", -1))
                     threads[tid] = pid
+                    # the placement's host (agent address | "local")
+                    # names the pid row: a fleet trace must say WHICH
+                    # MACHINE each attempt ran on, not just the index
+                    host = child.tags.get("host")
+                    if host is not None:
+                        hosts[pid] = f"replica {pid} ({host})"
                     walk(child, pid, tid)
                 else:
                     walk(child, -1, 0)
@@ -234,6 +273,9 @@ class RequestTrace:
                      "tid": t, "args": {"name": "request" if t == 0
                                         else f"attempt-{t}"}}
                     for t, pid in threads.items()]
+            meta.extend({"name": "process_name", "ph": "M", "pid": pid,
+                         "tid": 0, "args": {"name": name}}
+                        for pid, name in sorted(hosts.items()))
             return {
                 "displayTimeUnit": "ms",
                 "otherData": {"request_id": str(self.request_id),
@@ -324,11 +366,19 @@ class TraceBuffer:
         for t in traces:
             tags = {k: v for k, v in t.root.tags.items()
                     if k != "request_id"}
+            # "host": which machine(s) the request's placements ran on
+            # (agent address | "local"), matching the ``host`` field
+            # requests.jsonl rows carry — without it a listing cannot
+            # tell two hosts' requests apart
+            hosts = [c.tags.get("host") for c in t.root.children
+                     if c.name.startswith("attempt-")
+                     and c.tags.get("host") is not None]
             # "placements": replica placements (attempt spans) — the
             # root's own "attempts" terminal tag keeps its metrics
             # meaning (FAILED engine runs) and must not be clobbered
             out.append({"request_id": str(t.request_id),
                         "placements": t.n_attempts,
+                        "host": hosts[-1] if hosts else None,
                         "done": t.done, **tags})
         return out
 

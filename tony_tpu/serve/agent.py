@@ -26,7 +26,15 @@ behind ``gateway/remote.RemoteServer``):
                       "result": {...}}`` line. A dropped connection
                       costs nothing: reconnect with ``offset`` =
                       tokens already received and the stream resumes
-                      exactly there — reconnect, not failover.
+                      exactly there — reconnect, not failover. The
+                      terminal line additionally carries ``obs``: the
+                      dispatch-timeline record fragments THIS request
+                      rode (admits by request_id, decode/verify by the
+                      ``requests`` tag) — so the gateway can graft the
+                      request's complete span set into its trace
+                      BEFORE delivering, instead of losing the tail
+                      of a short request to the next obs-pull's lag.
+                      (The puller dedups against these by agent seq.)
   POST /v1/reset      ``{"epoch"}``: adopt the (newer) epoch, hard-
                       reset the engine, drop every ticket — the
                       gateway's breaker recovery calls this before a
@@ -39,8 +47,34 @@ behind ``gateway/remote.RemoteServer``):
                       this path too — the agent deregisters by
                       draining, never by vanishing.
   GET  /healthz       the heartbeat target: engine counters, epoch,
-                      slots, ``ok``/``failed``/``draining`` — one
-                      cheap GET the gateway's lease rides on.
+                      slots, ``ok``/``failed``/``draining``, and
+                      ``t_mono`` (this process's monotonic clock — the
+                      gateway's RTT-midpoint clock-offset estimate
+                      reads it) — one cheap GET the gateway's lease
+                      rides on.
+  GET  /v1/obs?cursor=N
+                      the fleet observability channel (ISSUE-15): the
+                      engine's dispatch-timeline records with
+                      ``seq > cursor`` still in the ring (wire form of
+                      ``obs.timeline.DispatchRecord``, timestamps in
+                      THIS process's monotonic clock), the lifetime
+                      per-kind timeline summary, and the goodput
+                      ledger — everything the gateway's obs-puller
+                      needs to make this host as observable as an
+                      in-process replica. Pull-based and cursor-
+                      incremental so a slow gateway costs the agent
+                      nothing but the GET; records evicted before
+                      being pulled are simply gone (bounded memory
+                      beats completeness for a debug channel). No
+                      epoch fence: reading records cannot corrupt
+                      state, and a fence would only blind the gateway
+                      during the exact recoveries it most wants to see.
+  POST /v1/profile    ``{"steps": N}``: arm a jax.profiler capture of
+                      THIS agent's next N working stepper iterations
+                      (the remote half of the gateway's
+                      ``POST /debug/profile`` fan-out); the xplane
+                      files land on THIS host under the agent's
+                      profile dir. GET /v1/profile reports status.
 
 EPOCH FENCE, agent side (the PR-5 fencing token carried over the
 wire): every call carries the gateway's epoch for this replica and
@@ -85,15 +119,20 @@ class _StaleEpoch(Exception):
 
 
 class _Ticket:
-    """One live-or-recently-finished request's agent-side record."""
+    """One live-or-recently-finished request's agent-side record.
+    ``seq0`` is the engine timeline's sequence number at submit time:
+    every dispatch record this request rode has ``seq > seq0``, so the
+    terminal-line fragment gather scans only the request's own tail of
+    the ring, never the whole ring."""
 
-    __slots__ = ("id", "tokens", "result", "t_done")
+    __slots__ = ("id", "tokens", "result", "t_done", "seq0")
 
-    def __init__(self, request_id):
+    def __init__(self, request_id, seq0: int = 0):
         self.id = request_id
         self.tokens: list[int] = []
         self.result: dict | None = None
         self.t_done: float | None = None
+        self.seq0 = seq0
 
 
 def result_doc(res: Result) -> dict:
@@ -150,10 +189,18 @@ class ReplicaAgent:
     single-owner step contract the in-process ``_Replica`` keeps."""
 
     def __init__(self, server: Server, *, agent_id: str | None = None,
-                 keepalive_s: float = 0.5):
+                 keepalive_s: float = 0.5,
+                 profile_dir: str | None = None):
+        from tony_tpu.profiler import ServeProfiler
+
         self.server = server
         self.agent_id = agent_id or f"agent-{uuid.uuid4().hex[:8]}"
         self.keepalive_s = max(0.05, keepalive_s)
+        # on-demand xplane captures (POST /v1/profile — the remote half
+        # of the gateway's /debug/profile fan-out): polled once per
+        # WORKING stepper iteration; an un-armed poll is one attribute
+        # read
+        self.profiler = ServeProfiler(profile_dir)
         self.epoch = 0
         self.failed: str | None = None
         self.draining = False
@@ -182,6 +229,9 @@ class ReplicaAgent:
         with self._cond:
             self._cond.notify_all()
         self._thread.join(timeout=5)
+        # finalize a capture left mid-flight (operator armed it, the
+        # agent drained) so its xplane files land
+        self.profiler.close()
 
     # ------------------------------------------------------- the wire
 
@@ -228,10 +278,13 @@ class ReplicaAgent:
                         "duplicate": True}
             # ticket registered UNDER the lock before the engine sees
             # the request: a stream connecting right after the 200 must
-            # find it
+            # find it. seq0 read BEFORE the engine submit: any record
+            # this request rides has a later sequence number.
+            tl = self.server.timeline
+            seq0 = tl.seq if tl is not None else 0
             self.server.submit(req)  # engine submit() is thread-safe;
             # inside our lock only to pair with the ticket insert
-            self._tickets[req.id] = _Ticket(req.id)
+            self._tickets[req.id] = _Ticket(req.id, seq0)
             self._cond.notify_all()
         return {"ok": True, "id": req.id, "epoch": self.epoch}
 
@@ -280,7 +333,62 @@ class ReplicaAgent:
             "speculate_k": server.speculate_k,
             "prefix": server.prefix is not None,
             "counters": server.counters(),
+            # this process's monotonic clock, read in-handler: the
+            # gateway brackets the call and estimates the clock offset
+            # as t_mono - RTT midpoint (uncertainty = RTT/2)
+            "t_mono": time.monotonic(),
         }
+
+    def obs(self, cursor: int) -> dict:
+        """GET /v1/obs payload: incremental timeline records past
+        ``cursor``, the lifetime summary, and the goodput ledger.
+        Degrades to an empty channel with the timeline off — an agent
+        booted ``timeline=False`` is unobservable, not broken."""
+        from tony_tpu.obs.timeline import record_doc
+
+        tl = self.server.timeline
+        if tl is None:
+            return {"cursor": 0, "records": [], "summary": {},
+                    "goodput": None, "epoch": self.epoch,
+                    "t_mono": time.monotonic()}
+        new, new_cursor = tl.take_new(max(0, int(cursor)))
+        return {
+            "cursor": new_cursor,
+            "records": [record_doc(r) for r in new],
+            "summary": tl.summary(),
+            "goodput": self.server.goodput(),
+            "epoch": self.epoch,
+            "t_mono": time.monotonic(),
+        }
+
+    def request_obs(self, request_id) -> list:
+        """The dispatch-record fragments one request rode (wire form),
+        scanned from the timeline ring at stream end: admit records by
+        ``request_id``, decode/verify records by the ``requests`` tag.
+        Rides the stream's terminal line so the gateway grafts a
+        finished request's COMPLETE span set before delivery — the
+        cursor pull alone would lose the tail of any request shorter
+        than one heartbeat. The scan anchors at the ticket's
+        submit-time seq (``since(seq0)``) — the request's own slice of
+        the ring, not the whole ring, so the gather cannot contend
+        O(ring) work per finished request against the engine's hot
+        ``record()`` lock. Ring-bounded like everything else here:
+        records already evicted are gone, which only happens to
+        requests that outlived the whole ring."""
+        tl = self.server.timeline
+        if tl is None:
+            return []
+        from tony_tpu.obs.timeline import record_doc
+
+        with self._cond:
+            ticket = self._tickets.get(request_id)
+            seq0 = ticket.seq0 if ticket is not None else 0
+        out = []
+        for rec in tl.since(seq0):
+            if rec.request_id == request_id or request_id in (
+                    rec.tags.get("requests") or ()):
+                out.append(record_doc(rec))
+        return out
 
     # -------------------------------------------------------- stepper
 
@@ -310,6 +418,10 @@ class ReplicaAgent:
                 continue
             try:
                 finished = self.server.step()
+                # one WORKING iteration: the on-demand profile capture
+                # counts it (near-free attribute read while un-armed) —
+                # the agent-side twin of the gateway replica loop's poll
+                self.profiler.poll()
                 with self._cond:  # snapshot: submits mutate the dict
                     seen = {t.id: len(t.tokens)
                             for t in self._tickets.values()
@@ -395,6 +507,7 @@ class ReplicaAgent:
                 last_emit = time.monotonic()
             if result is not None:
                 yield {"done": True, "result": result,
+                       "obs": self.request_obs(request_id),
                        "epoch": self.epoch}
                 return
             if time.monotonic() - last_emit >= self.keepalive_s:
@@ -423,6 +536,14 @@ class AgentHandler(BaseHTTPRequestHandler):
         path, _, query = self.path.partition("?")
         if path == "/healthz":
             return self._send(200, self.agent.healthz())
+        if path == "/v1/obs":
+            try:
+                cursor = int(dict(parse_qsl(query)).get("cursor", 0))
+            except ValueError as e:
+                return self._send(400, {"error": str(e)})
+            return self._send(200, self.agent.obs(cursor))
+        if path == "/v1/profile":
+            return self._send(200, self.agent.profiler.status())
         if path.startswith("/v1/stream/"):
             return self._stream(unquote(path[len("/v1/stream/"):]),
                                 dict(parse_qsl(query)))
@@ -462,6 +583,20 @@ class AgentHandler(BaseHTTPRequestHandler):
         if path == "/v1/drain":
             timeout = float(body.get("timeout_s", 120.0))
             return self._send(200, self.agent.drain(timeout))
+        if path == "/v1/profile":
+            # the remote half of the gateway's /debug/profile fan-out:
+            # arm a capture of this agent's next N working iterations.
+            # Same status mapping as the gateway's own endpoint — 409
+            # while one is pending/active (jax has ONE global session)
+            try:
+                steps = int(body.get("steps", 10))
+                logdir = self.agent.profiler.request(steps)
+            except ValueError as e:
+                return self._send(400, {"error": str(e)})
+            except RuntimeError as e:
+                return self._send(409, {"error": str(e)})
+            return self._send(200, {"armed": True, "steps": steps,
+                                    "logdir": logdir})
         return self._send(404, {"error": "not found"})
 
     def _submit(self, body: dict) -> None:

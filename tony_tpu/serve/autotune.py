@@ -153,10 +153,16 @@ class AutotuneController:
 
     def _sample(self, server) -> dict | None:
         """One cumulative reading of the engine's shape-relevant
-        sensors; None when the engine carries no timeline (remote
-        stubs, timeline=False) — such replicas are never actuated."""
+        sensors; None for engines with no timeline (timeline=False)
+        and for REMOTE stubs — such replicas are never actuated. The
+        remote check is explicit (a transport marks a stub) rather
+        than timeline-is-None: since ISSUE-15 stubs carry a pulled
+        RemoteTimeline, but their shape knobs live on the AGENT's
+        engine — actuating the stub's dead local attributes would log
+        phantom decisions that never reach the device."""
         timeline = getattr(server, "timeline", None)
-        if timeline is None:
+        if timeline is None or getattr(server, "transport",
+                                       None) is not None:
             return None
         summ = timeline.summary()
         out = {"dispatches": 0, "tokens": 0, "work": 0,

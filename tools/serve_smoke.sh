@@ -31,7 +31,14 @@
 # tony_tpu.cli.replica` agent subprocesses behind a --agents gateway;
 # concurrent traffic, `kill -9` one agent mid-run -> zero 5xx, every
 # output token-exact vs a local-replica control gateway, the corpse
-# quarantined, the survivor agent SIGTERM-drained clean.
+# quarantined, the survivor agent SIGTERM-drained clean. ISSUE-15
+# extended the round: the survivor's dispatch counts and a non-null
+# merged goodput block must land on /stats, tony_goodput_fraction +
+# tony_transport_clock_offset_ms on /metrics, and one POST
+# /debug/profile must fan a real capture out to the survivor agent.
+# Plus a BUNDLE round (ISSUE-15): a synthetic alert on a live
+# subprocess gateway must dump a self-contained debug bundle into the
+# history job dir, validated as JSON (`make bundle-smoke`).
 #
 # Usage: tools/serve_smoke.sh       (repo root; `make serve-smoke`)
 #        SERVE_SMOKE_ROUNDS=chaos tools/serve_smoke.sh
@@ -42,6 +49,8 @@
 #                                   (goodput/alerts round only; `make goodput-smoke`)
 #        SERVE_SMOKE_ROUNDS=remote tools/serve_smoke.sh
 #                                   (remote round only; `make remote-smoke`)
+#        SERVE_SMOKE_ROUNDS=bundle tools/serve_smoke.sh
+#                                   (flight-recorder round only; `make bundle-smoke`)
 #        SERVE_SMOKE_ROUNDS=shard tools/serve_smoke.sh
 #                                   (sharded-replica round only; `make shard-smoke`)
 set -u
@@ -66,7 +75,8 @@ AT_PID=''
 ATCTRL_PID=''
 SHGW_PID=''
 SHCTRL_PID=''
-trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID $RGW_PID $RCTRL_PID $DGW_PID $DCTRL_PID $AT_PID $ATCTRL_PID $SHGW_PID $SHCTRL_PID 2>/dev/null; kill -9 $AGENT0_PID $AGENT1_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
+BGW_PID=''
+trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID $RGW_PID $RCTRL_PID $DGW_PID $DCTRL_PID $AT_PID $ATCTRL_PID $SHGW_PID $SHCTRL_PID $BGW_PID 2>/dev/null; kill -9 $AGENT0_PID $AGENT1_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
 
 fail() { echo "serve-smoke: FAIL: $1" >&2; exit 1; }
 
@@ -273,9 +283,52 @@ assert rows[0]["state"] == "quarantined", rows[0]["state"]
 assert rows[0]["transport"]["address"] == sys.argv[2]
 assert rows[1]["state"] == "healthy", rows[1]["state"]
 assert rows[1]["completed"] >= 1, rows[1]["completed"]
+# ISSUE-15: the survivor is OBSERVED, not a black hole — its pulled
+# dispatch timeline and goodput ledger land in the gateway surfaces
+r1 = rows[1]
+assert r1["obs"]["pulls"] >= 1, r1.get("obs")
+assert r1["dispatch"]["decode"]["count"] >= 1, r1.get("dispatch")
+assert r1["goodput"] is not None
+assert sum(r1["goodput"]["buckets"].values()) <= 1 + 1e-6
+eng = stats["engine"]
+assert eng["dispatch"]["decode"]["count"] >= 1, eng.get("dispatch")
+assert eng["goodput"] and eng["goodput"]["buckets"], eng.get("goodput")
 EOF
     curl_s "$WORK/remote_metrics" "$RURL/metrics" >/dev/null 2>&1
     grep -q 'tony_transport_rtt_seconds' "$WORK/remote_metrics" || fail "no transport metrics on /metrics"
+    # ISSUE-15: goodput fractions + the clock-offset model exported
+    # with the remote replica present
+    grep -q 'tony_goodput_fraction{' "$WORK/remote_metrics" || fail "no goodput fractions on /metrics with a remote replica"
+    grep -q 'tony_transport_clock_offset_ms{' "$WORK/remote_metrics" || fail "no clock-offset series on /metrics"
+    grep -q 'tony_transport_obs_pulls_total{' "$WORK/remote_metrics" || fail "no obs-pull series on /metrics"
+
+    # ISSUE-15: one POST /debug/profile fans the capture out to the
+    # surviving agent host (the dead one reports its error, never
+    # blocks the fan-out)
+    code=$(curl_s "$WORK/remote_prof" "$RURL/debug/profile?steps=2" '{}') || fail "profile fanout curl"
+    [ "$code" = 200 ] || fail "profile fanout -> $code"
+    $PY - "$WORK/remote_prof" "$A1" <<'EOF' || fail "profile fanout did not arm the survivor agent ($(cat "$WORK/remote_prof"))"
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["armed"] is True
+assert doc["remote"][sys.argv[2]]["armed"] is True, doc["remote"]
+EOF
+    # drive traffic until the agent-side capture lands (the first
+    # start_trace of a process can block ~10 s on plugin spin-up)
+    i=0
+    while [ $i -lt $BOUND ]; do
+        curl_s "$WORK/remote_drive" "$RURL/v1/generate" '{"token_ids": [5, 5], "max_new_tokens": 4}' >/dev/null 2>&1
+        curl_s "$WORK/remote_prof_status" "$RURL/debug/profile" >/dev/null 2>&1
+        if $PY - "$WORK/remote_prof_status" "$A1" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+sys.exit(0 if doc.get("remote", {}).get(sys.argv[2], {})
+         .get("captures", 0) >= 1 else 1)
+EOF
+        then break; fi
+        sleep 1; i=$((i + 1))
+    done
+    [ $i -lt $BOUND ] || fail "survivor agent capture never completed: $(cat "$WORK/remote_prof_status")"
 
     # gateway SIGTERM drain (attached agents are left running), then
     # the survivor agent deregisters by DRAINING on its own SIGTERM
@@ -304,6 +357,93 @@ EOF
     wait $RCTRL_PID 2>/dev/null
     RCTRL_PID=''
     echo "serve-smoke: remote OK (kill -9 one of 2 agents -> zero 5xx, token-exact vs local control, corpse quarantined, survivor drained clean)"
+}
+
+# ---- bundle round (also standalone: SERVE_SMOKE_ROUNDS=bundle) -------
+# ISSUE-15 flight recorder: a live subprocess gateway with --history
+# and a synthetic alert (queue_aging threshold 0.05 s against a
+# 1-slot replica under a 6-request burst) must dump ONE self-contained
+# debug bundle into <job dir>/bundles/ at the firing transition, and
+# GET /debug/bundle must serve the same document shape on demand.
+bundle_round() {
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --replicas 1 --serve-batch 1 --port 0 --compile-cache '' \
+        --history "$WORK/bhistory" --alert-queue-wait 0.05 \
+        --alert-interval 0.1 \
+        >"$WORK/bundle_boot.log" 2>"$WORK/bundle_stderr.log" &
+    BGW_PID=$!
+    BURL=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        BURL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/bundle_boot.log")
+        [ -n "$BURL" ] && break
+        kill -0 $BGW_PID 2>/dev/null || fail "bundle gateway died at boot: $(cat "$WORK/bundle_stderr.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$BURL" ] || fail "bundle gateway did not print its URL within ${BOUND}s"
+    echo "serve-smoke: bundle gateway at $BURL (queue_aging armed at 0.05s)"
+
+    # 6 concurrent requests at ONE slot: the queue ages past the
+    # synthetic threshold while the first request pays its compiles
+    BUNDLE_PIDS=''
+    n=0
+    while [ $n -lt 6 ]; do
+        curl_s "$WORK/bundle_$n" "$BURL/v1/generate" \
+            "{\"token_ids\": [$((1 + n)), 3], \"max_new_tokens\": 16, \"id\": $n}" \
+            >"$WORK/bundle_${n}.code" &
+        BUNDLE_PIDS="$BUNDLE_PIDS $!"
+        n=$((n + 1))
+    done
+    wait $BUNDLE_PIDS
+    n=0
+    while [ $n -lt 6 ]; do
+        [ "$(cat "$WORK/bundle_${n}.code")" = 200 ] || fail "bundle round request $n -> $(cat "$WORK/bundle_${n}.code")"
+        n=$((n + 1))
+    done
+
+    # the firing alert dumped a bundle into the history job dir
+    i=0
+    while [ $i -lt $BOUND ]; do
+        BUNDLE=$(ls "$WORK"/bhistory/intermediate/*/bundles/bundle-*.json 2>/dev/null | head -1)
+        [ -n "$BUNDLE" ] && break
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$BUNDLE" ] || fail "no alert-triggered bundle written under $WORK/bhistory"
+    $PY - "$BUNDLE" <<'EOF' || fail "dumped bundle JSON malformed ($BUNDLE)"
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["reason"] == "alert" and doc["trigger"], doc.get("trigger")
+al = doc["alerts"]
+assert al["enabled"] and al["fired"].get("queue_aging", 0) >= 1, al
+assert doc["replicas"] and "dispatch" in doc["replicas"][0]
+assert doc["goodput"]["fleet"], doc["goodput"]
+assert "signals" in doc and "supervision" in doc
+assert isinstance(doc["traces"]["summaries"], list)
+EOF
+    echo "serve-smoke: alert-triggered bundle at $BUNDLE"
+
+    # GET /debug/bundle serves the same document shape on demand, and
+    # its recorder trail names the dumped file
+    code=$(curl_s "$WORK/bundle_live" "$BURL/debug/bundle") || fail "live bundle curl"
+    [ "$code" = 200 ] || fail "live bundle -> $code"
+    $PY - "$WORK/bundle_live" <<'EOF' || fail "live /debug/bundle malformed"
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["reason"] == "manual"
+assert doc["alerts"]["enabled"] and doc["replicas"]
+assert doc["bundles"]["written"] >= 1 and doc["bundles"]["last_path"]
+EOF
+
+    kill -TERM $BGW_PID
+    i=0
+    while kill -0 $BGW_PID 2>/dev/null; do
+        [ $i -ge $BOUND ] && fail "bundle gateway did not drain within ${BOUND}s"
+        sleep 1; i=$((i + 1))
+    done
+    wait $BGW_PID; rc=$?
+    [ $rc = 0 ] || fail "bundle gateway exited $rc after SIGTERM"
+    BGW_PID=''
+    echo "serve-smoke: bundle OK (synthetic alert -> one browsable bundle in the job dir, live /debug/bundle consistent)"
 }
 
 # ---- autoscale round (also standalone: SERVE_SMOKE_ROUNDS=autoscale) --
@@ -915,6 +1055,10 @@ if [ "${SERVE_SMOKE_ROUNDS:-all}" = remote ]; then
     remote_round   # `make remote-smoke`: just the remote-replica round
     exit 0
 fi
+if [ "${SERVE_SMOKE_ROUNDS:-all}" = bundle ]; then
+    bundle_round   # `make bundle-smoke`: just the flight-recorder round
+    exit 0
+fi
 if [ "${SERVE_SMOKE_ROUNDS:-all}" = autoscale ]; then
     autoscale_round   # `make autoscale-smoke`: just the elastic round
     exit 0
@@ -1268,4 +1412,7 @@ shard_round
 
 # ---- remote round: agents on "hosts", kill -9 one, keep serving ------
 remote_round
+
+# ---- bundle round: synthetic alert -> flight-recorder dump -----------
+bundle_round
 echo "serve-smoke: ALL OK"
