@@ -81,10 +81,16 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 #     under greedy/sampled/prefix/streaming traffic, byte-identical
 #     outputs vs a single-device control gateway, mesh topology +
 #     per-chip pricing on /stats engine.mesh + tony_mesh_* metrics
+#   make storm-smoke - just the connection-storm round of serve-smoke:
+#     tools/storm.py parks 500 idle keep-alive connections on an
+#     event-edge gateway, then fires 2000 concurrent NDJSON streams
+#     in bursts — zero shed / zero unintentional 5xx, token-exact
+#     spot checks vs unary controls, edge block on /stats +
+#     tony_edge_* on /metrics, clean SIGTERM drain
 
 .PHONY: lint smoke check test bench serve-smoke chaos-smoke \
 	autoscale-smoke goodput-smoke remote-smoke disagg-smoke \
-	autotune-smoke shard-smoke bundle-smoke
+	autotune-smoke shard-smoke bundle-smoke storm-smoke
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -132,3 +138,6 @@ shard-smoke:
 
 bundle-smoke:
 	PY=$(PY) SERVE_SMOKE_ROUNDS=bundle sh tools/serve_smoke.sh
+
+storm-smoke:
+	PY=$(PY) SERVE_SMOKE_ROUNDS=storm sh tools/serve_smoke.sh

@@ -2334,6 +2334,119 @@ def bench_launch() -> dict:
     return out
 
 
+def _storm_run(edge: str, idle: int, streams: int,
+               timeout_s: float) -> dict:
+    """Boot one demo-model gateway subprocess behind the given edge,
+    drive it with tools/storm.py, SIGTERM-drain it, and return the
+    flattened report. The subprocess pins JAX to CPU (this bench is
+    host-scheduling-bound; the parent owns any chip)."""
+    import signal as _signal
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    gw = subprocess.Popen(
+        [sys.executable, "-m", "tony_tpu.cli.gateway", "--demo-model",
+         "--edge", edge, "--serve-batch", "64", "--chunk-steps", "4",
+         "--max-queue", str(2 * streams + 64),
+         "--max-pending", str(2 * streams + 64),
+         "--port", "0", "--compile-cache", ""],
+        cwd=root, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    try:
+        base = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            ln = gw.stdout.readline()
+            if not ln:
+                break
+            if "gateway at http://" in ln:
+                base = ln.split("gateway at ")[1].split()[0]
+                break
+        if base is None:
+            return {"error": f"{edge} gateway never printed its boot line"}
+        storm = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "storm.py"),
+             "--base", base, "--idle", str(idle),
+             "--streams", str(streams),
+             "--tokens", "8", "--bursts", "10", "--burst-gap", "0.2",
+             "--check", "16", "--server-pid", str(gw.pid),
+             "--timeout", str(timeout_s)],
+            cwd=root, capture_output=True, text=True,
+            timeout=timeout_s + 120)
+        if storm.returncode != 0:
+            tail = (storm.stderr or storm.stdout).strip()[-300:]
+            return {"error": f"storm.py rc={storm.returncode}: {tail}"}
+        doc = json.loads(storm.stdout)
+        gw.send_signal(_signal.SIGTERM)
+        try:
+            drained = gw.wait(timeout=120) == 0
+        except subprocess.TimeoutExpired:
+            drained = False
+        idle_r, st = doc.get("idle", {}), doc.get("storm", {})
+        return {
+            "edge": edge,
+            "idle_connections": idle_r.get("opened"),
+            "rss_kb_per_idle_conn": idle_r.get("rss_kb_per_idle_conn"),
+            "streams": st.get("launched"),
+            "completed_200": st.get("completed_200"),
+            "shed": st.get("shed"),
+            "shed_rate": st.get("shed_rate"),
+            "errors": st.get("errors"),
+            "peak_server_threads": st.get("peak_server_threads"),
+            "edge_threads": (st.get("edge") or {}).get("threads"),
+            "ttft_p50_ms": st.get("ttft_p50_ms"),
+            "ttft_p99_ms": st.get("ttft_p99_ms"),
+            "tokens_checked": st.get("tokens_checked"),
+            "tokens_exact": st.get("tokens_exact"),
+            "sigterm_drained_clean": drained,
+        }
+    finally:
+        if gw.poll() is None:
+            gw.kill()
+            gw.wait(timeout=10)
+
+
+def bench_storm(on_tpu: bool) -> dict:
+    """Connection-storm datum for the event-driven edge (ISSUE-16).
+    Slow lane, two measured runs on the demo model:
+
+    1. the event edge under the full storm — 10k parked idle
+       keep-alive connections (per-connection RSS cost), then 10k
+       concurrent NDJSON streams in bursts (shed rate, TTFT tails,
+       token-exact spot checks, peak thread count: the edge's thread
+       count must NOT scale with connections);
+    2. the thread-per-connection control (``--edge threaded``) at a
+       fifth of that load — expected to shed/fail (its collapse IS
+       the datum).
+
+    The gate: the event edge completes >= 5x the streams the control
+    sustains. ``TONY_BENCH_STORM_STREAMS`` scales both runs down for
+    quick passes."""
+    streams = int(os.environ.get("TONY_BENCH_STORM_STREAMS", "10000"))
+    event = _storm_run("event", idle=streams, streams=streams,
+                       timeout_s=600.0)
+    if "error" in event:
+        return event
+    ctrl_streams = max(1, streams // 5)
+    control = _storm_run("threaded", idle=0, streams=ctrl_streams,
+                         timeout_s=420.0)
+    out = {"event": event, "threaded_control": control}
+    sustained = control.get("completed_200") or 0
+    if control.get("errors") or control.get("shed"):
+        # the control could not sustain even its 1/5 load: its max
+        # sustainable concurrency is below ctrl_streams
+        out["control_max_sustained_streams"] = sustained
+    else:
+        out["control_max_sustained_streams"] = ctrl_streams
+    done = event.get("completed_200") or 0
+    out["event_vs_control_ratio"] = round(
+        done / max(1, out["control_max_sustained_streams"]), 2)
+    out["fivefold_vs_threaded"] = (
+        done == event.get("streams")
+        and done >= 5 * out["control_max_sustained_streams"])
+    return out
+
+
 def _maybe_reexec_on_tpu(line: dict) -> dict:
     """End-of-run second chance: the CPU benches took minutes — if the
     tunnel recovered meanwhile, re-run the WHOLE bench pinned to TPU in a
@@ -2497,6 +2610,10 @@ def _collect_line() -> dict:
     except Exception as e:
         extras["quant"] = {"error": f"{type(e).__name__}: {e}"}
     gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
+    try:
+        extras["storm"] = bench_storm(on_tpu)
+    except Exception as e:
+        extras["storm"] = {"error": f"{type(e).__name__}: {e}"}
     try:
         extras["launch"] = bench_launch()
     except Exception as e:

@@ -1,0 +1,433 @@
+"""The event-driven edge (ISSUE-16): connection-plane behavior that
+thread-per-connection servers get wrong — slow readers, half-open
+sockets, trickled uploads, connection breakers — pinned against a fake
+gateway so the suite needs no jax and runs in milliseconds.
+
+The fake implements exactly the surface both edges consume: submit()
+with the on_event callback contract (("tokens", ids) / ("done", res,
+metrics) / ("shed", status, reason)), health()/snapshot()/ready for
+the GET routes, and register_edge() for the /stats edge block."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tony_tpu.gateway.core import GatewayQueueFull, QuotaExceeded
+from tony_tpu.gateway.edge import GatewayEdge
+from tony_tpu.gateway.http import GatewayHTTP
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+
+class _Result:
+    def __init__(self, rid, prompt, tokens):
+        self.id = rid
+        self.prompt = list(prompt)
+        self.tokens = list(tokens)
+        self.finish_reason = "length"
+
+
+class _Ticket:
+    def __init__(self, request):
+        import queue
+
+        self.request = request
+        self.events = queue.Queue()  # the threaded edge's consumer
+
+
+class FakeGateway:
+    """Event-contract double: submit() immediately streams scripted
+    events from a worker thread, like replica threads do."""
+
+    def __init__(self, script=None, shed=None, delay_s=0.0,
+                 tokens_per_event=2, events=2):
+        self.ready = True
+        self.draining = False
+        self.n_healthy = 1
+        self.traces = None
+        self._edge = None
+        self.script = script
+        self.shed = shed
+        self.delay_s = delay_s
+        self.tokens_per_event = tokens_per_event
+        self.events = events
+        self.submits = 0
+        self.threads: list[threading.Thread] = []
+
+    def register_edge(self, fn):
+        self._edge = fn
+
+    def health(self):
+        return {"status": "ok", "healthy": 1, "replicas": []}
+
+    def snapshot(self):
+        out = {"completed": self.submits, "ready": self.ready}
+        if self._edge is not None:
+            out["edge"] = self._edge()
+        return out
+
+    def goodput_report(self):
+        return {"goodput": 1.0}
+
+    def submit(self, request, on_event=None):
+        self.submits += 1
+        if self.shed is not None:
+            raise self.shed
+        ticket = _Ticket(request)
+        if on_event is None:  # the threaded edge reads ticket.events
+            def on_event(t, event):
+                t.events.put(event)
+
+        def run():
+            if self.script is not None:
+                self.script(ticket, on_event)
+                return
+            toks = []
+            for i in range(self.events):
+                time.sleep(self.delay_s)
+                batch = list(range(i * self.tokens_per_event,
+                                   (i + 1) * self.tokens_per_event))
+                toks.extend(batch)
+                on_event(ticket, ("tokens", batch))
+            res = _Result(request.id, request.prompt, toks)
+            on_event(ticket, ("done", res, {"tokens_out": len(toks)}))
+
+        t = threading.Thread(target=run, daemon=True)
+        self.threads.append(t)
+        t.start()
+        return ticket
+
+
+@pytest.fixture()
+def edge_factory():
+    """Yields a make(gateway, **kw) -> (edge, base_url) helper that
+    tears every edge down at test end."""
+    edges = []
+
+    def make(gw, **kw):
+        edge = GatewayEdge(gw, port=0, **kw).start()
+        edges.append(edge)
+        return edge, f"http://{edge.host}:{edge.port}"
+
+    yield make
+    for e in edges:
+        e.stop()
+
+
+def _post(url, doc, timeout=30):
+    req = urllib.request.Request(
+        url + "/v1/generate", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _connect(url):
+    host, port = url.split("//")[1].split(":")
+    s = socket.create_connection((host, int(port)), timeout=30)
+    return s
+
+
+def _raw_request(sock, body: bytes, stream=True):
+    doc = body if isinstance(body, bytes) else json.dumps(body).encode()
+    sock.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+                 b"Content-Type: application/json\r\n"
+                 b"Content-Length: " + str(len(doc)).encode()
+                 + b"\r\n\r\n" + doc)
+
+
+def _edge_stats(gw):
+    return gw.snapshot()["edge"]
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------------------------- routes
+
+def test_edge_routes_and_unary(edge_factory):
+    gw = FakeGateway(events=2, tokens_per_event=2)
+    _, url = edge_factory(gw)
+    health = json.loads(urllib.request.urlopen(
+        url + "/healthz", timeout=30).read())
+    assert health["status"] == "ok"
+    assert urllib.request.urlopen(url + "/readyz",
+                                  timeout=30).status == 200
+    doc = json.loads(_post(url, {"token_ids": [1, 2],
+                                 "max_new_tokens": 4,
+                                 "id": "u"}).read())
+    assert doc["id"] == "u" and doc["request_id"] == "u"
+    assert doc["token_ids"] == [1, 2, 0, 1, 2, 3]
+    assert doc["finish_reason"] == "length"
+    stats = json.loads(urllib.request.urlopen(
+        url + "/stats", timeout=30).read())
+    assert stats["edge"]["kind"] == "event"
+    assert stats["edge"]["threads"] == 1 + stats["edge"]["workers"]
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(url + "/nope", timeout=30)
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url, {"max_new_tokens": 4})
+    assert e.value.code == 400  # no token_ids/prompt
+
+
+def test_edge_shed_maps_status_and_retry_after(edge_factory):
+    gw = FakeGateway(shed=GatewayQueueFull("queue full"))
+    _, url = edge_factory(gw)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url, {"token_ids": [1]})
+    assert e.value.code == 429
+    gw.shed = QuotaExceeded("quota", retry_after_s=3.0)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url, {"token_ids": [1]})
+    assert e.value.code == 429
+    assert e.value.headers.get("Retry-After") == "3"
+
+
+def test_edge_streaming_token_exact(edge_factory):
+    gw = FakeGateway(events=3, tokens_per_event=2)
+    _, url = edge_factory(gw)
+    resp = _post(url, {"token_ids": [7, 8], "max_new_tokens": 6,
+                       "stream": True, "id": "s"})
+    assert resp.headers.get("Content-Type") == "application/x-ndjson"
+    lines = [json.loads(ln) for ln in resp.read().decode().splitlines()]
+    toks = [t for ln in lines[:-1] for t in ln["token_ids"]]
+    assert lines[-1]["finish_reason"] == "length"
+    assert lines[-1]["token_ids"] == [7, 8] + toks
+    assert toks == [0, 1, 2, 3, 4, 5]
+
+
+# -------------------------------------------------- stream keepalives
+
+@pytest.mark.parametrize("edge_kind", ["event", "threaded"])
+def test_stream_keepalives_pinned_both_edges(edge_kind, edge_factory):
+    """A quiet stream gets {"keepalive": true} frames at the keepalive
+    cadence on BOTH edges; they carry no token_ids, so reassembling
+    deltas while filtering keepalives stays token-exact. This is the
+    documented client contract — a client that naively extends on
+    every line would still be correct (keepalives have no token_ids),
+    but one that errors on unknown lines would break: pinned here."""
+    gw = FakeGateway(events=2, tokens_per_event=1, delay_s=0.6)
+    if edge_kind == "event":
+        _, url = edge_factory(gw, keepalive_s=0.1)
+        http = None
+    else:
+        http = GatewayHTTP(gw, port=0, keepalive_s=0.1).start()
+        url = f"http://{http.host}:{http.port}"
+    try:
+        resp = _post(url, {"token_ids": [1], "stream": True, "id": "k"})
+        lines = [json.loads(ln)
+                 for ln in resp.read().decode().splitlines()]
+    finally:
+        if http is not None:
+            http.stop()
+    keepalives = [ln for ln in lines if ln.get("keepalive")]
+    assert keepalives, lines  # the 0.6 s gap must emit at least one
+    assert all("token_ids" not in ln for ln in keepalives)
+    toks = [t for ln in lines
+            if "finish_reason" not in ln
+            for t in ln.get("token_ids", [])]
+    assert toks == [0, 1]
+    assert lines[-1]["token_ids"] == [1] + toks
+
+
+# ---------------------------------------------------- slow client
+
+def test_slow_reader_aborted_counted_co_tenant_unharmed(edge_factory):
+    """A client that stops reading mid-stream while the server keeps
+    producing must be aborted by the slow-client policy (bounded write
+    buffer + drain timeout), counted, with its slot freed — and a
+    co-tenant request during AND after the abort must complete
+    normally (never a 500, never a stall)."""
+    stop = threading.Event()
+
+    def firehose(ticket, on_event):
+        # ~14 MB if nobody aborts: far past every kernel buffer
+        # (tcp_wmem autotunes to 4 MB), so a reader that stalls MUST
+        # trip the drain timeout
+        n = 0
+        while not stop.is_set() and n < 4000:
+            on_event(ticket, ("tokens", list(range(512))))
+            n += 1
+            if n % 100 == 0:
+                time.sleep(0.01)
+        res = _Result(ticket.request.id, ticket.request.prompt, [0])
+        on_event(ticket, ("done", res, {}))
+
+    gw = FakeGateway(script=firehose)
+    _, url = edge_factory(gw, write_buffer_kb=16, drain_timeout_s=0.3)
+    host, port = url.split("//")[1].split(":")
+    s = socket.socket()
+    # BEFORE connect: caps the advertised receive window, so the
+    # server side can't stash megabytes in flight
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    s.connect((host, int(port)))
+    _raw_request(s, {"token_ids": [1], "stream": True, "id": "slow"})
+    # read a little to commit headers, then go silent
+    assert s.recv(256)
+    _wait(lambda: _edge_stats(gw)["slow_client_aborts"] >= 1,
+          timeout=20, msg="slow client abort")
+    # co-tenant on a fresh connection: normal service
+    gw.script = None
+    doc = json.loads(_post(url, {"token_ids": [5], "id": "co"}).read())
+    assert doc["id"] == "co"
+    stop.set()
+    _wait(lambda: _edge_stats(gw)["active_streams"] == 0,
+          timeout=20, msg="stream slot freed")
+    s.close()
+
+
+def test_disconnect_without_fin_frees_slot(edge_factory):
+    """A client that vanishes mid-stream (RST, no FIN) must be
+    detected by the edge's write path, its connection and stream slot
+    freed, and the disconnect counted — not a hung handler thread."""
+    stop = threading.Event()
+
+    def drip(ticket, on_event):
+        while not stop.is_set():
+            on_event(ticket, ("tokens", [1, 2, 3]))
+            time.sleep(0.02)
+        res = _Result(ticket.request.id, ticket.request.prompt, [0])
+        on_event(ticket, ("done", res, {}))
+
+    gw = FakeGateway(script=drip)
+    _, url = edge_factory(gw)
+    s = _connect(url)
+    _raw_request(s, {"token_ids": [1], "stream": True, "id": "rst"})
+    assert s.recv(256)
+    # SO_LINGER 0 close() sends RST: the hard-vanish case
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                 b"\x01\x00\x00\x00\x00\x00\x00\x00")
+    s.close()
+    _wait(lambda: _edge_stats(gw)["active_streams"] == 0,
+          timeout=20, msg="stream slot freed after RST")
+    stats = _edge_stats(gw)
+    assert (stats["client_disconnects"] >= 1
+            or stats["slow_client_aborts"] >= 1), stats
+    stop.set()
+    # the edge still serves: co-tenant sanity
+    gw.script = None
+    assert json.loads(_post(url, {"token_ids": [2],
+                                  "id": "after"}).read())["id"] == "after"
+
+
+def test_trickled_post_body_408_bounded(edge_factory):
+    """A request body that dribbles in must be bounded by the io
+    timeout (408 + close), not hold a parser slot forever. Idle
+    keep-alive connections are exempt: only a STARTED request is on
+    the clock."""
+    gw = FakeGateway()
+    _, url = edge_factory(gw, io_timeout_s=0.4)
+    s = _connect(url)
+    s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+              b"Content-Type: application/json\r\n"
+              b"Content-Length: 1000\r\n\r\n{\"tok")  # ...and stall
+    buf = b""
+    t0 = time.monotonic()
+    while b"\r\n\r\n" not in buf and time.monotonic() - t0 < 15:
+        chunk = s.recv(4096)
+        if not chunk:
+            break
+        buf += chunk
+    assert b" 408 " in buf.split(b"\r\n", 1)[0], buf[:200]
+    s.close()
+
+
+def test_idle_keepalive_connection_outlives_io_timeout(edge_factory):
+    """An idle keep-alive connection sits PAST the io timeout for
+    free, then still serves a request: the timeout clock only starts
+    at a request's first byte (that's what makes 10k parked
+    connections cost zero threads and zero timers)."""
+    gw = FakeGateway(events=1, tokens_per_event=1)
+    _, url = edge_factory(gw, io_timeout_s=0.3)
+    s = _connect(url)
+    time.sleep(1.0)  # 3x the io timeout: must NOT be reaped
+    _raw_request(s, {"token_ids": [1], "id": "idle"})
+    buf = b""
+    t0 = time.monotonic()
+    while b"\r\n\r\n" not in buf and time.monotonic() - t0 < 15:
+        buf += s.recv(4096)
+    assert b" 200 " in buf.split(b"\r\n", 1)[0], buf[:200]
+    s.close()
+
+
+# ------------------------------------------------- connection breaker
+
+def test_connection_limit_breaker_503_retry_after(edge_factory):
+    gw = FakeGateway(events=1, tokens_per_event=1)
+    _, url = edge_factory(gw, max_connections=4)
+    parked = [_connect(url) for _ in range(4)]
+    _wait(lambda: _edge_stats(gw)["open_connections"] >= 4,
+          timeout=10, msg="4 parked connections")
+    s = _connect(url)
+    _raw_request(s, {"token_ids": [1], "id": "over"})
+    buf = b""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 15:
+        chunk = s.recv(4096)
+        if not chunk:
+            break
+        buf += chunk
+    head = buf.split(b"\r\n\r\n", 1)[0]
+    assert b" 503 " in head.split(b"\r\n", 1)[0], buf[:200]
+    assert b"retry-after" in head.lower(), head
+    assert _edge_stats(gw)["conn_limit_sheds"] >= 1
+    s.close()
+    for p in parked:
+        p.close()
+    # breaker recovers once load drops
+    _wait(lambda: _edge_stats(gw)["open_connections"] == 0,
+          timeout=10, msg="connections drained")
+    doc = json.loads(_post(url, {"token_ids": [1], "id": "ok"}).read())
+    assert doc["id"] == "ok"
+
+
+def test_edge_stats_detach_on_stop():
+    gw = FakeGateway()
+    edge = GatewayEdge(gw, port=0).start()
+    assert "edge" in gw.snapshot()
+    edge.stop()
+    assert "edge" not in gw.snapshot()
+
+
+def test_unary_shed_is_clean_error(edge_factory):
+    """A mid-request shed (engine gave up) maps to its real status on
+    the unary path too — not a 500, not a hang."""
+
+    def shed_late(ticket, on_event):
+        on_event(ticket, ("tokens", [1]))
+        on_event(ticket, ("shed", 504, "deadline exceeded"))
+
+    gw = FakeGateway(script=shed_late)
+    _, url = edge_factory(gw)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url, {"token_ids": [1], "id": "late"})
+    assert e.value.code == 504
+
+
+def test_mid_stream_shed_terminates_stream(edge_factory):
+    """Once headers are committed a shed can't change the status —
+    the stream ends with an in-band error doc + clean terminator."""
+
+    def shed_mid(ticket, on_event):
+        on_event(ticket, ("tokens", [1, 2]))
+        time.sleep(0.05)
+        on_event(ticket, ("shed", 504, "deadline exceeded"))
+
+    gw = FakeGateway(script=shed_mid)
+    _, url = edge_factory(gw)
+    resp = _post(url, {"token_ids": [9], "stream": True, "id": "ms"})
+    assert resp.status == 200  # already committed
+    lines = [json.loads(ln) for ln in resp.read().decode().splitlines()]
+    assert lines[0]["token_ids"] == [1, 2]
+    assert lines[-1]["error"] and lines[-1]["status"] == 504

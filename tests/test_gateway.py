@@ -26,8 +26,8 @@ import numpy as np
 import pytest
 
 from tony_tpu.gateway import (BadRequest, DeadlineExceeded, Gateway,
-                              GatewayClosed, GatewayHTTP, GatewayQueueFull,
-                              GenRequest)
+                              GatewayClosed, GatewayEdge, GatewayHTTP,
+                              GatewayQueueFull, GenRequest)
 from tony_tpu.models import Transformer, TransformerConfig, generate
 from tony_tpu.serve import QueueFull, Request, Server
 
@@ -306,10 +306,15 @@ def test_gateway_history_feeds_portal(tiny, tmp_path):
 # -------------------------------------------------------------- http
 
 
-@pytest.fixture()
-def http_gateway(tiny):
+@pytest.fixture(params=["event", "threaded"])
+def http_gateway(tiny, request):
+    # every front-door contract runs against BOTH edges: the event
+    # loop (default) and the thread-per-connection A/B control
     gw = Gateway(_servers(tiny, 1, chunk_steps=1), max_queue=8).start()
-    http = GatewayHTTP(gw).start()
+    if request.param == "event":
+        http = GatewayEdge(gw).start()
+    else:
+        http = GatewayHTTP(gw).start()
     yield gw, f"http://{http.host}:{http.port}"
     gw.drain(timeout=60)
     http.stop()

@@ -710,6 +710,53 @@ def test_metrics_exposition_consistency_with_remote_stub(tiny):
         agent.stop()
 
 
+def test_metrics_exposition_edge_block(tiny):
+    """ISSUE-16: the exposition-consistency contract extended to the
+    connection plane — with an event edge attached, snapshot() grows
+    an `edge` block and /metrics grows the tony_edge_* families, and
+    the two surfaces agree on every figure."""
+    from tony_tpu.gateway import GatewayEdge
+
+    gw = _mk_gateway(tiny).start()
+    edge = GatewayEdge(gw).start()
+    try:
+        url = f"http://{edge.host}:{edge.port}"
+        body = json.dumps({"token_ids": [1, 2, 3], "max_new_tokens": 3,
+                           "id": "e0"}).encode()
+        req = urllib.request.Request(
+            url + "/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        assert json.loads(urllib.request.urlopen(
+            req, timeout=120).read())["id"] == "e0"
+        text = prometheus_text(gw)
+        types = _validate_exposition(text)
+        snap = gw.snapshot()
+        e = snap["edge"]
+        assert e["kind"] == "event"
+        assert types["tony_edge_threads"] == "gauge"
+        assert types["tony_edge_accepts_total"] == "counter"
+        assert types["tony_edge_requests_total"] == "counter"
+        assert types["tony_edge_slow_client_aborts_total"] == "counter"
+        assert types["tony_edge_conn_limit_sheds_total"] == "counter"
+        assert f'tony_edge_threads {e["threads"]}' in text
+        assert f'tony_edge_max_connections {e["max_connections"]}' \
+            in text
+        # counters only move via edge traffic, so they are exact
+        # across the two snapshots here
+        assert f'tony_edge_requests_total {e["requests"]}' in text
+        assert f'tony_edge_accepts_total {e["accepts"]}' in text
+        assert e["requests"] >= 1 and e["accepts"] >= 1
+        # and /stats through the edge itself carries the same block
+        stats = json.loads(urllib.request.urlopen(
+            url + "/stats", timeout=60).read())
+        assert stats["edge"]["kind"] == "event"
+        assert stats["edge"]["requests"] >= e["requests"]
+    finally:
+        edge.stop()
+        assert "edge" not in gw.snapshot()  # stop() detaches
+        gw.drain(timeout=60)
+
+
 # ------------------------------------------------------ HTTP endpoints
 
 

@@ -455,6 +455,179 @@ class TestAgentTransport:
 
 
 # --------------------------------------------------------------------
+# the multiplexed agent channel (ISSUE-16)
+# --------------------------------------------------------------------
+
+class TestMuxChannel:
+    """ONE long-lived /v1/channel connection carries every ticket
+    stream as tagged frames. make_stub defaults to mux, so the whole
+    remote suite (epoch fence, chaos anchor, disconnect-resume) runs
+    over the channel; this class pins the channel-specific claims."""
+
+    def _drain(self, stub, n, timeout=120.0):
+        got = {}
+        deadline = time.monotonic() + timeout
+        while len(got) < n and time.monotonic() < deadline:
+            for res in stub.step():
+                got[res.id] = list(res.tokens)
+        return got
+
+    def test_64_streams_one_connection_token_exact(self, demo,
+                                                   monkeypatch):
+        from tony_tpu.serve.agent import AgentHandler
+
+        calls = {"stream": 0, "channel": 0}
+        orig_get = AgentHandler.do_GET
+        orig_post = AgentHandler.do_POST
+
+        def counting_get(self):
+            if self.path.startswith("/v1/stream/"):
+                calls["stream"] += 1
+            return orig_get(self)
+
+        def counting_post(self):
+            if self.path.partition("?")[0] == "/v1/channel":
+                calls["channel"] += 1
+            return orig_post(self)
+
+        monkeypatch.setattr(AgentHandler, "do_GET", counting_get)
+        monkeypatch.setattr(AgentHandler, "do_POST", counting_post)
+        agent = start_agent(demo, batch_size=8)
+        stub = make_stub(agent.address)
+        try:
+            reqs = [Request([1 + (i % 5), 2, 3], 4, id=f"m{i}")
+                    for i in range(64)]
+            ctrl = control_outputs(demo, reqs)
+            for r in reqs:
+                stub.submit(r)
+            got = self._drain(stub, len(reqs))
+            assert sorted(got) == sorted(ctrl)
+            for rid, toks in got.items():
+                assert toks == ctrl[rid], rid
+            # the whole fan-in rode ONE channel connection: no
+            # per-ticket stream was ever opened
+            assert calls["channel"] == 1, calls
+            assert calls["stream"] == 0, calls
+            assert stub.transport_stats()["channel"] == "mux"
+            assert stub.reconnects == 0
+        finally:
+            stub.close()
+            agent.stop()
+
+    def test_warm_engine_fast_finish_race(self, demo):
+        """Regression pin: a warm engine can finish a request and the
+        channel deliver EVERY frame before the submit POST returns.
+        The stub pre-registers tickets (and ignores the racing `gone`)
+        so nothing is dropped — this exact shape deadlocked before."""
+        agent = start_agent(demo, batch_size=8)
+        stub = make_stub(agent.address)
+        try:
+            stub.submit(Request([9, 2, 3], 4, id="warm"))
+            assert "warm" in self._drain(stub, 1)
+            # now every submit races a hot engine
+            reqs = [Request([1 + i, 2, 3], 4, id=f"r{i}")
+                    for i in range(8)]
+            ctrl = control_outputs(demo, reqs)
+            for r in reqs:
+                stub.submit(r)
+            got = self._drain(stub, len(reqs), timeout=60.0)
+            assert sorted(got) == sorted(ctrl), got
+            for rid, toks in got.items():
+                assert toks == ctrl[rid], rid
+        finally:
+            stub.close()
+            agent.stop()
+
+    def test_garbled_frame_degrades_not_dies(self, demo, monkeypatch):
+        """WIRE-LEVEL pin for the ISSUE-16 bugfix: one corrupted
+        channel frame must be counted + resynced (reconnect at held
+        offsets), never kill the demux loop — streams stay
+        token-exact."""
+        from tony_tpu.serve.agent import AgentHandler
+
+        orig_chunk = AgentHandler._chunk
+        hits = {"n": 0}
+
+        def corrupting(self, doc):
+            if "token_ids" in doc and "rid" in doc:
+                hits["n"] += 1
+                if hits["n"] == 2:  # swallow a REAL token frame and
+                    # emit garbage instead: both the parse failure and
+                    # the hidden window must heal via resync
+                    data = b'{"rid": ### not json\n'
+                    self.wfile.write(f"{len(data):X}\r\n".encode()
+                                     + data + b"\r\n")
+                    self.wfile.flush()
+                    return
+            return orig_chunk(self, doc)
+
+        monkeypatch.setattr(AgentHandler, "_chunk", corrupting)
+        agent = start_agent(demo, batch_size=4)
+        stub = make_stub(agent.address)
+        try:
+            reqs = [Request([2 + i, 3, 4], 8, id=f"g{i}")
+                    for i in range(4)]
+            ctrl = control_outputs(demo, reqs)
+            for r in reqs:
+                stub.submit(r)
+            got = self._drain(stub, len(reqs))
+            for rid, toks in got.items():
+                assert toks == ctrl[rid], rid
+            assert len(got) == len(reqs)
+            assert stub.garbled_frames >= 1
+            assert stub.transport_stats()["garbled_frames"] >= 1
+        finally:
+            stub.close()
+            agent.stop()
+
+    def test_mux_disconnect_resume_by_offset(self, demo):
+        """The PR-11 resume contract over the channel: injected
+        disconnects mid-channel -> reconnect re-establishes every
+        in-flight stream at its absolute offset, token-exact."""
+        agent = start_agent(demo, batch_size=4)
+        stub = make_stub(agent.address)
+        try:
+            # warm first so faults land mid-decode, not mid-compile
+            stub.submit(Request([8, 8], 2, id="w"))
+            self._drain(stub, 1)
+            stub.transport.fault_plan = FaultPlan(
+                [Fault("disconnect", call=1, times=3)])
+            reqs = [Request([1 + i, 2, 3], 24, id=f"d{i}")
+                    for i in range(4)]
+            ctrl = control_outputs(demo, reqs)
+            for r in reqs:
+                stub.submit(r)
+            got = self._drain(stub, len(reqs), timeout=120.0)
+            for rid, toks in got.items():
+                assert toks == ctrl[rid], rid
+            assert len(got) == len(reqs)
+            assert stub.reconnects >= 1
+        finally:
+            stub.close()
+            agent.stop()
+
+    def test_per_ticket_ab_control(self, demo):
+        """--agent-channel per-ticket: the pre-mux path stays as the
+        A/B control and produces identical outputs."""
+        agent = start_agent(demo, batch_size=4)
+        stub = make_stub(agent.address, agent_channel="per-ticket")
+        try:
+            reqs = [Request([1 + i, 2, 3], 6, id=f"p{i}")
+                    for i in range(4)]
+            ctrl = control_outputs(demo, reqs)
+            for r in reqs:
+                stub.submit(r)
+            got = self._drain(stub, len(reqs))
+            for rid, toks in got.items():
+                assert toks == ctrl[rid], rid
+            assert len(got) == len(reqs)
+            assert stub.transport_stats()["channel"] == "per-ticket"
+        finally:
+            stub.close()
+            agent.stop()
+
+
+# --------------------------------------------------------------------
 # the stub + gateway over remote replicas
 # --------------------------------------------------------------------
 
@@ -647,11 +820,13 @@ class TestRemoteObservability:
         pull_errors and leave lag_s stale, but the replica stays
         HEALTHY, keeps serving with zero 5xx, and its /stats row says
         explicitly that it is unobserved (goodput null) rather than
-        silently omitting the keys."""
+        silently omitting the keys. Per-ticket mode: a pre-ISSUE-15
+        agent predates the mux channel too (under mux the channel
+        itself delivers obs, so the pull path never runs dry)."""
         from tony_tpu.gateway.core import GenRequest
 
         agent = start_agent(demo)
-        stub = make_stub(agent.address)
+        stub = make_stub(agent.address, agent_channel="per-ticket")
         stub._OBS_PATH = "/v1/obs-not-there"  # a pre-ISSUE-15 agent
         gw = make_gateway([stub])
         try:
@@ -866,6 +1041,14 @@ class TestRemoteChaos:
             # mid-compile
             gw.submit(GenRequest([7, 7], max_new_tokens=2,
                                  id="warm")).result(timeout=120)
+
+            # throttle the DOOMED engine (every dispatch sleeps a
+            # beat, well under the stall horizon) so the kill lands
+            # mid-decode even on a warm process — the mux channel
+            # otherwise delivers all six streams before the grafted
+            # span below is ever observed
+            agents[0].agent.server.fault_plan = FaultPlan(
+                [Fault("wedge", dispatch=1, seconds=0.25, times=-1)])
 
             # arm disconnect-mid-stream on the SURVIVOR's transport:
             # times=3 transient — resume-by-offset must absorb it

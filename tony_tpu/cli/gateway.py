@@ -92,6 +92,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--agent-lease-misses", type=int, default=5,
                    help="missed heartbeats before an agent's lease "
                         "expires (see --agent-heartbeat)")
+    p.add_argument("--agent-channel", choices=("mux", "per-ticket"),
+                   default="mux",
+                   help="gateway->agent streaming transport: 'mux' is "
+                        "ONE long-lived connection per replica "
+                        "carrying every ticket stream as tagged "
+                        "frames (reconnect re-establishes all of them "
+                        "at their offsets in one round trip); "
+                        "'per-ticket' keeps the one-connection-per-"
+                        "request readers as the A/B control")
     p.add_argument("--replicas", type=int, default=1,
                    help="data-parallel serve.Server replicas (each with "
                         "its own KV cache and scheduler thread)")
@@ -177,6 +186,37 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000,
                    help="0 picks an ephemeral port")
+    p.add_argument("--edge", choices=("event", "threaded"),
+                   default="event",
+                   help="HTTP front end: 'event' (default) is the "
+                        "selector edge — one loop thread plus a small "
+                        "fixed worker pool holds tens of thousands of "
+                        "concurrent NDJSON streams; 'threaded' is the "
+                        "thread-per-connection stdlib server, kept as "
+                        "the A/B control")
+    p.add_argument("--edge-max-connections", type=int, default=16384,
+                   help="event edge connection breaker: past this "
+                        "many open sockets new connections shed 503 "
+                        "with Retry-After instead of degrading "
+                        "everyone (threaded edge ignores this)")
+    p.add_argument("--edge-workers", type=int, default=4,
+                   help="event edge worker threads for blocking "
+                        "gateway calls (submit, snapshot); the edge "
+                        "itself stays on one loop thread")
+    p.add_argument("--edge-write-buffer-kb", type=int, default=256,
+                   help="event edge per-connection write buffer bound "
+                        "in KiB; a client that cannot keep up beyond "
+                        "it gets --edge-drain-timeout to catch up")
+    p.add_argument("--edge-drain-timeout", type=float, default=10.0,
+                   help="event edge slow-client policy: seconds a "
+                        "full write buffer may take to drain before "
+                        "the stream is aborted (counted, never pins "
+                        "a worker thread)")
+    p.add_argument("--edge-io-timeout", type=float, default=30.0,
+                   help="event edge bound on reading one request "
+                        "(head+body) once its first byte arrives — "
+                        "trickled uploads get 408; IDLE keep-alive "
+                        "connections are exempt and cost nothing")
     p.add_argument("--max-queue", type=int, default=128,
                    help="admission queue bound; past it requests shed "
                         "with 429")
@@ -556,6 +596,7 @@ def remote_server_factory(args):
             heartbeat_interval_s=getattr(args, "agent_heartbeat", 1.0),
             lease_misses=getattr(args, "agent_lease_misses", 5),
             stall_timeout_s=args.stall_timeout,
+            agent_channel=getattr(args, "agent_channel", "mux"),
             transport_faults=FaultPlan.transport_from_env(replica=index),
             agent_proc=proc)
 
@@ -801,7 +842,7 @@ def main(argv=None) -> int:
             print("note: no tokenizer in model dir; token_ids "
                   "requests only", file=sys.stderr)
 
-    from tony_tpu.gateway import GatewayHTTP
+    from tony_tpu.gateway import GatewayEdge, GatewayHTTP
     from tony_tpu.metrics import MetricsStore
 
     gateway = build_gateway(args, model, params, eos,
@@ -809,8 +850,18 @@ def main(argv=None) -> int:
     scaler = build_scaler(args, gateway, model, params, eos)
     if scaler is not None:
         scaler.start()
-    http = GatewayHTTP(gateway, host=args.host, port=args.port,
-                       encode=encode, decode=decode).start()
+    if getattr(args, "edge", "event") == "event":
+        http = GatewayEdge(
+            gateway, host=args.host, port=args.port,
+            encode=encode, decode=decode,
+            max_connections=args.edge_max_connections,
+            workers=args.edge_workers,
+            write_buffer_kb=args.edge_write_buffer_kb,
+            drain_timeout_s=args.edge_drain_timeout,
+            io_timeout_s=args.edge_io_timeout).start()
+    else:
+        http = GatewayHTTP(gateway, host=args.host, port=args.port,
+                           encode=encode, decode=decode).start()
     elastic = "" if scaler is None else \
         (f", autoscale {scaler.min_replicas}-{scaler.max_replicas}")
     n_rep = len(gateway.replicas)

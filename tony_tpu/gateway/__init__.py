@@ -6,7 +6,9 @@ tiers + tenant quotas; ``autoscale`` the elastic control loop driving
 ``Gateway.add_replica``/``remove_replica``; ``remote`` the
 remote-replica stub (serve ON provisioned hosts: a replica agent per
 host, lease heartbeats, epoch fencing, resumable streams); ``http``
-the stdlib network face. The CLI entrypoint is ``python -m
+the stdlib thread-per-connection network face; ``edge`` the
+event-driven selector front end (one loop thread + a small worker
+pool holds tens of thousands of concurrent streams). The CLI entrypoint is ``python -m
 tony_tpu.cli.gateway``; ``tony-tpu generate --serve`` drives the same
 core over stdin/stdout JSONL; ``python -m tony_tpu.cli.replica`` is
 the per-host agent.
@@ -22,6 +24,7 @@ from tony_tpu.gateway.core import (BadRequest, DeadlineExceeded, Gateway,
                                    GatewayQueueFull, GenRequest,
                                    NoHealthyReplicas, QuotaExceeded,
                                    RetryBudgetExhausted, Shed, Ticket)
+from tony_tpu.gateway.edge import GatewayEdge
 from tony_tpu.gateway.http import GatewayHTTP
 from tony_tpu.gateway.remote import (AgentHTTPError, AgentTransport,
                                      RemoteServer, launch_local_agent)
@@ -36,6 +39,7 @@ __all__ = [
     "DeadlineExceeded",
     "Gateway",
     "GatewayClosed",
+    "GatewayEdge",
     "GatewayHTTP",
     "GatewayHistory",
     "GatewayQueueFull",

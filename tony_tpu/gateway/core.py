@@ -1488,6 +1488,11 @@ class Gateway:
         # itself): snapshot() surfaces its status block, drain() stops
         # its loop before closing the fleet
         self.scaler = None
+        # the network face's connection-plane stats provider (ISSUE-16:
+        # gateway/edge.py registers its snapshot fn) — the gateway core
+        # knows nothing about sockets, but /stats and /metrics are the
+        # one pane of glass, so the edge block rides the same snapshot
+        self._edge_stats: Callable | None = None
         # the alert/event bus (obs/alerts.py): a rule engine evaluated
         # on the same consistent snapshot the autoscaler reads, firing
         # deduplicated fire/resolve events into /stats ``alerts``,
@@ -2687,7 +2692,19 @@ class Gateway:
         scaler = self.scaler
         if scaler is not None:
             out["scaler"] = scaler.status()
+        edge = self._edge_stats
+        if edge is not None:
+            try:
+                out["edge"] = edge()
+            except Exception:  # a dying edge must not break /stats
+                log.exception("edge stats provider failed")
         return out
+
+    def register_edge(self, stats_fn: Callable | None) -> None:
+        """Attach the serving edge's connection-plane stats callable
+        (-> dict); its block appears as snapshot()["edge"] and the
+        ``tony_edge_*`` /metrics families. None detaches."""
+        self._edge_stats = stats_fn
 
     def _engine_summary(self, replica_rows: list | None = None,
                         live: list | None = None) -> dict:

@@ -1,15 +1,28 @@
-"""The gateway's network face: a stdlib-only concurrent HTTP server.
+"""The gateway's network face: route logic + the threaded HTTP server.
 
-One handler thread per connection (``ThreadingHTTPServer``, the same
-transport the portal uses) — a slow reader stalls only its own thread,
-never the decode loops, which live on the replica threads behind the
-admission queue. Endpoints:
+Two servers share the route logic in this module:
 
-  POST /v1/generate   submit one request; JSON body (see _parse_body)
+- ``GatewayHTTP`` (here): the original stdlib ``ThreadingHTTPServer``
+  face — one handler thread per connection. Kept as the
+  ``--edge threaded`` A/B control: a slow reader stalls only its own
+  thread, but ten thousand readers are ten thousand OS threads.
+- ``GatewayEdge`` (gateway/edge.py): the event-driven face — tens of
+  thousands of concurrent connections on one loop thread plus a small
+  fixed worker pool (``--edge event``, the default).
+
+Both serve the SAME contracts through the module-level helpers
+(``get_route`` / ``parse_generate`` / ``finish_doc`` /
+``profile_request``), so every test and smoke round carries over
+between them. Endpoints:
+
+  POST /v1/generate   submit one request; JSON body (see parse_generate)
                       {"stream": true} -> chunked NDJSON: one
                       {"id", "token_ids": [delta...]} line per step,
-                      then a final line with finish_reason/metrics.
-                      Otherwise one JSON object when done.
+                      a {"keepalive": true} line when the stream idles
+                      past the keepalive interval (clients filter these
+                      out of token reassembly), then a final line with
+                      finish_reason/metrics. Otherwise one JSON object
+                      when done.
   GET  /healthz       liveness: 200 while the process serves at all;
                       body = per-replica breaker state + heartbeat age
                       ("ok" / "degraded" / "down" — the early-warning
@@ -18,12 +31,9 @@ admission queue. Endpoints:
                       healthy replicas (the load-balancer signal
                       during graceful shutdown and total outage)
   GET  /stats         the Gateway.snapshot() JSON (counters, queue
-                      depths, p50/p95/p99 queue-wait/TTFT/TPOT, and
-                      the engine rollup — prefills/decode steps/
-                      occupancy/wasted_steps plus the engine.spec
-                      speculative-decoding acceptance block, the
-                      engine.prefix hit-rate block, the engine.dispatch
-                      timeline block, and per-replica host gauges)
+                      depths, p50/p95/p99 queue-wait/TTFT/TPOT, the
+                      engine rollup, and — behind the event edge — the
+                      ``edge`` connection-plane block)
   GET  /metrics       Prometheus text exposition (0.0.4) of the same
                       numbers /stats carries: counters, gauges, and
                       lifetime TTFT/TPOT/queue-wait/e2e histograms —
@@ -76,6 +86,15 @@ queue full OR tenant quota (the quota flavor carries Retry-After),
 line is only committed at the FIRST event, so a request shed while
 queued still gets its real status code, not a 200 with an error
 trailer.
+
+Stream keepalives: the agent already emits idle NDJSON keepalive lines
+on its resumable stream (serve/agent.py); the client-facing stream
+used to go silent between tokens, so a slow decode behind a proxy/LB
+idle timeout dropped healthy streams. Both edges now emit the same
+``{"keepalive": true}`` doc once the COMMITTED stream idles past the
+keepalive interval (pre-commit silence is preserved — the lazy status
+contract needs it). Clients reassembling tokens must skip keepalive
+lines (tests pin this).
 """
 
 from __future__ import annotations
@@ -93,6 +112,203 @@ from tony_tpu.gateway.core import Gateway, GenRequest, Shed
 
 log = logging.getLogger(__name__)
 
+# the client-facing stream keepalive cadence (seconds of committed-
+# stream silence before a {"keepalive": true} line) — generous enough
+# to be invisible in normal traffic, tight enough to beat common LB
+# idle timeouts; both edges and the CLI knob default to it
+STREAM_KEEPALIVE_S = 15.0
+
+
+# --------------------------------------------------------------------
+# shared route logic (both network faces serve exactly this)
+# --------------------------------------------------------------------
+
+def readyz_doc(gateway: Gateway) -> tuple[int, dict]:
+    """The /readyz contract: 200 accepting; 503 draining/starting OR
+    zero healthy replicas (every breaker open — shed clean 503s until
+    a probe rejoins one)."""
+    if gateway.ready and gateway.n_healthy > 0:
+        return 200, {"status": "ready"}
+    if gateway.ready:
+        return 503, {"status": "no healthy replicas"}
+    return 503, {"status": "draining" if gateway.draining
+                 else "starting"}
+
+
+def get_route(gateway: Gateway, path: str) -> tuple[int, dict] | None:
+    """Dispatch one JSON GET route; None = not a JSON GET route here
+    (/metrics is text and stays with the caller; unknown paths 404 at
+    the caller too, after it checks its own extras)."""
+    if path == "/healthz":
+        return 200, gateway.health()
+    if path == "/readyz":
+        return readyz_doc(gateway)
+    if path == "/stats":
+        return 200, gateway.snapshot()
+    if path == "/debug/trace":
+        if gateway.traces is None:
+            return 404, {"error": "tracing disabled"}
+        return 200, {"request_ids": gateway.traces.ids()}
+    if path == "/debug/traces":
+        # the browsable listing: ids PLUS terminal tags (outcome,
+        # finish_reason, tokens, attempts) — /debug/trace/<id>
+        # required already knowing the id; this is how you find it
+        if gateway.traces is None:
+            return 404, {"error": "tracing disabled"}
+        return 200, {"capacity": gateway.traces.capacity,
+                     "traces": gateway.traces.summaries()}
+    if path == "/debug/goodput":
+        return 200, gateway.goodput_report()
+    if path.startswith("/debug/trace/"):
+        if gateway.traces is None:
+            return 404, {"error": "tracing disabled"}
+        rid = unquote(path[len("/debug/trace/"):])
+        trace = gateway.traces.get(rid)
+        if trace is None:
+            return 404, {"error": f"no trace for request_id {rid!r} "
+                         f"(buffer keeps the most recent "
+                         f"{gateway.traces.capacity})"}
+        return 200, trace.to_chrome()
+    if path == "/debug/profile":
+        status = gateway.profiler.status()
+        remote = gateway.remote_profile_status()
+        if remote:
+            status["remote"] = remote
+        return 200, status
+    if path == "/debug/bundle":
+        return 200, gateway.debug_bundle()
+    return None
+
+
+def profile_request(gateway: Gateway, query: str) -> tuple[int, dict]:
+    """POST /debug/profile?steps=N[&logdir=<subdir>] — arm an
+    on-demand serving profile (profiler.ServeProfiler). The body is
+    ignored; the knobs ride the query string so `curl -XPOST
+    .../debug/profile?steps=20` is the whole interface. ``logdir``
+    is a RELATIVE name under the server's configured profile dir —
+    an absolute or traversing path would hand any HTTP client an
+    arbitrary-directory write primitive, so it 400s instead."""
+    import os
+
+    params = dict(parse_qsl(query))
+    logdir = None
+    sub = params.get("logdir")
+    if sub:
+        base = os.path.realpath(gateway.profiler.default_logdir)
+        logdir = os.path.realpath(os.path.join(base, sub))
+        if logdir != base and not logdir.startswith(base + os.sep):
+            return 400, {"error": "logdir must be a relative subpath "
+                                  "of the server's profile dir "
+                                  "(--profile-dir)"}
+        # fresh timestamped dir per capture: the xplane parsers sum
+        # every *.xplane.pb under a logdir, so re-using a name would
+        # silently double-count across captures
+        logdir = os.path.join(logdir,
+                              f"profile-{int(time.time() * 1000)}")
+    try:
+        steps = int(params.get("steps", 10))
+        if steps < 1:
+            raise ValueError("steps must be >= 1")
+    except ValueError as e:
+        return 400, {"error": str(e)}
+    has_remote = gateway.has_remote_replicas
+    local_error = None
+    armed_logdir = None
+    if gateway.has_local_replicas:
+        # mixed/local fleets arm this process's profiler too; a
+        # PURE-ROUTER fleet skips it — there is no local jax work
+        # worth capturing. jax's one-global-session constraint is
+        # PER PROCESS, so a local capture already in flight must
+        # not block arming the agents (separate processes): on a
+        # fleet with remotes the local refusal is reported in the
+        # response instead of 409ing the whole fan-out; a
+        # local-only fleet keeps the 409 contract.
+        try:
+            armed_logdir = gateway.profiler.request(steps, logdir)
+        except RuntimeError as e:  # a capture is already in flight
+            if not has_remote:
+                return 409, {"error": str(e)}
+            local_error = str(e)
+        except ValueError as e:
+            return 400, {"error": str(e)}
+    out = {"armed": armed_logdir is not None, "steps": steps,
+           "logdir": armed_logdir}
+    if local_error is not None:
+        out["local_error"] = local_error
+    # remote replicas: fan the capture out to every agent host
+    # (ISSUE-15) — best-effort per host, reported per host; the
+    # xplane files land on each agent's own machine
+    remote = gateway.arm_remote_profiles(steps)
+    if remote:
+        out["remote"] = remote
+        out["armed"] = out["armed"] or any(
+            v.get("armed") for v in remote.values())
+    return 200, out
+
+
+def parse_generate(d: dict,
+                   encode: Callable | None) -> tuple[GenRequest, bool]:
+    """POST /v1/generate body -> (GenRequest, stream flag). Raises
+    ValueError/TypeError on anything malformed — both edges map that
+    to a 400."""
+    if not isinstance(d, dict):
+        raise ValueError("request must be a JSON object")
+    if "token_ids" in d:
+        ids = [int(x) for x in d["token_ids"]]
+    elif "prompt" in d:
+        if encode is None:
+            raise ValueError(
+                "text prompt needs a tokenizer in the model dir; "
+                "send token_ids instead")
+        ids = encode(str(d["prompt"]))
+    else:
+        raise ValueError("request needs token_ids or prompt")
+    ttl = d.get("ttl_s", d.get("timeout_s"))
+    # "request_id" is the documented spelling; "id" accepted for
+    # back-compat. Absent -> the gateway mints a UUID, echoed in
+    # every response/stats/history/trace surface so the client can
+    # correlate its request with the server-side records.
+    rid = d.get("request_id", d.get("id"))
+    tenant = d.get("tenant")
+    priority = d.get("priority")
+    return GenRequest(
+        ids,
+        max_new_tokens=int(d.get("max_new_tokens", 64)),
+        temperature=float(d.get("temperature", 0.0)),
+        top_k=int(d.get("top_k", 0)),
+        seed=int(d.get("seed", 0)),
+        id=rid,
+        ttl_s=float(ttl) if ttl is not None else None,
+        session=d.get("session"),
+        # multi-tenant admission: tier + quota identity (validated
+        # by the gateway — unknown priority names are a 400)
+        tenant=str(tenant) if tenant is not None else None,
+        priority=str(priority) if priority is not None else None,
+    ), bool(d.get("stream", False))
+
+
+def finish_doc(res, metrics: dict, decode: Callable | None) -> dict:
+    """The terminal response document (unary body / stream last line)."""
+    out = {"id": res.id, "request_id": res.id,
+           "token_ids": list(res.prompt) + list(res.tokens),
+           "finish_reason": res.finish_reason, "metrics": metrics}
+    if decode is not None:
+        out["text"] = decode(out["token_ids"])
+    return out
+
+
+def shed_headers(e: Shed) -> dict | None:
+    """Retry-After for the quota 429: an honest machine-readable
+    backoff (whole seconds, ceil'd, floor 1 — "0" reads as "now")."""
+    retry = getattr(e, "retry_after_s", None)
+    if retry is None:
+        return None
+    return {"Retry-After": str(max(1, math.ceil(retry)))}
+
+
+# --------------------------------------------------------------------
+# the threaded face (--edge threaded; the A/B control)
+# --------------------------------------------------------------------
 
 class GatewayHandler(BaseHTTPRequestHandler):
     # bound by GatewayHTTP: the shared Gateway plus optional tokenizer
@@ -100,6 +316,7 @@ class GatewayHandler(BaseHTTPRequestHandler):
     gateway: Gateway
     encode: Callable | None = None
     decode: Callable | None = None
+    keepalive_s: float = STREAM_KEEPALIVE_S
     protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # quiet: requests are metrics,
@@ -109,66 +326,14 @@ class GatewayHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         path = self.path.partition("?")[0]
-        if path == "/healthz":
-            # 200 while the PROCESS serves at all — but the body now
-            # carries per-replica breaker state + heartbeat age, so a
-            # balancer sees "degraded" before anything 503s
-            return self._send(200, self.gateway.health())
-        if path == "/readyz":
-            if self.gateway.ready and self.gateway.n_healthy > 0:
-                return self._send(200, {"status": "ready"})
-            if self.gateway.ready:  # started, zero healthy replicas:
-                # every breaker is open — shed clean 503s until a
-                # probe rejoins one
-                return self._send(503, {"status": "no healthy replicas"})
-            return self._send(503, {"status": "draining"
-                                    if self.gateway.draining
-                                    else "starting"})
-        if path == "/stats":
-            return self._send(200, self.gateway.snapshot())
         if path == "/metrics":
             from tony_tpu.obs import prometheus_text
 
             return self._send_text(200, prometheus_text(self.gateway))
-        if path == "/debug/trace":
-            if self.gateway.traces is None:
-                return self._send(404, {"error": "tracing disabled"})
-            return self._send(200,
-                              {"request_ids": self.gateway.traces.ids()})
-        if path == "/debug/traces":
-            # the browsable listing: ids PLUS terminal tags (outcome,
-            # finish_reason, tokens, attempts) — /debug/trace/<id>
-            # required already knowing the id; this is how you find it
-            if self.gateway.traces is None:
-                return self._send(404, {"error": "tracing disabled"})
-            return self._send(200, {
-                "capacity": self.gateway.traces.capacity,
-                "traces": self.gateway.traces.summaries()})
-        if path == "/debug/goodput":
-            # the roofline ledger report: fleet + per-replica bucket
-            # fractions with the single largest waste bucket named —
-            # "where does the other 67% go", as an endpoint
-            return self._send(200, self.gateway.goodput_report())
-        if path.startswith("/debug/trace/"):
-            if self.gateway.traces is None:
-                return self._send(404, {"error": "tracing disabled"})
-            rid = unquote(path[len("/debug/trace/"):])
-            trace = self.gateway.traces.get(rid)
-            if trace is None:
-                return self._send(404, {"error": f"no trace for "
-                                        f"request_id {rid!r} (buffer "
-                                        f"keeps the most recent "
-                                        f"{self.gateway.traces.capacity})"})
-            return self._send(200, trace.to_chrome())
-        if path == "/debug/profile":
-            status = self.gateway.profiler.status()
-            remote = self.gateway.remote_profile_status()
-            if remote:
-                status["remote"] = remote
-            return self._send(200, status)
-        if path == "/debug/bundle":
-            return self._send(200, self.gateway.debug_bundle())
-        return self._send(404, {"error": "not found"})
+        route = get_route(self.gateway, path)
+        if route is None:
+            return self._send(404, {"error": "not found"})
+        return self._send(*route)
 
     # ------------------------------------------------------------ POST
 
@@ -181,7 +346,7 @@ class GatewayHandler(BaseHTTPRequestHandler):
             return self._send(404, {"error": "not found"})
         try:
             body = self._read_body()
-            req, stream = self._parse_body(body)
+            req, stream = parse_generate(body, self.encode)
             req.t_receive = t_receive  # the trace's http_receive span
         except (TypeError, ValueError) as e:
             # TypeError too: int()/float()/iteration over wrong-typed
@@ -191,14 +356,8 @@ class GatewayHandler(BaseHTTPRequestHandler):
         try:
             ticket = self.gateway.submit(req)
         except Shed as e:
-            headers = None
-            retry = getattr(e, "retry_after_s", None)
-            if retry is not None:
-                # quota 429: an honest machine-readable backoff (whole
-                # seconds, ceil'd, floor 1 — "0" reads as "now")
-                headers = {"Retry-After": str(max(1, math.ceil(retry)))}
             return self._send(e.http_status, {"error": e.reason},
-                              headers=headers)
+                              headers=shed_headers(e))
         try:
             if stream:
                 self._respond_stream(ticket)
@@ -209,15 +368,6 @@ class GatewayHandler(BaseHTTPRequestHandler):
             # and its deadline/shed path handles abandoned successors
 
     def _profile_request(self, query: str) -> None:
-        """POST /debug/profile?steps=N[&logdir=<subdir>] — arm an
-        on-demand serving profile (profiler.ServeProfiler). The body is
-        ignored; the knobs ride the query string so `curl -XPOST
-        .../debug/profile?steps=20` is the whole interface. ``logdir``
-        is a RELATIVE name under the server's configured profile dir —
-        an absolute or traversing path would hand any HTTP client an
-        arbitrary-directory write primitive, so it 400s instead."""
-        import os
-
         length = int(self.headers.get("Content-Length") or 0)
         if length > 1 << 20:
             # refusing to drain an arbitrarily large body; 413 closes
@@ -226,63 +376,7 @@ class GatewayHandler(BaseHTTPRequestHandler):
             return self._send(413, {"error": "request body too large"})
         if length > 0:  # drain: unread body bytes would desync a
             self.rfile.read(length)  # keep-alive socket
-        params = dict(parse_qsl(query))
-        logdir = None
-        sub = params.get("logdir")
-        if sub:
-            base = os.path.realpath(
-                self.gateway.profiler.default_logdir)
-            logdir = os.path.realpath(os.path.join(base, sub))
-            if logdir != base and not logdir.startswith(base + os.sep):
-                return self._send(400, {
-                    "error": "logdir must be a relative subpath of "
-                             "the server's profile dir "
-                             "(--profile-dir)"})
-            # fresh timestamped dir per capture: the xplane parsers sum
-            # every *.xplane.pb under a logdir, so re-using a name
-            # would silently double-count across captures
-            logdir = os.path.join(logdir,
-                                  f"profile-{int(time.time() * 1000)}")
-        try:
-            steps = int(params.get("steps", 10))
-            if steps < 1:
-                raise ValueError("steps must be >= 1")
-        except ValueError as e:
-            return self._send(400, {"error": str(e)})
-        has_remote = self.gateway.has_remote_replicas
-        local_error = None
-        armed_logdir = None
-        if self.gateway.has_local_replicas:
-            # mixed/local fleets arm this process's profiler too; a
-            # PURE-ROUTER fleet skips it — there is no local jax work
-            # worth capturing. jax's one-global-session constraint is
-            # PER PROCESS, so a local capture already in flight must
-            # not block arming the agents (separate processes): on a
-            # fleet with remotes the local refusal is reported in the
-            # response instead of 409ing the whole fan-out; a
-            # local-only fleet keeps the 409 contract.
-            try:
-                armed_logdir = self.gateway.profiler.request(steps,
-                                                             logdir)
-            except RuntimeError as e:  # a capture is already in flight
-                if not has_remote:
-                    return self._send(409, {"error": str(e)})
-                local_error = str(e)
-            except ValueError as e:
-                return self._send(400, {"error": str(e)})
-        out = {"armed": armed_logdir is not None, "steps": steps,
-               "logdir": armed_logdir}
-        if local_error is not None:
-            out["local_error"] = local_error
-        # remote replicas: fan the capture out to every agent host
-        # (ISSUE-15) — best-effort per host, reported per host; the
-        # xplane files land on each agent's own machine
-        remote = self.gateway.arm_remote_profiles(steps)
-        if remote:
-            out["remote"] = remote
-            out["armed"] = out["armed"] or any(
-                v.get("armed") for v in remote.values())
-        return self._send(200, out)
+        return self._send(*profile_request(self.gateway, query))
 
     def _read_body(self) -> dict:
         length = int(self.headers.get("Content-Length") or 0)
@@ -299,49 +393,7 @@ class GatewayHandler(BaseHTTPRequestHandler):
             raise ValueError("request must be a JSON object")
         return body
 
-    def _parse_body(self, d: dict) -> tuple[GenRequest, bool]:
-        if "token_ids" in d:
-            ids = [int(x) for x in d["token_ids"]]
-        elif "prompt" in d:
-            if self.encode is None:
-                raise ValueError(
-                    "text prompt needs a tokenizer in the model dir; "
-                    "send token_ids instead")
-            ids = self.encode(str(d["prompt"]))
-        else:
-            raise ValueError("request needs token_ids or prompt")
-        ttl = d.get("ttl_s", d.get("timeout_s"))
-        # "request_id" is the documented spelling; "id" accepted for
-        # back-compat. Absent -> the gateway mints a UUID, echoed in
-        # every response/stats/history/trace surface so the client can
-        # correlate its request with the server-side records.
-        rid = d.get("request_id", d.get("id"))
-        tenant = d.get("tenant")
-        priority = d.get("priority")
-        return GenRequest(
-            ids,
-            max_new_tokens=int(d.get("max_new_tokens", 64)),
-            temperature=float(d.get("temperature", 0.0)),
-            top_k=int(d.get("top_k", 0)),
-            seed=int(d.get("seed", 0)),
-            id=rid,
-            ttl_s=float(ttl) if ttl is not None else None,
-            session=d.get("session"),
-            # multi-tenant admission: tier + quota identity (validated
-            # by the gateway — unknown priority names are a 400)
-            tenant=str(tenant) if tenant is not None else None,
-            priority=str(priority) if priority is not None else None,
-        ), bool(d.get("stream", False))
-
     # -------------------------------------------------------- responses
-
-    def _finish_doc(self, res, metrics: dict) -> dict:
-        out = {"id": res.id, "request_id": res.id,
-               "token_ids": list(res.prompt) + list(res.tokens),
-               "finish_reason": res.finish_reason, "metrics": metrics}
-        if self.decode is not None:
-            out["text"] = self.decode(out["token_ids"])
-        return out
 
     def _respond_unary(self, ticket) -> None:
         try:
@@ -350,14 +402,27 @@ class GatewayHandler(BaseHTTPRequestHandler):
             return self._send(e.http_status, {"error": e.reason})
         # ticket.metrics is the replica's canonical per-request record
         # (same dict the stream's final line and /stats window carry)
-        self._send(200, self._finish_doc(res, ticket.metrics or {}))
+        self._send(200, finish_doc(res, ticket.metrics or {},
+                                   self.decode))
 
     def _respond_stream(self, ticket) -> None:
         """Chunked NDJSON. Headers are sent lazily at the first event
-        so sheds keep their real status code."""
+        so sheds keep their real status code; once committed, idle
+        gaps longer than the keepalive interval emit a keepalive line
+        (filtered by clients) so slow decodes survive LB idle
+        timeouts."""
+        import queue as _queue
+
         headers_sent = False
         while True:
-            kind, *rest = ticket.events.get()
+            try:
+                # pre-commit: block without keepalives (nothing may be
+                # written before the status line)
+                kind, *rest = ticket.events.get(
+                    timeout=self.keepalive_s if headers_sent else None)
+            except _queue.Empty:
+                self._chunk({"keepalive": True})
+                continue
             if kind == "tokens":
                 if not headers_sent:
                     self._start_stream()
@@ -370,7 +435,7 @@ class GatewayHandler(BaseHTTPRequestHandler):
                 if not headers_sent:
                     self._start_stream()
                     headers_sent = True
-                self._chunk(self._finish_doc(res, metrics))
+                self._chunk(finish_doc(res, metrics, self.decode))
                 self.wfile.write(b"0\r\n\r\n")
                 return
             elif kind == "shed":
@@ -430,11 +495,13 @@ class GatewayHTTP:
 
     def __init__(self, gateway: Gateway, host: str = "127.0.0.1",
                  port: int = 0, encode: Callable | None = None,
-                 decode: Callable | None = None):
+                 decode: Callable | None = None,
+                 keepalive_s: float = STREAM_KEEPALIVE_S):
         handler = type("BoundGatewayHandler", (GatewayHandler,),
                        {"gateway": gateway, "encode": staticmethod(encode)
                         if encode else None,
-                        "decode": staticmethod(decode) if decode else None})
+                        "decode": staticmethod(decode) if decode else None,
+                        "keepalive_s": max(0.05, keepalive_s)})
         self.server = ThreadingHTTPServer((host, port), handler)
         self.server.daemon_threads = True
         self.host, self.port = self.server.server_address[:2]

@@ -26,14 +26,24 @@ changes is only what a network adds:
   itself refuses calls older than what it has adopted (409) — a
   wedged-then-revived host can neither deliver stale tokens nor
   accept stale work.
-- **Resume, not failover, for connection blips**: each in-flight
-  request has a reader thread on the agent's resumable NDJSON stream
-  (absolute token offsets). A dropped connection to a HEALTHY agent
-  reconnects at ``offset = tokens already held`` and the stream
-  continues exactly — no retry budget charged, no replica failed.
-  Connect errors retry with capped exponential backoff + jitter
-  *within* the lease (a transient blip is not a failover); only the
-  lease decides death.
+- **Resume, not failover, for connection blips**: every in-flight
+  request streams at absolute token offsets, so a dropped connection
+  to a HEALTHY agent reconnects at ``offset = tokens already held``
+  and the stream continues exactly — no retry budget charged, no
+  replica failed. Connect errors retry with capped exponential
+  backoff + jitter *within* the lease (a transient blip is not a
+  failover); only the lease decides death.
+- **ONE multiplexed channel per replica** (ISSUE-16, the default):
+  all of a replica's ticket streams ride a single long-lived
+  ``POST /v1/channel`` connection as tagged NDJSON frames
+  (``{"rid", "off", "token_ids"}`` / ``{"rid", "done", "result"}``),
+  demuxed by ONE thread — connections and reader threads stop
+  scaling with the replica's batch size. Reconnect re-establishes
+  every in-flight stream at its offset in one round trip (the resume
+  map rides the request body); the epoch fence and the PR-15 obs
+  batches ride the same frames. ``agent_channel="per-ticket"``
+  (``--agent-channel`` in the CLI) keeps the original
+  one-connection-per-stream path as the A/B control.
 - **Typed refusals**: the agent maps engine refusals to ``kind`` tags
   and the stub re-raises the real types (``QueueFull``,
   ``PoolExhausted``, ``ValueError``), so the gateway's admission
@@ -117,7 +127,18 @@ class AgentTransport:
     exponential backoff with jitter on CONNECT errors (refused/reset
     before a response) — the in-lease transient-blip absorber. Read
     timeouts are never retried here: the caller already paid the
-    wait, and the lease is the authority on death."""
+    wait, and the lease is the authority on death.
+
+    Control calls (``call()``: healthz / obs / submit / reset / drain)
+    ride ONE persistent keep-alive connection (ISSUE-16): a heartbeat
+    every second used to pay a TCP handshake every second, and under
+    load the submits compounded that. The connection is rebuilt on any
+    error; a REUSED connection that fails is the classic stale-keep-
+    alive race (the agent closed it between our calls), so those
+    failures stay in the retryable class — one backoff lap gets a
+    fresh socket. Per-call timeout bounds still apply (the socket's
+    deadline is set per request), so the obs pull's lease-slack bound
+    carries over unchanged."""
 
     def __init__(self, address: str, *, connect_timeout_s: float = 2.0,
                  read_timeout_s: float = 5.0, connect_retries: int = 3,
@@ -140,6 +161,11 @@ class AgentTransport:
         self.connect_errors = 0  # connect errors seen (pre-retry)
         self._lock = threading.Lock()
         self._rng = random.Random(0xA9E27 ^ hash(address))
+        # the persistent control connection: all call()s serialize on
+        # it (they are small and bounded; streams get their own
+        # sockets). None = rebuild on next use.
+        self._ctrl: http.client.HTTPConnection | None = None
+        self._ctrl_lock = threading.Lock()
 
     def _backoff(self, attempt: int) -> float:
         base = min(self.backoff_max_s,
@@ -150,15 +176,58 @@ class AgentTransport:
         with self._lock:
             return base * (0.5 + 0.5 * self._rng.random())
 
+    def close(self) -> None:
+        """Drop the persistent control connection (stub shutdown)."""
+        with self._ctrl_lock:
+            self._drop_ctrl()
+
+    def _drop_ctrl(self) -> None:
+        # caller holds _ctrl_lock
+        if self._ctrl is not None:
+            try:
+                self._ctrl.close()
+            except Exception:  # noqa: BLE001 — closing a broken socket
+                pass
+            self._ctrl = None
+
+    def _ctrl_roundtrip(self, method: str, path: str,
+                        body: bytes | None, epoch: int,
+                        timeout: float) -> tuple[int, bytes]:
+        """One request/response on the persistent control connection.
+        Caller holds ``_ctrl_lock``."""
+        if self._ctrl is None:
+            self._ctrl = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.connect_timeout_s)
+            self._ctrl.connect()
+        conn = self._ctrl
+        if conn.sock is not None:
+            # the per-call deadline (heartbeat bound, obs lease-slack
+            # bound, drain budget) applies to THIS round trip, not the
+            # connection's construction default
+            conn.sock.settimeout(timeout)
+        conn.request(method, path, body=body, headers={
+            "X-Tony-Epoch": str(epoch),
+            "Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        if resp.will_close:
+            # the agent asked to close (its >=400 replies do): honor it
+            # now rather than discovering a dead socket next call
+            self._drop_ctrl()
+        return resp.status, data
+
     def call(self, method: str, path: str, doc: dict | None = None,
              *, epoch: int = 0, request=None,
              timeout: float | None = None) -> dict:
-        """One JSON request/response. Raises ``AgentHTTPError`` on a
-        non-200, ``ConnectionError``/``TimeoutError`` on transport
-        failure (after in-lease connect retries)."""
+        """One JSON request/response over the persistent control
+        connection. Raises ``AgentHTTPError`` on a non-200,
+        ``ConnectionError``/``TimeoutError`` on transport failure
+        (after in-lease connect retries)."""
         attempt = 0
+        tmo = timeout if timeout is not None else self.read_timeout_s
+        body = None if doc is None else json.dumps(doc).encode()
         while True:
-            conn = None
+            reused = False
             try:
                 # the fault hook INSIDE the retry scope: an injected
                 # refusal must exercise the same backoff path a real
@@ -166,49 +235,68 @@ class AgentTransport:
                 if self.fault_plan is not None:
                     self.fault_plan.on_call(f"{method} {path}",
                                             request=request)
-                conn = http.client.HTTPConnection(
-                    self.host, self.port,
-                    timeout=timeout if timeout is not None
-                    else self.read_timeout_s)
-                body = None if doc is None else json.dumps(doc).encode()
-                conn.request(method, path, body=body, headers={
-                    "X-Tony-Epoch": str(epoch),
-                    "Content-Type": "application/json"})
-                resp = conn.getresponse()
-                data = resp.read()
+                with self._ctrl_lock:
+                    reused = self._ctrl is not None
+                    try:
+                        status, data = self._ctrl_roundtrip(
+                            method, path, body, epoch, tmo)
+                    except BaseException:
+                        self._drop_ctrl()  # never reuse a socket in an
+                        raise              # unknown protocol state
                 out = json.loads(data) if data else {}
-                if resp.status != 200:
-                    raise AgentHTTPError(resp.status, out)
+                if status != 200:
+                    raise AgentHTTPError(status, out)
                 return out
-            except (ConnectionError, TimeoutError, OSError) as e:
-                refused = isinstance(e, (ConnectionRefusedError,
-                                         ConnectionResetError,
-                                         BrokenPipeError))
+            except (ConnectionError, TimeoutError, OSError,
+                    http.client.HTTPException) as e:
+                # retryable: refused-class (dead port mid-restart), or
+                # ANY non-timeout failure on a REUSED connection — the
+                # agent may simply have closed the idle keep-alive
+                # under us (HTTPException covers the garbled half-read
+                # that race can leave). Timeouts are never retried:
+                # the caller already paid the wait.
+                retryable = isinstance(e, (ConnectionRefusedError,
+                                           ConnectionResetError,
+                                           BrokenPipeError)) \
+                    or (reused and not isinstance(e, TimeoutError))
                 with self._lock:
                     self.connect_errors += 1
-                if not refused or attempt >= self.connect_retries:
+                if not retryable or attempt >= self.connect_retries:
+                    if isinstance(e, http.client.HTTPException) and \
+                            not isinstance(e, ConnectionError):
+                        # callers catch the ConnectionError family;
+                        # a garbled response is transport trouble too
+                        raise ConnectionError(
+                            f"garbled agent response: {e!r}") from e
                     raise
                 with self._lock:
                     self.retries += 1
                 time.sleep(self._backoff(attempt))
                 attempt += 1
-            finally:
-                if conn is not None:
-                    conn.close()
 
-    def stream_lines(self, path: str, *, epoch: int = 0, request=None):
-        """Generator over one NDJSON stream's parsed docs. Transport
+    def stream_lines(self, path: str, *, epoch: int = 0, request=None,
+                     method: str = "GET", doc: dict | None = None):
+        """Generator over one NDJSON stream's parsed docs (its own
+        dedicated socket — never the control connection). Transport
         trouble mid-stream raises; a clean server-side close just ends
         the generator (the reader's resume logic treats both as a
         disconnect). No internal retry — resume-by-offset IS the
-        retry, and it needs the caller's current offset."""
+        retry, and it needs the caller's current offset.
+
+        A line that fails to parse is NOT fatal: it yields a
+        ``{"_garbled": true}`` sentinel so the reader can count it and
+        resync (reconnect at the offsets it holds) instead of dying —
+        one corrupt frame on a multiplexed channel must not take down
+        every stream riding it."""
         if self.fault_plan is not None:
-            self.fault_plan.on_call(f"GET {path}", request=request)
+            self.fault_plan.on_call(f"{method} {path}", request=request)
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.read_timeout_s)
         try:
-            conn.request("GET", path,
-                         headers={"X-Tony-Epoch": str(epoch)})
+            body = None if doc is None else json.dumps(doc).encode()
+            conn.request(method, path, body=body,
+                         headers={"X-Tony-Epoch": str(epoch),
+                                  "Content-Type": "application/json"})
             resp = conn.getresponse()
             if resp.status != 200:
                 raise AgentHTTPError(resp.status,
@@ -219,7 +307,10 @@ class AgentTransport:
                 line = resp.readline()
                 if not line:
                     return
-                yield json.loads(line)
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    yield {"_garbled": True}
         except (ConnectionError, TimeoutError, OSError):
             with self._lock:
                 self.connect_errors += 1
@@ -276,15 +367,23 @@ class RemoteTimeline:
 
 class _RemoteTicket:
     """One in-flight request's stub-side record: the absolute token
-    sequence received so far plus the terminal result doc."""
+    sequence received so far plus the terminal result doc.
 
-    __slots__ = ("id", "epoch", "tokens", "result")
+    ``confirmed`` = the agent's submit response has been read. In mux
+    mode tickets register BEFORE the submit POST (the channel can race
+    a fast engine and deliver frames before the POST returns — they
+    must find the ticket), so an agent-side ``gone`` frame is only
+    believed for confirmed tickets: before confirmation it just means
+    the channel's resume raced our in-flight submit."""
 
-    def __init__(self, request_id, epoch: int):
+    __slots__ = ("id", "epoch", "tokens", "result", "confirmed")
+
+    def __init__(self, request_id, epoch: int, confirmed: bool = True):
         self.id = request_id
         self.epoch = epoch
         self.tokens: list[int] = []
         self.result: dict | None = None
+        self.confirmed = confirmed
 
 
 class _RemoteSlots:
@@ -321,12 +420,22 @@ class RemoteServer:
                  lease_misses: int = 5, connect_timeout_s: float = 2.0,
                  read_timeout_s: float = 5.0, boot_timeout_s: float = 60.0,
                  stall_timeout_s: float = 30.0, obs_pull: bool = True,
+                 agent_channel: str = "mux",
                  transport_faults=None, agent_proc=None):
+        if agent_channel not in ("mux", "per-ticket"):
+            raise ValueError(f"agent_channel must be 'mux' or "
+                             f"'per-ticket', got {agent_channel!r}")
         self.transport = AgentTransport(
             address, connect_timeout_s=connect_timeout_s,
             read_timeout_s=read_timeout_s, fault_plan=transport_faults)
         self.transport_faults = transport_faults
         self.host_addr = address
+        # ISSUE-16: "mux" (default) carries every ticket's stream +
+        # the obs batches over ONE long-lived /v1/channel connection
+        # demuxed by a single thread; "per-ticket" is the original
+        # one-connection-one-thread-per-stream path, kept for A/B
+        self.agent_channel = agent_channel
+        self._channel_thread: threading.Thread | None = None
         self.heartbeat_interval_s = max(0.05, heartbeat_interval_s)
         self.lease_misses = max(1, lease_misses)
         self.stall_timeout_s = stall_timeout_s
@@ -346,6 +455,7 @@ class RemoteServer:
         self.stale_epoch_drops = 0
         self.lease_expiries = 0
         self.heartbeat_failures = 0
+        self.garbled_frames = 0  # corrupt NDJSON frames survived
         self._rtt_ms = 0.0  # EMA over heartbeat round trips
         self._last_hb = time.monotonic()
         # fleet observability (ISSUE-15): the pulled timeline/ledger +
@@ -535,6 +645,15 @@ class RemoteServer:
             with self._stats_lock:
                 self.obs_pull_errors += 1
             return
+        self._ingest_obs_batch(doc)
+
+    def _ingest_obs_batch(self, doc: dict) -> None:
+        """Land one /v1/obs document — from the heartbeat-cadence GET
+        or from an ``obs`` frame riding the multiplexed channel. The
+        two producers dedup against each other by cursor/seq inside
+        ``_ingest_obs_records``."""
+        if not isinstance(doc, dict):
+            return
         try:
             cursor = int(doc.get("cursor", self._obs_cursor))
         except (TypeError, ValueError):
@@ -562,7 +681,9 @@ class RemoteServer:
         skip seqs a fragment already landed. An agent restart (cursor
         regression) resets the seq space."""
         with self._stats_lock:
-            if new_cursor is not None and new_cursor < self._obs_cursor:
+            regressed = new_cursor is not None \
+                and new_cursor < self._obs_cursor
+            if regressed:
                 # agent restarted: its seq space began again — and so,
                 # possibly, did its CLOCK (a host reboot restarts
                 # CLOCK_MONOTONIC): the offset EWMA re-seeds from the
@@ -589,6 +710,11 @@ class RemoteServer:
                     if rec.seq <= self._obs_cursor:
                         continue  # the puller already landed it
                     self._obs_stream_seen.add(rec.seq)
+                elif not regressed and rec.seq <= self._obs_cursor:
+                    # TWO pull producers exist now (the heartbeat GET
+                    # and the channel's obs frames): whichever lands a
+                    # window second must not re-land its records
+                    continue
                 # agent monotonic -> gateway monotonic, with the
                 # honest error bar stamped on the record (and thus on
                 # any trace span grafted from it)
@@ -676,11 +802,27 @@ class RemoteServer:
                 else encode_array(logits),
             }
             path = "/v1/handoff"
+        # Mux mode pre-registers the ticket: a warm engine can finish
+        # the request and the channel deliver every frame BEFORE this
+        # submit POST returns — the demux must find the ticket or the
+        # result is dropped on the floor. The ticket stays unconfirmed
+        # until the response lands so a racing ``gone`` frame (the
+        # channel resumed before the agent saw the submit) is ignored.
+        pre = self.agent_channel == "mux" and request.id is not None
+        if pre:
+            with self._cond:
+                ticket = _RemoteTicket(request.id, self.epoch,
+                                       confirmed=False)
+                self._tickets[request.id] = ticket
+                self._cond.notify_all()  # wake a parked channel loop
+            self._ensure_channel()
         try:
             resp = self.transport.call("POST", path, doc,
                                        epoch=self.epoch,
                                        request=request.id)
         except AgentHTTPError as e:
+            if pre:
+                self._unregister(request.id)
             kind = e.doc.get("kind", "")
             if kind == "QueueFull":
                 raise QueueFull(e.doc.get("error", str(e))) from None
@@ -695,14 +837,46 @@ class RemoteServer:
             # cannot take work right now — surface as a transport
             # failure so the scheduler's failover path owns it
             raise ConnectionError(str(e)) from e
+        except Exception:
+            if pre:
+                self._unregister(request.id)
+            raise
         rid = resp.get("id", request.id)
         with self._cond:
-            ticket = _RemoteTicket(rid, self.epoch)
-            self._tickets[rid] = ticket
-        threading.Thread(target=self._read_stream, args=(ticket,),
-                         name=f"agent-stream-{self.host_addr}",
-                         daemon=True).start()
+            ticket = self._tickets.get(rid) if pre and rid == request.id \
+                else None
+            if ticket is None or ticket.epoch != self.epoch:
+                ticket = _RemoteTicket(rid, self.epoch)
+                self._tickets[rid] = ticket
+            ticket.confirmed = True
+            self._cond.notify_all()  # wake a parked channel loop
+        if self.agent_channel == "mux":
+            # the multiplexed channel: one demux loop carries every
+            # ticket — the agent discovers new tickets automatically,
+            # so a submit is just bookkeeping plus (once) the thread
+            self._ensure_channel()
+        else:
+            threading.Thread(target=self._read_stream, args=(ticket,),
+                             name=f"agent-stream-{self.host_addr}",
+                             daemon=True).start()
         return rid
+
+    def _unregister(self, rid) -> None:
+        """Drop a pre-registered ticket whose submit never landed (the
+        POST failed) — unless frames already carried a result to it."""
+        with self._cond:
+            t = self._tickets.get(rid)
+            if t is not None and t.result is None and not t.confirmed:
+                del self._tickets[rid]
+
+    def _ensure_channel(self) -> None:
+        with self._stats_lock:
+            if self._channel_thread is not None:
+                return
+            self._channel_thread = threading.Thread(
+                target=self._channel_loop,
+                name=f"agent-channel-{self.host_addr}", daemon=True)
+        self._channel_thread.start()
 
     def step(self) -> list:
         """One scheduler beat: wait briefly for stream progress, then
@@ -770,6 +944,140 @@ class RemoteServer:
 
     # -------------------------------------------------- stream reader
 
+    def _channel_loop(self) -> None:
+        """The multiplexed channel's ONE demux thread (ISSUE-16): a
+        long-lived POST /v1/channel connection carries every ticket's
+        stream as tagged frames plus the incremental obs batches; this
+        loop places token windows by absolute offset, lands results,
+        and on ANY disconnect reconnects with the full resume map —
+        every in-flight stream re-established at its offset in one
+        round trip. A garbled frame degrades (counted, resynced via
+        reconnect — absolute offsets make the resume exact), never
+        kills the loop. Parks while the replica is marked dead; the
+        breaker's reset() revives it under the bumped epoch."""
+        attempt = 0
+        while not self._closed:
+            with self._cond:
+                if self._dead is not None:
+                    self._cond.wait(timeout=0.25)
+                    continue
+                epoch = self.epoch
+                resume = [[t.id, len(t.tokens)]
+                          for t in self._tickets.values()
+                          if t.result is None and t.epoch == epoch]
+            body = {"epoch": epoch, "streams": resume}
+            if self._obs_enabled:
+                with self._stats_lock:
+                    body["obs_cursor"] = self._obs_cursor
+            # ``resync``: the channel ended deliberately (stale epoch,
+            # garbled frame, gap) — reconnect immediately, without the
+            # disconnect counter or backoff a NETWORK failure gets
+            resync = False
+            try:
+                for doc in self.transport.stream_lines(
+                        "/v1/channel", epoch=epoch, method="POST",
+                        doc=body):
+                    if self._closed:
+                        return
+                    if doc.get("_garbled"):
+                        with self._stats_lock:
+                            self.garbled_frames += 1
+                        resync = True
+                        break
+                    if doc.get("stale") or doc.get("epoch") != epoch:
+                        # the fence: the agent (or we) moved on — drop
+                        # the channel, reconnect under the current epoch
+                        with self._stats_lock:
+                            self.stale_epoch_drops += 1
+                        resync = True
+                        break
+                    if doc.get("keepalive") or doc.get("channel"):
+                        attempt = 0
+                        continue
+                    try:
+                        if "obs" in doc and "rid" not in doc:
+                            # the PR-15 pull, riding the channel
+                            if self._obs_pull:
+                                self._ingest_obs_batch(doc["obs"])
+                            attempt = 0
+                            continue
+                        if "error" in doc and "rid" not in doc:
+                            # the agent's ENGINE failed: same funnel
+                            # as a dead dispatch
+                            self._note_dead(
+                                f"agent {self.host_addr} reported: "
+                                f"{doc['error']}")
+                            break
+                        rid = doc.get("rid")
+                        with self._cond:
+                            ticket = self._tickets.get(rid)
+                        if ticket is None or ticket.epoch != epoch:
+                            continue  # collected, or a late frame
+                        if doc.get("gone"):
+                            if not ticket.confirmed:
+                                # channel resume raced an in-flight
+                                # submit: the agent hasn't seen the
+                                # ticket *yet* — its discovery loop
+                                # picks it up once the POST lands
+                                continue
+                            # the agent no longer knows an in-flight
+                            # ticket: it restarted (state gone) —
+                            # everything it held must fail over
+                            self._note_dead(
+                                f"agent {self.host_addr} lost request "
+                                f"{rid!r} (agent restart?)")
+                            break
+                        if "token_ids" in doc:
+                            self._place(ticket, int(doc["off"]),
+                                        [int(x) for x in
+                                         doc["token_ids"]])
+                            attempt = 0
+                        if doc.get("done"):
+                            obs = doc.get("obs")
+                            if obs and self._obs_enabled:
+                                self._ingest_obs_records(obs)
+                            with self._cond:
+                                if ticket.epoch == self.epoch:
+                                    ticket.result = doc["result"]
+                                    self._progress = True
+                                    self._cond.notify_all()
+                    except Exception as e:
+                        # ANY malformed frame — a gap RuntimeError
+                        # from _place (a garbled frame HID a window),
+                        # a done frame missing its result, an obs
+                        # batch that fails to parse — degrades: count
+                        # it and resync-reconnect (absolute offsets
+                        # make the resume exact). The demux thread
+                        # must never die to one bad frame.
+                        log.warning("agent %s channel frame rejected "
+                                    "(%r) — resyncing",
+                                    self.host_addr, e)
+                        with self._stats_lock:
+                            self.garbled_frames += 1
+                        resync = True
+                        break
+                # EOF without a terminal frame: mid-stream disconnect
+            except AgentHTTPError as e:
+                if e.status == 409:
+                    with self._stats_lock:
+                        self.stale_epoch_drops += 1
+                    resync = True  # re-open under the adopted epoch
+                else:
+                    log.warning("agent %s channel error: %s",
+                                self.host_addr, e)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                log.debug("agent %s channel disconnect: %r",
+                          self.host_addr, e)
+            if self._closed:
+                return
+            if resync:
+                time.sleep(0.01)  # bounds a pathological 409 spin
+            else:
+                with self._stats_lock:
+                    self.reconnects += 1
+                time.sleep(self.transport._backoff(attempt))
+                attempt = min(attempt + 1, 8)
+
     def _read_stream(self, ticket: _RemoteTicket) -> None:
         """One in-flight request's reader: follow the agent's NDJSON
         stream, placing token windows by ABSOLUTE offset; on any
@@ -790,6 +1098,12 @@ class RemoteServer:
             try:
                 for doc in self.transport.stream_lines(
                         path, epoch=ticket.epoch, request=ticket.id):
+                    if doc.get("_garbled"):
+                        # corrupt frame: count it and resync by
+                        # reconnecting at the offset already held
+                        with self._stats_lock:
+                            self.garbled_frames += 1
+                        break
                     if doc.get("epoch") != ticket.epoch:
                         # a revived host talking from another epoch:
                         # the fence — count and drop the whole stream
@@ -884,6 +1198,13 @@ class RemoteServer:
                 "heartbeat_age_s": round(
                     time.monotonic() - self._last_hb, 3),
                 "lease_s": round(self.lease_s, 3),
+                # which stream carrier this stub runs ("mux" = one
+                # multiplexed /v1/channel connection; "per-ticket" =
+                # the A/B control) and the demux loop's resilience
+                # counter — a non-zero garbled_frames with healthy
+                # streams IS the degrade-don't-die contract working
+                "channel": self.agent_channel,
+                "garbled_frames": self.garbled_frames,
                 "reconnects": self.reconnects,
                 "retries": self.transport.retries,
                 "connect_errors": self.transport.connect_errors,
@@ -948,6 +1269,7 @@ class RemoteServer:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
+        self.transport.close()  # drop the persistent control conn
 
 
 def launch_local_agent(agent_args: list[str], *, port_file: str,

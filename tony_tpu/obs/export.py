@@ -290,6 +290,49 @@ def prometheus_text(gateway) -> str:
                 "job dir (the ISSUE-15 flight recorder)",
                 bundles.get("written", 0))
 
+    # the connection-plane block (ISSUE-16): the event edge's socket
+    # economics — how many streams one loop thread is holding, and
+    # what got shed or aborted to keep it that way
+    edge = snap.get("edge") or {}
+    if edge:
+        gauge("tony_edge_threads",
+              "Edge threads, FIXED at loop + worker pool "
+              "(the denominator of the streams-per-thread claim)",
+              edge["threads"])
+        gauge("tony_edge_open_connections",
+              "Sockets currently open on the edge",
+              edge["open_connections"])
+        gauge("tony_edge_active_streams",
+              "NDJSON token streams currently in flight",
+              edge["active_streams"])
+        gauge("tony_edge_max_connections",
+              "Connection breaker threshold (503 past it)",
+              edge["max_connections"])
+        gauge("tony_edge_accepts_per_second",
+              "Recent connection-accept rate",
+              edge["accepts_per_s"])
+        gauge("tony_edge_write_buffer_hwm_bytes",
+              "High-water mark of any connection's write buffer",
+              edge["write_buffer_hwm_bytes"])
+        counter("tony_edge_accepts_total",
+                "Connections accepted", edge["accepts"])
+        counter("tony_edge_requests_total",
+                "HTTP requests parsed (keep-alive reuse included)",
+                edge["requests"])
+        counter("tony_edge_slow_client_aborts_total",
+                "Streams aborted by the slow-client policy (write "
+                "buffer full past the drain timeout)",
+                edge["slow_client_aborts"])
+        counter("tony_edge_conn_limit_sheds_total",
+                "Connections shed 503 by the connection breaker",
+                edge["conn_limit_sheds"])
+        counter("tony_edge_client_disconnects_total",
+                "Connections the client dropped mid-request",
+                edge["client_disconnects"])
+        counter("tony_edge_keepalives_sent_total",
+                "Stream keepalive frames sent to quiet clients",
+                edge["keepalives_sent"])
+
     # the queue block (ISSUE-9): the autoscaler's primary sensor,
     # scrapable standalone
     q = snap.get("queue") or {}

@@ -514,6 +514,114 @@ class ReplicaAgent:
                 yield {"keepalive": True, "epoch": self.epoch}
                 last_emit = time.monotonic()
 
+    def channel_events(self, resume: dict, epoch: int,
+                       obs_cursor: int | None = None):
+        """Generator of tagged NDJSON frames for POST /v1/channel — the
+        MULTIPLEXED form of ``stream_events`` (ISSUE-16): ONE long-lived
+        connection carries every ticket's stream, each frame tagged with
+        its request id:
+
+          {"channel": true, "resumed": N, "epoch"}     the accept frame
+          {"rid", "off", "token_ids", "epoch"}         token window at
+                                                       absolute offset
+          {"rid", "done": true, "result", "obs", "epoch"}  terminal
+          {"rid", "gone": true, "epoch"}               unknown ticket
+                                                       (agent restart)
+          {"keepalive": true, "epoch"}                 idle heartbeat
+          {"obs": <v1/obs doc>, "epoch"}               incremental obs
+                                                       batch (when the
+                                                       caller sent
+                                                       obs_cursor)
+          {"stale": true, ...} / {"failed": true, ...} channel over
+
+        ``resume`` maps request id -> tokens the caller already holds;
+        a reconnect re-establishes EVERY in-flight stream at its
+        absolute offset in this one round trip. Tickets the agent
+        finished that the caller did NOT name in ``resume`` were fully
+        delivered on a previous channel incarnation — they are skipped,
+        never double-delivered. Tickets submitted while the channel is
+        live join it automatically from offset 0.
+
+        With ``obs_cursor`` the PR-15 observability pull rides the same
+        wire: whenever the timeline holds records past the cursor, a
+        full /v1/obs document goes out as an ``obs`` frame (the stub
+        ingests it exactly like a pull response; its seq-dedup makes
+        the occasional overlap with a GET pull harmless)."""
+        self.check_epoch(epoch)
+        offsets = {rid: max(0, int(off)) for rid, off in resume.items()}
+        with self._cond:
+            # finished tickets the caller did not ask to resume were
+            # delivered before this channel opened — never re-stream
+            done_sent = {rid for rid, t in self._tickets.items()
+                         if t.result is not None and rid not in offsets}
+        yield {"channel": True, "resumed": len(offsets),
+               "epoch": self.epoch}
+        last_emit = time.monotonic()
+        while True:
+            token_frames: list = []
+            done_rids: list = []
+            terminal: dict | None = None
+            with self._cond:
+                if self.epoch != epoch:
+                    terminal = {"error": "epoch superseded",
+                                "stale": True, "epoch": self.epoch}
+                elif self.failed is not None:
+                    terminal = {"error": self.failed, "failed": True,
+                                "epoch": self.epoch}
+                else:
+                    # new submits join the channel from offset 0
+                    for rid in self._tickets:
+                        if rid not in offsets and rid not in done_sent:
+                            offsets[rid] = 0
+                    for rid in list(offsets):
+                        t = self._tickets.get(rid)
+                        if t is None:
+                            # resume named a ticket the agent no longer
+                            # holds (restart / pruned): the stub's
+                            # restart-detection case, per stream
+                            token_frames.append(
+                                {"rid": rid, "gone": True,
+                                 "epoch": self.epoch})
+                            del offsets[rid]
+                            continue
+                        off = offsets[rid]
+                        tokens = t.tokens[off:]
+                        if tokens:
+                            token_frames.append(
+                                {"rid": rid, "off": off,
+                                 "token_ids": tokens,
+                                 "epoch": self.epoch})
+                            offsets[rid] = off + len(tokens)
+                        if t.result is not None:
+                            done_rids.append((rid, t.result))
+                            del offsets[rid]
+                            done_sent.add(rid)
+                    if not token_frames and not done_rids:
+                        self._cond.wait(timeout=self.keepalive_s)
+            if terminal is not None:
+                yield terminal
+                return
+            for frame in token_frames:
+                yield frame
+                last_emit = time.monotonic()
+            for rid, result in done_rids:
+                # request_obs takes the condition lock itself — the
+                # gather runs OUTSIDE the lock held above
+                yield {"rid": rid, "done": True, "result": result,
+                       "obs": self.request_obs(rid),
+                       "epoch": self.epoch}
+                last_emit = time.monotonic()
+            if obs_cursor is not None:
+                tl = self.server.timeline
+                if tl is not None and tl.seq > obs_cursor:
+                    doc = self.obs(obs_cursor)
+                    obs_cursor = doc["cursor"]
+                    yield {"obs": doc, "epoch": self.epoch}
+                    last_emit = time.monotonic()
+            if time.monotonic() - last_emit >= self.keepalive_s:
+                yield {"keepalive": True, "epoch": self.epoch}
+                last_emit = time.monotonic()
+
 
 class AgentHandler(BaseHTTPRequestHandler):
     agent: ReplicaAgent
@@ -559,6 +667,8 @@ class AgentHandler(BaseHTTPRequestHandler):
                 raise ValueError("request must be a JSON object")
         except (ValueError, TypeError) as e:
             return self._send(400, {"error": str(e)})
+        if path == "/v1/channel":
+            return self._channel(body)
         if path == "/v1/submit":
             return self._submit(body)
         if path == "/v1/handoff":
@@ -617,6 +727,39 @@ class AgentHandler(BaseHTTPRequestHandler):
                                     "kind": "ValueError"})
         except RuntimeError as e:  # draining / failed
             return self._send(503, {"error": str(e), "kind": "Unavailable"})
+
+    def _channel(self, body: dict) -> None:
+        """POST /v1/channel: the multiplexed stream carrier. Body
+        ``{"epoch": E, "streams": [[rid, off], ...], "obs_cursor": N}``
+        (streams as PAIRS, not an object — JSON object keys are always
+        strings and rids can be ints). Responds with an endless chunked
+        NDJSON of tagged frames (see channel_events)."""
+        try:
+            epoch = int(body.get("epoch", 0))
+            resume = {rid: int(off)
+                      for rid, off in body.get("streams") or []}
+            cursor = body.get("obs_cursor")
+            cursor = int(cursor) if cursor is not None else None
+        except (TypeError, ValueError) as e:
+            return self._send(400, {"error": str(e)})
+        try:
+            events = self.agent.channel_events(resume, epoch, cursor)
+            first = next(events)
+        except _StaleEpoch as e:
+            return self._send(409, {"error": str(e),
+                                    "epoch": self.agent.epoch})
+        except StopIteration:
+            return self._send(500, {"error": "empty channel"})
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Cache-Control", "no-store")
+        self.end_headers()
+        self._chunk(first)
+        for doc in events:
+            self._check_killed()
+            self._chunk(doc)
+        self.wfile.write(b"0\r\n\r\n")
 
     def _stream(self, rid: str, params: dict) -> None:
         request_id: object = int(rid) if rid.lstrip("-").isdigit() else rid
@@ -710,6 +853,16 @@ class AgentHTTP:
         self.server.shutdown()
         self.server.server_close()
         self._handler.agent.stop()
+        # a stopped server must also stop ANSWERING: daemon handler
+        # threads still hold accepted keep-alive sockets (incl. the
+        # mux channel), and a persistent client connection would keep
+        # landing requests on the corpse — in-process restarts on the
+        # same port would then feed a stub's control connection from
+        # the DEAD agent while the live one never sees the request
+        # (a real process exit RSTs these sockets; emulate that)
+        self._handler.killed = True
+        with self._handler.agent._cond:
+            self._handler.agent._cond.notify_all()
 
     def kill(self) -> None:
         """Chaos: drop off the network like a SIGKILLed process."""

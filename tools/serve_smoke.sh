@@ -39,6 +39,12 @@
 # Plus a BUNDLE round (ISSUE-15): a synthetic alert on a live
 # subprocess gateway must dump a self-contained debug bundle into the
 # history job dir, validated as JSON (`make bundle-smoke`).
+# Plus a STORM round (ISSUE-16): tools/storm.py drives 2000+
+# concurrent NDJSON streams (after parking 500 idle keep-alive
+# connections) into the event-driven edge — zero unintentional 5xx,
+# token-exact spot checks vs unary controls, the edge block on
+# /stats + tony_edge_* on /metrics, then a clean SIGTERM drain
+# (`make storm-smoke`).
 #
 # Usage: tools/serve_smoke.sh       (repo root; `make serve-smoke`)
 #        SERVE_SMOKE_ROUNDS=chaos tools/serve_smoke.sh
@@ -53,6 +59,8 @@
 #                                   (flight-recorder round only; `make bundle-smoke`)
 #        SERVE_SMOKE_ROUNDS=shard tools/serve_smoke.sh
 #                                   (sharded-replica round only; `make shard-smoke`)
+#        SERVE_SMOKE_ROUNDS=storm tools/serve_smoke.sh
+#                                   (connection-storm round only; `make storm-smoke`)
 set -u
 
 PY=${PY:-python}
@@ -76,7 +84,8 @@ ATCTRL_PID=''
 SHGW_PID=''
 SHCTRL_PID=''
 BGW_PID=''
-trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID $RGW_PID $RCTRL_PID $DGW_PID $DCTRL_PID $AT_PID $ATCTRL_PID $SHGW_PID $SHCTRL_PID $BGW_PID 2>/dev/null; kill -9 $AGENT0_PID $AGENT1_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
+STGW_PID=''
+trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID $RGW_PID $RCTRL_PID $DGW_PID $DCTRL_PID $AT_PID $ATCTRL_PID $SHGW_PID $SHCTRL_PID $BGW_PID $STGW_PID 2>/dev/null; kill -9 $AGENT0_PID $AGENT1_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
 
 fail() { echo "serve-smoke: FAIL: $1" >&2; exit 1; }
 
@@ -1031,6 +1040,88 @@ EOF
     echo "serve-smoke: shard OK (mesh=4 replica byte-identical to single-device control, topology + per-chip pricing on /stats)"
 }
 
+# ---- storm round (also standalone: SERVE_SMOKE_ROUNDS=storm) ---------
+# ISSUE-16: the event-driven edge under a connection storm. One
+# gateway subprocess behind GatewayEdge; tools/storm.py first parks
+# 500 idle keep-alive connections (per-connection memory cost), then
+# fires 2000 concurrent NDJSON streams in bursts. Gates: every stream
+# completes 200 (zero shed, zero unintentional 5xx), token-exact spot
+# checks vs unary controls, the edge stats block on /stats and
+# tony_edge_* series on /metrics, then a clean SIGTERM drain.
+storm_round() {
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --serve-batch 64 --chunk-steps 4 \
+        --max-queue 4096 --max-pending 4096 \
+        --port 0 --compile-cache '' \
+        >"$WORK/storm_boot.log" 2>"$WORK/storm_stderr.log" &
+    STGW_PID=$!
+    STURL=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        STURL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/storm_boot.log")
+        [ -n "$STURL" ] && break
+        kill -0 $STGW_PID 2>/dev/null || fail "storm gateway died at boot: $(cat "$WORK/storm_stderr.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$STURL" ] || fail "storm gateway did not print URL within ${BOUND}s"
+    echo "serve-smoke: storm gateway at $STURL (event edge)"
+
+    timeout -k 10 "$BOUND" $PY tools/storm.py --base "$STURL" \
+        --idle 500 --streams 2000 --tokens 4 --bursts 10 \
+        --burst-gap 0.1 --check 8 --server-pid $STGW_PID \
+        --timeout "$BOUND" --json "$WORK/storm.json" \
+        >"$WORK/storm_out.log" 2>&1 \
+        || fail "storm.py failed: $(tail -5 "$WORK/storm_out.log")"
+    $PY - "$WORK/storm.json" <<'EOF' || fail "storm gates: $(cat "$WORK/storm.json")"
+import json, sys
+doc = json.load(open(sys.argv[1]))
+idle, st = doc["idle"], doc["storm"]
+assert idle["opened"] == 500, idle
+assert idle["connect_errors"] == 0, idle
+assert st["launched"] == 2000, st
+assert st["completed_200"] == 2000, st       # every stream finished
+assert st["shed"] == 0, st                   # no 429/503 at this scale
+assert st["errors"] == 0, st                 # zero unintentional 5xx
+assert st["tokens_checked"] > 0, st
+assert st["tokens_exact"] == st["tokens_checked"], st
+edge = st["edge"]
+assert edge["kind"] == "event", edge
+assert edge["slow_client_aborts"] == 0, edge
+assert edge["conn_limit_sheds"] == 0, edge
+EOF
+
+    code=$(curl_s "$WORK/storm_stats" "$STURL/stats") || fail "storm stats curl"
+    [ "$code" = 200 ] || fail "storm stats -> $code"
+    $PY - "$WORK/storm_stats" <<'EOF' || fail "no edge block on /stats: $(cat "$WORK/storm_stats")"
+import json, sys
+stats = json.load(open(sys.argv[1]))
+edge = stats["edge"]
+assert edge["kind"] == "event", edge
+assert edge["requests"] >= 2000, edge
+assert edge["accepts"] >= 2500, edge         # idle conns + streams
+EOF
+    curl_s "$WORK/storm_metrics" "$STURL/metrics" >/dev/null 2>&1
+    grep -q 'tony_edge_threads ' "$WORK/storm_metrics" || fail "no tony_edge_threads on /metrics"
+    grep -q 'tony_edge_accepts_total ' "$WORK/storm_metrics" || fail "no tony_edge_accepts_total on /metrics"
+    grep -q 'tony_edge_slow_client_aborts_total 0' "$WORK/storm_metrics" || fail "no tony_edge_slow_client_aborts_total on /metrics"
+
+    kill -TERM $STGW_PID
+    i=0
+    while kill -0 $STGW_PID 2>/dev/null; do
+        [ $i -ge $BOUND ] && fail "storm gateway did not drain within ${BOUND}s of SIGTERM"
+        sleep 1; i=$((i + 1))
+    done
+    wait $STGW_PID; rc=$?
+    [ $rc = 0 ] || fail "storm gateway exited $rc after SIGTERM"
+    grep -q 'drained clean' "$WORK/storm_stderr.log" || fail "storm gateway did not report a clean drain"
+    STGW_PID=''
+    echo "serve-smoke: storm OK (2000/2000 streams over the event edge, zero shed, token-exact spot checks, clean drain)"
+}
+
+if [ "${SERVE_SMOKE_ROUNDS:-all}" = storm ]; then
+    storm_round   # `make storm-smoke`: just the connection-storm round
+    exit 0
+fi
 if [ "${SERVE_SMOKE_ROUNDS:-all}" = shard ]; then
     shard_round   # `make shard-smoke`: just the sharded-replica round
     exit 0
@@ -1415,4 +1506,7 @@ remote_round
 
 # ---- bundle round: synthetic alert -> flight-recorder dump -----------
 bundle_round
+
+# ---- storm round: 2000 concurrent streams over the event edge --------
+storm_round
 echo "serve-smoke: ALL OK"

@@ -1,0 +1,438 @@
+#!/usr/bin/env python
+"""Connection-storm load harness for the event-driven edge (ISSUE-16).
+
+A selectors-based client: one thread holds every socket, so the
+HARNESS can't be the concurrency bottleneck it is measuring. Two
+phases against a live gateway:
+
+  idle  - open N keep-alive connections that never send a byte and
+          hold them; the gateway's RSS delta prices the edge's memory
+          per idle connection (the edge parks them in the loop at
+          zero thread cost).
+  storm - drive S concurrent NDJSON token streams (POST /v1/generate,
+          stream=true) with a bursty arrival schedule over a synthetic
+          tenant population; measure TTFT percentiles, shed rate
+          (429/503 with Retry-After), completion count, and peak
+          concurrent open streams. A spot-check re-runs the first K
+          prompts unary at zero concurrency and asserts the streamed
+          token_ids reassemble to the exact same sequence.
+
+Usage (the gateway must already be running):
+
+  python tools/storm.py --base http://127.0.0.1:8000 \
+      --idle 10000 --streams 10000 --tokens 4 --server-pid $GW_PID \
+      --json /tmp/storm.json
+
+Pure stdlib, no jax — runs as a light sidecar process so the client's
+fd budget doesn't share the server's.
+"""
+
+import argparse
+import json
+import selectors
+import socket
+import sys
+import time
+import urllib.request
+
+
+def proc_status(pid: int) -> dict:
+    """VmRSS (KiB) and Threads for a pid, from /proc."""
+    out = {}
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["rss_kb"] = int(line.split()[1])
+                elif line.startswith("Threads:"):
+                    out["threads"] = int(line.split()[1])
+    except OSError:
+        pass
+    return out
+
+
+def http_get_json(base: str, path: str, timeout: float = 10.0):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def http_post_json(base: str, path: str, doc: dict,
+                   timeout: float = 60.0):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def parse_base(base: str) -> tuple[str, int]:
+    rest = base.split("//", 1)[-1].rstrip("/")
+    host, _, port = rest.partition(":")
+    return host, int(port or 80)
+
+
+# --------------------------------------------------------------- idle
+
+def idle_phase(host: str, port: int, n: int, server_pid: int,
+               base: str, hold_s: float, deadline: float) -> dict:
+    """Open n idle keep-alive connections, hold them, price the RSS."""
+    before = proc_status(server_pid)
+    sel = selectors.DefaultSelector()
+    socks: list[socket.socket] = []
+    pending = 0
+    opened = 0
+    errors = 0
+    i = 0
+    while (opened + errors) < n and time.monotonic() < deadline:
+        # ramp in bounded batches so connect() backlog overflow turns
+        # into retries, not a thundering failure
+        while i < n and pending < 512:
+            s = socket.socket()
+            s.setblocking(False)
+            try:
+                s.connect((host, port))
+            except BlockingIOError:
+                pass
+            except OSError:
+                s.close()
+                errors += 1
+                i += 1
+                continue
+            sel.register(s, selectors.EVENT_WRITE)
+            pending += 1
+            i += 1
+        for key, _ in sel.select(timeout=1.0):
+            s = key.fileobj
+            sel.unregister(s)
+            pending -= 1
+            err = s.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+            if err:
+                s.close()
+                errors += 1
+            else:
+                socks.append(s)
+                opened += 1
+    time.sleep(hold_s)  # let the server's accept loop fully settle
+    after = proc_status(server_pid)
+    stats = {}
+    try:
+        stats = http_get_json(base, "/stats").get("edge", {})
+    except OSError:
+        pass
+    out = {
+        "target": n,
+        "opened": opened,
+        "connect_errors": errors,
+        "server_rss_before_kb": before.get("rss_kb", 0),
+        "server_rss_after_kb": after.get("rss_kb", 0),
+        "server_threads": after.get("threads", 0),
+        "edge_open_connections": stats.get("open_connections", -1),
+    }
+    if opened:
+        delta = out["server_rss_after_kb"] - out["server_rss_before_kb"]
+        out["rss_kb_per_idle_conn"] = round(max(0, delta) / opened, 3)
+    for s in socks:
+        try:
+            s.close()
+        except OSError:
+            pass
+    sel.close()
+    return out
+
+
+# -------------------------------------------------------------- storm
+
+class _Stream:
+    """One in-flight streaming request's client-side state machine."""
+
+    __slots__ = ("sock", "buf", "state", "status", "t_sent", "t_first",
+                 "t_done", "tokens", "chunk_need", "body", "idx",
+                 "keepalives")
+
+    def __init__(self, sock, idx):
+        self.sock = sock
+        self.idx = idx
+        self.buf = b""
+        self.state = "connect"   # connect -> sent -> headers -> body
+        self.status = 0
+        self.t_sent = 0.0
+        self.t_first = 0.0
+        self.t_done = 0.0
+        self.tokens: list[int] = []
+        self.chunk_need = -1     # -1: expecting a chunk-size line
+        self.body = b""
+        self.keepalives = 0
+
+    def feed(self, data: bytes) -> bool:
+        """Consume response bytes; True when the response is complete."""
+        self.buf += data
+        if self.state == "headers":
+            end = self.buf.find(b"\r\n\r\n")
+            if end < 0:
+                return False
+            head = self.buf[:end].decode("latin-1")
+            self.buf = self.buf[end + 4:]
+            self.status = int(head.split(None, 2)[1])
+            self.state = "body"
+        if self.state != "body":
+            return False
+        # de-chunk: every complete chunk's payload joins self.body;
+        # a zero chunk ends the response
+        while True:
+            if self.chunk_need < 0:
+                nl = self.buf.find(b"\r\n")
+                if nl < 0:
+                    return False
+                try:
+                    self.chunk_need = int(self.buf[:nl], 16)
+                except ValueError:
+                    # not chunked (an error doc with Content-Length):
+                    # callers treat EOF as the end instead
+                    self.body += self.buf
+                    self.buf = b""
+                    return False
+                self.buf = self.buf[nl + 2:]
+                if self.chunk_need == 0:
+                    return True
+            if len(self.buf) < self.chunk_need + 2:
+                return False
+            self.body += self.buf[:self.chunk_need]
+            self.buf = self.buf[self.chunk_need + 2:]
+            self.chunk_need = -1
+            self._drain_lines()
+
+    def _drain_lines(self) -> None:
+        while b"\n" in self.body:
+            line, _, self.body = self.body.partition(b"\n")
+            if not line.strip():
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if doc.get("keepalive"):
+                self.keepalives += 1
+                continue
+            if "finish_reason" in doc:
+                # the terminal doc repeats the FULL token_ids
+                # (prompt + generation) — the delta frames already
+                # delivered them
+                continue
+            ids = doc.get("token_ids")
+            if ids:
+                if not self.t_first:
+                    self.t_first = time.monotonic()
+                self.tokens.extend(int(x) for x in ids)
+
+
+def storm_prompt(i: int) -> list[int]:
+    return [1 + (i % 50), 2, 3]
+
+
+def storm_phase(host: str, port: int, base: str, n: int, tokens: int,
+                tenants: int, bursts: int, burst_gap_s: float,
+                server_pid: int, deadline: float, check: int) -> dict:
+    """Drive n concurrent streams with a bursty arrival schedule."""
+    sel = selectors.DefaultSelector()
+    streams: list[_Stream] = []
+    live = 0
+    peak_live = 0
+    done: list[_Stream] = []
+    failed = 0
+    burst_size = max(1, n // max(1, bursts))
+    launched = 0
+    next_burst_t = time.monotonic()
+    peak_threads = proc_status(server_pid).get("threads", 0)
+
+    def launch_one(i: int) -> None:
+        nonlocal live, failed
+        s = socket.socket()
+        s.setblocking(False)
+        try:
+            s.connect((host, port))
+        except BlockingIOError:
+            pass
+        except OSError:
+            failed += 1
+            s.close()
+            return
+        st = _Stream(s, i)
+        streams.append(st)
+        sel.register(s, selectors.EVENT_WRITE, st)
+        live += 1
+
+    def finish(st: _Stream, ok: bool) -> None:
+        nonlocal live, failed
+        st.t_done = time.monotonic()
+        sel.unregister(st.sock)
+        try:
+            st.sock.close()
+        except OSError:
+            pass
+        live -= 1
+        if ok:
+            done.append(st)
+        else:
+            failed += 1
+
+    while (launched < n or live > 0) and time.monotonic() < deadline:
+        now = time.monotonic()
+        if launched < n and now >= next_burst_t:
+            for _ in range(min(burst_size, n - launched)):
+                launch_one(launched)
+                launched += 1
+            next_burst_t = now + burst_gap_s
+        peak_live = max(peak_live, live)
+        for key, mask in sel.select(timeout=0.2):
+            st = key.data
+            if mask & selectors.EVENT_WRITE:
+                err = st.sock.getsockopt(socket.SOL_SOCKET,
+                                         socket.SO_ERROR)
+                if err:
+                    finish(st, ok=False)
+                    continue
+                body = json.dumps({
+                    "token_ids": storm_prompt(st.idx),
+                    "max_new_tokens": tokens, "stream": True,
+                    "id": f"storm-{st.idx}",
+                    "tenant": f"t{st.idx % max(1, tenants)}",
+                }).encode()
+                req = (b"POST /v1/generate HTTP/1.1\r\n"
+                       b"Host: storm\r\n"
+                       b"Content-Type: application/json\r\n"
+                       b"Content-Length: " + str(len(body)).encode()
+                       + b"\r\nConnection: close\r\n\r\n" + body)
+                try:
+                    st.sock.sendall(req)
+                except OSError:
+                    finish(st, ok=False)
+                    continue
+                st.t_sent = time.monotonic()
+                st.state = "headers"
+                sel.modify(st.sock, selectors.EVENT_READ, st)
+                continue
+            try:
+                data = st.sock.recv(65536)
+            except BlockingIOError:
+                continue
+            except OSError:
+                finish(st, ok=False)
+                continue
+            if not data:
+                finish(st, ok=bool(st.status))
+                continue
+            if st.feed(data):
+                finish(st, ok=True)
+        t = proc_status(server_pid).get("threads", 0)
+        peak_threads = max(peak_threads, t)
+
+    # anything still live at the deadline counts as failed
+    for st in list(streams):
+        if st.t_done == 0.0 and st.sock.fileno() >= 0:
+            finish(st, ok=False)
+
+    ok = [st for st in done if st.status == 200]
+    shed = [st for st in done if st.status in (429, 503)]
+    other = [st for st in done
+             if st.status not in (200, 429, 503)]
+    ttfts = sorted((st.t_first - st.t_sent) * 1e3
+                   for st in ok if st.t_first)
+
+    def pct(q: float) -> float:
+        if not ttfts:
+            return 0.0
+        return round(ttfts[min(len(ttfts) - 1,
+                               int(q * (len(ttfts) - 1)))], 1)
+
+    out = {
+        "streams": n,
+        "launched": launched,
+        "completed_200": len(ok),
+        "shed": len(shed),
+        "shed_rate": round(len(shed) / max(1, launched), 4),
+        "errors": failed + len(other),
+        "peak_concurrent_streams": peak_live,
+        "peak_server_threads": peak_threads,
+        "keepalives_seen": sum(st.keepalives for st in ok),
+        "ttft_p50_ms": pct(0.50),
+        "ttft_p95_ms": pct(0.95),
+        "ttft_p99_ms": pct(0.99),
+    }
+    # token-exact spot check: re-run the first K prompts unary at zero
+    # concurrency; the streamed reassembly must match exactly
+    checked = exact = 0
+    by_idx = {st.idx: st for st in ok}
+    for i in sorted(by_idx):
+        if checked >= check:
+            break
+        st = by_idx[i]
+        try:
+            ref = http_post_json(base, "/v1/generate", {
+                "token_ids": storm_prompt(st.idx),
+                "max_new_tokens": tokens, "id": f"check-{st.idx}"})
+        except OSError:
+            continue
+        prompt = storm_prompt(st.idx)
+        ref_new = ref.get("token_ids", [])[len(prompt):]
+        checked += 1
+        if st.tokens == ref_new:
+            exact += 1
+    out["tokens_checked"] = checked
+    out["tokens_exact"] = exact
+    try:
+        out["edge"] = http_get_json(base, "/stats").get("edge", {})
+    except OSError:
+        pass
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--base", required=True,
+                    help="gateway base URL, e.g. http://127.0.0.1:8000")
+    ap.add_argument("--idle", type=int, default=0,
+                    help="idle keep-alive connections to hold")
+    ap.add_argument("--streams", type=int, default=0,
+                    help="concurrent NDJSON streams to drive")
+    ap.add_argument("--tokens", type=int, default=4,
+                    help="max_new_tokens per stream")
+    ap.add_argument("--tenants", type=int, default=16,
+                    help="synthetic tenant population size")
+    ap.add_argument("--bursts", type=int, default=10,
+                    help="arrival schedule: launch in this many bursts")
+    ap.add_argument("--burst-gap", type=float, default=0.5,
+                    help="seconds between bursts")
+    ap.add_argument("--hold", type=float, default=2.0,
+                    help="idle phase: seconds to hold before measuring")
+    ap.add_argument("--check", type=int, default=8,
+                    help="streams to spot-check token-exact vs unary")
+    ap.add_argument("--server-pid", type=int, default=0,
+                    help="gateway pid for /proc RSS+thread readings")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="whole-run ceiling in seconds")
+    ap.add_argument("--json", default="",
+                    help="write the report JSON here (stdout always)")
+    args = ap.parse_args(argv)
+
+    host, port = parse_base(args.base)
+    deadline = time.monotonic() + args.timeout
+    report = {"base": args.base}
+    if args.idle > 0:
+        report["idle"] = idle_phase(host, port, args.idle,
+                                    args.server_pid, args.base,
+                                    args.hold, deadline)
+    if args.streams > 0:
+        report["storm"] = storm_phase(
+            host, port, args.base, args.streams, args.tokens,
+            args.tenants, args.bursts, args.burst_gap,
+            args.server_pid, deadline, args.check)
+    text = json.dumps(report, indent=2)
+    print(text)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
