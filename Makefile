@@ -87,10 +87,17 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 #     in bursts — zero shed / zero unintentional 5xx, token-exact
 #     spot checks vs unary controls, edge block on /stats +
 #     tony_edge_* on /metrics, clean SIGTERM drain
+#   make migrate-smoke - just the live-migration round of serve-smoke:
+#     two replicas leasing ONE shared PagePool, remove_replica freezes
+#     a throttled in-flight stream mid-decode and the survivor adopts
+#     it by owner swap — token-exact vs a no-migration control, zero
+#     5xx, zero KV pages copied, retiring drain bounded by freeze
+#     cost instead of the stream's remaining decode budget
 
 .PHONY: lint smoke check test bench serve-smoke chaos-smoke \
 	autoscale-smoke goodput-smoke remote-smoke disagg-smoke \
-	autotune-smoke shard-smoke bundle-smoke storm-smoke
+	autotune-smoke shard-smoke bundle-smoke storm-smoke \
+	migrate-smoke
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -141,3 +148,6 @@ bundle-smoke:
 
 storm-smoke:
 	PY=$(PY) SERVE_SMOKE_ROUNDS=storm sh tools/serve_smoke.sh
+
+migrate-smoke:
+	PY=$(PY) SERVE_SMOKE_ROUNDS=migrate sh tools/serve_smoke.sh

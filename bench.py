@@ -38,7 +38,10 @@ Measurements (BASELINE.md rows 2-3 + VERDICT next-steps, r1-r3):
    goodput ledger datum — decode HBM-BW% from the product's analytic
    cost model + the wall-clock bucket decomposition at the
    serving-scale shape, with the overhead gate re-run goodput+alerts
-   armed (extras.goodput).
+   armed (extras.goodput), and the live-migration datum — drain-latency
+   A/B of a planned replica exit with a stream in flight (freeze +
+   owner swap vs decode-to-completion) plus the owner swap's
+   bytes-not-moved against a timed gather_pages copy (extras.migrate).
 
 5. Launch -> first-step latency through the REAL submit path
    (TonyClient -> coordinator -> agent -> payload jit step) on the mini
@@ -2447,6 +2450,114 @@ def bench_storm(on_tpu: bool) -> dict:
     return out
 
 
+def bench_migrate(on_tpu: bool) -> dict:
+    """Live-session-migration datum (ISSUE-18 acceptance). One seeded
+    stream on a 2-replica gateway whose engines share ONE PagePool and
+    are wedge-throttled 30 ms/dispatch (so a mid-stream freeze window
+    exists on a CPU-sized model — the costs measured here are host-side
+    scheduling + page bookkeeping, the right probe on either backend):
+
+    1. drain-latency A/B: ``remove_replica`` with the stream live,
+       migration armed (freeze + owner swap, the survivor resumes) vs
+       disabled on the same config (``extract_session`` nulled on the
+       victim -> the old decode-to-completion drain). Both arms must
+       stay token-identical to a no-migration control and shed nothing;
+       the headline is the drain-time ratio.
+    2. the bytes ledger: the owner swap moved ZERO pages where a
+       cross-host migration would have gathered+copied the session's
+       whole KV — the counterfactual ``gather_pages`` copy is run and
+       timed so bytes-not-moved has a measured price next to it."""
+    import numpy as np
+
+    from tony_tpu.gateway.core import Gateway, GenRequest
+    from tony_tpu.models import Transformer, TransformerConfig
+    from tony_tpu.serve import FaultPlan, Request, Server
+    from tony_tpu.serve.slots import PagePool, gather_pages
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=64,
+                            dtype=jnp.float32,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = np.random.default_rng(3).integers(1, 64, size=13).tolist()
+    budget, wedge, page = 48, 0.03, 8
+
+    ctrl = Server(model, params, batch_size=2, eos_id=-1, paged=True,
+                  kv_page_size=page, prefix_cache_mb=0)
+    ctrl.submit(Request(list(prompt), budget, id="c", temperature=0.8,
+                        top_k=8, seed=7))
+    expect = list(list(ctrl.run())[0].tokens)
+
+    def run(migrate: bool):
+        pool = PagePool(model, params, 128, page, shared=True)
+        plan = lambda: FaultPlan.wedge_at(1, wedge, times=-1)  # noqa: E731
+        gw = Gateway([Server(model, params, batch_size=2, eos_id=-1,
+                             paged=True, kv_page_size=page,
+                             prefix_cache_mb=0, page_pool=pool,
+                             fault_plan=plan())
+                      for _ in range(2)]).start()
+        try:
+            t = gw.submit(GenRequest(list(prompt), max_new_tokens=budget,
+                                     temperature=0.8, top_k=8, seed=7,
+                                     id="mig"))
+            deadline = time.monotonic() + 60
+            while t._n_emitted < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            victim = gw.replicas[t.replica]
+            if not migrate:
+                # null the hook -> remove_replica falls back to the
+                # pre-ISSUE-18 decode-to-completion drain, same config
+                victim.server.extract_session = None
+            left = budget - t._n_emitted
+            t0 = time.perf_counter()
+            assert gw.remove_replica(t.replica, timeout=120)
+            drain_s = time.perf_counter() - t0
+            tokens = list(t.result(timeout=120).tokens)
+            snap = gw.snapshot()
+        finally:
+            gw.drain(timeout=60)
+        assert pool.n_used == 0, "page leak after drain"
+        return tokens, drain_s, left, snap, pool
+
+    run(True)  # warm: prefill bucket + decode + adopt programs
+    toks_mig, s_mig, left_mig, snap_mig, pool = run(True)
+    toks_off, s_off, left_off, snap_off, _ = run(False)
+    identical = toks_mig == expect and toks_off == expect
+    assert identical, "migration or drain changed seeded outputs"
+    mig = snap_mig["engine"]["migrations"]
+
+    # the counterfactual: gathering the frozen session's pages (what a
+    # cross-host migration copies) — timed on the same pool geometry
+    n_pages = -(-(len(prompt) + budget) // page)
+    idx = jnp.arange(n_pages, dtype=jnp.int32)
+    gather_ms = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(gather_pages(pool.cache, idx))
+        gather_ms.append((time.perf_counter() - t0) * 1e3)
+    return {
+        "outputs_identical": identical,
+        "shed_migrate": snap_mig["shed"],       # the zero-5xx contract
+        "shed_decode": snap_off["shed"],
+        "tokens_left_at_freeze": left_mig,
+        "tokens_left_at_drain_off": left_off,
+        "drain_s_migrate": round(s_mig, 4),
+        "drain_s_decode_to_completion": round(s_off, 4),
+        # the headline: a planned exit costs freeze time, not the
+        # stream's remaining decode budget
+        "drain_speedup": round(s_off / max(s_mig, 1e-9), 1),
+        "migrations_out": mig["out"],
+        "migrations_in": mig["in"],
+        "owner_swap_pages_moved": mig["pages_moved"],   # stays 0
+        "owner_swap_bytes_avoided": mig["bytes_avoided"],
+        "freeze_resume_ms": mig["freeze_resume_ms"],
+        "gather_copy_pages": n_pages,
+        "gather_copy_ms": round(float(np.median(gather_ms)), 3),
+    }
+
+
 def _maybe_reexec_on_tpu(line: dict) -> dict:
     """End-of-run second chance: the CPU benches took minutes — if the
     tunnel recovered meanwhile, re-run the WHOLE bench pinned to TPU in a
@@ -2614,6 +2725,11 @@ def _collect_line() -> dict:
         extras["storm"] = bench_storm(on_tpu)
     except Exception as e:
         extras["storm"] = {"error": f"{type(e).__name__}: {e}"}
+    gc.collect()  # TrainState/etc cycles pin GBs of HBM until swept
+    try:
+        extras["migrate"] = bench_migrate(on_tpu)
+    except Exception as e:
+        extras["migrate"] = {"error": f"{type(e).__name__}: {e}"}
     try:
         extras["launch"] = bench_launch()
     except Exception as e:

@@ -225,6 +225,24 @@ def test_bench_eos_refill_closes_the_overshoot_bucket(bench):
 
 
 @pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
+def test_bench_migrate_drain_and_bytes_bounds(bench):
+    """The extras.migrate acceptance bounds (ISSUE-18): (a) both arms
+    of the drain A/B stay token-identical to the no-migration control
+    with zero shed; (b) the migrating drain beats decode-to-completion
+    by >= 3x (measured ~40x: freeze cost vs ~45 wedged dispatches);
+    (c) the owner swap moved ZERO pages while the bytes a gather copy
+    would have shipped registered in bytes_avoided."""
+    out = bench.bench_migrate(False)
+    assert out["outputs_identical"], out
+    assert out["shed_migrate"] == {} and out["shed_decode"] == {}, out
+    assert out["drain_speedup"] >= 3.0, out
+    assert out["migrations_out"] >= 1 and out["migrations_in"] >= 1, out
+    assert out["owner_swap_pages_moved"] == 0, out
+    assert out["owner_swap_bytes_avoided"] > 0, out
+    assert out["gather_copy_pages"] > 0, out
+
+
+@pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'
 def test_bench_goodput_ledger_and_overhead_gate(bench):
     """The extras.goodput acceptance bounds (ISSUE-10): (a) the ledger
     produced by the product sensor is well-formed — bucket fractions

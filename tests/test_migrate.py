@@ -1,0 +1,331 @@
+"""Live session migration (ISSUE-18): the zero-copy KV fabric that
+moves in-flight streams between replicas token-exact.
+
+The exactness discipline is the house rule: every migrated stream is
+pinned BYTE-IDENTICAL to a no-migration control on a fresh engine —
+greedy and seeded sampling, speculation live — because a freeze
+captures the rng chain mid-flight and the adopting engine resumes it
+at the exact position. The structural claims ride deterministic
+counters: a shared-pool owner swap moves ZERO pages (bytes_avoided
+grows instead), a cross-host migration ships real pages over the
+wire (pages_moved grows), a retiring replica's out-side ledger
+survives its own departure via the gateway carry, and the page pool
+conserves refcounts (n_used == 0 after drain, always).
+
+The failure half of the contract: a migrated payload is ONE-SHOT —
+consumed at admit — so a SIGKILL on the adopting host afterwards
+degrades to the ordinary crash path (re-run from the prompt), which
+determinism makes token-exact too. Zero 5xx throughout.
+
+Tiny reference-attention model, CPU-only; engines are throttled with
+a wedge fault (30 ms per dispatch, token-exact preserved) so the
+mid-stream windows the tests need actually exist on a model this
+small.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.gateway.core import Gateway, GenRequest
+from tony_tpu.models import Transformer, TransformerConfig
+from tony_tpu.serve import Request, Server
+from tony_tpu.serve.faults import FaultPlan
+from tony_tpu.serve.slots import PagePool
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=64,
+                            dtype=jnp.float32,
+                            attention_backend="reference")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _prompt(seed=3, n=13):
+    return np.random.default_rng(seed).integers(1, 64, size=n).tolist()
+
+
+def _slow():
+    # 30 ms per dispatch: a 40-token stream stays in flight ~1.2 s,
+    # wide enough to freeze mid-stream deterministically
+    return FaultPlan.wedge_at(1, 0.03, times=-1)
+
+
+def _mk(tiny, **kw):
+    model, params = tiny
+    kw.setdefault("prefix_cache_mb", 0)
+    kw.setdefault("batch_size", 2)
+    return Server(model, params, eos_id=-1, paged=True,
+                  kv_page_size=8, **kw)
+
+
+def _control(tiny, prompt, budget, *, temperature=0.0, top_k=0,
+             seed=0, **server_kw):
+    """No-migration control on a fresh single engine."""
+    srv = _mk(tiny, **server_kw)
+    srv.submit(Request(list(prompt), budget, id="c",
+                       temperature=temperature, top_k=top_k, seed=seed))
+    return list(srv.run())[0].tokens
+
+
+def _wait(cond, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _wait_emitted(t, n, timeout=30.0):
+    _wait(lambda: t._n_emitted >= n, timeout,
+          f"{n} tokens emitted (got {t._n_emitted})")
+
+
+# ------------------------------------------------- local owner swap
+
+
+@pytest.mark.parametrize("temperature,top_k,seed",
+                         [(0.0, 0, 0), (0.8, 8, 7)])
+def test_remove_replica_migrates_mid_stream_token_exact(
+        tiny, temperature, top_k, seed):
+    """THE local anchor: two replicas lease one shared PagePool;
+    remove_replica mid-stream freezes the live session and the
+    survivor adopts it by OWNER SWAP — zero pages copied, tokens
+    byte-identical to the no-migration control, both greedy and
+    seeded (the rng chain migrates at its exact position). The trace
+    carries the migrate fence between the two attempt spans, and the
+    pool refcounts conserve to zero after drain."""
+    model, params = tiny
+    prompt, budget = _prompt(), 40
+    expect = _control(tiny, prompt, budget, temperature=temperature,
+                      top_k=top_k, seed=seed)
+    pool = PagePool(model, params, 128, 8, shared=True)
+    gw = Gateway([_mk(tiny, page_pool=pool, fault_plan=_slow()),
+                  _mk(tiny, page_pool=pool, fault_plan=_slow())]).start()
+    try:
+        t = gw.submit(GenRequest(list(prompt), max_new_tokens=budget,
+                                 temperature=temperature, top_k=top_k,
+                                 seed=seed, id="mig"))
+        _wait_emitted(t, 3)
+        src = t.replica
+        assert src is not None
+        assert gw.remove_replica(src, timeout=60)
+        res = t.result(timeout=120)
+        assert list(res.tokens) == list(expect)
+        snap = gw.snapshot()
+        assert snap["shed"] == {}  # zero 5xx
+        assert snap["routing"]["migrations"] >= 1
+        mig = snap["engine"]["migrations"]
+        # out-side counters survived the source's retirement (carry)
+        assert mig["out"] >= 1 and mig["in"] >= 1
+        # owner swap: both sides count local, nothing crosses a wire
+        assert mig["local"] >= 2 and mig["remote"] == 0
+        assert mig["pages_moved"] == 0
+        assert mig["bytes_avoided"] > 0
+        assert mig["freeze_resume_ms"] >= 0
+        # ONE trace spans the handover: attempt on the source ends
+        # with the migrate fence, attempt on the survivor follows
+        tr = gw.traces.get("mig")
+        assert tr is not None and tr.n_attempts >= 2
+        names = {e.get("name")
+                 for e in tr.to_chrome().get("traceEvents", [])}
+        assert "migrate" in names, names
+    finally:
+        assert gw.drain(timeout=60)
+    assert pool.n_used == 0
+    assert (np.asarray(pool.refcount) >= 0).all()
+
+
+def test_migration_with_speculation_live_token_exact(tiny):
+    """Speculation survives the freeze: the snapshot carries the
+    draft-acceptance EMA and the adopting engine keeps speculating —
+    output still byte-identical to a speculating control. Greedy with
+    a repetitive prompt: prompt-lookup drafting only arms on greedy
+    requests, and the repeated n-gram guarantees proposals fire."""
+    prompt, budget = [1, 2, 3] * 4 + [1, 2], 40
+    model, params = tiny
+    expect = _control(tiny, prompt, budget, speculate_k=2)
+    pool = PagePool(model, params, 128, 8, shared=True)
+    gw = Gateway([_mk(tiny, page_pool=pool, fault_plan=_slow(),
+                      speculate_k=2),
+                  _mk(tiny, page_pool=pool, fault_plan=_slow(),
+                      speculate_k=2)]).start()
+    try:
+        t = gw.submit(GenRequest(list(prompt), max_new_tokens=budget,
+                                 id="spec"))
+        _wait_emitted(t, 3)
+        assert gw.remove_replica(t.replica, timeout=60)
+        res = t.result(timeout=120)
+        assert list(res.tokens) == list(expect)
+        snap = gw.snapshot()
+        assert snap["shed"] == {}
+        assert snap["engine"]["migrations"]["out"] >= 1
+        # the adopter actually speculated after the handover
+        assert snap["engine"]["spec"]["rounds"] >= 1
+    finally:
+        assert gw.drain(timeout=60)
+    assert pool.n_used == 0
+
+
+# ------------------------------------------------- cross-host wire
+
+
+def _start_agent(tiny, **server_kw):
+    from tony_tpu.serve.agent import AgentHTTP, ReplicaAgent
+
+    return AgentHTTP(ReplicaAgent(_mk(tiny, **server_kw))).start()
+
+
+def _stub(address, **kw):
+    from tony_tpu.gateway.remote import RemoteServer
+
+    kw.setdefault("heartbeat_interval_s", 0.1)
+    kw.setdefault("lease_misses", 3)
+    kw.setdefault("boot_timeout_s", 20.0)
+    return RemoteServer(address, **kw)
+
+
+def test_cross_host_migration_token_exact(tiny):
+    """The wire anchor: one local replica, one remote agent. Removing
+    whichever replica holds the stream ships the session to the other
+    side of the wire — gathered pages travel as the codec's bitwise
+    wire form (pages_moved > 0; this direction has no shared pool to
+    swap within) and the stream stays byte-identical to the
+    control."""
+    prompt, budget = _prompt(), 40
+    expect = _control(tiny, prompt, budget)
+    http = _start_agent(tiny, fault_plan=_slow(), prefix_cache_mb=4)
+    gw = Gateway([_mk(tiny, fault_plan=_slow()),
+                  _stub(http.address)]).start()
+    try:
+        t = gw.submit(GenRequest(list(prompt), max_new_tokens=budget,
+                                 id="wire"))
+        _wait_emitted(t, 3)
+        assert gw.remove_replica(t.replica, timeout=60)
+        res = t.result(timeout=120)
+        assert list(res.tokens) == list(expect)
+        assert gw.snapshot()["shed"] == {}
+
+        def _settled():
+            m = gw.snapshot()["engine"]["migrations"]
+            return m["out"] >= 1 and m["in"] >= 1 \
+                and m["pages_moved"] >= 1
+        # remote counters ride the next heartbeat; don't race it
+        _wait(_settled, msg="migration counters settled")
+        mig = gw.snapshot()["engine"]["migrations"]
+        assert mig["remote"] >= 1
+    finally:
+        assert gw.drain(timeout=60)
+        http.stop()
+
+
+def test_sigkill_after_migration_falls_back_to_rerun(tiny):
+    """The failure half of the one-shot payload contract: migrate a
+    stream between two REMOTE replicas, then SIGKILL the adopter (as
+    the network sees it). The payload was consumed at admit, so
+    failover re-runs the request from its prompt on the survivor —
+    greedy determinism makes even the re-run token-exact, and no
+    client ever sees a 5xx."""
+    prompt, budget = _prompt(9), 40
+    expect = _control(tiny, prompt, budget)
+    agents = [_start_agent(tiny, fault_plan=_slow()) for _ in range(2)]
+    gw = Gateway([_stub(a.address) for a in agents],
+                 stall_timeout_s=10.0, breaker_base_s=0.05,
+                 breaker_max_s=0.25).start()
+    try:
+        t = gw.submit(GenRequest(list(prompt), max_new_tokens=budget,
+                                 id="chaos"))
+        _wait_emitted(t, 3)
+        src = t.replica
+        assert gw.migrate_session("chaos") is True
+        _wait(lambda: t.replica is not None and t.replica != src,
+              msg="stream adopted by the other replica")
+        target = t.replica
+        # let the adopter stream a few tokens so the kill lands on a
+        # LIVE migrated session, then drop it off the network
+        n_now = t._n_emitted
+        _wait_emitted(t, n_now + 2)
+        agents[target].kill()
+        res = t.result(timeout=180)
+        assert list(res.tokens) == list(expect)
+        snap = gw.snapshot()
+        assert snap["shed"] == {}  # zero 5xx
+        assert snap["supervision"]["failovers"] >= 1
+    finally:
+        gw.drain(timeout=60)
+        for a in agents:
+            a.stop()
+
+
+# ---------------------------------------------- rebalance + affinity
+
+
+def test_migrate_session_rebalances_token_exact(tiny):
+    """The operator-driven flavor: migrate_session moves a live
+    stream with NO retirement — the source keeps serving — and an
+    unknown request id reports False instead of raising."""
+    model, params = tiny
+    prompt, budget = _prompt(), 40
+    expect = _control(tiny, prompt, budget, temperature=0.6, top_k=4,
+                      seed=5)
+    pool = PagePool(model, params, 128, 8, shared=True)
+    gw = Gateway([_mk(tiny, page_pool=pool, fault_plan=_slow()),
+                  _mk(tiny, page_pool=pool, fault_plan=_slow())]).start()
+    try:
+        t = gw.submit(GenRequest(list(prompt), max_new_tokens=budget,
+                                 temperature=0.6, top_k=4, seed=5,
+                                 id="reb"))
+        _wait_emitted(t, 3)
+        src = t.replica
+        assert gw.migrate_session("reb") is True
+        res = t.result(timeout=120)
+        assert list(res.tokens) == list(expect)
+        assert t.replica != src
+        assert gw.migrate_session("nope") is False
+        assert gw.snapshot()["shed"] == {}
+    finally:
+        assert gw.drain(timeout=60)
+    assert pool.n_used == 0
+
+
+def test_remote_prefix_affinity_via_heartbeat_summary(tiny):
+    """Satellite: a REMOTE replica's warmth is visible to the
+    prefix-affinity router through the bounded radix summary its
+    agent ships on every heartbeat — the warm remote wins the probe
+    over a cold local even when least-outstanding points the other
+    way."""
+    base = list(range(1, 21))
+    http = _start_agent(tiny, prefix_cache_mb=2.0)
+    stub = _stub(http.address)
+    gw = Gateway([stub, _mk(tiny, prefix_cache_mb=2.0)],
+                 prefix_affinity=True).start()
+    try:
+        # pin the warm-up on the remote, then let a heartbeat ship
+        # the summary that proves it holds the prefix
+        gw.replicas[1].outstanding = 500
+        gw.submit(GenRequest(list(base), 4,
+                             id="warm")).result(timeout=300)
+        gw.replicas[1].outstanding = 0
+        _wait(lambda: stub.prefix_match_len(base) >= len(base),
+              msg="heartbeat shipped the radix summary")
+        # skew load so least-outstanding prefers the cold local
+        gw.replicas[0].outstanding = 500
+        t = gw.submit(GenRequest(list(base) + [7, 8], 4, id="probe"))
+        t.result(timeout=300)
+        assert t.metrics["replica"] == 0
+        assert gw.snapshot()["routing"]["prefix_routed"] >= 1
+    finally:
+        gw.replicas[0].outstanding = 0
+        gw.drain(timeout=60)
+        http.stop()

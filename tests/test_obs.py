@@ -579,6 +579,25 @@ def test_metrics_exposition_format_and_stats_consistency(tiny):
         for i, row in enumerate(snap["replicas"]):
             assert (f'tony_engine_prefills_total{{replica="{i}"}} '
                     f'{row["prefills"]}') in text
+        # ISSUE-18: migration families render on every fleet (zero
+        # here — nothing migrated) and agree with /stats on both the
+        # per-replica rows and the carry-inclusive fleet rollup
+        mig = snap["engine"]["migrations"]
+        assert types["tony_migration_out_total"] == "counter"
+        assert f'tony_migrations_total {snap["routing"]["migrations"]}' \
+            in text
+        for key, fam in (("out", "tony_migration_out_total"),
+                         ("in", "tony_migration_in_total"),
+                         ("local", "tony_migration_local_total"),
+                         ("remote", "tony_migration_remote_total"),
+                         ("pages_moved",
+                          "tony_migration_pages_moved_total"),
+                         ("bytes_avoided",
+                          "tony_migration_bytes_avoided_total")):
+            assert f"{fam} {mig[key]}" in text, fam
+        for i, row in enumerate(snap["replicas"]):
+            assert (f'tony_engine_migrations_out_total{{replica="{i}"}} '
+                    f'{row["migrations_out"]}') in text
         # the paged-KV block: /metrics and /stats must agree on every
         # kv_pages figure (per-replica gauges sum to the engine rollup)
         kv = snap["engine"]["kv_pages"]

@@ -214,6 +214,50 @@ def test_tier_lru_eviction_under_budget():
     assert tier.match_len(np.arange(8, dtype=np.int32)) == 0
 
 
+def test_async_spill_fifo_ordering_and_accounting():
+    """ISSUE-18 satellite: ``spill_async`` queues the device->host
+    copy for the background worker. The contract pinned here: (a)
+    counters move at DISPATCH time and equal the landed totals after
+    ``flush()``; (b) ``has()`` sees queued content immediately, so
+    the engine never re-spills a sequence already in flight; (c) the
+    single-worker FIFO lands inserts in eviction order — under a
+    2-entry budget the FIRST-queued entry is the one evicted; (d)
+    only the ``n`` REAL pages are charged and stored, the gather's
+    pow2 padding is dropped."""
+    def payload(v):
+        # a 4-page gather (pow2 bucket) of which only 2 are real
+        return {"cached_key": np.full((4, 8, 2, 16), v, np.float32),
+                "cached_value": np.full((4, 8, 2, 16), v, np.float32)}
+
+    # host charge per entry: 2 leaves x 2 real pages x 1024 B = 4 KiB;
+    # budget holds exactly two entries
+    tier = HostPageTier(budget_bytes=10_000)
+    toks = [np.arange(8 * i, 8 * i + 8, dtype=np.int32)
+            for i in range(3)]
+    for i, t in enumerate(toks):
+        tier.spill_async(t, payload(float(i + 1)), 2, None)
+        assert tier.has(t)  # pending or landed: either way visible
+    assert tier.spills == 3
+    assert tier.bytes_spilled == 3 * 4096
+    assert tier.flush(timeout=10.0)
+    st = tier.stats()
+    assert st["entries"] == 2 and st["evictions"] == 1, st
+    # FIFO: the first-queued sequence was first in, first evicted
+    assert tier.match_len(toks[0]) == 0
+    assert tier.match_len(toks[1]) == 8
+    assert tier.match_len(toks[2]) == 8
+    # the landed rows are the n=2 REAL pages, bitwise, padding gone
+    match, entry = tier.acquire(toks[2])
+    assert match == 8 and entry is not None
+    try:
+        for leaf in entry.row.values():
+            assert leaf.shape[0] == 2
+            np.testing.assert_array_equal(
+                leaf, np.full((2, 8, 2, 16), 3.0, np.float32))
+    finally:
+        tier.release(entry)
+
+
 # ------------------------------------------------- kv_host_thrash alert
 
 

@@ -169,6 +169,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve fixed-shape per-slot cache rows instead "
                         "of the paged pool (A/B escape hatch; "
                         "sliding-window models downgrade automatically)")
+    p.add_argument("--no-shared-pool", action="store_true",
+                   help="give each in-process replica its own private "
+                        "KV page pool instead of one gateway-owned "
+                        "shared pool (the shared pool makes "
+                        "prefill->decode handoffs and live session "
+                        "migration zero-copy owner swaps, and pools "
+                        "the fleet's free-page headroom)")
     p.add_argument("--mesh", default="",
                    help="sharded replicas (ISSUE-14): devices per "
                         "replica as a bare count (tensor-parallel, "
@@ -485,6 +492,27 @@ def server_factory(args, model, params, eos):
             "store (--prefix-cache-mb > 0)")
         kv_host_mb = 0.0
 
+    # ONE gateway-owned shared PagePool lent to every co-located
+    # replica (ISSUE-18): prefill->decode handoffs and live session
+    # migration between in-process replicas become zero-copy refcount
+    # owner swaps, and the fleet's free-page headroom is pooled (a
+    # retiring replica's pages are instantly usable by the survivors).
+    # Sized for the fleet CEILING — the same HBM the per-replica pools
+    # would have held between them, in one allocation.
+    pool = None
+    if paged_kw.get("paged") \
+            and not getattr(args, "no_shared_pool", False):
+        from tony_tpu.serve.slots import PagePool, default_page_size
+
+        cfg = model.cfg
+        ps = paged_kw.get("kv_page_size", 0) \
+            or default_page_size(cfg)
+        ps = max(1, min(int(ps), cfg.max_seq_len))
+        per_replica = paged_kw.get("kv_pages", 0) \
+            or args.serve_batch * (-(-cfg.max_seq_len // ps))
+        pool = PagePool(model, params, int(per_replica) * ceiling, ps,
+                        mesh=mesh, shared=True)
+
     def make(index: int):
         return Server(model, params, batch_size=args.serve_batch,
                       eos_id=eos, chunk_steps=args.chunk_steps,
@@ -500,6 +528,7 @@ def server_factory(args, model, params, eos):
                           args, "no_in_dispatch_eos", False),
                       mesh=mesh,
                       shard_rules=getattr(args, "shard_rules", "serve"),
+                      page_pool=pool,
                       **paged_kw)
 
     return make

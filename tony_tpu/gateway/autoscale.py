@@ -8,8 +8,13 @@ every sensor that loop needs — queue depth and oldest-wait age on the
 new ``/stats`` queue block, shed rates, TTFT SLO burn in the lifetime
 histograms, ``kv_pages`` pressure — and both actuation primitives
 (``Gateway.add_replica`` rides the circuit breaker's probe admission,
-``Gateway.remove_replica`` rides the zero-loss drain), but nothing
-connected them. ``AutoScaler`` is that connection:
+``Gateway.remove_replica`` rides the zero-loss drain — which, since
+ISSUE-18, MIGRATES the victim's in-flight sessions to the survivors
+mid-stream instead of decoding them to completion, so a scale-down is
+also a defragmentation: the fleet's live work packs onto the replicas
+that remain, token-exact, and the victim's drain time is bounded by
+freeze cost rather than its longest remaining generation), but
+nothing connected them. ``AutoScaler`` is that connection:
 
 - a control loop samples ``Gateway.scale_signals()`` every
   ``interval_s`` and classifies the fleet as PRESSURED (queue depth

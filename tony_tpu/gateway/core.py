@@ -98,6 +98,20 @@ of role tasks onto a container pool). The pieces, front to back:
   dropped connection to a healthy agent is a reconnect at the held
   offset, not a failover), and in-lease connect retries with capped
   jittered backoff. A dead host is just a wedged replica.
+- LIVE MIGRATION (``serve/migrate.py``, ISSUE-18): every PLANNED
+  topology change — ``remove_replica()`` retirement, the autoscaler's
+  scale-down, a ``migrate_session()`` rebalance — moves in-flight
+  decode sessions to the survivors instead of finishing or re-running
+  them: the source engine freezes each live slot at a dispatch
+  boundary into a ``SessionSnapshot`` (pages + sampler/PRNG state +
+  absolute emitted offset) and the ticket re-routes carrying it, so
+  the stream resumes mid-flight on its new replica, token-exact.
+  Between co-located replicas lent one SHARED ``PagePool`` the
+  transfer is a zero-copy refcount owner swap (page ids, no KV bytes
+  moved); to a remote replica the snapshot rides the agent wire
+  (``POST /v1/migrate_in``) over the multiplexed channel. Failures
+  mid-migration fall back to the crash path above — re-run from the
+  prompt, still token-exact.
 """
 
 from __future__ import annotations
@@ -279,6 +293,14 @@ class Ticket:
         self.phase: str | None = None
         self.handoff: Any = None
         self._prefill_meta: dict | None = None
+        # live migration (ISSUE-18): the frozen ``SessionSnapshot`` a
+        # planned move carries between replicas — set by
+        # _relay_migration, consumed (and CLEARED: the payload is
+        # one-shot, its transfer ref moves into the adopting slot) by
+        # the admission that resumes it. A ticket whose snapshot is
+        # gone falls back to the crash path: re-run from the prompt,
+        # token-exact.
+        self.migrate: Any = None
         self._wfq_key: tuple | None = None  # set by WFQueue.push
         self.metrics: dict | None = None  # the done-event record
         self.events: queue.Queue = queue.Queue()
@@ -362,6 +384,45 @@ class Ticket:
                 exc = cls(reason)
                 exc.http_status = status
                 raise exc
+
+
+def _release_snapshot(snap) -> None:
+    """Give back the shared-pool transfer ref a LOCAL (owner-swap)
+    ``SessionSnapshot`` still holds. Wire snapshots carry content, not
+    references — nothing to release."""
+    if snap is None or isinstance(snap, dict):
+        return
+    pool = getattr(snap, "pool", None)
+    if not getattr(snap, "local", False) or pool is None:
+        return
+    try:
+        with pool.lock:
+            pool.unref([int(p) for p in snap.pages])
+    except Exception:
+        log.exception("migrate snapshot page release failed")
+    snap.local = False
+    snap.pool = None
+
+
+def _release_ticket_payload(ticket) -> None:
+    """Drop (and, for owner-swap forms, unref) the one-shot payloads a
+    ticket still carries — run on every terminal path and on the
+    refused-payload fallback, so a shed or re-run mid-migration can
+    never leak shared-pool pages. Wire payloads hold no references and
+    device-tree handoffs stay reusable, so only the id-carrying forms
+    are touched."""
+    snap, ticket.migrate = ticket.migrate, None
+    _release_snapshot(snap)
+    ho = ticket.handoff
+    if isinstance(ho, dict) and "page_ids" in ho:
+        ticket.handoff = None
+        pool = ho.get("pool")
+        try:
+            if pool is not None:
+                with pool.lock:
+                    pool.unref([int(p) for p in ho["page_ids"]])
+        except Exception:
+            log.exception("handoff page release failed")
 
 
 class _Replica:
@@ -555,6 +616,12 @@ class _Replica:
                 if not self._recover():
                     return
                 continue
+            if self.retiring:
+                # planned exit (ISSUE-18): hand the work to survivors
+                # instead of finishing it here — every loop iteration,
+                # so a request that was still mid-prefill last round
+                # migrates the moment it reaches a live decode slot
+                self._migrate_out(epoch)
             try:
                 self._admit_from_queue(epoch)
                 with self.cv:
@@ -606,6 +673,57 @@ class _Replica:
                 if not self._recover():
                     return
 
+    def _migrate_out(self, epoch: int) -> None:
+        """Retirement accelerator (ISSUE-18), on this replica's own
+        thread: a retiring replica moves its work to the survivors
+        instead of decoding it to completion. Queued tickets simply
+        re-route (they never started); live decode slots freeze into
+        ``SessionSnapshot``s and resume mid-stream elsewhere,
+        token-exact. Whatever cannot move — no healthy taker, an
+        unpaged engine, a request still mid-prefill — keeps running
+        here, so the zero-loss drain promise is unchanged; migration
+        only makes the drain fast."""
+        gw = self.gateway
+        # queued first: a ticket that re-routes before admission costs
+        # nothing to move
+        while True:
+            with self.cv:
+                if self.epoch != epoch:
+                    return
+                ticket = self.queue.pop()
+            if ticket is None:
+                break
+            try:
+                target = gw._route(ticket,
+                                   ticket.excluded | {self.index})
+            except NoHealthyReplicas:
+                # nobody can take work: keep it and run it here
+                with self.cv:
+                    if self.epoch == epoch:
+                        self.queue.unpop(ticket)
+                break
+            with self.cv:
+                if self.epoch == epoch:
+                    self.outstanding = max(
+                        0, self.outstanding - ticket.cost)
+            if ticket.trace is not None:
+                ticket.trace.end_attempt(time.monotonic(),
+                                         outcome="moved")
+            ticket.state = QUEUED
+            ticket.replica = None
+            try:
+                target.enqueue(ticket, force=True)
+            except (GatewayClosed, _ReplicaUnhealthy):
+                gw._requeue(self, ticket,
+                            f"replica {self.index} retiring")
+        # then the live slots: freeze + relay, one at a time
+        with self.cv:
+            if self.epoch != epoch:
+                return
+            live = list(self._tickets.items())
+        for engine_id, ticket in live:
+            gw._migrate_ticket(self, engine_id, ticket, epoch)
+
     def _server_busy(self) -> bool:
         server = self.server  # single read vs concurrent retirement
         if server is None:  # retired: engine released
@@ -649,7 +767,11 @@ class _Replica:
                     # handoff); a ticket carrying a handoff payload
                     # admits it instead of prefilling
                     prefill_only=self.role == "prefill",
-                    handoff=ticket.handoff))
+                    handoff=ticket.handoff,
+                    # a migrated-in session resumes mid-stream: the
+                    # engine arms a slot from the snapshot instead of
+                    # prefilling (serve/migrate.py)
+                    migrate=ticket.migrate))
             except QueueFull:
                 # engine bound hit (shouldn't happen: we feed at most
                 # free-slot many) — put it back and stop admitting.
@@ -672,6 +794,27 @@ class _Replica:
                 self._shed(ticket, 503, str(e), epoch=epoch)
                 continue
             except ValueError as e:
+                if ticket.migrate is not None or (
+                        isinstance(ticket.handoff, dict)
+                        and "page_ids" in ticket.handoff):
+                    # this engine refused the CARRIED state (owner-swap
+                    # payload from a pool it does not hold, codec
+                    # drift after a topology change) — that is a
+                    # placement mistake, not the client's: drop the
+                    # payload (refs released) and fall back to the
+                    # crash path, a token-exact re-run from the prompt
+                    log.warning(
+                        "replica %d refused a migrated payload (%s); "
+                        "falling back to re-run", self.index, e)
+                    _release_ticket_payload(ticket)
+                    with self.cv:
+                        if self.epoch == epoch:
+                            self.queue.unpop(ticket)
+                            continue
+                    self.gateway._failover(
+                        self, [], [ticket],
+                        f"replica {self.index} failed during admission")
+                    return
                 self._shed(ticket, 400, str(e), epoch=epoch)
                 continue
             except (ConnectionError, TimeoutError, OSError):
@@ -691,6 +834,17 @@ class _Replica:
                     f"replica {self.index} transport failed during "
                     f"admission")
                 return
+            # one-shot payloads are CONSUMED by the submit that
+            # succeeded (their transfer ref moved into the engine), so
+            # they must not survive on the ticket: a later failover
+            # re-submitting a spent owner-swap doc would install
+            # dangling page ids. Clearing them degrades that failover
+            # to the crash path — re-run from the prompt, token-exact.
+            if ticket.migrate is not None:
+                ticket.migrate = None
+            if isinstance(ticket.handoff, dict) \
+                    and "page_ids" in ticket.handoff:
+                ticket.handoff = None
             with self.cv:
                 if self.epoch != epoch:
                     # declared failed mid-admission: the ticket we just
@@ -747,7 +901,8 @@ class _Replica:
         for rec in new:
             if rec.kind in ("prefill", "prefill_chunk", "hit_admit",
                             "cow_admit", "handoff_admit",
-                            "handoff_out"):
+                            "handoff_out", "migrate_out",
+                            "migrate_in"):
                 targets = [tickets.get(rec.request_id)]
             else:
                 targets = [tickets.get(eid)
@@ -886,6 +1041,8 @@ class _Replica:
     def _shed(self, ticket: Ticket, status: int, reason: str,
               epoch: int | None = None) -> None:
         self.shed += 1
+        _release_ticket_payload(ticket)  # a dead ticket must not pin
+        #                                  shared-pool pages
         with self.cv:
             if epoch is None or self.epoch == epoch:
                 # fenced + clamped: a steal that raced the caller's
@@ -1124,6 +1281,15 @@ class _Stats:
         # prefix-affinity probe, and prefill->decode handoffs relayed
         self.prefix_routed = 0
         self.handoffs = 0
+        # live migration (ISSUE-18): sessions relayed mid-stream to a
+        # new replica (retirement drain, scale-down defrag, or a
+        # migrate_session rebalance). ``migrate_carry`` holds the
+        # migration counters of replicas that RETIRED — the out-side
+        # of a retirement drain lives on the engine being released, so
+        # without the carry every scale-down would erase its own
+        # ledger from /stats
+        self.migrations = 0
+        self.migrate_carry: dict[str, float] = {}
         # the flight recorder (ISSUE-15): alert-triggered debug
         # bundles dumped into the history job dir
         self.bundles_written = 0
@@ -1659,11 +1825,17 @@ class Gateway:
                        timeout: float | None = None) -> bool:
         """Shrink the fleet at runtime over the existing ZERO-LOSS
         drain: the replica leaves routing immediately (``retiring`` —
-        new submits re-route, the enqueue race re-routes), finishes
-        every queued + in-flight request it holds, then parks RETIRED
-        with its engine released (the KV cache's memory goes back to
-        the provisioner's account). A dispatch that wedges during the
-        drain still fails over: the watchdog keeps watching until the
+        new submits re-route, the enqueue race re-routes), MIGRATES
+        its work to the survivors — queued tickets re-route untouched,
+        live decode slots freeze into ``SessionSnapshot``s and resume
+        mid-stream elsewhere, token-exact (ISSUE-18) — then parks
+        RETIRED with its engine released (the KV cache's memory goes
+        back to the provisioner's account). What cannot migrate (an
+        unpaged engine, a request mid-prefill, no healthy taker) is
+        finished here, so the drain time is bounded by the slowest
+        FREEZE rather than the longest remaining generation whenever
+        migration applies. A dispatch that wedges during the drain
+        still fails over: the watchdog keeps watching until the
         thread is joined. Refuses to remove the last live replica.
         Returns True when the drain completed inside ``timeout``."""
         replica = self.replicas[index]  # IndexError = caller bug
@@ -1693,6 +1865,22 @@ class Gateway:
             # and busy() guard against the None
             server = replica.server
             replica.server = None
+        # fold the departing engine's migration ledger into the carry
+        # before the reference is dropped — the out-side of the drain
+        # it just performed is counted on IT
+        try:
+            counts = server.counters() if server is not None else {}
+        except Exception:
+            counts = {}
+        with self.stats.lock:
+            for key in ("migrations_out", "migrations_in",
+                        "migrations_local", "migrations_remote",
+                        "migrate_pages_moved", "migrate_bytes_avoided",
+                        "migrate_freeze_resume_ms"):
+                if counts.get(key):
+                    self.stats.migrate_carry[key] = \
+                        self.stats.migrate_carry.get(key, 0) \
+                        + counts[key]
         # remote replicas: stop the stub's lease/heartbeat machinery
         # (and, for agents the stub launched, drain + reap the agent
         # process) — a retired replica must not keep pinging a host
@@ -2183,8 +2371,12 @@ class Gateway:
         walk, no device work, no counters moved) and pin to the
         longest match when it is worth it. Ties break by least
         outstanding work, so two equally-warm replicas still balance.
-        Remote stubs don't expose a local radix (a per-request network
-        probe would cost more than it saves) and simply never win."""
+        Remote stubs answer from the bounded radix summary their
+        agent ships on every heartbeat (ISSUE-18) — no per-request
+        network probe, staleness bounded by the heartbeat interval,
+        and a stale hit costs a suboptimal preference, never
+        correctness — so a REMOTE replica holding the prefix can win
+        over a cold local one."""
         best, best_len = None, 0
         for r in healthy:
             probe = getattr(r.server, "prefix_match_len", None)
@@ -2395,6 +2587,127 @@ class Gateway:
                 continue
             return
 
+    # ------------------------------------------------ live migration
+
+    def _migrate_ticket(self, replica: _Replica, engine_id: int,
+                        ticket: Ticket, epoch: int) -> bool:
+        """Freeze one live decode slot off ``replica`` and relay it to
+        another replica (ISSUE-18). False means the session did NOT
+        move and keeps running where it is — not-live-yet (pending or
+        mid-prefill), unpaged engine, no healthy taker, or the extract
+        lost a race; every one of those leaves the old behavior (decode
+        to completion, or crash-path failover) intact."""
+        server = replica.server
+        if server is None or not getattr(server, "paged", False):
+            return False
+        if getattr(server, "extract_session", None) is None:
+            return False
+        # probe for a taker BEFORE freezing: with nobody to adopt it, a
+        # freeze would degrade the session to a re-run from the prompt
+        # for nothing
+        try:
+            self._route(ticket, ticket.excluded | {replica.index})
+        except NoHealthyReplicas:
+            return False
+        # owner-swap extract (page ids, zero bytes moved) whenever the
+        # engine's pool is shared — if routing then lands the ticket on
+        # a REMOTE replica, the stub gathers the content late
+        # (serve/migrate.gather_local); otherwise gather to wire now
+        pool = getattr(getattr(server, "slots", None), "pool", None)
+        wire = not (pool is not None and getattr(pool, "shared", False))
+        try:
+            snap = server.extract_session(engine_id, wire=wire)
+        except Exception:
+            log.exception("migrate-out extract failed on replica %d",
+                          replica.index)
+            return False
+        if snap is None:
+            return False  # not in a live slot: pending, prefilling,
+            #               or it finished under us
+        with replica.cv:
+            owned = replica.epoch == epoch \
+                and replica._tickets.pop(engine_id, None) is not None
+            if owned:
+                replica.outstanding = max(
+                    0, replica.outstanding - ticket.cost)
+        if not owned:
+            # the watchdog's steal raced the freeze: failover owns the
+            # ticket now (re-run from prompt) — drop the frozen copy
+            _release_snapshot(snap)
+            return False
+        self._relay_migration(replica, ticket, snap, time.monotonic())
+        return True
+
+    def _relay_migration(self, replica: _Replica, ticket: Ticket,
+                         snap, now: float) -> None:
+        """The planned-move hinge (ISSUE-18), the migration analog of
+        ``_relay_handoff``: a frozen live session leaves ``replica``
+        carrying its ``SessionSnapshot`` and resumes mid-stream on
+        whichever replica routing picks — prefix affinity included.
+        Not a failover (no attempt charged, no exclusion — the source
+        did nothing wrong) and not a completion (the stream continues;
+        the absolute-offset emit dedup keeps the client gap/dup-free).
+        Both attempts land in ONE trace, fenced by the ``migrate``
+        span. No taker left — a narrow race, callers probe before
+        freezing — falls back to the crash path: drop the snapshot
+        (refs released) and requeue an ordinary re-run from the
+        prompt, token-exact."""
+        with self.stats.lock:
+            self.stats.migrations += 1
+        ticket.migrate = snap
+        ticket.state = QUEUED
+        ticket.replica = None
+        if ticket.trace is not None:
+            local = not isinstance(snap, dict) \
+                and bool(getattr(snap, "local", False))
+            n_tok = snap.get("n_tokens") if isinstance(snap, dict) \
+                else snap.n_tokens
+            ticket.trace.end_attempt(now, outcome="migrate")
+            ticket.trace.add("migrate", now, attempt=False,
+                             from_replica=replica.index,
+                             n_tokens=int(n_tok), local=local)
+        tried = {replica.index}
+        while True:
+            try:
+                target = self._route(ticket, ticket.excluded | tried)
+            except NoHealthyReplicas:
+                _release_ticket_payload(ticket)
+                self._requeue(
+                    replica, ticket,
+                    "no replica left to adopt the migrated session")
+                return
+            try:
+                target.enqueue(ticket, force=True)
+            except (GatewayClosed, _ReplicaUnhealthy):
+                tried.add(target.index)
+                continue
+            return
+
+    def migrate_session(self, request_id) -> bool:
+        """Move one in-flight request to another replica, mid-stream
+        and token-exact — the operator/rebalancer entry to the same
+        machinery retirement uses. The new placement goes through the
+        ordinary routing stack, so with prefix affinity on, a hot
+        session migrates TOWARD the replica already holding its
+        prefix. Returns False when the request is not currently in a
+        live decode slot (queued, mid-prefill, finished, unknown) or
+        nothing could adopt it; the request is unharmed either way.
+
+        Safe from any thread: the freeze itself serializes against the
+        source's decode loop under the engine dispatch lock (local) or
+        happens on the agent's scheduler (remote)."""
+        for r in self.replicas:
+            if r.retired or r.server is None:
+                continue
+            with r.cv:
+                epoch = r.epoch
+                found = [(eid, t) for eid, t in r._tickets.items()
+                         if t.request.id == request_id]
+            if found:
+                return self._migrate_ticket(r, found[0][0],
+                                            found[0][1], epoch)
+        return False
+
     def _shed_ticket(self, replica: _Replica, ticket: Ticket,
                      status: int, reason: str,
                      exc: type | None = None) -> None:
@@ -2404,6 +2717,8 @@ class Gateway:
         was already zeroed wholesale by the steal, so that is NOT
         touched). ``exc`` tells ``Ticket.result()`` which Shed subclass
         to raise when the bare status is ambiguous (the 503 family)."""
+        _release_ticket_payload(ticket)  # a dead ticket must not pin
+        #                                  shared-pool pages
         if ticket.trace is not None:
             ticket.trace.finish(outcome="shed", status=status,
                                 reason=reason)
@@ -2622,6 +2937,7 @@ class Gateway:
                 "prefix_affinity": self.prefix_affinity,
                 "prefix_routed": self.stats.prefix_routed,
                 "handoffs": self.stats.handoffs,
+                "migrations": self.stats.migrations,
                 "roles": {r.index: r.role for r in live}
                 if self.roles else None,
             }
@@ -2721,6 +3037,10 @@ class Gateway:
         servers = [r.server for r in replicas if r.server is not None]
         counts = [s.counters() for s in servers]
         total = lambda key: sum(c.get(key, 0) for c in counts)  # noqa: E731
+        # migration totals include the retired replicas' carry — see
+        # _Stats.migrate_carry
+        carry = dict(self.stats.migrate_carry)
+        mtotal = lambda key: total(key) + carry.get(key, 0)  # noqa: E731
         lookups = total("prefix_lookups")
         drafted = total("spec_drafted")
         if replica_rows is not None:
@@ -2773,6 +3093,21 @@ class Gateway:
             "handoffs": {
                 "out": total("handoffs_out"),
                 "in": total("handoffs_in"),
+            },
+            # live migration (ISSUE-18): sessions frozen out / adopted
+            # in, split by HOW the pages moved — owner swap (shared
+            # pool, ids only) vs gathered content — plus the bytes the
+            # swaps did NOT copy and the freeze->resume stall the
+            # moved streams actually saw
+            "migrations": {
+                "out": mtotal("migrations_out"),
+                "in": mtotal("migrations_in"),
+                "local": mtotal("migrations_local"),
+                "remote": mtotal("migrations_remote"),
+                "pages_moved": mtotal("migrate_pages_moved"),
+                "bytes_avoided": mtotal("migrate_bytes_avoided"),
+                "freeze_resume_ms": round(
+                    mtotal("migrate_freeze_resume_ms"), 3),
             },
             # sharded replicas (ISSUE-14): mesh topology rollup —
             # device/shard counts ride the flat counters (so remote

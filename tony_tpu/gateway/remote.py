@@ -48,6 +48,17 @@ changes is only what a network adds:
   and the stub re-raises the real types (``QueueFull``,
   ``PoolExhausted``, ``ValueError``), so the gateway's admission
   paths cannot tell local from remote.
+- **Live migration, over the wire** (ISSUE-18): ``submit`` ships a
+  frozen session (``request.migrate``) to ``POST /v1/migrate_in``,
+  and ``extract_session`` freezes a live slot OUT of the agent via
+  ``POST /v1/migrate_out``. Owner-swap payloads (shared-pool page
+  ids from a co-located source) are gathered to page CONTENT here —
+  in place, consuming the transfer ref exactly once, so a retried or
+  requeued ticket ships the gathered copy instead of dangling ids.
+  The agent's bounded radix summary rides every heartbeat, and
+  ``prefix_match_len`` scores it with the same grain-grid probe the
+  local store uses — prefix affinity can now prefer a REMOTE replica
+  that holds the prompt's prefix over a cold local one.
 - **The observability plane, pulled over the wire** (ISSUE-15): an
   obs-puller rides the heartbeat cadence — after each successful
   ``/healthz`` it GETs ``/v1/obs?cursor=`` and lands the agent's
@@ -91,6 +102,7 @@ from types import SimpleNamespace
 from tony_tpu.obs.timeline import record_from_doc
 from tony_tpu.serve.agent import result_from_doc
 from tony_tpu.serve.engine import PoolExhausted, QueueFull, Request
+from tony_tpu.serve.prefix import summary_match_len
 
 log = logging.getLogger(__name__)
 
@@ -491,6 +503,9 @@ class RemoteServer:
         # the engine-summary probe reads ``prefix is not None``
         self.prefix = True if info.get("prefix") else None
         self._counters = dict(info.get("counters", {}))
+        # the agent's bounded radix summary ([[n_tokens, crc32], ...]),
+        # refreshed on every heartbeat — what prefix_match_len scores
+        self._prefix_summary = list(info.get("prefix_summary") or [])
 
     # ------------------------------------------------------------ boot
 
@@ -581,6 +596,10 @@ class RemoteServer:
                     counters = doc.get("counters")
                     if isinstance(counters, dict):
                         self._counters = counters
+                    summary = doc.get("prefix_summary")
+                    if isinstance(summary, list):
+                        # atomic swap; readers never see a partial list
+                        self._prefix_summary = summary
                     # register (not ping): also RESURRECTS the lease
                     # entry after an expiry once the agent is back
                     if self._monitor is not None:
@@ -793,15 +812,50 @@ class RemoteServer:
             # into its own pool and the round trip is bitwise
             from tony_tpu.serve.tier import encode_array, encode_payload
 
-            pages = request.handoff["pages"]
-            logits = request.handoff["logits"]
+            ho = request.handoff
+            if "page_ids" in ho:
+                # an owner-swap payload (shared-pool page ids) routed
+                # off-host after all: gather the content — consuming
+                # the transfer ref — and rewrite the dict IN PLACE
+                # (ticket and request alias it, so a requeue ships the
+                # gathered copy, never dangling ids)
+                from tony_tpu.serve.migrate import gather_local
+
+                ho["pages"] = encode_payload(
+                    gather_local(ho.pop("pool"), ho.pop("page_ids")))
+                if not isinstance(ho["logits"], dict):
+                    ho["logits"] = encode_array(ho["logits"])
+            pages = ho["pages"]
+            logits = ho["logits"]
             doc["handoff"] = {
-                "n_tokens": int(request.handoff["n_tokens"]),
+                "n_tokens": int(ho["n_tokens"]),
                 "pages": encode_payload(pages),
                 "logits": logits if isinstance(logits, dict)
                 else encode_array(logits),
             }
             path = "/v1/handoff"
+        if request.migrate is not None:
+            # live migration intake (ISSUE-18): a frozen session rides
+            # /v1/submit's contract to /v1/migrate_in. A LOCAL snapshot
+            # (owner-swap page ids) is gathered to wire form first —
+            # mutated in place for the same requeue-safety reason as
+            # the handoff above: the transfer ref is consumed exactly
+            # once, and retries re-ship the encoded content.
+            from tony_tpu.serve.migrate import SessionSnapshot, \
+                gather_local, snapshot_to_doc
+            from tony_tpu.serve.tier import encode_payload
+
+            mig = request.migrate
+            if isinstance(mig, SessionSnapshot):
+                if mig.local:
+                    pool, ids = mig.pool, mig.pages
+                    mig.pages = encode_payload(gather_local(pool, ids))
+                    mig.local = False
+                    mig.pool = None
+                doc["migrate"] = snapshot_to_doc(mig)
+            else:
+                doc["migrate"] = mig  # already wire form (remote hop)
+            path = "/v1/migrate_in"
         # Mux mode pre-registers the ticket: a warm engine can finish
         # the request and the channel deliver every frame BEFORE this
         # submit POST returns — the demux must find the ticket or the
@@ -869,6 +923,62 @@ class RemoteServer:
             if t is not None and t.result is None and not t.confirmed:
                 del self._tickets[rid]
 
+    def extract_session(self, request_id, *, wire: bool = True):
+        """Freeze one live session OUT of the agent (ISSUE-18): POST
+        /v1/migrate_out returns the wire snapshot of the request's
+        decode slot, or ``None`` when the agent holds no live slot for
+        the id (finished, still pending, mid-prefill — nothing worth
+        moving). Remote snapshots are always wire form; ``wire`` is
+        accepted for surface parity with ``serve.Server`` and ignored.
+
+        While the call is in flight the stub ticket is marked
+        unconfirmed, so a ``gone`` frame racing on the mux channel
+        (the agent drops its ticket the moment the freeze lands) is
+        not read as an agent restart. On success the ticket leaves
+        with the session — its stream continues from the adopting
+        replica at the absolute offset the gateway already holds; on
+        anything else it is restored and the stream resumes here."""
+        from tony_tpu.serve.migrate import snapshot_from_doc
+
+        if self._dead:
+            raise ConnectionError(self._dead)
+        with self._cond:
+            ticket = self._tickets.get(request_id)
+            was_confirmed = True if ticket is None else ticket.confirmed
+            if ticket is not None:
+                ticket.confirmed = False
+        try:
+            resp = self.transport.call(
+                "POST", "/v1/migrate_out",
+                {"id": request_id, "epoch": self.epoch},
+                epoch=self.epoch, request=request_id,
+                timeout=max(self.transport.read_timeout_s, 30.0))
+        except AgentHTTPError as e:
+            self._unfreeze(request_id, was_confirmed)
+            if e.status == 409:
+                with self._stats_lock:
+                    self.stale_epoch_drops += 1
+            raise ConnectionError(str(e)) from e
+        except Exception:
+            self._unfreeze(request_id, was_confirmed)
+            raise
+        if not resp.get("found"):
+            self._unfreeze(request_id, was_confirmed)
+            return None
+        with self._cond:
+            self._tickets.pop(request_id, None)
+            self._cond.notify_all()
+        return snapshot_from_doc(resp["snapshot"])
+
+    def _unfreeze(self, rid, confirmed: bool) -> None:
+        """Undo ``extract_session``'s gone-frame suppression when the
+        session did NOT leave: the ticket stays live here."""
+        with self._cond:
+            t = self._tickets.get(rid)
+            if t is not None:
+                t.confirmed = confirmed
+                self._cond.notify_all()
+
     def _ensure_channel(self) -> None:
         with self._stats_lock:
             if self._channel_thread is not None:
@@ -908,6 +1018,17 @@ class RemoteServer:
 
     def counters(self) -> dict:
         return dict(self._counters)
+
+    def prefix_match_len(self, tokens) -> int:
+        """The router's affinity probe, remote flavor (ISSUE-18):
+        scored against the radix summary the agent ships on every
+        heartbeat — the same grain-grid ``[[n_tokens, crc32], ...]``
+        convention the device store and host tier publish, so a
+        REMOTE replica holding the prompt's prefix can win routing
+        over a cold local one. Staleness is bounded by the heartbeat
+        interval; a stale hit costs one suboptimal preference, never
+        correctness (the engine re-probes its own store on admit)."""
+        return summary_match_len(self._prefix_summary, tokens)
 
     def goodput(self):
         """The agent engine's goodput ledger, as of the last obs pull

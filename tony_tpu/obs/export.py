@@ -82,6 +82,21 @@ _REPLICA_COUNTERS = (
      "Prefill-pool requests handed off as page lists"),
     ("handoffs_in", "tony_engine_handoffs_in_total",
      "Handoff payloads admitted by this (decode-pool) replica"),
+    ("migrations_out", "tony_engine_migrations_out_total",
+     "Live sessions frozen and extracted off this replica mid-stream"),
+    ("migrations_in", "tony_engine_migrations_in_total",
+     "Migrated sessions adopted into a decode slot on this replica"),
+    ("migrations_local", "tony_engine_migrations_local_total",
+     "Shared-pool owner swaps (both sides count one)"),
+    ("migrations_remote", "tony_engine_migrations_remote_total",
+     "Cross-host wire migrations (both sides count one)"),
+    ("migrate_pages_moved", "tony_engine_migrate_pages_moved_total",
+     "KV pages physically copied by migrations (wire path)"),
+    ("migrate_bytes_avoided", "tony_engine_migrate_bytes_avoided_total",
+     "KV bytes an owner swap kept in place instead of copying"),
+    ("migrate_freeze_resume_ms",
+     "tony_engine_migrate_freeze_resume_ms_total",
+     "Milliseconds sessions spent frozen between extract and adopt"),
     ("kv_host_spills", "tony_kv_host_spills_total",
      "Prefix-store entries spilled device->host into the page tier"),
     ("kv_host_page_ins", "tony_kv_host_page_ins_total",
@@ -427,6 +442,36 @@ def prometheus_text(gateway) -> str:
     counter("tony_handoffs_total",
             "Prefill->decode page-list handoffs relayed",
             routing.get("handoffs", 0))
+
+    # live session migration (ISSUE-18): fleet totals include the
+    # carry folded in by remove_replica, so a retired replica's
+    # out-side ledger survives its own departure — per-replica rows
+    # above only cover replicas still alive
+    counter("tony_migrations_total",
+            "Live sessions relayed mid-stream to a new replica",
+            routing.get("migrations", 0))
+    mig = eng.get("migrations") or {}
+    counter("tony_migration_out_total",
+            "Sessions frozen + extracted, fleet-wide (carry-inclusive)",
+            mig.get("out", 0))
+    counter("tony_migration_in_total",
+            "Migrated sessions adopted, fleet-wide (carry-inclusive)",
+            mig.get("in", 0))
+    counter("tony_migration_local_total",
+            "Shared-pool owner swaps, both sides counted",
+            mig.get("local", 0))
+    counter("tony_migration_remote_total",
+            "Cross-host wire migrations, both sides counted",
+            mig.get("remote", 0))
+    counter("tony_migration_pages_moved_total",
+            "KV pages physically copied by migrations",
+            mig.get("pages_moved", 0))
+    counter("tony_migration_bytes_avoided_total",
+            "KV bytes owner swaps kept in place instead of copying",
+            mig.get("bytes_avoided", 0))
+    counter("tony_migration_freeze_resume_ms_total",
+            "Milliseconds sessions spent frozen between extract and "
+            "adopt", mig.get("freeze_resume_ms", 0.0))
 
     # the goodput ledger (obs/goodput.py): fleet wall-clock bucket
     # fractions — sum(tony_goodput_fraction) <= 1 by construction, and

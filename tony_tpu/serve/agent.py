@@ -35,6 +35,19 @@ behind ``gateway/remote.RemoteServer``):
                       BEFORE delivering, instead of losing the tail
                       of a short request to the next obs-pull's lag.
                       (The puller dedups against these by agent seq.)
+  POST /v1/migrate_in live migration, adopt half (ISSUE-18): the
+                      /v1/submit contract plus ``migrate``, a frozen
+                      session's wire snapshot (serve/migrate.py) —
+                      pages ride the same base64 leaf codec as
+                      /v1/handoff; the engine resumes decode at the
+                      exact position with no prefill.
+  POST /v1/migrate_out
+                      live migration, freeze half: ``{"id", "epoch"}``
+                      -> ``{"found", "snapshot"}``; the agent freezes
+                      the live slot at a dispatch boundary, drops its
+                      ticket (the stream continues from the adopting
+                      replica), and the session's pages/sampler state
+                      leave in wire form.
   POST /v1/reset      ``{"epoch"}``: adopt the (newer) epoch, hard-
                       reset the engine, drop every ticket — the
                       gateway's breaker recovery calls this before a
@@ -266,7 +279,11 @@ class ReplicaAgent:
             # /v1/submit; a handoff payload arrives via /v1/handoff
             # (same body + the encoded pages) — the engine decodes it
             prefill_only=bool(doc.get("prefill_only", False)),
-            handoff=doc.get("handoff"))
+            handoff=doc.get("handoff"),
+            # live migration (ISSUE-18): a frozen session's wire doc
+            # arrives via /v1/migrate_in — the engine adopts it with no
+            # prefill and resumes decode at the exact position
+            migrate=doc.get("migrate"))
         with self._cond:
             # IDEMPOTENT on the request id: the stub retries connect
             # errors, and a reset that lands after the agent processed
@@ -332,12 +349,43 @@ class ReplicaAgent:
             "paged": bool(server.paged),
             "speculate_k": server.speculate_k,
             "prefix": server.prefix is not None,
+            # bounded radix summary (ISSUE-18): [[n_tokens, crc32],
+            # ...] of cached prefixes, so the gateway's prefix-affinity
+            # probe can score THIS remote replica instead of assuming 0
+            "prefix_summary": server.prefix_summary(),
             "counters": server.counters(),
             # this process's monotonic clock, read in-handler: the
             # gateway brackets the call and estimates the clock offset
             # as t_mono - RTT midpoint (uncertainty = RTT/2)
             "t_mono": time.monotonic(),
         }
+
+    def migrate_out(self, doc: dict) -> dict:
+        """POST /v1/migrate_out: freeze one live session into its wire
+        snapshot and drop its ticket — the source half of a remote
+        migration (ISSUE-18). The engine's dispatch lock lands the
+        freeze at a dispatch boundary, so the snapshot is token-exact
+        no matter where the stepper was. ``found: false`` when the
+        request is not in a live decode slot (still pending or
+        mid-prefill — nothing worth moving; the caller re-runs it as
+        an ordinary request)."""
+        from tony_tpu.serve.migrate import snapshot_to_doc
+
+        self.check_epoch(int(doc.get("epoch", 0)))
+        if self.failed is not None:
+            raise RuntimeError(f"agent failed: {self.failed}")
+        rid = doc.get("id")
+        snap = self.server.extract_session(rid, wire=True)
+        if snap is None:
+            return {"found": False, "epoch": self.epoch}
+        with self._cond:
+            # the ticket moves with the session: its stream continues
+            # from the ADOPTING replica, and leaving it here would
+            # park a never-finishing entry on the mux channel
+            self._tickets.pop(rid, None)
+            self._cond.notify_all()
+        return {"found": True, "snapshot": snapshot_to_doc(snap),
+                "epoch": self.epoch}
 
     def obs(self, cursor: int) -> dict:
         """GET /v1/obs payload: incremental timeline records past
@@ -681,6 +729,26 @@ class AgentHandler(BaseHTTPRequestHandler):
                 return self._send(400, {"error": "handoff body needs "
                                         "a 'handoff' payload"})
             return self._submit(body)
+        if path == "/v1/migrate_in":
+            # the adopt half of live migration (ISSUE-18): /v1/submit's
+            # contract, body carries a frozen session's wire snapshot —
+            # the engine resumes it with no prefill, no first-token draw
+            if "migrate" not in body:
+                return self._send(400, {"error": "migrate_in body "
+                                        "needs a 'migrate' snapshot"})
+            return self._submit(body)
+        if path == "/v1/migrate_out":
+            try:
+                return self._send(200, self.agent.migrate_out(body))
+            except _StaleEpoch as e:
+                return self._send(409, {"error": str(e),
+                                        "epoch": self.agent.epoch})
+            except (ValueError, TypeError, KeyError) as e:
+                return self._send(400, {"error": str(e),
+                                        "kind": "ValueError"})
+            except RuntimeError as e:
+                return self._send(503, {"error": str(e),
+                                        "kind": "Unavailable"})
         if path == "/v1/reset":
             try:
                 return self._send(200,

@@ -59,6 +59,7 @@ from tony_tpu.obs.goodput import (CostModel, detect_hbm_gbps,
                                   detect_peak_flops, ledger)
 from tony_tpu.obs.timeline import DispatchRecord, DispatchTimeline
 from tony_tpu.serve.faults import FaultPlan
+from tony_tpu.serve.migrate import SessionSnapshot, snapshot_from_doc
 from tony_tpu.serve.prefix import PrefixStore
 from tony_tpu.serve.slots import (PagePool, SlotCache, _gather_pages,
                                   _read_slot, _scatter_pages,
@@ -66,7 +67,7 @@ from tony_tpu.serve.slots import (PagePool, SlotCache, _gather_pages,
                                   paged_view, paged_write_back)
 from tony_tpu.serve.tier import (HostPageTier, decode_array,
                                  decode_payload, pad_host_pages,
-                                 pages_to_host, payload_pages)
+                                 payload_pages)
 
 log = logging.getLogger(__name__)
 
@@ -562,7 +563,14 @@ class Request:
     into fresh pages, samples the first token from the carried logits
     with THIS request's knobs/seed, and decodes — token-exact vs a
     single engine doing both (the first-token draw and every decode
-    step see bitwise the state the donor engine would have had)."""
+    step see bitwise the state the donor engine would have had).
+
+    ``migrate`` (ISSUE-18) is the live-migration entry: a
+    ``SessionSnapshot`` (or its wire doc) another engine froze
+    mid-stream via ``extract_session``. Admission adopts the carried
+    pages + sampler state and resumes decode from the exact position
+    — no prefill, no first-token sample (every emitted token,
+    including the one the next step feeds, already rode over)."""
 
     prompt: list
     max_new_tokens: int
@@ -572,6 +580,7 @@ class Request:
     id: Any = None
     prefill_only: bool = False
     handoff: Any = None
+    migrate: Any = None
 
 
 @dataclass
@@ -713,7 +722,8 @@ class Server:
                  kv_page_size: int = 0, kv_pages: int = 0,
                  hbm_gbps: float = 0.0, prefill_chunk_tokens: int = 0,
                  kv_host_mb: float = 0.0, in_dispatch_eos: bool = True,
-                 mesh=None, shard_rules: str = "serve"):
+                 mesh=None, shard_rules: str = "serve",
+                 page_pool: PagePool | None = None):
         if model.cfg.quantized:
             # nothing structural in the way — the q8 apply is the same
             # model.apply — but untested here; fail loud, not wrong
@@ -807,24 +817,44 @@ class Server:
         # rows for A/B.
         self.paged = (not model.cfg.sliding_window) if paged is None \
             else bool(paged)
+        if page_pool is not None and not self.paged:
+            raise ValueError("a shared page_pool needs the paged KV "
+                             "cache")
         if self.paged:
-            ps = int(kv_page_size) or default_page_size(model.cfg)
-            ps = max(1, min(ps, model.cfg.max_seq_len))
-            max_pages = -(-model.cfg.max_seq_len // ps)
-            # auto pool: the unpaged-equivalent footprint — every slot
-            # can still hold a full-length sequence, so capacity parity
-            # with the fixed-shape path is the floor; explicit
-            # kv_pages grows the batch into the same HBM or shrinks
-            # the footprint for short-sequence traffic
-            n_pages = int(kv_pages) or batch_size * max_pages
-            # mesh: the pool allocates DIRECTLY under its kv-head
-            # shardings (slots._alloc_sharded) — a dense-then-reshard
-            # order would transiently hold the whole pool on one chip
-            # and OOM exactly the configurations the mesh unlocks
-            pool = PagePool(model, params, n_pages, ps, mesh=mesh)
+            if page_pool is not None:
+                # SHARED pool (ISSUE-18): a gateway-owned fleet pool
+                # lent to every co-located engine — the pool keeps
+                # device-tree ownership (SlotCache delegates), and
+                # the pool's lock is the single-writer dispatch
+                # discipline serialized below
+                pool = page_pool
+            else:
+                ps = int(kv_page_size) or default_page_size(model.cfg)
+                ps = max(1, min(ps, model.cfg.max_seq_len))
+                max_pages = -(-model.cfg.max_seq_len // ps)
+                # auto pool: the unpaged-equivalent footprint — every
+                # slot can still hold a full-length sequence, so
+                # capacity parity with the fixed-shape path is the
+                # floor; explicit kv_pages grows the batch into the
+                # same HBM or shrinks the footprint for short-sequence
+                # traffic
+                n_pages = int(kv_pages) or batch_size * max_pages
+                # mesh: the pool allocates DIRECTLY under its kv-head
+                # shardings (slots._alloc_sharded) — a dense-then-
+                # reshard order would transiently hold the whole pool
+                # on one chip and OOM exactly the configurations the
+                # mesh unlocks
+                pool = PagePool(model, params, n_pages, ps, mesh=mesh)
             self.slots = SlotCache(model, params, batch_size, pool=pool)
         else:
             self.slots = SlotCache(model, params, batch_size, mesh=mesh)
+        # single-writer dispatch discipline: engines sharing a pool
+        # serialize every device mutation through the POOL's lock (one
+        # writer to the shared tree at a time); a private engine takes
+        # its own — same code path, zero contention
+        self._dispatch_lock = self.slots.pool.lock \
+            if self.paged and self.slots.pool.shared \
+            else threading.RLock()
         cache_leaves = jax.tree_util.tree_leaves(self.slots.cache)
         self._kv_bytes_total = sum(
             int(np.prod(x.shape)) * x.dtype.itemsize for x in cache_leaves)
@@ -986,6 +1016,17 @@ class Server:
         self.prefill_chunked = 0           # requests that took >1 chunk
         self.handoffs_out = 0  # prefill_only requests handed off
         self.handoffs_in = 0   # handoff admissions (decode pool)
+        # live session migration (ISSUE-18)
+        self.migrations_out = 0      # sessions frozen + extracted here
+        self.migrations_in = 0       # sessions adopted + resumed here
+        self.migrations_local = 0    # extracts as zero-copy owner swap
+        self.migrations_remote = 0   # extracts as gathered content
+        self.migrate_pages_moved = 0  # pages whose CONTENT moved
+        self.migrate_bytes_avoided = 0  # bytes owner swaps did NOT
+        #                                 move (migration + shared-pool
+        #                                 handoff aliasing)
+        self.migrate_freeze_resume_ms = 0.0  # summed freeze->resume
+        #                                      wall ms (mean = / in)
         self._cache_treedef = jax.tree_util.tree_structure(
             self.slots.cache)
         # (flat leaf index, page axis) of the first paged leaf: lets
@@ -1099,11 +1140,21 @@ class Server:
             raise ValueError("prefill_only and handoff are the two "
                              "HALVES of a disaggregated request — one "
                              "request cannot be both")
-        if (request.prefill_only or request.handoff is not None) \
-                and not self.paged:
+        if request.migrate is not None \
+                and (request.prefill_only or request.handoff is not None):
+            raise ValueError("a migrated session is already past "
+                             "prefill — it cannot also be a "
+                             "prefill_only/handoff half")
+        if (request.prefill_only or request.handoff is not None
+                or request.migrate is not None) and not self.paged:
             raise ValueError(
                 "prefill/decode disaggregation needs the paged KV "
                 "cache (the handoff unit is a page list)")
+        if request.migrate is not None:
+            # geometry + continuity checked HERE, where a mismatch is
+            # one request's clean 400 refusal instead of a whole-
+            # replica admission crash (the handoff precedent below)
+            self._check_migrate(request.migrate, p)
         if request.handoff is not None:
             if int(request.handoff["n_tokens"]) != len(p):
                 raise ValueError(
@@ -1116,6 +1167,13 @@ class Server:
             # replica and cascade the crash-reset through every decode
             # replica the failover retries
             self._check_handoff_geometry(request.handoff, len(p))
+            if "page_ids" in request.handoff \
+                    and request.handoff.get("pool") \
+                    is not self.slots.pool:
+                raise ValueError(
+                    "an owner-swap handoff carries page ids in a "
+                    "shared pool this engine does not hold — gather "
+                    "it to wire form to cross pools")
         if request.id is None:
             request.id = next(self._ids)
         request.max_new_tokens = min(request.max_new_tokens,
@@ -1179,6 +1237,23 @@ class Server:
         if self.host_tier is not None:
             n = max(n, self.host_tier.match_len(tokens))
         return n
+
+    def prefix_summary(self, max_items: int = 512) -> list:
+        """Bounded ``[[n_tokens, crc32], ...]`` summary of every
+        prefix this replica could seed (device store + host tier,
+        deduplicated) — shipped on the agent heartbeat so the
+        gateway's prefix-affinity probe can score a REMOTE replica
+        (``serve.prefix.summary_match_len``) instead of assuming 0."""
+        out: list = []
+        seen: set = set()
+        for store in (self.prefix, self.host_tier):
+            if store is None:
+                continue
+            for ln, crc in store.summary(max_items):
+                if (ln, crc) not in seen:
+                    seen.add((ln, crc))
+                    out.append([ln, crc])
+        return out[:max_items]
 
     # --------------------------------------------------------- scheduling
 
@@ -1362,6 +1437,8 @@ class Server:
         bucketed suffix as one multi-token window writing straight
         into the slot's pages (no row copy — the unpaged path's
         ``write_slot_row`` admission copies are gone)."""
+        if req.migrate is not None:
+            return self._admit_migrate(req, finished)
         if req.handoff is not None:
             return self._admit_handoff(req, finished)
         s = self.slots
@@ -1813,17 +1890,39 @@ class Server:
         ``finish_reason="handoff"``. The payload is an immutable
         device pytree: local decode replicas scatter it straight into
         their own pool (device->device, no host hop); the agent wire
-        encodes it via serve/tier.py."""
+        encodes it via serve/tier.py.
+
+        On a SHARED pool (ISSUE-18) there is nothing to gather: the
+        consumer reads the same device tree, so the payload is the
+        page-ID list itself — pinned by one extra refcount that
+        TRANSFERS to whoever consumes the doc (a co-located decode
+        engine's owner-swap admit, or the remote stub's late gather)
+        — and the local prefill->decode handoff becomes a pure
+        pointer move."""
         pool = self.slots.pool
         n = len(pages)
-        idx = _padded_pages(pages)
-        n_pad = len(idx)
         t0 = time.monotonic()
         occ = self.slots.n_active
-        payload = _gather_pages(self.slots.cache,
-                                jnp.asarray(idx, jnp.int32))
         res = Result(req.id, list(req.prompt), [], "handoff",
                      hit_tokens, saved, prefill_chunks=chunks)
+        if pool.shared:
+            pool.share(pages)  # the doc's own ref; its consumer unrefs
+            res.handoff = {"n_tokens": int(n_tok),
+                           "page_ids": [int(pg) for pg in pages],
+                           "pool": pool, "logits": jnp.asarray(logits)}
+            finished.append(res)
+            self.handoffs_out += 1
+            if self.timeline is not None:
+                self._record_dispatch(
+                    "handoff_out", t0, (time.monotonic() - t0) * 1e3,
+                    occ, n, 0, ("handoff_out", 0), request_id=req.id,
+                    tags={"pages": n, "n_tokens": int(n_tok),
+                          "owner_swap": True}, work=1, fed=1)
+            return
+        idx = _padded_pages(pages)
+        n_pad = len(idx)
+        payload = _gather_pages(self.slots.cache,
+                                jnp.asarray(idx, jnp.int32))
         res.handoff = {"n_tokens": int(n_tok), "pages": payload,
                        "logits": jnp.asarray(logits)}
         finished.append(res)
@@ -1837,9 +1936,11 @@ class Server:
                 fed=1, est=self.cost.host_move(n * pool.page_nbytes))
 
     def _handoff_page_count(self, doc: dict) -> int:
-        """Page-axis length of a handoff payload, for BOTH forms —
-        wire (shapes carried per leaf) and device pytree — without
-        decoding anything."""
+        """Page-axis length of a handoff payload, for ALL forms —
+        shared-pool page ids, wire (shapes carried per leaf), and
+        device pytree — without decoding anything."""
+        if "page_ids" in doc:
+            return len(doc["page_ids"])
         pages = doc["pages"]
         if isinstance(pages, dict) and "leaves" in pages:
             if len(pages["leaves"]) != self._cache_treedef.num_leaves:
@@ -1881,13 +1982,22 @@ class Server:
         knobs/seed, arm the slot. Token-exact vs one engine doing
         prefill + decode itself: the pages round-trip bitwise and the
         first-token draw uses the same PRNGKey the fused admit would
-        have."""
+        have.
+
+        Shared-pool form (``page_ids``): no scatter at all — the pages
+        are already resident, so the admit aliases them CoW-style via
+        ``seed_pages`` (the fork matters: many decode requests can
+        adopt the same hot prompt concurrently, and each needs its own
+        writable tail page) and drops the doc's transfer ref."""
         s = self.slots
         pool = s.pool
         ps = pool.page_size
         p = np.asarray(req.prompt, np.int32)
         n_tok = int(req.handoff["n_tokens"])
         worst = -(-(len(p) + req.max_new_tokens) // ps)
+        if "page_ids" in req.handoff:
+            return self._admit_handoff_shared(req, finished, p, n_tok,
+                                              worst)
         granted = pool.reserve(worst)
         while not granted and self.prefix is not None \
                 and self.prefix.evict_one():
@@ -1947,6 +2057,300 @@ class Server:
         self._live[slot] = _Live(req, [tok])
         return True
 
+    def _admit_handoff_shared(self, req: Request, finished: list,
+                              p: np.ndarray, n_tok: int,
+                              worst: int) -> bool:
+        """Owner-swap admit: the handoff pages already live in THIS
+        engine's pool, so admission is ``seed_pages`` aliasing — share
+        each full page, fork the partial tail (many decode requests
+        can adopt the same hot prompt concurrently, and each needs its
+        own writable tail) — then drop the doc's transfer ref. KV
+        bytes moved: one page when the prompt ends mid-page, else
+        zero."""
+        s = self.slots
+        pool = s.pool
+        ps = pool.page_size
+        page_ids = [int(pg) for pg in req.handoff["page_ids"]]
+        n_alias = -(-n_tok // ps)
+        fork = 1 if n_tok % ps else 0
+        need = worst - n_alias + fork
+        granted = pool.reserve(need)
+        while not granted and self.prefix is not None \
+                and self.prefix.evict_one():
+            granted = pool.reserve(need)
+        if not granted:
+            return False  # transient; the doc's ref keeps pages alive
+        if self.fault_plan is not None:
+            try:
+                self.fault_plan.on_admit(req.id)
+            except BaseException:
+                pool.cancel(need)
+                raise
+        slot = self._free_slots()[0]
+        t0 = time.monotonic()
+        occ = s.n_active
+        s.seed_pages(slot, page_ids[:n_alias], n_tok, need)
+        pool.unref(page_ids)  # the transfer ref moves to the slot
+        logits = req.handoff["logits"]
+        tok, key = _sample_first(
+            jnp.asarray(logits), jnp.float32(req.temperature),
+            jnp.int32(req.top_k), jax.random.PRNGKey(req.seed))
+        if self.prefix is not None:
+            self.prefix.insert(p, pages=s.slot_pages(slot, n_tok),
+                               logits=jnp.asarray(logits))
+        self.handoffs_in += 1
+        self.migrate_bytes_avoided += \
+            (n_alias - fork) * pool.page_nbytes
+        tok = int(tok)
+        if self.timeline is not None:
+            self._record_dispatch(
+                "handoff_admit", t0, (time.monotonic() - t0) * 1e3,
+                occ, n_alias, 1, ("handoff_admit", 0),
+                request_id=req.id,
+                tags={"prompt_len": len(p), "pages": n_alias,
+                      "owner_swap": True}, work=1, fed=1,
+                est=self.cost.host_move(fork * pool.page_nbytes))
+        if tok in self.eos_ids or req.max_new_tokens == 1:
+            reason = "eos" if tok in self.eos_ids else "length"
+            finished.append(Result(req.id, list(req.prompt), [tok],
+                                   reason))
+            s.release_pages(slot)
+            return True
+        s.admit(slot, len(p), tok, req.temperature, req.top_k, key)
+        self._spec_ema[slot] = 1.0
+        self._live[slot] = _Live(req, [tok])
+        return True
+
+    # ------------------------------------------------- live migration
+
+    def _check_migrate(self, snap, p: list) -> None:
+        """Continuity + geometry of a migrate payload at submit time —
+        a mismatch is one request's clean refusal (400 at the
+        gateway), not a whole-replica admission crash (the handoff
+        precedent). Accepts both forms: a ``SessionSnapshot`` (local
+        owner swap or in-process remote) and the agent wire doc."""
+        if isinstance(snap, dict):
+            gen = snap.get("generated") or []
+            n_tok = int(snap.get("n_tokens", -1))
+            prompt = [int(t) for t in snap.get("prompt", ())]
+            pages = snap.get("pages")
+            if not (isinstance(pages, dict) and "leaves" in pages):
+                raise ValueError(
+                    "a wire migrate doc carries base64 leaf pages")
+            have = self._handoff_page_count({"pages": pages})
+        else:
+            gen = list(snap.generated)
+            n_tok = int(snap.n_tokens)
+            prompt = [int(t) for t in snap.prompt]
+            if snap.local:
+                if snap.pool is not self.slots.pool:
+                    raise ValueError(
+                        "a local (owner-swap) snapshot holds page ids "
+                        "in a pool this engine does not share — "
+                        "extract with wire=True to cross pools")
+                have = len(snap.pages)
+            else:
+                have = self._handoff_page_count({"pages": snap.pages})
+        if not gen:
+            raise ValueError(
+                "a migrated session carries at least one generated "
+                "token (pre-first-token sessions re-run as ordinary "
+                "requests)")
+        if prompt != [int(t) for t in p]:
+            raise ValueError(
+                "migrate snapshot prompt differs from the request "
+                "prompt — the stream would not be continuous")
+        if n_tok != len(p) + len(gen) - 1:
+            raise ValueError(
+                f"migrate snapshot holds {n_tok} KV positions, "
+                f"prompt + generated - 1 is {len(p) + len(gen) - 1} "
+                "— the final sampled token is never fed, so its K/V "
+                "must not be present")
+        ps = self.slots.pool.page_size
+        need = -(-n_tok // ps)
+        if have < need:
+            raise ValueError(
+                f"migrate snapshot holds {have} pages, the session "
+                f"needs {need} at page_size {ps} — mismatched page "
+                "geometry between source and target")
+
+    def extract_session(self, request_id, *, wire: bool = False):
+        """Freeze a live decode slot into a ``SessionSnapshot`` and
+        evict it — the source half of a migration, called between
+        dispatches by the replica's own driver thread.
+
+        Returns None when ``request_id`` is not in a live decode slot
+        (still pending or mid-prefill) — those carry no per-slot state
+        worth moving, so the caller re-runs them as ordinary requests.
+
+        ``wire=False`` (local owner swap): the snapshot holds page IDS
+        pinned by one ``share()`` ref that transfers with it — zero KV
+        bytes move, and adopt is a page-table install. ``wire=True``:
+        the snapshot holds gathered page CONTENT (a device pytree) fit
+        for ``snapshot_to_doc`` and the agent wire."""
+        with self._dispatch_lock:
+            s = self.slots
+            pool = s.pool
+            if wire is False and not pool.shared:
+                raise ValueError(
+                    "a local owner-swap snapshot needs a shared pool "
+                    "— extract with wire=True")
+            slot = None
+            for i, live in enumerate(self._live):
+                if live is not None and live.request.id == request_id:
+                    slot = i
+                    break
+            if slot is None:
+                return None
+            live = self._live[slot]
+            req = live.request
+            t0 = time.monotonic()
+            occ = s.n_active
+            n_tok = int(s.lengths[slot])
+            n = -(-n_tok // pool.page_size)
+            pages = [int(pg) for pg in s.page_table[slot, :n]]
+            if wire:
+                idx = _padded_pages(pages)
+                payload = _gather_pages(self.slots.cache,
+                                        jnp.asarray(idx, jnp.int32))
+                jax.block_until_ready(payload)
+                self.migrations_remote += 1
+                self.migrate_pages_moved += n
+            else:
+                pool.share(pages)  # the snapshot's transfer ref
+                payload = pages
+                self.migrations_local += 1
+                self.migrate_bytes_avoided += n * pool.page_nbytes
+            snap = SessionSnapshot(
+                prompt=list(req.prompt),
+                generated=list(live.generated),
+                max_new_tokens=int(req.max_new_tokens),
+                temperature=float(s.temperature[slot]),
+                top_k=int(s.top_k[slot]),
+                seed=int(req.seed),
+                rng=np.array(s.rng[slot], np.uint32),
+                spec_ema=float(self._spec_ema[slot]),
+                n_tokens=n_tok,
+                pages=payload,
+                local=not wire,
+                t_freeze=time.time(),
+                pool=pool if not wire else None)
+            self._live[slot] = None
+            s.evict(slot)
+            self.migrations_out += 1
+            if self.timeline is not None:
+                est = self.cost.host_move(n * pool.page_nbytes) \
+                    if wire else (0.0, 0.0)
+                self._record_dispatch(
+                    "migrate_out", t0, (time.monotonic() - t0) * 1e3,
+                    occ, n, 0, ("migrate_out", n if wire else 0),
+                    request_id=req.id,
+                    tags={"pages": n, "n_tokens": n_tok,
+                          "local": not wire}, work=1, fed=1, est=est)
+            return snap
+
+    def _admit_migrate(self, req: Request, finished: list) -> bool:
+        """Adopt a frozen session: restore its pages (owner swap or
+        scatter), then arm the slot DIRECTLY with the carried sampler
+        state — no prefill, no first-token draw; every token of this
+        stream so far was already sampled, and the PRNG key resumes at
+        its exact chain position. The next decode round continues as
+        if the slot had lived here all along."""
+        snap = req.migrate
+        if isinstance(snap, dict):
+            snap = snapshot_from_doc(snap)
+        s = self.slots
+        pool = s.pool
+        ps = pool.page_size
+        p = np.asarray(req.prompt, np.int32)
+        n_tok = int(snap.n_tokens)
+        n = -(-n_tok // ps)
+        worst = -(-(len(p) + req.max_new_tokens) // ps)
+        t0 = time.monotonic()
+        occ = s.n_active
+        if snap.local:
+            # owner swap: the snapshot's share() ref transfers to the
+            # slot via a direct page-table install. No CoW fork — a
+            # migration has exactly one writer (move semantics), and
+            # the tail page's written extent stops where every other
+            # holder's read extent does.
+            if snap.pool is not pool:
+                raise ValueError(
+                    "local migrate snapshot is from a different pool")
+            need = worst - n
+            granted = pool.reserve(need)
+            while not granted and self.prefix is not None \
+                    and self.prefix.evict_one():
+                granted = pool.reserve(need)
+            if not granted:
+                return False  # transient; snapshot ref pins the pages
+            if self.fault_plan is not None:
+                try:
+                    self.fault_plan.on_admit(req.id)
+                except BaseException:
+                    pool.cancel(need)
+                    raise
+            slot = self._free_slots()[0]
+            s.reserve_left[slot] = need
+            s.n_slot_pages[slot] = n
+            s.page_table[slot, :n] = np.asarray(snap.pages, np.int32)
+            s.page_table[slot, n:] = pool.n_pages
+            self.migrate_bytes_avoided += n * pool.page_nbytes
+        else:
+            granted = pool.reserve(worst)
+            while not granted and self.prefix is not None \
+                    and self.prefix.evict_one():
+                granted = pool.reserve(worst)
+            if not granted:
+                return False
+            if self.fault_plan is not None:
+                try:
+                    self.fault_plan.on_admit(req.id)
+                except BaseException:
+                    pool.cancel(worst)
+                    raise
+            slot = self._free_slots()[0]
+            pages_tree = snap.pages
+            if isinstance(pages_tree, dict) and "leaves" in pages_tree:
+                pages_tree = decode_payload(pages_tree,
+                                            self._cache_treedef)
+            s.seed_pages(slot, [], 0, worst)
+            s.ensure_pages(slot, n_tok)
+            n_pad = payload_pages(pages_tree)
+            if n_pad < n:
+                s.release_pages(slot)
+                raise ValueError(
+                    f"migrate payload holds {n_pad} pages, the "
+                    f"session needs {n} at page_size {ps}")
+            dst = s.page_table[slot, :n].tolist() \
+                + [pool.n_pages] * (n_pad - n)
+            s.cache = _scatter_pages(s.cache, pages_tree,
+                                     jnp.asarray(dst, jnp.int32))
+            self.migrate_pages_moved += n
+        gen = [int(t) for t in snap.generated]
+        s.admit(slot, n_tok, gen[-1], snap.temperature, snap.top_k,
+                snap.rng)
+        self._spec_ema[slot] = float(snap.spec_ema)
+        self._live[slot] = _Live(req, gen)
+        self.migrations_in += 1
+        if snap.local:
+            self.migrations_local += 1
+        else:
+            self.migrations_remote += 1
+        self.migrate_freeze_resume_ms += \
+            max(0.0, (time.time() - snap.t_freeze) * 1e3)
+        if self.timeline is not None:
+            est = (0.0, 0.0) if snap.local \
+                else self.cost.host_move(n * pool.page_nbytes)
+            self._record_dispatch(
+                "migrate_in", t0, (time.monotonic() - t0) * 1e3, occ,
+                n, 0, ("migrate_in", 0 if snap.local else n),
+                request_id=req.id,
+                tags={"pages": n, "n_tokens": n_tok,
+                      "generated": len(gen), "local": snap.local},
+                work=1, fed=1, est=est)
+        return True
+
     # --------------------------------------------------- host page tier
 
     def _spill_entry(self, entry) -> None:
@@ -1969,18 +2373,18 @@ class Server:
         t0 = time.monotonic()
         payload = _gather_pages(self.slots.cache,
                                 jnp.asarray(idx, jnp.int32))
-        host = pages_to_host(payload, n)  # syncs; bitwise
-        logits = np.asarray(entry.logits) \
-            if entry.logits is not None else None
-        ok = tier.insert(tokens, host, logits)
+        # DISPATCH only: the gather snapshots the pre-eviction cache
+        # value (cache buffers are never donated, so later page reuse
+        # cannot touch it), and the device->host sync runs on the
+        # tier's copy thread — decode rounds proceed during the spill
+        tier.spill_async(tokens, payload, n, entry.logits)
         if self.timeline is not None:
-            tags = {"pages": n, "tokens": int(tokens.size)}
-            if not ok:
-                tags["rejected"] = True
             self._record_dispatch(
                 "host_spill", t0, (time.monotonic() - t0) * 1e3,
                 self.slots.n_active, n_pad, 0, ("host_spill", n_pad),
-                tags=tags, work=1, fed=1,
+                tags={"pages": n, "tokens": int(tokens.size),
+                      "async": True},
+                work=1, fed=1,
                 est=self.cost.host_move(n * pool.page_nbytes))
 
     def _maybe_page_in(self, p: np.ndarray, off: int, entry):
@@ -2053,7 +2457,16 @@ class Server:
         return k
 
     def step(self) -> list[Result]:
-        """One scheduler iteration; returns requests that finished."""
+        """One scheduler iteration; returns requests that finished.
+        The whole iteration holds ``_dispatch_lock`` — on a private
+        pool that is a free re-entrant acquire, on a SHARED pool it is
+        the single-writer discipline across every engine lending from
+        the pool (refcounts, page tables, and the one device tree all
+        mutate under it)."""
+        with self._dispatch_lock:
+            return self._step_locked()
+
+    def _step_locked(self) -> list[Result]:
         if self.fault_plan is not None:
             self.fault_plan.on_dispatch()
         finished: list[Result] = []
@@ -2561,9 +2974,12 @@ class Server:
         holds a slot completes instead of being dropped mid-decode."""
         finished: list[Result] = []
         while self.slots.n_active or self._prefilling:
-            self._advance_prefills(finished)
-            if self.slots.n_active:
-                finished.extend(self._decode_round())
+            # lock PER ITERATION: on a shared pool, co-tenant engines
+            # keep stepping between this engine's drain rounds
+            with self._dispatch_lock:
+                self._advance_prefills(finished)
+                if self.slots.n_active:
+                    finished.extend(self._decode_round())
         return finished
 
     def live_progress(self, since: dict | None = None) -> dict:
@@ -2607,6 +3023,14 @@ class Server:
             "prefill_chunked_requests": self.prefill_chunked,
             "handoffs_out": self.handoffs_out,
             "handoffs_in": self.handoffs_in,
+            "migrations_out": self.migrations_out,
+            "migrations_in": self.migrations_in,
+            "migrations_local": self.migrations_local,
+            "migrations_remote": self.migrations_remote,
+            "migrate_pages_moved": self.migrate_pages_moved,
+            "migrate_bytes_avoided": self.migrate_bytes_avoided,
+            "migrate_freeze_resume_ms": round(
+                self.migrate_freeze_resume_ms, 3),
         }
         if self.mesh is not None:
             # flat numeric twins of mesh_info() so MetricsStore and
@@ -2663,13 +3087,15 @@ class Server:
         a Result; the caller sheds them. ``slots.reset()`` alone leaves
         the engine inconsistent (``_live`` ghosts would decode garbage
         and emit phantom results), so external callers use this."""
-        with self._pending_lock:
-            self.pending.clear()
-        self._live = [None] * self.slots.batch_size
-        # mid-chunked-prefill slots drop with their requests; their
-        # page reservations are returned by slots.reset()'s evicts
-        self._prefilling.clear()
-        self.slots.reset()
+        with self._dispatch_lock:
+            with self._pending_lock:
+                self.pending.clear()
+            self._live = [None] * self.slots.batch_size
+            # mid-chunked-prefill slots drop with their requests;
+            # their page reservations are returned by slots.reset()'s
+            # evicts
+            self._prefilling.clear()
+            self.slots.reset()
 
     def run(self, requests: Iterable[Request] = ()) -> Iterator[Result]:
         """Submit ``requests`` and drive the loop until everything

@@ -1,0 +1,153 @@
+"""Live session migration: freeze an in-flight stream, resume it
+elsewhere token-exact.
+
+The failover path (gateway/core.py) already survives replica DEATH
+token-exact by re-running from the prompt — correct, but every
+*planned* event (drain, scale-down, rebalance) would pay the same
+re-prefill and finish-everything latency. This module is the planned
+path: at a dispatch boundary the source engine freezes a live decode
+slot into a ``SessionSnapshot`` — everything the decode loop's
+exactness invariant says the slot IS:
+
+- ``n_tokens`` positions of token-exact KV (prompt + generated), as
+  either shared-pool page ids (local owner swap, zero bytes moved) or
+  gathered page content (remote, over the agent wire);
+- the sampler state: per-slot PRNG key at its CURRENT chain position
+  (advanced only by sampled draws, so resuming from it continues the
+  exact random sequence a never-migrated slot would have drawn),
+  temperature/top-k, and the speculation acceptance EMA;
+- the absolute emitted prefix (``generated``) and the ORIGINAL budget
+  — remaining budget is derived, and the gateway's absolute-offset
+  emit dedup makes the client stream continue gap/dup-free.
+
+The target engine adopts the snapshot without any prefill or sampling
+dispatch: the first token of every future step was already drawn, so
+the slot is armed directly (``SlotCache.admit`` with the carried rng)
+and the next decode round continues as if the slot had lived there
+all along. Byte-identical streams under greedy AND seeded sampling,
+speculation live, is the acceptance bar (tests/test_migrate.py).
+
+Failure model: migration is MOVE semantics with a copy-then-delete
+ordering on the remote path — the source frees its half only after
+the target's adopt returns. A SIGKILL of either end mid-migration
+leaves at most one live copy plus the gateway's ticket, and the
+ordinary failover path re-runs the request from the prompt,
+token-exact. Nothing here weakens the crash story; it only makes the
+planned story cheap.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from tony_tpu.serve.tier import decode_array, encode_array, \
+    encode_payload
+
+
+@dataclass
+class SessionSnapshot:
+    """One frozen in-flight session, captured at a dispatch boundary.
+
+    The engine's decode invariant after any dispatch: ``n_tokens``
+    (= slot length) equals ``len(prompt) + len(generated) - 1`` — the
+    final sampled token was never fed back, so its K/V is not in the
+    pages; ``generated[-1]`` is the token the next step feeds. Both
+    facts are what make adopt a pure arm-the-slot, no dispatch.
+    """
+
+    prompt: list
+    generated: list        # absolute emitted tokens, first to last
+    max_new_tokens: int    # ORIGINAL budget; remaining is derived
+    temperature: float
+    top_k: int
+    seed: int
+    rng: Any               # np.uint32[2] PRNG key, current chain pos
+    spec_ema: float        # speculation acceptance EMA (k autotune)
+    n_tokens: int          # KV positions held = len(prompt)+len(generated)-1
+    pages: Any             # local: [page_id] (share()d, transferable);
+    # remote: gathered page content (device tree or wire dict)
+    local: bool            # True = pages are ids in a shared pool
+    t_freeze: float        # wall clock at freeze (freeze->resume ms)
+    pool: Any = None       # the shared PagePool ids live in (local
+    # only) — adopt refuses a snapshot from a different pool
+
+    @property
+    def remaining(self) -> int:
+        """Token budget left at resume time."""
+        return max(0, int(self.max_new_tokens) - len(self.generated))
+
+
+def gather_local(pool, pages) -> Any:
+    """Materialize the CONTENT of shared-pool ``pages`` as a
+    standalone device tree and release the transfer ref they carried —
+    the bridge from an owner-swap payload (page ids, zero-copy while
+    the session stays on this host) to a wire-shippable one, taken
+    when routing sends the session to a REMOTE replica after all.
+
+    Ordering matters: the gather is forced (``block_until_ready``)
+    BEFORE the unref, so the pages cannot be reallocated and
+    overwritten while their content is still being read. The caller
+    must replace the id payload with the returned tree IN PLACE
+    (ticket and request share the payload object) — the transfer ref
+    is consumed exactly once, and any retry/requeue ships the gathered
+    copy instead of dangling ids."""
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.serve.engine import _padded_pages
+    from tony_tpu.serve.slots import _gather_pages
+
+    ids = [int(p) for p in pages]
+    with pool.lock:
+        idx = jnp.asarray(_padded_pages(ids), jnp.int32)
+        payload = _gather_pages(pool.cache, idx)
+        jax.block_until_ready(payload)
+        pool.unref(ids)
+    return payload
+
+
+def snapshot_to_doc(snap: SessionSnapshot) -> dict:
+    """Wire form (JSON-safe) of a REMOTE snapshot — rides the agent
+    ``POST /v1/migrate_in`` op on the mux channel, pages through the
+    same base64 leaf codec as ``/v1/handoff``."""
+    if snap.local:
+        raise ValueError(
+            "a local (owner-swap) snapshot holds page ids, not page "
+            "content — extract with wire pages to cross hosts")
+    return {
+        "prompt": [int(t) for t in snap.prompt],
+        "generated": [int(t) for t in snap.generated],
+        "max_new_tokens": int(snap.max_new_tokens),
+        "temperature": float(snap.temperature),
+        "top_k": int(snap.top_k),
+        "seed": int(snap.seed),
+        "rng": encode_array(np.asarray(snap.rng, np.uint32)),
+        "spec_ema": float(snap.spec_ema),
+        "n_tokens": int(snap.n_tokens),
+        "pages": encode_payload(snap.pages),
+        "t_freeze": float(snap.t_freeze),
+    }
+
+
+def snapshot_from_doc(doc: dict) -> SessionSnapshot:
+    """Inverse of ``snapshot_to_doc``. ``pages`` stays in wire form —
+    the adopting engine decodes it against its OWN cache treedef
+    (mismatched model configs fail loudly there, same contract as the
+    handoff path)."""
+    return SessionSnapshot(
+        prompt=[int(t) for t in doc["prompt"]],
+        generated=[int(t) for t in doc["generated"]],
+        max_new_tokens=int(doc["max_new_tokens"]),
+        temperature=float(doc["temperature"]),
+        top_k=int(doc["top_k"]),
+        seed=int(doc["seed"]),
+        rng=np.asarray(decode_array(doc["rng"]), np.uint32).reshape(2),
+        spec_ema=float(doc["spec_ema"]),
+        n_tokens=int(doc["n_tokens"]),
+        pages=doc["pages"],
+        local=False,
+        t_freeze=float(doc["t_freeze"]),
+    )

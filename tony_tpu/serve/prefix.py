@@ -43,6 +43,7 @@ from __future__ import annotations
 import itertools
 import logging
 import threading
+import zlib
 from typing import Any
 
 import jax
@@ -408,6 +409,39 @@ class PrefixStore:
             child.parent = node.parent
             node.parent.children[int(child.edge[0])] = child
 
+    # ----------------------------------------------------------- summary
+
+    def summary(self, max_items: int = 512, grain: int = 8) -> list:
+        """Bounded wire summary of what this store could seed:
+        ``[[n_tokens, crc32], ...]`` pairs, one per stored-sequence
+        PREFIX on a ``grain``-token grid (plus each entry's full
+        length), most-recent entries first, deduplicated. Shipped on
+        the agent heartbeat (ISSUE-18) so the gateway's prefix-
+        affinity probe can score a REMOTE replica's warmth via
+        ``summary_match_len`` without shipping the radix tree. The
+        grid makes PARTIAL matches visible — a prompt sharing only
+        the system preamble of a longer stored conversation still
+        hashes equal at the preamble's grid points."""
+        with self._lock:
+            entries = sorted(self._entries.values(),
+                             key=lambda e: e.tick, reverse=True)
+        out: list = []
+        seen: set = set()
+        for e in entries:
+            n = int(e.tokens.size)
+            lens = list(range(grain, n + 1, grain))
+            if not lens or lens[-1] != n:
+                lens.append(n)
+            for ln in reversed(lens):
+                item = (ln, zlib.crc32(e.tokens[:ln].tobytes()))
+                if item in seen:
+                    continue
+                seen.add(item)
+                out.append([item[0], item[1]])
+                if len(out) >= max_items:
+                    return out
+        return out
+
     # ------------------------------------------------------------- stats
 
     def __len__(self) -> int:
@@ -440,6 +474,23 @@ class PrefixStore:
                 "evictions": self.evictions,
                 "rejected": self.rejected,
             }
+
+
+def summary_match_len(summary, tokens) -> int:
+    """Longest summarized prefix of ``tokens`` — the probe side of
+    ``PrefixStore.summary()``, run by the gateway's remote stub against
+    the pairs a heartbeat shipped. Hashing convention (int32 bytes,
+    crc32) matches the producer exactly; a crc collision costs one
+    mis-routed request, never a wrong token."""
+    toks = np.asarray(tokens, np.int32)
+    by_len: dict[int, set] = {}
+    for ln, crc in summary or ():
+        if 0 < int(ln) <= toks.size:
+            by_len.setdefault(int(ln), set()).add(int(crc))
+    for ln in sorted(by_len, reverse=True):
+        if zlib.crc32(toks[:ln].tobytes()) in by_len[ln]:
+            return ln
+    return 0
 
 
 def _freshest_entry(node: _Node) -> _Entry | None:

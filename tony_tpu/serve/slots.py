@@ -13,6 +13,7 @@ next admit, so no device work is ever spent clearing it).
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 import jax
@@ -361,14 +362,30 @@ class PagePool:
     free). Admission blocks (stays pending) when a reservation cannot
     be granted, after the engine has squeezed the prefix store; it
     never kills an in-flight request.
+
+    With ``shared=True`` the pool is a FLEET resource lent to several
+    co-located engines at once (live session migration, ISSUE-18): the
+    pool RETAINS ownership of the device tree — every attached
+    ``SlotCache`` delegates its ``cache`` attribute here, so one
+    engine's dispatch reassignment is immediately visible to the
+    others, and moving a session between two attached engines is a
+    pure page-table/refcount swap with zero KV bytes copied. ``lock``
+    is the single-writer dispatch discipline: engines sharing the pool
+    serialize every device mutation (step, reset, extract, adopt)
+    through it, so the read-dispatch-reassign cycle on the shared tree
+    can never interleave and drop writes.
     """
 
     def __init__(self, model, params, n_pages: int, page_size: int,
-                 mesh=None):
+                 mesh=None, shared: bool = False):
         if n_pages < 1 or page_size < 1:
             raise ValueError("n_pages and page_size must be >= 1")
         self.n_pages = int(n_pages)
         self.page_size = int(page_size)
+        self.shared = bool(shared)
+        # reentrant: a shared-pool engine's step() may nest an evict /
+        # adopt that takes the pool again on the same thread
+        self.lock = threading.RLock()
         self.cache = paged_cache(model, params, n_pages, page_size,
                                  mesh=mesh)
         self.page_nbytes = page_nbytes(self.cache)
@@ -503,13 +520,19 @@ class SlotCache:
         self.batch_size = batch_size
         self.max_seq_len = model.cfg.max_seq_len
         self.pool = pool
+        self._cache = None
         if pool is not None:
-            # take OWNERSHIP of the device tree: the live pools are
-            # reassigned onto self.cache after every dispatch, and a
-            # reference left on the pool would pin the t=0 allocation
-            # (a full duplicate of the KV pool) for the server's life
-            self.cache = pool.cache
-            pool.cache = None
+            if not pool.shared:
+                # take OWNERSHIP of the device tree: the live pools are
+                # reassigned onto self.cache after every dispatch, and a
+                # reference left on the pool would pin the t=0
+                # allocation (a full duplicate of the KV pool) for the
+                # server's life
+                self.cache = pool.cache
+                pool.cache = None
+            # shared pool: ownership stays with the pool — several
+            # SlotCaches delegate to pool.cache through the property
+            # below, so no duplicate reference exists to pin
             self.max_pages = -(-self.max_seq_len // pool.page_size)
             self.page_table = np.full((batch_size, self.max_pages),
                                       pool.n_pages, np.int32)
@@ -531,6 +554,21 @@ class SlotCache:
         self.temperature = np.zeros(batch_size, np.float32)
         self.top_k = np.zeros(batch_size, np.int32)
         self.rng = np.zeros((batch_size, 2), np.uint32)
+
+    @property
+    def cache(self) -> Any:
+        pool = self.pool
+        if pool is not None and pool.shared:
+            return pool.cache
+        return self._cache
+
+    @cache.setter
+    def cache(self, value: Any) -> None:
+        pool = self.pool
+        if pool is not None and pool.shared:
+            pool.cache = value
+        else:
+            self._cache = value
 
     def free_slots(self) -> list[int]:
         return [i for i in range(self.batch_size) if not self.active[i]]

@@ -15,6 +15,14 @@ and the admission that follows hits it exactly as if it had never
 left. Million-session prefix reuse stops being bounded by HBM; it is
 bounded by host RAM (``--kv-host-mb``).
 
+Spills are ASYNC (ISSUE-18): the engine dispatches the page gather
+(cheap — device work it never waits on) and hands the still-on-device
+payload to ``spill_async``; ONE background thread does the
+device->host sync and the store insert, FIFO, so decode rounds
+proceed during the copy. Lookups that feed a page-in (``acquire``)
+flush the queue first — the evict-then-resubmit race stays
+deterministic — while the routing probe (``match_len``) never blocks.
+
 Exactness: the spill and the page-in are the ``gather_pages`` /
 ``scatter_pages`` pair from serve/slots.py — pure copies, no
 arithmetic — so a device->host->device round trip is BITWISE
@@ -39,6 +47,10 @@ so one encoder serves both.
 from __future__ import annotations
 
 import base64
+import logging
+import threading
+import time
+from collections import deque
 from typing import Any
 
 import jax
@@ -46,6 +58,8 @@ import numpy as np
 
 from tony_tpu.serve.prefix import PrefixStore, tree_nbytes
 from tony_tpu.serve.slots import cache_batch_axis
+
+log = logging.getLogger(__name__)
 
 
 # ------------------------------------------------------ payload shaping
@@ -160,22 +174,46 @@ class HostPageTier:
         self.page_ins = 0        # entries restored host -> device
         self.bytes_spilled = 0   # payload bytes copied out, lifetime
         self.bytes_paged_in = 0  # payload bytes restored, lifetime
+        # async spill machinery (ISSUE-18): a FIFO of dispatched-but-
+        # not-yet-copied payloads drained by ONE background thread, so
+        # the device->host sync never blocks the scheduler's decode
+        # rounds. FIFO + single worker = inserts land in eviction
+        # order, the ordering the tests pin.
+        self._q: deque = deque()
+        self._pending: set[bytes] = set()  # keys queued or mid-copy
+        self._cond = threading.Condition()
+        self._worker: threading.Thread | None = None
 
     # ------------------------------------------------------------ index
 
     def has(self, tokens) -> bool:
+        key = np.asarray(tokens, np.int32).tobytes()
+        with self._cond:
+            if key in self._pending:
+                return True  # queued content counts: don't re-spill
         return self.store.has(tokens)
 
     def touch(self, tokens) -> None:
         """Refresh an EXISTING sequence's LRU position (the caller
         checked ``has()``): a re-evicted device entry whose content
         already lives here skips the device->host copy entirely."""
+        key = np.asarray(tokens, np.int32).tobytes()
+        with self._cond:
+            if key in self._pending:
+                return  # the queued copy will land with a fresh tick
         self.store.insert(tokens, row=None)
 
     def match_len(self, tokens) -> int:
+        # pending spills are invisible here ON PURPOSE: this is the
+        # routing probe, and blocking it on a flush would trade a
+        # transient undercount for scheduler stalls
         return self.store.match_len(tokens)
 
     def acquire(self, tokens):
+        # the lookup that feeds a PAGE-IN must see every spill already
+        # initiated, or an evict-then-resubmit race would re-prefill
+        # nondeterministically; flush is a no-op when the queue is dry
+        self.flush()
         return self.store.acquire(tokens)
 
     def release(self, entry) -> None:
@@ -184,10 +222,10 @@ class HostPageTier:
     # ------------------------------------------------------------ moves
 
     def insert(self, tokens, payload: Any, logits) -> bool:
-        """One spill: store the host ``payload`` (numpy pytree of the
-        sequence's real pages) + optional last-position logits.
-        Returns False when the budget refuses it (payload alone over
-        budget, or everything resident is pinned)."""
+        """One SYNCHRONOUS spill: store the host ``payload`` (numpy
+        pytree of the sequence's real pages) + optional last-position
+        logits. Returns False when the budget refuses it (payload
+        alone over budget, or everything resident is pinned)."""
         ok = self.store.insert(tokens, row=payload, logits=logits)
         if ok:
             self.spills += 1
@@ -195,9 +233,74 @@ class HostPageTier:
                 tree_nbytes(logits) if logits is not None else 0)
         return ok
 
+    def spill_async(self, tokens, payload: Any, n: int,
+                    logits) -> None:
+        """Queue one spill: ``payload`` is the still-on-device gather
+        the engine just dispatched (its ``n`` real pages + pow2
+        padding); the background thread does the device->host sync +
+        tier insert, FIFO, while decode rounds keep running. Counters
+        move NOW — they mean "spills initiated", stay single-writer
+        deterministic for the engine thread, and equal the completed
+        count after ``flush()``."""
+        tokens = np.asarray(tokens, np.int32)
+        per_page = tree_nbytes(payload) // max(1, payload_pages(payload))
+        self.spills += 1
+        self.bytes_spilled += per_page * int(n) + (
+            tree_nbytes(logits) if logits is not None else 0)
+        with self._cond:
+            self._pending.add(tokens.tobytes())
+            self._q.append((tokens, payload, int(n), logits))
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._copy_loop, name="kv-host-spill",
+                    daemon=True)
+                self._worker.start()
+            self._cond.notify_all()
+
+    def flush(self, timeout: float | None = None) -> bool:
+        """Block until every queued spill has landed in the store.
+        True on drained; False on timeout. A dry queue returns
+        immediately (one lock round trip)."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._cond:
+            while self._pending:
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cond.wait(timeout=0.5 if left is None else left)
+        return True
+
+    def _copy_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q:
+                    self._cond.wait()
+                tokens, payload, n, logits = self._q[0]
+            try:
+                host = pages_to_host(payload, n)  # the sync, off-thread
+                logits_h = np.asarray(logits) \
+                    if logits is not None else None
+                self.store.insert(tokens, row=host, logits=logits_h)
+            except Exception:
+                log.exception("async KV spill failed")
+            with self._cond:
+                self._q.popleft()
+                self._pending.discard(tokens.tobytes())
+                self._cond.notify_all()
+
     def note_page_in(self, n_bytes: int) -> None:
         self.page_ins += 1
         self.bytes_paged_in += int(n_bytes)
+
+    def summary(self, max_items: int = 512) -> list:
+        """The tier's share of the heartbeat prefix summary
+        (ISSUE-18): same ``[[n_tokens, crc32], ...]`` convention as
+        the device store — a page-in is still far cheaper than a
+        re-prefill, so remote affinity should count host-resident
+        prefixes too."""
+        return self.store.summary(max_items)
 
     # ------------------------------------------------------------ stats
 

@@ -45,6 +45,12 @@
 # token-exact spot checks vs unary controls, the edge block on
 # /stats + tony_edge_* on /metrics, then a clean SIGTERM drain
 # (`make storm-smoke`).
+# Plus a MIGRATE round (ISSUE-18): two replicas on ONE shared
+# PagePool; remove_replica freezes a throttled in-flight stream and
+# the survivor adopts it by owner swap — token-exact vs a
+# no-migration control, zero 5xx, zero pages copied, and the
+# retiring drain returns in freeze-time instead of decoding the
+# remaining budget to completion (`make migrate-smoke`).
 #
 # Usage: tools/serve_smoke.sh       (repo root; `make serve-smoke`)
 #        SERVE_SMOKE_ROUNDS=chaos tools/serve_smoke.sh
@@ -61,6 +67,8 @@
 #                                   (sharded-replica round only; `make shard-smoke`)
 #        SERVE_SMOKE_ROUNDS=storm tools/serve_smoke.sh
 #                                   (connection-storm round only; `make storm-smoke`)
+#        SERVE_SMOKE_ROUNDS=migrate tools/serve_smoke.sh
+#                                   (live-migration round only; `make migrate-smoke`)
 set -u
 
 PY=${PY:-python}
@@ -1118,6 +1126,86 @@ EOF
     echo "serve-smoke: storm OK (2000/2000 streams over the event edge, zero shed, token-exact spot checks, clean drain)"
 }
 
+# ---- migrate round (also standalone: SERVE_SMOKE_ROUNDS=migrate) -----
+# ISSUE-18: live session migration. Two replicas lease ONE shared
+# PagePool; a throttled in-flight stream is frozen mid-decode by
+# remove_replica and adopted by the survivor WITHOUT copying KV
+# (owner swap). The pins: tokens byte-identical to a no-migration
+# control, zero 5xx, /stats engine.migrations registers the handover
+# (pages_moved stays 0, bytes_avoided grows), and the retiring drain
+# returns in freeze-time — visibly faster than decoding the stream's
+# remaining budget to completion would have been.
+migrate_round() {
+    timeout -k 10 "$BOUND" env JAX_PLATFORMS=cpu $PY - <<'EOF' || fail "migrate round"
+import time
+
+import jax, jax.numpy as jnp, numpy as np
+from tony_tpu.gateway.core import Gateway, GenRequest
+from tony_tpu.models import Transformer, TransformerConfig
+from tony_tpu.serve import Request, Server
+from tony_tpu.serve.faults import FaultPlan
+from tony_tpu.serve.slots import PagePool
+
+cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                        n_layers=2, d_ff=64, max_seq_len=64,
+                        dtype=jnp.float32, attention_backend="reference")
+model = Transformer(cfg)
+params = model.init(jax.random.PRNGKey(0),
+                    jnp.zeros((1, 8), jnp.int32))["params"]
+prompt = np.random.default_rng(3).integers(1, 64, size=13).tolist()
+BUDGET, WEDGE = 48, 0.03
+
+def mk(**kw):
+    return Server(model, params, batch_size=2, eos_id=-1, paged=True,
+                  kv_page_size=8, prefix_cache_mb=0,
+                  fault_plan=FaultPlan.wedge_at(1, WEDGE, times=-1),
+                  **kw)
+
+# no-migration control on a fresh engine
+ctrl = Server(model, params, batch_size=2, eos_id=-1, paged=True,
+              kv_page_size=8, prefix_cache_mb=0)
+ctrl.submit(Request(list(prompt), BUDGET, id="c", temperature=0.8,
+                    top_k=8, seed=7))
+expect = list(list(ctrl.run())[0].tokens)
+
+pool = PagePool(model, params, 128, 8, shared=True)
+gw = Gateway([mk(page_pool=pool), mk(page_pool=pool)]).start()
+try:
+    t = gw.submit(GenRequest(list(prompt), max_new_tokens=BUDGET,
+                             temperature=0.8, top_k=8, seed=7,
+                             id="mig"))
+    deadline = time.monotonic() + 60
+    while t._n_emitted < 3:
+        assert time.monotonic() < deadline, "stream never got going"
+        time.sleep(0.02)
+    left = BUDGET - t._n_emitted  # tokens a full decode still owes
+    t0 = time.monotonic()
+    assert gw.remove_replica(t.replica, timeout=60)
+    rm_s = time.monotonic() - t0
+    res = t.result(timeout=120)
+    assert list(res.tokens) == expect, "migrated stream diverged"
+    snap = gw.snapshot()
+    assert snap["shed"] == {}, snap["shed"]  # zero 5xx
+    mig = snap["engine"]["migrations"]
+    assert mig["out"] >= 1 and mig["in"] >= 1, mig
+    assert mig["pages_moved"] == 0 and mig["bytes_avoided"] > 0, mig
+    # the drain point: freeze-time, not decode-to-completion time
+    full = left * WEDGE
+    assert rm_s < full / 2, (rm_s, full)
+    print("serve-smoke: migrate drain %.3fs vs >=%.2fs decode-to-"
+          "completion; %d KV bytes swapped in place" %
+          (rm_s, full, mig["bytes_avoided"]))
+finally:
+    assert gw.drain(timeout=60)
+assert pool.n_used == 0, pool.n_used  # every page accounted for
+EOF
+    echo "serve-smoke: migrate OK (mid-stream owner swap, token-exact, zero 5xx, fast drain)"
+}
+
+if [ "${SERVE_SMOKE_ROUNDS:-all}" = migrate ]; then
+    migrate_round   # `make migrate-smoke`: just the live-migration round
+    exit 0
+fi
 if [ "${SERVE_SMOKE_ROUNDS:-all}" = storm ]; then
     storm_round   # `make storm-smoke`: just the connection-storm round
     exit 0
@@ -1509,4 +1597,7 @@ bundle_round
 
 # ---- storm round: 2000 concurrent streams over the event edge --------
 storm_round
+
+# ---- migrate round: freeze a live stream, survivor adopts it ---------
+migrate_round
 echo "serve-smoke: ALL OK"
