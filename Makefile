@@ -93,11 +93,17 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 #     it by owner swap — token-exact vs a no-migration control, zero
 #     5xx, zero KV pages copied, retiring drain bounded by freeze
 #     cost instead of the stream's remaining decode budget
+#   make rebalance-smoke - just the rebalancer round of serve-smoke:
+#     three live streams piled onto one replica of a two-engine
+#     shared-pool fleet, the Rebalancer detects the occupancy skew
+#     and autonomously migrates a session to the idle replica —
+#     token-exact vs no-rebalance controls, zero 5xx, the decision
+#     trail in the gateway history's metrics/rebalance.jsonl
 
 .PHONY: lint smoke check test bench serve-smoke chaos-smoke \
 	autoscale-smoke goodput-smoke remote-smoke disagg-smoke \
 	autotune-smoke shard-smoke bundle-smoke storm-smoke \
-	migrate-smoke
+	migrate-smoke rebalance-smoke
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -151,3 +157,6 @@ storm-smoke:
 
 migrate-smoke:
 	PY=$(PY) SERVE_SMOKE_ROUNDS=migrate sh tools/serve_smoke.sh
+
+rebalance-smoke:
+	PY=$(PY) SERVE_SMOKE_ROUNDS=rebalance sh tools/serve_smoke.sh

@@ -2537,6 +2537,92 @@ def bench_migrate(on_tpu: bool) -> dict:
         t0 = time.perf_counter()
         jax.block_until_ready(gather_pages(pool.cache, idx))
         gather_ms.append((time.perf_counter() - t0) * 1e3)
+
+    # 3. prefix-delta wire arm (ISSUE-19): freeze a live session to
+    #    wire form, trim it against a WARM target's radix summary, and
+    #    weigh the two payloads — then actually adopt the delta and
+    #    pin the resumed stream to the control (the byte win is only
+    #    worth reporting on a token-exact path)
+    from tony_tpu.serve.migrate import delta_trim_doc, snapshot_to_doc
+    from tony_tpu.serve.tier import payload_nbytes
+
+    src = Server(model, params, batch_size=2, eos_id=-1, paged=True,
+                 kv_page_size=page, prefix_cache_mb=0)
+    src.submit(Request(list(prompt), budget, id="w", temperature=0.8,
+                       top_k=8, seed=7))
+    for _ in range(600):
+        src.step()
+        lv = next((l for l in src._live
+                   if l is not None and l.request.id == "w"), None)
+        if lv is not None and len(lv.generated) >= budget - 8:
+            break
+    snap = src.extract_session("w", wire=True)
+    assert snap is not None, "wire freeze missed the live window"
+    doc = snapshot_to_doc(snap)
+    ctx = [int(t) for t in snap.prompt] \
+        + [int(t) for t in snap.generated][:-1]
+    tgt = Server(model, params, batch_size=2, eos_id=-1, paged=True,
+                 kv_page_size=page, prefix_cache_mb=2.0)
+    tgt.submit(Request(list(ctx), 1, id="warm"))
+    list(tgt.run())
+    trimmed = delta_trim_doc(doc, tgt.prefix_summary())
+    assert trimmed is not None, "warm-target trim declined"
+    full_b, delta_b = payload_nbytes(doc["pages"]), \
+        payload_nbytes(trimmed["pages"])
+    tgt.submit(Request(list(prompt), budget, id="w", migrate=trimmed))
+    toks_delta = {r.id: list(r.tokens) for r in tgt.run()}["w"]
+    assert toks_delta == expect, "delta adoption changed seeded outputs"
+
+    # 4. page-granular shared-pool dispatch (ISSUE-19): two co-located
+    #    engines on ONE pool, each driven by its own thread — the
+    #    two-lock pool lets their dispatch windows overlap vs the
+    #    ``serialize_dispatch=True`` single-writer control. Dispatches
+    #    are wedge-throttled (10 ms, the drain A/B's trick) so each
+    #    window has device-sized latency on a CPU-sized model: the A/B
+    #    then measures exactly the lock structure — do co-located
+    #    windows overlap or not. Same requests both arms, exactness
+    #    asserted.
+    import threading
+
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 64, size=9).tolist() for _ in range(4)]
+    cbudget = 48
+
+    def pool_arm(serialize: bool):
+        pool2 = PagePool(model, params, 128, page, shared=True)
+        engines = [Server(model, params, batch_size=2, eos_id=-1,
+                          paged=True, kv_page_size=page,
+                          prefix_cache_mb=0, page_pool=pool2,
+                          serialize_dispatch=serialize,
+                          fault_plan=FaultPlan.wedge_at(1, 0.01,
+                                                        times=-1))
+                   for _ in range(2)]
+        outs: list = [None, None]
+
+        def drive(i: int):
+            reqs = [Request(list(p), cbudget, id=f"{i}-{j}")
+                    for j, p in enumerate(prompts[2 * i:2 * i + 2])]
+            outs[i] = {r.id: list(r.tokens)
+                       for r in engines[i].run(reqs)}
+
+        threads = [threading.Thread(target=drive, args=(i,))
+                   for i in range(2)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        assert pool2.n_used == 0, "page leak after concurrent run"
+        toks = {**outs[0], **outs[1]}
+        return 4 * cbudget / wall, toks
+
+    pool_arm(False)  # warm: compile the d256 decode programs once
+    tps_conc, toks_conc = pool_arm(False)
+    tps_serial, toks_serial = pool_arm(True)
+    assert toks_conc == toks_serial, \
+        "shared-pool concurrency changed outputs"
+
     return {
         "outputs_identical": identical,
         "shed_migrate": snap_mig["shed"],       # the zero-5xx contract
@@ -2555,6 +2641,19 @@ def bench_migrate(on_tpu: bool) -> dict:
         "freeze_resume_ms": mig["freeze_resume_ms"],
         "gather_copy_pages": n_pages,
         "gather_copy_ms": round(float(np.median(gather_ms)), 3),
+        # prefix-delta wire arm (ISSUE-19)
+        "delta_outputs_identical": toks_delta == expect,
+        "wire_bytes_full": full_b,
+        "wire_bytes_delta": delta_b,
+        "wire_bytes_ratio": round(full_b / max(delta_b, 1), 1),
+        "delta_prefix_tokens": trimmed["delta"]["prefix_tokens"],
+        "delta_in": tgt.migrate_delta_in,
+        # shared-pool concurrent dispatch arm (ISSUE-19)
+        "concurrent_outputs_identical": toks_conc == toks_serial,
+        "pool_tok_s_concurrent": round(tps_conc, 1),
+        "pool_tok_s_serialized": round(tps_serial, 1),
+        "pool_concurrency_speedup": round(
+            tps_conc / max(tps_serial, 1e-9), 2),
     }
 
 

@@ -231,7 +231,12 @@ def test_bench_migrate_drain_and_bytes_bounds(bench):
     with zero shed; (b) the migrating drain beats decode-to-completion
     by >= 3x (measured ~40x: freeze cost vs ~45 wedged dispatches);
     (c) the owner swap moved ZERO pages while the bytes a gather copy
-    would have shipped registered in bytes_avoided."""
+    would have shipped registered in bytes_avoided. The ISSUE-19
+    arms: (d) a migration into a warm target ships >= 5x fewer wire
+    bytes via the prefix-delta trim, token-exact; (e) two co-located
+    engines on one shared pool decode >= 1.2x faster with overlapping
+    dispatch windows than under the serialize_dispatch control,
+    token-exact."""
     out = bench.bench_migrate(False)
     assert out["outputs_identical"], out
     assert out["shed_migrate"] == {} and out["shed_decode"] == {}, out
@@ -240,6 +245,12 @@ def test_bench_migrate_drain_and_bytes_bounds(bench):
     assert out["owner_swap_pages_moved"] == 0, out
     assert out["owner_swap_bytes_avoided"] > 0, out
     assert out["gather_copy_pages"] > 0, out
+    assert out["delta_outputs_identical"], out
+    assert out["wire_bytes_ratio"] >= 5.0, out
+    assert out["wire_bytes_delta"] < out["wire_bytes_full"], out
+    assert out["delta_in"] == 1, out
+    assert out["concurrent_outputs_identical"], out
+    assert out["pool_concurrency_speedup"] >= 1.2, out
 
 
 @pytest.mark.slow  # heavyweight; tier-1 runs -m 'not slow'

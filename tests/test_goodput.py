@@ -438,6 +438,22 @@ def test_breaker_flap_and_goodput_collapse_rules():
         {"now": 0.0, "replica_failures": 0,
          "states": ["healthy", "broken", "probing"]}) is None
 
+    from tony_tpu.obs.alerts import ShedStormRule
+
+    storm = ShedStormRule(storm_count=10, storm_window_s=5.0)
+    assert storm.evaluate({"now": 0.0,
+                           "shed_capacity_total": 0}) is None
+    # a slow trickle of sheds never accumulates past the window
+    assert storm.evaluate({"now": 1.0,
+                           "shed_capacity_total": 4}) is None
+    out = storm.evaluate({"now": 2.0, "shed_capacity_total": 15})
+    assert out and out["sheds_in_window"] == 15
+    assert out["window_s"] == 5.0
+    # the window prunes by TIME: the burst above ages out, so the
+    # same cumulative level 10 s later is calm, not a storm
+    assert storm.evaluate({"now": 12.0,
+                           "shed_capacity_total": 16}) is None
+
     col = GoodputCollapseRule(collapse_frac=0.5, min_updates=3)
     state = {"toks": 0, "useful": 0.0, "disp": 0.0}
 

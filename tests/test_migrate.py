@@ -239,8 +239,22 @@ def test_sigkill_after_migration_falls_back_to_rerun(tiny):
     client ever sees a 5xx."""
     prompt, budget = _prompt(9), 40
     expect = _control(tiny, prompt, budget)
-    agents = [_start_agent(tiny, fault_plan=_slow()) for _ in range(2)]
-    gw = Gateway([_stub(a.address) for a in agents],
+    # 100 ms wedge (vs _slow's 30): the remote-to-remote migration
+    # dance (probe, extract through a first-time XLA gather compile,
+    # ship, adopt) costs 1-2 s on a starved 1-core host, and the
+    # stream must still have tokens LEFT afterwards for the kill to
+    # land on a live migrated session.
+    agents = [_start_agent(tiny,
+                           fault_plan=FaultPlan.wedge_at(1, 0.1,
+                                                         times=-1))
+              for _ in range(2)]
+    # lease_misses=30 (3 s lease): the wire extract holds the agent's
+    # dispatch lock through that same compile stall, which can outlive
+    # the default 0.3 s lease — expiring the SOURCE mid-migration and
+    # turning the test into a different (crash-path) scenario than the
+    # one under test. The kill half only needs expiry to happen at
+    # all, not fast.
+    gw = Gateway([_stub(a.address, lease_misses=30) for a in agents],
                  stall_timeout_s=10.0, breaker_base_s=0.05,
                  breaker_max_s=0.25).start()
     try:
@@ -297,6 +311,354 @@ def test_migrate_session_rebalances_token_exact(tiny):
     finally:
         assert gw.drain(timeout=60)
     assert pool.n_used == 0
+
+
+def test_kill_between_freeze_and_ship_adopts_leased_snapshot(tiny):
+    """The extract-vs-steal lease (this PR): the source replica dies
+    WHILE the migrate extract is in flight — the old behavior
+    abandoned the frozen snapshot and re-ran the victim from its
+    prompt even when the freeze completed a moment later. With the
+    lease, failover waits for the in-flight extract and ADOPTS the
+    completed snapshot: the stream resumes token-exact with no
+    recompute, and migrate_lease_adoptions proves the path taken."""
+    import threading
+
+    prompt, budget = _prompt(11), 40
+    expect = _control(tiny, prompt, budget)
+    srv0 = _mk(tiny, fault_plan=_slow())
+    gw = Gateway([srv0, _mk(tiny, fault_plan=_slow())]).start()
+    froze = threading.Event()   # the real extract finished
+    release = threading.Event()  # let the wrapper return the snap
+    real_extract = srv0.extract_session
+
+    def held_extract(engine_id, wire=True):
+        snap = real_extract(engine_id, wire=wire)
+        froze.set()
+        # the kill window: the snapshot exists but has not shipped —
+        # the test fails the source here, then lets us return
+        assert release.wait(20.0), "test release never arrived"
+        return snap
+
+    srv0.extract_session = held_extract
+    try:
+        t = gw.submit(GenRequest(list(prompt), max_new_tokens=budget,
+                                 id="lease"))
+        _wait_emitted(t, 3)
+        r0 = gw.replicas[t.replica]
+        epoch = r0.epoch
+        mover = threading.Thread(
+            target=lambda: gw.migrate_session("lease"), daemon=True)
+        mover.start()
+        assert froze.wait(30.0), "extract never froze the session"
+        # SIGKILL-as-the-gateway-sees-it, mid-extract: the steal runs
+        # on its own thread (like the watchdog) and its _failover
+        # blocks inside the lease claim until the extract completes
+        killer = threading.Thread(
+            target=lambda: gw._fail_replica(
+                r0, epoch, "test: source died mid-extract"),
+            daemon=True)
+        killer.start()
+        _wait(lambda: not gw._snap_leases, msg="failover claimed the "
+                                               "in-flight lease")
+        release.set()
+        mover.join(30.0)
+        killer.join(30.0)
+        res = t.result(timeout=120)
+        assert list(res.tokens) == list(expect)
+        snap = gw.snapshot()
+        assert snap["shed"] == {}  # zero 5xx
+        assert snap["routing"]["migrate_lease_adoptions"] == 1
+        # adopted, not recomputed: the survivor resumed mid-stream
+        # (its engine counted a migrate-in), and the whole fleet never
+        # re-prefilled the prompt a second time
+        assert snap["engine"]["migrations"]["in"] >= 1
+    finally:
+        srv0.extract_session = real_extract
+        gw.drain(timeout=60)
+
+
+def test_lease_expiry_falls_back_to_rerun(tiny):
+    """The lease's other half: an extract that NEVER completes (agent
+    truly dead) must not wedge failover — the claim times out after
+    migrate_lease_s, the ticket re-runs from its prompt (token-exact
+    by determinism), and the late snapshot is released by the
+    abandoned flag, not leaked."""
+    import threading
+
+    prompt, budget = _prompt(12), 40
+    expect = _control(tiny, prompt, budget)
+    srv0 = _mk(tiny, fault_plan=_slow())
+    gw = Gateway([srv0, _mk(tiny, fault_plan=_slow())]).start()
+    gw.migrate_lease_s = 0.2  # keep the test fast
+    froze = threading.Event()
+    release = threading.Event()
+    real_extract = srv0.extract_session
+
+    def wedged_extract(engine_id, wire=True):
+        snap = real_extract(engine_id, wire=wire)
+        froze.set()
+        release.wait(20.0)  # holds well past the 0.2 s lease
+        return snap
+
+    srv0.extract_session = wedged_extract
+    try:
+        t = gw.submit(GenRequest(list(prompt), max_new_tokens=budget,
+                                 id="wedge"))
+        _wait_emitted(t, 3)
+        r0 = gw.replicas[t.replica]
+        epoch = r0.epoch
+        mover = threading.Thread(
+            target=lambda: gw.migrate_session("wedge"), daemon=True)
+        mover.start()
+        assert froze.wait(30.0), "extract never froze the session"
+        gw._fail_replica(r0, epoch, "test: extract wedged")  # blocks
+        # ~migrate_lease_s, then gives up and requeues crash-path
+        release.set()  # the late snapshot arrives AFTER abandonment
+        mover.join(30.0)
+        res = t.result(timeout=120)
+        assert list(res.tokens) == list(expect)
+        snap = gw.snapshot()
+        assert snap["shed"] == {}
+        assert snap["routing"]["migrate_lease_adoptions"] == 0
+        assert snap["supervision"]["failovers"] >= 1
+        assert not gw._snap_leases  # nothing leaked on either path
+    finally:
+        srv0.extract_session = real_extract
+        gw.drain(timeout=60)
+
+
+# ------------------------------------------- prefix-delta migration
+
+
+@pytest.fixture(scope="module", params=[False, True],
+                ids=["f32kv", "int8kv"])
+def kvmodel(request):
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=64,
+                            dtype=jnp.float32,
+                            attention_backend="reference",
+                            kv_cache_quant=request.param)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _freeze_wire(srv, prompt, budget, rid="src", min_gen=4):
+    """Run ``prompt`` on ``srv`` until at least ``min_gen`` tokens are
+    live, then freeze + evict it as a WIRE snapshot (page content, not
+    ids) — the in-process stand-in for a source replica mid-stream."""
+    srv.submit(Request(list(prompt), budget, id=rid))
+    for _ in range(600):
+        srv.step()
+        lv = next((l for l in srv._live
+                   if l is not None and l.request.id == rid), None)
+        if lv is not None and len(lv.generated) >= min_gen:
+            break
+    else:
+        raise AssertionError("source stream never reached min_gen")
+    snap = srv.extract_session(rid, wire=True)
+    assert snap is not None
+    return snap
+
+
+def _warm(srv, tokens):
+    """Put ``tokens``' KV into ``srv``'s prefix store (run + donate)."""
+    srv.submit(Request(list(tokens), 1, id=f"warm{len(tokens)}"))
+    list(srv.run())
+
+
+def _wire_pages(payload):
+    for d in payload["leaves"]:
+        ax = d.get("page_axis")
+        if ax is not None:
+            return int(d["shape"][int(ax)])
+    return 0
+
+
+@pytest.mark.parametrize("scenario",
+                         ["exact", "partial", "nomatch", "stale"])
+def test_delta_migration_matrix(kvmodel, scenario):
+    """The delta-trim contract cell by cell, f32 and int8-KV pages:
+
+    - exact:   target store covers the whole context -> only the
+               final (always-shipped) page crosses; the adopter
+               refcount-shares its own store pages for the prefix.
+    - partial: target covers a shorter prefix -> exactly the
+               uncovered suffix ships.
+    - nomatch: cold target summary -> the trim declines (None) and
+               the full payload ships, delta counters untouched.
+    - stale:   the summary CLAIMS coverage the target no longer has
+               -> submit refuses with StaleDelta (no pin leaked) and
+               the full-payload re-ship lands token-exact.
+
+    Every cell's resumed stream is byte-identical to the no-migration
+    control, and ``migrate_bytes_wire`` counts exactly the shipped
+    pages."""
+    from tony_tpu.serve.migrate import StaleDelta, delta_trim_doc, \
+        snapshot_to_doc
+    from tony_tpu.serve.prefix import summary_match_len
+    from tony_tpu.serve.tier import payload_nbytes
+
+    prompt, budget = _prompt(11, 21), 12
+    expect = _control(kvmodel, prompt, budget)
+    src = _mk(kvmodel)
+    snap = _freeze_wire(src, prompt, budget)
+    doc = snapshot_to_doc(snap)
+    ctx = [int(t) for t in snap.prompt] \
+        + [int(t) for t in snap.generated][:-1]
+    ps = src.slots.pool.page_size
+    n = -(-int(doc["n_tokens"]) // ps)
+    assert n >= 3  # the matrix needs room between exact and partial
+
+    tgt = _mk(kvmodel, prefix_cache_mb=2.0)
+    if scenario == "exact":
+        _warm(tgt, ctx)
+    elif scenario == "partial":
+        _warm(tgt, ctx[:2 * ps])
+    summary = tgt.prefix_summary()
+    if scenario == "stale":
+        # an honest summary from a DIFFERENT warm engine: it claims
+        # coverage the actual target does not hold
+        helper = _mk(kvmodel, prefix_cache_mb=2.0)
+        _warm(helper, ctx)
+        summary = helper.prefix_summary()
+    trimmed = delta_trim_doc(doc, summary)
+
+    if scenario == "nomatch":
+        assert trimmed is None
+        send = doc
+    else:
+        assert trimmed is not None
+        covered = summary_match_len(summary, ctx)
+        k = min(covered // ps, n - 1)
+        assert trimmed["delta"]["prefix_tokens"] == k * ps
+        assert _wire_pages(trimmed["pages"]) == n - k
+        if scenario == "exact":
+            assert k == n - 1          # only the tail page ships
+        elif scenario == "partial":
+            assert k == 2 and k < n - 1
+        assert payload_nbytes(trimmed["pages"]) \
+            < payload_nbytes(doc["pages"])
+        send = trimmed
+
+    if scenario == "stale":
+        with pytest.raises(StaleDelta):
+            tgt.submit(Request(list(prompt), budget, id="adopt",
+                               migrate=send))
+        assert not tgt._migrate_pins  # the refusal released its pin
+        send = doc                    # the sender's contracted retry
+
+    tgt.submit(Request(list(prompt), budget, id="adopt", migrate=send))
+    res = {r.id: r for r in tgt.run()}["adopt"]
+    assert list(res.tokens) == list(expect)
+    nb = tgt.slots.pool.page_nbytes
+    if scenario in ("exact", "partial"):
+        assert tgt.migrate_delta_in == 1
+        assert tgt.migrate_bytes_wire == (n - k) * nb
+        assert tgt.migrate_bytes_avoided >= k * nb
+    else:
+        assert tgt.migrate_delta_in == 0
+        assert tgt.migrate_bytes_wire == n * nb
+    assert tgt.migrations_in == 1 and tgt.migrations_remote == 1
+    assert not tgt._migrate_pins
+
+
+def test_remote_delta_migration_ships_suffix_only(tiny):
+    """The wire half of the tentpole: the gateway's RemoteServer stub
+    trims the migrate doc against the target agent's heartbeat radix
+    summary, so a migration into a warm remote ships only the
+    uncovered suffix pages — token-exact, with the trim visible in the
+    stub's ``migrate_delta_trims`` and the agent engine's
+    ``delta_in``/``bytes_avoided`` counters riding the next
+    heartbeat."""
+    prompt, budget = _prompt(), 24
+    expect = _control(tiny, prompt, budget)
+    http = _start_agent(tiny, prefix_cache_mb=2.0, fault_plan=_slow())
+    stub = _stub(http.address)
+    # affinity off: it would route the live stream straight onto the
+    # warm remote, and the point is to MIGRATE into it over the wire
+    gw = Gateway([_mk(tiny, fault_plan=_slow()), stub],
+                 prefix_affinity=False).start()
+    try:
+        # warm the REMOTE with the stream's eventual full context
+        # (greedy determinism makes it knowable in advance), then let
+        # a heartbeat ship the summary that proves it
+        gw.replicas[0].outstanding = 500
+        gw.submit(GenRequest(list(prompt) + list(expect), 1,
+                             id="warm")).result(timeout=300)
+        gw.replicas[0].outstanding = 0
+        _wait(lambda: stub.prefix_match_len(list(prompt)) >= 8,
+              msg="heartbeat shipped the radix summary")
+        # pin the live stream on the LOCAL replica
+        gw.replicas[1].outstanding = 500
+        t = gw.submit(GenRequest(list(prompt), max_new_tokens=budget,
+                                 id="d"))
+        _wait_emitted(t, 3)
+        gw.replicas[1].outstanding = 0
+        assert gw.migrate_session("d") is True
+        res = t.result(timeout=120)
+        assert list(res.tokens) == list(expect)
+        assert gw.snapshot()["shed"] == {}
+        assert stub.migrate_delta_trims >= 1
+        assert stub.migrate_delta_fallbacks == 0
+
+        def _settled():
+            m = gw.snapshot()["engine"]["migrations"]
+            return m["delta_in"] >= 1 and m["bytes_wire"] > 0
+        _wait(_settled, msg="delta counters settled")
+        m = gw.snapshot()["engine"]["migrations"]
+        assert m["bytes_avoided"] > 0  # the prefix never crossed
+    finally:
+        gw.drain(timeout=60)
+        http.stop()
+
+
+def test_remote_delta_stale_summary_falls_back_full(tiny):
+    """The fallback half: a stale summary makes the adopter refuse
+    with kind=StaleDelta and the stub re-ships the FULL payload
+    exactly once — the stream stays token-exact, and the episode is
+    visible as one trim + one fallback."""
+    from tony_tpu.gateway.remote import RemoteServer
+
+    class _ForcedSummary(RemoteServer):
+        # heartbeats cannot clear the forced summary: the staleness
+        # window stays open for as long as the test needs it
+        @property
+        def _prefix_summary(self):
+            return getattr(self, "_forced", [])
+
+        @_prefix_summary.setter
+        def _prefix_summary(self, value):
+            pass
+
+    prompt, budget = _prompt(9), 24
+    expect = _control(tiny, prompt, budget)
+    # the agent's store is ENABLED but cold; the forced summary is an
+    # honest one from a different warm engine
+    helper = _mk(tiny, prefix_cache_mb=2.0)
+    _warm(helper, list(prompt) + list(expect))
+    http = _start_agent(tiny, prefix_cache_mb=2.0, fault_plan=_slow())
+    stub = _ForcedSummary(http.address, heartbeat_interval_s=0.1,
+                          lease_misses=3, boot_timeout_s=20.0)
+    gw = Gateway([_mk(tiny, fault_plan=_slow()), stub],
+                 prefix_affinity=False).start()
+    try:
+        stub._forced = helper.prefix_summary()
+        gw.replicas[1].outstanding = 500
+        t = gw.submit(GenRequest(list(prompt), max_new_tokens=budget,
+                                 id="d"))
+        _wait_emitted(t, 3)
+        gw.replicas[1].outstanding = 0
+        assert gw.migrate_session("d") is True
+        res = t.result(timeout=120)
+        assert list(res.tokens) == list(expect)
+        assert gw.snapshot()["shed"] == {}  # the fallback is silent
+        assert stub.migrate_delta_trims == 1
+        assert stub.migrate_delta_fallbacks == 1
+    finally:
+        gw.drain(timeout=60)
+        http.stop()
 
 
 def test_remote_prefix_affinity_via_heartbeat_summary(tiny):

@@ -593,11 +593,36 @@ def test_metrics_exposition_format_and_stats_consistency(tiny):
                          ("pages_moved",
                           "tony_migration_pages_moved_total"),
                          ("bytes_avoided",
-                          "tony_migration_bytes_avoided_total")):
+                          "tony_migration_bytes_avoided_total"),
+                         # ISSUE-19: the wire-economy pair rides the
+                         # same carry-inclusive rollup
+                         ("bytes_wire",
+                          "tony_migration_bytes_wire_total"),
+                         ("delta_in",
+                          "tony_migration_delta_in_total")):
             assert f"{fam} {mig[key]}" in text, fam
         for i, row in enumerate(snap["replicas"]):
             assert (f'tony_engine_migrations_out_total{{replica="{i}"}} '
                     f'{row["migrations_out"]}') in text
+            assert (f'tony_engine_migrate_bytes_wire_total'
+                    f'{{replica="{i}"}} '
+                    f'{row["migrate_bytes_wire"]}') in text
+        # ISSUE-19: rebalance families are absent until a Rebalancer
+        # is attached, then agree with the /stats rebalance block
+        assert "tony_rebalance_" not in text
+        from tony_tpu.gateway import Rebalancer
+
+        Rebalancer(gw, interval_s=999.0)  # registers, never started
+        text2 = prometheus_text(gw)
+        _validate_exposition(text2)
+        rb = gw.snapshot()["rebalance"]
+        assert rb["enabled"]
+        for key, fam in (("moves", "tony_rebalance_moves_total"),
+                         ("move_failures",
+                          "tony_rebalance_move_failures_total"),
+                         ("ticks", "tony_rebalance_ticks_total"),
+                         ("streak", "tony_rebalance_streak")):
+            assert f"{fam} {rb[key]}" in text2, fam
         # the paged-KV block: /metrics and /stats must agree on every
         # kv_pages figure (per-replica gauges sum to the engine rollup)
         kv = snap["engine"]["kv_pages"]

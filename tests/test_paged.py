@@ -12,6 +12,8 @@ the same logical positions as the unpaged buffer, so parity is exact,
 not approximate.
 """
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -111,6 +113,69 @@ def test_pool_reservation_invariant(tiny):
         pool.cancel(1)
     pool.unref(got)
     assert pool.available() == 4
+
+
+def test_pool_concurrent_churn_reconciles(tiny):
+    """The two-lock allocator under real thread contention: several
+    threads churn reserve/alloc/share/unref/cancel against ONE shared
+    pool, and the ledger reconciles exactly — no page is ever issued
+    to two owners (the final free list holds each page id exactly
+    once), ``free >= reserved`` holds at every sampled instant, and
+    once every thread drops its references the pool is empty with
+    ``allocs == frees``."""
+    model, params = tiny
+    pool = PagePool(model, params, n_pages=32, page_size=8, shared=True)
+    n_threads, iters = 6, 250
+    barrier = threading.Barrier(n_threads)
+    errors: list[BaseException] = []
+
+    def churn(seed):
+        rng = np.random.default_rng(seed)
+        held: list[int] = []   # pages this thread holds one ref to
+        barrier.wait()
+        try:
+            for _ in range(iters):
+                op = int(rng.integers(0, 5))
+                if op == 0:                      # reserve -> alloc
+                    n = int(rng.integers(1, 3))
+                    if pool.reserve(n):
+                        got = pool.alloc(n, from_reservation=True)
+                        # freshly allocated pages belong to this
+                        # thread alone: refcount is exactly 1
+                        assert all(pool.refcount[p] == 1 for p in got)
+                        held.extend(got)
+                elif op == 1:                    # reserve -> cancel
+                    n = int(rng.integers(1, 3))
+                    if pool.reserve(n):
+                        pool.cancel(n)
+                elif op == 2 and held:           # cow fork: extra ref
+                    page = held[int(rng.integers(len(held)))]
+                    pool.share([page])
+                    held.append(page)
+                elif held:                       # drop one ref
+                    page = held.pop(int(rng.integers(len(held))))
+                    pool.unref([page])
+                st = pool.stats()                # one _mu snapshot
+                assert st["free"] + st["used"] == st["total"]
+                assert st["free"] >= st["reserved"] >= 0
+        except BaseException as e:               # pragma: no cover
+            errors.append(e)
+        finally:
+            for page in held:
+                pool.unref([page])
+
+    threads = [threading.Thread(target=churn, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+    assert pool.n_used == 0 and pool.reserved == 0
+    assert (pool.refcount == 0).all()
+    assert pool.allocs == pool.frees
+    # a double-issued page would appear twice here (or be missing)
+    assert sorted(pool._free) == list(range(pool.n_pages))
 
 
 def test_cow_fork_preserves_parent(tiny):

@@ -314,6 +314,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="lockout after a scale-up (s)")
     p.add_argument("--autoscale-cooldown-down", type=float, default=30.0,
                    help="lockout after a scale-down (s)")
+    p.add_argument("--rebalance", action="store_true",
+                   help="arm the pressure-driven rebalancer "
+                        "(gateway/rebalance.py): watches per-replica "
+                        "slot-occupancy skew and live-migrates "
+                        "in-flight sessions off the hottest replica, "
+                        "token-exact, preferring victims whose prefix "
+                        "the cold side already caches")
+    p.add_argument("--no-rebalance", action="store_true",
+                   help="explicitly disable the rebalancer (the A/B "
+                        "control; wins over --rebalance)")
+    p.add_argument("--rebalance-interval", type=float, default=1.0,
+                   help="rebalancer control-loop tick in seconds")
+    p.add_argument("--rebalance-skew", type=float, default=0.5,
+                   help="hot-minus-cold occupancy-fraction gap that "
+                        "counts as skew (0.5 = 50 points fuller)")
+    p.add_argument("--rebalance-stable", type=int, default=2,
+                   help="consecutive skewed ticks before a move "
+                        "(hysteresis)")
+    p.add_argument("--rebalance-cooldown", type=float, default=5.0,
+                   help="lockout after a successful move (s); a move "
+                        "that found no victim waits twice as long")
     p.add_argument("--no-in-dispatch-eos", action="store_true",
                    help="disable the in-dispatch EOS/refill freeze "
                    "(ISSUE-13) and fused speculation rounds — the "
@@ -375,6 +396,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ttft_slo_burn alert: TTFT SLO in seconds "
                         "(>10%% of a tick's completions over it "
                         "fires; 0 disables the rule)")
+    p.add_argument("--alert-shed-storm", type=int, default=50,
+                   help="shed_storm alert: capacity sheds "
+                        "(429/503/504, quota excluded) within the "
+                        "storm window that count as a storm")
+    p.add_argument("--alert-shed-window", type=float, default=10.0,
+                   help="shed_storm alert: rate window in seconds")
     p.add_argument("--no-alert-bundles", action="store_true",
                    help="disable the flight recorder: by default a "
                         "FIRING alert dumps one self-contained debug "
@@ -728,6 +755,10 @@ def build_gateway(args, model, params, eos, *, metrics_store=None):
                        "host_thrash_bytes": getattr(
                            args, "alert_host_thrash_bytes",
                            float(1 << 20)),
+                       "shed_storm_count": getattr(
+                           args, "alert_shed_storm", 50),
+                       "shed_storm_window_s": getattr(
+                           args, "alert_shed_window", 10.0),
                    },
                    bundle_on_alert=not getattr(args, "no_alert_bundles",
                                                False),
@@ -810,6 +841,25 @@ def build_scaler(args, gateway, model, params, eos):
         drain_timeout_s=getattr(args, "drain_timeout", 120.0))
 
 
+def build_rebalancer(args, gateway):
+    """Arm the pressure-driven rebalancer when --rebalance asks for
+    one (--no-rebalance wins: it is the A/B control in smoke runs
+    that pass both). Returns None when not armed."""
+    if getattr(args, "no_rebalance", False) \
+            or not getattr(args, "rebalance", False):
+        return None
+    from tony_tpu.gateway import Rebalancer
+
+    cooldown = getattr(args, "rebalance_cooldown", 5.0)
+    return Rebalancer(
+        gateway,
+        interval_s=getattr(args, "rebalance_interval", 1.0),
+        skew_frac=getattr(args, "rebalance_skew", 0.5),
+        stable=getattr(args, "rebalance_stable", 2),
+        cooldown_s=cooldown,
+        fail_cooldown_s=2 * cooldown)
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -879,6 +929,9 @@ def main(argv=None) -> int:
     scaler = build_scaler(args, gateway, model, params, eos)
     if scaler is not None:
         scaler.start()
+    rebalancer = build_rebalancer(args, gateway)
+    if rebalancer is not None:
+        rebalancer.start()
     if getattr(args, "edge", "event") == "event":
         http = GatewayEdge(
             gateway, host=args.host, port=args.port,
@@ -893,6 +946,8 @@ def main(argv=None) -> int:
                            encode=encode, decode=decode).start()
     elastic = "" if scaler is None else \
         (f", autoscale {scaler.min_replicas}-{scaler.max_replicas}")
+    if rebalancer is not None:
+        elastic += ", rebalance on"
     n_rep = len(gateway.replicas)
     mode = ""
     if remote:

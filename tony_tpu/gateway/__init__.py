@@ -26,6 +26,7 @@ from tony_tpu.gateway.core import (BadRequest, DeadlineExceeded, Gateway,
                                    RetryBudgetExhausted, Shed, Ticket)
 from tony_tpu.gateway.edge import GatewayEdge
 from tony_tpu.gateway.http import GatewayHTTP
+from tony_tpu.gateway.rebalance import Rebalancer
 from tony_tpu.gateway.remote import (AgentHTTPError, AgentTransport,
                                      RemoteServer, launch_local_agent)
 
@@ -47,6 +48,7 @@ __all__ = [
     "NoHealthyReplicas",
     "ProvisionerBackend",
     "QuotaExceeded",
+    "Rebalancer",
     "RemoteServer",
     "RetryBudgetExhausted",
     "ScaleError",

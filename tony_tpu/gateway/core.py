@@ -404,6 +404,38 @@ def _release_snapshot(snap) -> None:
     snap.pool = None
 
 
+class _SnapLease:
+    """The extract-vs-steal handshake (this PR): registered by
+    ``_migrate_ticket`` BEFORE it freezes a session, claimed by
+    ``_failover`` when the source replica dies with the extract still
+    in flight. Without it, a SIGKILL between freeze and ship abandons
+    the frozen snapshot — failover re-runs the victim from its prompt
+    even when a complete, token-exact snapshot materializes a moment
+    later (a remote agent can answer ``/v1/migrate_out`` and die
+    before the relay). With it, failover waits a SHORT lease for the
+    in-flight extract: complete -> adopt the snapshot (no recompute),
+    timeout -> mark it abandoned so the extractor releases it, crash
+    path proceeds. All fields are mutated under the gateway's
+    ``_lease_lock``; ``done`` doubles as the claimer's wakeup."""
+
+    __slots__ = ("done", "snap", "abandoned", "t0")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.snap = None
+        self.abandoned = False
+        self.t0 = time.monotonic()
+
+
+def _lease_key(ticket) -> object:
+    """Lease key: the gateway request id (what migrate_session is
+    addressed by), falling back to the ticket's identity for requests
+    submitted without one — extractor and claimer must compute the
+    SAME key from the same ticket."""
+    rid = ticket.request.id
+    return rid if rid is not None else id(ticket)
+
+
 def _release_ticket_payload(ticket) -> None:
     """Drop (and, for owner-swap forms, unref) the one-shot payloads a
     ticket still carries — run on every terminal path and on the
@@ -1290,6 +1322,11 @@ class _Stats:
         # ledger from /stats
         self.migrations = 0
         self.migrate_carry: dict[str, float] = {}
+        # frozen snapshots a FAILOVER adopted instead of re-running
+        # from the prompt (the extract-vs-steal lease, this PR): each
+        # one is a mid-stream crash whose victim resumed token-exact
+        # with no recompute
+        self.migrate_lease_adoptions = 0
         # the flight recorder (ISSUE-15): alert-triggered debug
         # bundles dumped into the history job dir
         self.bundles_written = 0
@@ -1356,6 +1393,8 @@ class GatewayHistory:
                                            "autotune.jsonl")
         self._bundles_path = os.path.join(self.job_dir, "metrics",
                                           "bundles.jsonl")
+        self._rebalance_path = os.path.join(self.job_dir, "metrics",
+                                            "rebalance.jsonl")
 
     def _append_event(self, event) -> None:
         with self._lock, open(self.jhist, "a") as f:
@@ -1394,6 +1433,14 @@ class GatewayHistory:
         compile) in ``metrics/autotune.jsonl`` — "why did chunk depth
         change at 14:02" is answerable from the job history."""
         with self._lock, open(self._autotune_path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    def record_rebalance(self, row: dict) -> None:
+        """One rebalancer decision (move/no_victim/move_failed, the
+        occupancy it saw) in ``metrics/rebalance.jsonl`` — "why did
+        request 17 jump replicas at 14:02" is answerable from the job
+        history."""
+        with self._lock, open(self._rebalance_path, "a") as f:
             f.write(json.dumps(row) + "\n")
 
     def write_bundle(self, doc: dict) -> str:
@@ -1645,6 +1692,14 @@ class Gateway:
         self.profiler = ServeProfiler(profile_dir)
         self._lock = threading.Lock()
         self._drain_lock = threading.Lock()
+        # in-flight frozen-snapshot leases (_SnapLease): keyed by
+        # gateway request id, registered before every migrate extract,
+        # claimed by _failover when the source dies mid-move
+        self._snap_leases: dict = {}
+        self._lease_lock = threading.Lock()
+        self.migrate_lease_s = 5.0  # how long a failover waits for an
+        #                             in-flight extract before falling
+        #                             back to re-run-from-prompt
         self._drain_done: bool | None = None
         self._host_cache: tuple[float, dict] | None = None
         self._tpu_discoverer = None
@@ -1654,6 +1709,10 @@ class Gateway:
         # itself): snapshot() surfaces its status block, drain() stops
         # its loop before closing the fleet
         self.scaler = None
+        # an attached Rebalancer (gateway/rebalance.py registers
+        # itself): the pressure-driven session-packing loop — same
+        # snapshot/drain contract as the scaler
+        self.rebalancer = None
         # the network face's connection-plane stats provider (ISSUE-16:
         # gateway/edge.py registers its snapshot fn) — the gateway core
         # knows nothing about sockets, but /stats and /metrics are the
@@ -1728,6 +1787,12 @@ class Gateway:
             # let it try — and a scale-down's remove_replica must not
             # interleave with the fleet-wide join below
             scaler.stop()
+        rebalancer = self.rebalancer
+        if rebalancer is not None:
+            # same reasoning: migrating sessions around a fleet that
+            # is about to join is churn at best, a stranded frozen
+            # snapshot at worst
+            rebalancer.stop()
         if self._alert_loop is not None:
             # same reasoning: an alert evaluated over a half-joined
             # fleet is noise, and the history file is about to close
@@ -1876,6 +1941,7 @@ class Gateway:
             for key in ("migrations_out", "migrations_in",
                         "migrations_local", "migrations_remote",
                         "migrate_pages_moved", "migrate_bytes_avoided",
+                        "migrate_bytes_wire", "migrate_delta_in",
                         "migrate_freeze_resume_ms"):
                 if counts.get(key):
                     self.stats.migrate_carry[key] = \
@@ -1934,6 +2000,38 @@ class Gateway:
             "kv_host_page_in_bytes": sum(
                 c.get("kv_host_page_in_bytes", 0) for c in counts),
         }
+
+    def rebalance_signals(self) -> dict:
+        """One consistent read of everything the rebalancer watches:
+        per-replica slot occupancy, queue depth, and the in-flight
+        ticket set (request id, prompt for the prefix-heat probe,
+        remaining work for the tie-break). Only HEALTHY replicas with
+        a live engine appear — a broken or retiring replica is the
+        failover/retirement machinery's problem, not a packing
+        target."""
+        now = time.monotonic()
+        rows = []
+        for r in self.live_replicas:
+            server = r.server  # single read vs concurrent retirement
+            if server is None or r.state != HEALTHY:
+                continue
+            with r.cv:
+                tickets = [
+                    {"rid": t.request.id,
+                     "prompt": list(t.request.prompt),
+                     "remaining": max(
+                         0, t.request.max_new_tokens - t._n_emitted)}
+                    for t in r._tickets.values()
+                    if t.request.id is not None]
+            rows.append({
+                "index": r.index,
+                "active": server.slots.n_active,
+                "slots": server.slots.batch_size,
+                "depth": r.queue_signals(now)["depth"],
+                "outstanding": r.outstanding,
+                "tickets": tickets,
+            })
+        return {"now": now, "replicas": rows}
 
     def alert_signals(self) -> dict:
         """``scale_signals()`` plus what the alert rules additionally
@@ -2515,7 +2613,47 @@ class Gateway:
                     f"run(s) on replicas {sorted(ticket.excluded)} "
                     f"({reason})", exc=RetryBudgetExhausted)
                 continue
+            if any(ticket is t for t in admitted):
+                self._claim_snapshot(ticket)
             self._requeue(replica, ticket, reason)
+
+    def _claim_snapshot(self, ticket: Ticket) -> None:
+        """The lease's claim half: if a migrate extract for this
+        ticket is in flight (the source died mid-move), wait up to
+        ``migrate_lease_s`` for the frozen snapshot and attach it —
+        the requeue then resumes the session token-exact with NO
+        recompute. Timeout or a failed extract falls through to the
+        ordinary crash path (re-run from the prompt, still
+        token-exact, just slower); the abandoned flag tells the
+        extractor its late snapshot belongs to nobody."""
+        with self._lease_lock:
+            lease = self._snap_leases.pop(_lease_key(ticket), None)
+        if lease is None:
+            return
+        if not lease.done.wait(self.migrate_lease_s):
+            with self._lease_lock:
+                if not lease.done.is_set():
+                    # expired with the extract still running: the
+                    # extractor sees abandoned=True and releases the
+                    # snapshot when (if) it completes
+                    lease.abandoned = True
+                    log.warning("migrate snapshot lease expired after "
+                                "%.1fs; re-running from prompt",
+                                self.migrate_lease_s)
+                    return
+        if lease.snap is None:
+            return  # the extract failed: nothing to adopt
+        ticket.migrate = lease.snap
+        with self.stats.lock:
+            self.stats.migrate_lease_adoptions += 1
+            self.stats.migrations += 1
+        if ticket.trace is not None:
+            ticket.trace.add("migrate_lease_adopt", time.monotonic(),
+                             attempt=False,
+                             waited_s=round(
+                                 time.monotonic() - lease.t0, 3))
+        log.warning("failover adopted an in-flight migrate snapshot "
+                    "(token-exact resume, no recompute)")
 
     def _requeue(self, replica: _Replica, ticket: Ticket,
                  reason: str) -> None:
@@ -2615,15 +2753,46 @@ class Gateway:
         # (serve/migrate.gather_local); otherwise gather to wire now
         pool = getattr(getattr(server, "slots", None), "pool", None)
         wire = not (pool is not None and getattr(pool, "shared", False))
+        # register the lease BEFORE the freeze: if the source replica
+        # dies while the extract is in flight (remote migrate_out over
+        # a SIGKILLed agent, a wedged local scheduler), _failover finds
+        # this lease and waits a bounded time for the snapshot instead
+        # of instantly degrading the session to re-run-from-prompt
+        key = _lease_key(ticket)
+        lease = _SnapLease()
+        with self._lease_lock:
+            self._snap_leases[key] = lease
         try:
             snap = server.extract_session(engine_id, wire=wire)
         except Exception:
             log.exception("migrate-out extract failed on replica %d",
                           replica.index)
-            return False
+            snap = None
         if snap is None:
-            return False  # not in a live slot: pending, prefilling,
-            #               or it finished under us
+            # failed or not in a live slot (pending, prefilling, or it
+            # finished under us): wake any waiting claimer with
+            # nothing — it proceeds down the crash path immediately
+            with self._lease_lock:
+                self._snap_leases.pop(key, None)
+                lease.done.set()
+            return False
+        with self._lease_lock:
+            lease.snap = snap
+            lease.done.set()
+            claimed = self._snap_leases.pop(key, None) is None
+            abandoned = lease.abandoned
+        if abandoned:
+            # the claimer's lease expired before the extract finished:
+            # the ticket already re-ran from its prompt — the late
+            # snapshot is a duplicate of a stream someone else owns
+            _release_snapshot(snap)
+            return False
+        if claimed:
+            # _failover took the lease and is adopting the snapshot
+            # (it sets ticket.migrate and requeues): the session moves
+            # token-exact with no recompute — the move happened, just
+            # through the crash funnel instead of the relay below
+            return True
         with replica.cv:
             owned = replica.epoch == epoch \
                 and replica._tickets.pop(engine_id, None) is not None
@@ -2938,6 +3107,8 @@ class Gateway:
                 "prefix_routed": self.stats.prefix_routed,
                 "handoffs": self.stats.handoffs,
                 "migrations": self.stats.migrations,
+                "migrate_lease_adoptions":
+                    self.stats.migrate_lease_adoptions,
                 "roles": {r.index: r.role for r in live}
                 if self.roles else None,
             }
@@ -3008,6 +3179,9 @@ class Gateway:
         scaler = self.scaler
         if scaler is not None:
             out["scaler"] = scaler.status()
+        rebalancer = self.rebalancer
+        out["rebalance"] = rebalancer.status() \
+            if rebalancer is not None else {"enabled": False}
         edge = self._edge_stats
         if edge is not None:
             try:
@@ -3106,6 +3280,8 @@ class Gateway:
                 "remote": mtotal("migrations_remote"),
                 "pages_moved": mtotal("migrate_pages_moved"),
                 "bytes_avoided": mtotal("migrate_bytes_avoided"),
+                "bytes_wire": mtotal("migrate_bytes_wire"),
+                "delta_in": mtotal("migrate_delta_in"),
                 "freeze_resume_ms": round(
                     mtotal("migrate_freeze_resume_ms"), 3),
             },

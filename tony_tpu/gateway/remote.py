@@ -432,7 +432,7 @@ class RemoteServer:
                  lease_misses: int = 5, connect_timeout_s: float = 2.0,
                  read_timeout_s: float = 5.0, boot_timeout_s: float = 60.0,
                  stall_timeout_s: float = 30.0, obs_pull: bool = True,
-                 agent_channel: str = "mux",
+                 agent_channel: str = "mux", migrate_delta: bool = True,
                  transport_faults=None, agent_proc=None):
         if agent_channel not in ("mux", "per-ticket"):
             raise ValueError(f"agent_channel must be 'mux' or "
@@ -468,6 +468,12 @@ class RemoteServer:
         self.lease_expiries = 0
         self.heartbeat_failures = 0
         self.garbled_frames = 0  # corrupt NDJSON frames survived
+        # prefix-delta wire migration (ISSUE-19): trim migrate docs
+        # against the agent's heartbeat radix summary; a StaleDelta
+        # refusal re-ships the full payload once
+        self.migrate_delta = bool(migrate_delta)
+        self.migrate_delta_trims = 0      # docs shipped suffix-only
+        self.migrate_delta_fallbacks = 0  # stale summary -> full re-ship
         self._rtt_ms = 0.0  # EMA over heartbeat round trips
         self._last_hb = time.monotonic()
         # fleet observability (ISSUE-15): the pulled timeline/ledger +
@@ -834,6 +840,7 @@ class RemoteServer:
                 else encode_array(logits),
             }
             path = "/v1/handoff"
+        mig_full = None
         if request.migrate is not None:
             # live migration intake (ISSUE-18): a frozen session rides
             # /v1/submit's contract to /v1/migrate_in. A LOCAL snapshot
@@ -842,7 +849,7 @@ class RemoteServer:
             # the handoff above: the transfer ref is consumed exactly
             # once, and retries re-ship the encoded content.
             from tony_tpu.serve.migrate import SessionSnapshot, \
-                gather_local, snapshot_to_doc
+                delta_trim_doc, gather_local, snapshot_to_doc
             from tony_tpu.serve.tier import encode_payload
 
             mig = request.migrate
@@ -852,9 +859,21 @@ class RemoteServer:
                     mig.pages = encode_payload(gather_local(pool, ids))
                     mig.local = False
                     mig.pool = None
-                doc["migrate"] = snapshot_to_doc(mig)
+                mig_full = snapshot_to_doc(mig)
             else:
-                doc["migrate"] = mig  # already wire form (remote hop)
+                mig_full = mig  # already wire form (remote hop)
+            # prefix-delta trim (ISSUE-19): when the agent's heartbeat
+            # radix summary says it already holds a prefix of this
+            # session's context, ship only the uncovered suffix pages.
+            # Advisory — a stale summary comes back kind=StaleDelta
+            # and the full doc re-ships below.
+            trimmed = delta_trim_doc(mig_full, self._prefix_summary) \
+                if self.migrate_delta else None
+            if trimmed is not None:
+                with self._stats_lock:
+                    self.migrate_delta_trims += 1
+            doc["migrate"] = trimmed if trimmed is not None \
+                else mig_full
             path = "/v1/migrate_in"
         # Mux mode pre-registers the ticket: a warm engine can finish
         # the request and the channel deliver every frame BEFORE this
@@ -871,13 +890,33 @@ class RemoteServer:
                 self._cond.notify_all()  # wake a parked channel loop
             self._ensure_channel()
         try:
-            resp = self.transport.call("POST", path, doc,
-                                       epoch=self.epoch,
-                                       request=request.id)
+            try:
+                resp = self.transport.call("POST", path, doc,
+                                           epoch=self.epoch,
+                                           request=request.id)
+            except AgentHTTPError as e:
+                # stale-summary fallback (ISSUE-19): the adopter no
+                # longer holds the prefix the trim assumed — re-ship
+                # the FULL payload once. Correctness never rests on
+                # summary freshness; only the wire-byte win does.
+                if e.doc.get("kind", "") != "StaleDelta" \
+                        or mig_full is None \
+                        or doc.get("migrate") is mig_full:
+                    raise
+                with self._stats_lock:
+                    self.migrate_delta_fallbacks += 1
+                doc["migrate"] = mig_full
+                resp = self.transport.call("POST", path, doc,
+                                           epoch=self.epoch,
+                                           request=request.id)
         except AgentHTTPError as e:
             if pre:
                 self._unregister(request.id)
             kind = e.doc.get("kind", "")
+            if kind == "StaleDelta":
+                # a full payload refused as stale is an agent bug —
+                # surface it as the invalid-request it claims to be
+                raise ValueError(e.doc.get("error", str(e))) from None
             if kind == "QueueFull":
                 raise QueueFull(e.doc.get("error", str(e))) from None
             if kind == "PoolExhausted":
@@ -1332,6 +1371,9 @@ class RemoteServer:
                 "heartbeat_failures": self.heartbeat_failures,
                 "stale_epoch_drops": self.stale_epoch_drops,
                 "lease_expiries": self.lease_expiries,
+                # prefix-delta wire migration (ISSUE-19)
+                "migrate_delta_trims": self.migrate_delta_trims,
+                "migrate_delta_fallbacks": self.migrate_delta_fallbacks,
                 # the clock-offset model (ISSUE-15): what remote span
                 # timestamps were corrected by, and how far off that
                 # correction could honestly be

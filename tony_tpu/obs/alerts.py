@@ -265,6 +265,43 @@ class BreakerFlapRule(Rule):
         return None
 
 
+class ShedStormRule(Rule):
+    """Capacity sheds clustering in time: the gateway is 429/503-ing
+    clients faster than ``storm_count`` per ``storm_window_s`` — a
+    connection storm is hitting the admission gate and real requests
+    are bouncing off it. Fires on the RATE of the cumulative
+    ``shed_capacity_total`` counter (quota 429s are already excluded
+    upstream — a tenant over its own rate limit is policy, not an
+    incident), with the same time-pruned sample window as
+    ``BreakerFlapRule``: a fixed-length ring at sub-second alert
+    intervals would silently shrink the window. Before this rule, a
+    storm's sheds moved /stats and the autoscaler but never the alert
+    bus — the one surface operators actually page on."""
+
+    def __init__(self, storm_count: int = 50,
+                 storm_window_s: float = 10.0, **kw):
+        kw.setdefault("severity", "critical")
+        super().__init__("shed_storm",
+                         message="capacity sheds storming", **kw)
+        self.storm_count = max(1, storm_count)
+        self.storm_window_s = storm_window_s
+        self._samples: deque = deque()  # (t, shed_capacity_total)
+
+    def evaluate(self, signals):
+        now = signals.get("now", time.monotonic())
+        shed = signals.get("shed_capacity_total", 0)
+        self._samples.append((now, shed))
+        horizon = now - self.storm_window_s
+        while self._samples and self._samples[0][0] < horizon:
+            self._samples.popleft()
+        recent = shed - self._samples[0][1]
+        if recent >= self.storm_count:
+            return {"sheds_in_window": recent,
+                    "window_s": self.storm_window_s,
+                    "threshold": self.storm_count}
+        return None
+
+
 class GoodputCollapseRule(Rule):
     """Fleet useful fraction dropping hard below its own trailing
     baseline while real work is running — the "the fleet is busy but
@@ -330,7 +367,8 @@ class GoodputCollapseRule(Rule):
 def default_rules(thresholds: dict | None = None) -> list[Rule]:
     """The stock rule set; ``thresholds`` overrides any of
     queue_wait_s / kv_free_frac / ttft_slo_s / burn_frac /
-    flap_failures / flap_window_s / collapse_frac."""
+    flap_failures / flap_window_s / shed_storm_count /
+    shed_storm_window_s / collapse_frac."""
     t = thresholds or {}
     return [
         QueueAgingRule(queue_wait_s=t.get("queue_wait_s", 5.0)),
@@ -342,6 +380,8 @@ def default_rules(thresholds: dict | None = None) -> list[Rule]:
                         burn_frac=t.get("burn_frac", 0.10)),
         BreakerFlapRule(flap_failures=t.get("flap_failures", 2),
                         flap_window_s=t.get("flap_window_s", 60.0)),
+        ShedStormRule(storm_count=t.get("shed_storm_count", 50),
+                      storm_window_s=t.get("shed_storm_window_s", 10.0)),
         GoodputCollapseRule(
             collapse_frac=t.get("collapse_frac", 0.5)),
     ]

@@ -94,6 +94,10 @@ _REPLICA_COUNTERS = (
      "KV pages physically copied by migrations (wire path)"),
     ("migrate_bytes_avoided", "tony_engine_migrate_bytes_avoided_total",
      "KV bytes an owner swap kept in place instead of copying"),
+    ("migrate_bytes_wire", "tony_engine_migrate_bytes_wire_total",
+     "KV bytes that actually crossed the wire in migration payloads"),
+    ("migrate_delta_in", "tony_engine_migrate_delta_in_total",
+     "Wire adoptions that rebuilt their prefix from local radix pages"),
     ("migrate_freeze_resume_ms",
      "tony_engine_migrate_freeze_resume_ms_total",
      "Milliseconds sessions spent frozen between extract and adopt"),
@@ -220,6 +224,12 @@ _TRANSPORT_COUNTERS = (
      "Agent responses discarded by the epoch fence"),
     ("lease_expiries", "tony_transport_lease_expiries_total",
      "Lease expiries that declared the agent dead"),
+    ("migrate_delta_trims", "tony_transport_migrate_delta_trims_total",
+     "Migration payloads delta-trimmed against the target's radix "
+     "summary before shipping"),
+    ("migrate_delta_fallbacks",
+     "tony_transport_migrate_delta_fallbacks_total",
+     "Delta payloads the agent refused as stale, re-sent in full"),
 )
 
 _SUPERVISION = (
@@ -401,6 +411,23 @@ def prometheus_text(gateway) -> str:
         counter("tony_scaler_errors_total",
                 "Autoscaler tick/action errors", sc["errors"])
 
+    # rebalancer (absent / disabled unless --rebalance)
+    rb = snap.get("rebalance")
+    if rb and rb.get("enabled"):
+        counter("tony_rebalance_moves_total",
+                "Sessions live-migrated by the rebalancer",
+                rb["moves"])
+        counter("tony_rebalance_move_failures_total",
+                "Acting ticks that found no migratable session",
+                rb["move_failures"])
+        counter("tony_rebalance_errors_total",
+                "Rebalancer tick/action errors", rb["errors"])
+        counter("tony_rebalance_ticks_total",
+                "Rebalancer control-loop iterations", rb["ticks"])
+        gauge("tony_rebalance_streak",
+              "Consecutive skewed ticks toward the next move",
+              rb["streak"])
+
     eng = snap["engine"]
     gauge("tony_engine_active_slots", "Live cache slots, fleet-wide",
           eng["active_slots"])
@@ -469,6 +496,14 @@ def prometheus_text(gateway) -> str:
     counter("tony_migration_bytes_avoided_total",
             "KV bytes owner swaps kept in place instead of copying",
             mig.get("bytes_avoided", 0))
+    counter("tony_migration_bytes_wire_total",
+            "KV bytes migration payloads actually shipped (delta-"
+            "trimmed wire docs count only their suffix pages)",
+            mig.get("bytes_wire", 0))
+    counter("tony_migration_delta_in_total",
+            "Wire adoptions whose prefix pages came from the "
+            "adopter's own radix store instead of the payload",
+            mig.get("delta_in", 0))
     counter("tony_migration_freeze_resume_ms_total",
             "Milliseconds sessions spent frozen between extract and "
             "adopt", mig.get("freeze_resume_ms", 0.0))

@@ -779,6 +779,7 @@ class AgentHandler(BaseHTTPRequestHandler):
 
     def _submit(self, body: dict) -> None:
         from tony_tpu.serve.engine import PoolExhausted, QueueFull
+        from tony_tpu.serve.migrate import StaleDelta
 
         try:
             return self._send(200, self.agent.submit(body))
@@ -790,6 +791,10 @@ class AgentHandler(BaseHTTPRequestHandler):
         except PoolExhausted as e:
             return self._send(503, {"error": str(e),
                                     "kind": "PoolExhausted"})
+        except StaleDelta as e:
+            # must precede ValueError (StaleDelta subclasses it): the
+            # sender retries ONCE with the full snapshot on this kind
+            return self._send(400, {"error": str(e), "kind": "StaleDelta"})
         except (ValueError, TypeError, KeyError) as e:
             return self._send(400, {"error": str(e),
                                     "kind": "ValueError"})

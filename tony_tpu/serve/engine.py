@@ -59,7 +59,8 @@ from tony_tpu.obs.goodput import (CostModel, detect_hbm_gbps,
                                   detect_peak_flops, ledger)
 from tony_tpu.obs.timeline import DispatchRecord, DispatchTimeline
 from tony_tpu.serve.faults import FaultPlan
-from tony_tpu.serve.migrate import SessionSnapshot, snapshot_from_doc
+from tony_tpu.serve.migrate import SessionSnapshot, StaleDelta, \
+    snapshot_from_doc
 from tony_tpu.serve.prefix import PrefixStore
 from tony_tpu.serve.slots import (PagePool, SlotCache, _gather_pages,
                                   _read_slot, _scatter_pages,
@@ -723,7 +724,8 @@ class Server:
                  hbm_gbps: float = 0.0, prefill_chunk_tokens: int = 0,
                  kv_host_mb: float = 0.0, in_dispatch_eos: bool = True,
                  mesh=None, shard_rules: str = "serve",
-                 page_pool: PagePool | None = None):
+                 page_pool: PagePool | None = None,
+                 serialize_dispatch: bool = False):
         if model.cfg.quantized:
             # nothing structural in the way — the q8 apply is the same
             # model.apply — but untested here; fail loud, not wrong
@@ -848,13 +850,25 @@ class Server:
             self.slots = SlotCache(model, params, batch_size, pool=pool)
         else:
             self.slots = SlotCache(model, params, batch_size, mesh=mesh)
-        # single-writer dispatch discipline: engines sharing a pool
-        # serialize every device mutation through the POOL's lock (one
-        # writer to the shared tree at a time); a private engine takes
-        # its own — same code path, zero contention
+        # dispatch concurrency (ISSUE-19): every engine owns ITS OWN
+        # scheduler lock — co-located engines on a shared pool no
+        # longer serialize whole step() iterations through one
+        # pool-wide writer. The shared device TREE is protected at a
+        # finer grain instead: ``_tree_lock`` (the pool's lock when
+        # shared, else this same per-engine lock — a free re-entrant
+        # acquire) brackets each read-dispatch-reassign window, held
+        # only while ENQUEUEING a dispatch, never across the host sync
+        # — so two engines' device work overlaps while the tree-version
+        # chain stays linear. ``serialize_dispatch=True`` restores the
+        # old pool-wide single-writer discipline (whole steps under
+        # pool.lock) as the measured A/B control for bench
+        # extras.migrate's concurrent-pool arm.
+        shared = self.paged and self.slots.pool.shared
+        self.serialize_dispatch = bool(serialize_dispatch) and shared
         self._dispatch_lock = self.slots.pool.lock \
-            if self.paged and self.slots.pool.shared \
-            else threading.RLock()
+            if self.serialize_dispatch else threading.RLock()
+        self._tree_lock = self.slots.pool.lock if shared \
+            else self._dispatch_lock
         cache_leaves = jax.tree_util.tree_leaves(self.slots.cache)
         self._kv_bytes_total = sum(
             int(np.prod(x.shape)) * x.dtype.itemsize for x in cache_leaves)
@@ -1027,6 +1041,20 @@ class Server:
         #                                 handoff aliasing)
         self.migrate_freeze_resume_ms = 0.0  # summed freeze->resume
         #                                      wall ms (mean = / in)
+        # prefix-delta wire migration (ISSUE-19)
+        self.migrate_bytes_wire = 0  # page bytes that actually crossed
+        #                              the wire INTO this engine
+        #                              (adopter-side; full docs count n
+        #                              pages, delta docs n - k)
+        self.migrate_delta_in = 0    # adoptions that reconstructed the
+        #                              prefix from this engine's own
+        #                              store pages
+        # prefix entries pinned (refcount held) between a delta doc's
+        # submit-time check and its admission — eviction between the
+        # two would free the very pages the adopt aliases. Keyed by
+        # request id; released at admit, on post-check submit failure,
+        # and on reset().
+        self._migrate_pins: dict = {}
         self._cache_treedef = jax.tree_util.tree_structure(
             self.slots.cache)
         # (flat leaf index, page axis) of the first paged leaf: lets
@@ -1150,11 +1178,15 @@ class Server:
             raise ValueError(
                 "prefill/decode disaggregation needs the paged KV "
                 "cache (the handoff unit is a page list)")
+        if request.id is None:
+            request.id = next(self._ids)
         if request.migrate is not None:
             # geometry + continuity checked HERE, where a mismatch is
             # one request's clean 400 refusal instead of a whole-
-            # replica admission crash (the handoff precedent below)
-            self._check_migrate(request.migrate, p)
+            # replica admission crash (the handoff precedent below).
+            # Needs the id assigned above: a delta doc's check PINS a
+            # prefix entry keyed by it.
+            self._check_migrate(request, p)
         if request.handoff is not None:
             if int(request.handoff["n_tokens"]) != len(p):
                 raise ValueError(
@@ -1174,31 +1206,42 @@ class Server:
                     "an owner-swap handoff carries page ids in a "
                     "shared pool this engine does not hold — gather "
                     "it to wire form to cross pools")
-        if request.id is None:
-            request.id = next(self._ids)
         request.max_new_tokens = min(request.max_new_tokens,
                                      max_len - len(p))
-        if self.paged:
-            pool = self.slots.pool
-            # a prefill_only request never decodes here: its worst
-            # case is the prompt's pages alone (the decode pool pays
-            # for the generation budget)
-            life = len(p) if request.prefill_only \
-                else len(p) + request.max_new_tokens
-            worst = -(-life // pool.page_size)
-            if worst > pool.n_pages:
-                # could NEVER be admitted — shedding now (503 at the
-                # gateway) beats wedging the queue head forever
-                raise PoolExhausted(
-                    f"request needs {worst} KV pages worst-case, the "
-                    f"pool holds {pool.n_pages} (raise --kv-pages or "
-                    "lower max_new_tokens)")
-        with self._pending_lock:
-            if len(self.pending) >= self.max_pending:
-                raise QueueFull(
-                    f"pending queue at max_pending={self.max_pending}")
-            self.pending.append(request)
+        try:
+            if self.paged:
+                pool = self.slots.pool
+                # a prefill_only request never decodes here: its worst
+                # case is the prompt's pages alone (the decode pool
+                # pays for the generation budget)
+                life = len(p) if request.prefill_only \
+                    else len(p) + request.max_new_tokens
+                worst = -(-life // pool.page_size)
+                if worst > pool.n_pages:
+                    # could NEVER be admitted — shedding now (503 at
+                    # the gateway) beats wedging the queue head forever
+                    raise PoolExhausted(
+                        f"request needs {worst} KV pages worst-case, "
+                        f"the pool holds {pool.n_pages} (raise "
+                        "--kv-pages or lower max_new_tokens)")
+            with self._pending_lock:
+                if len(self.pending) >= self.max_pending:
+                    raise QueueFull(
+                        f"pending queue at "
+                        f"max_pending={self.max_pending}")
+                self.pending.append(request)
+        except BaseException:
+            # a refusal after _check_migrate pinned a prefix entry
+            # must not strand the pin (the request never reaches
+            # admission, where the pin is consumed)
+            self._release_migrate_pin(request.id)
+            raise
         return request.id
+
+    def _release_migrate_pin(self, rid) -> None:
+        entry = self._migrate_pins.pop(rid, None)
+        if entry is not None and self.prefix is not None:
+            self.prefix.release(entry)
 
     @property
     def n_pending(self) -> int:
@@ -1581,14 +1624,19 @@ class Server:
                 # bucket), not O(max_seq_len)
                 cols = min(_bucket_pow2(-(-len(p) // ps)), s.max_pages)
                 view_tokens = cols * ps
-                cache, tok, key, last = _paged_prefill_admit(
-                    self.model, self.params, s.cache,
-                    jnp.asarray(window), jnp.asarray(positions),
-                    jnp.int32(len(suffix)),
-                    jnp.asarray(s.page_table[slot:slot + 1, :cols]),
-                    jnp.float32(req.temperature), jnp.int32(req.top_k),
-                    jax.random.PRNGKey(req.seed))
-                s.cache = cache
+                # read-dispatch-reassign window on the (possibly
+                # shared) tree — enqueue only; the host sync below
+                # runs outside the lock
+                with self._tree_lock:
+                    cache, tok, key, last = _paged_prefill_admit(
+                        self.model, self.params, s.cache,
+                        jnp.asarray(window), jnp.asarray(positions),
+                        jnp.int32(len(suffix)),
+                        jnp.asarray(s.page_table[slot:slot + 1, :cols]),
+                        jnp.float32(req.temperature),
+                        jnp.int32(req.top_k),
+                        jax.random.PRNGKey(req.seed))
+                    s.cache = cache
                 self.prefills += 1
                 d_bucket = lb
                 if self.prefix is not None:
@@ -1707,11 +1755,12 @@ class Server:
         cols = min(_bucket_pow2(-(-(st.done + take) // ps)),
                    s.max_pages)
         view_tokens = cols * ps
-        cache = _paged_prefill_chunk(
-            self.model, self.params, s.cache, jnp.asarray(window),
-            jnp.asarray(positions),
-            jnp.asarray(s.page_table[slot:slot + 1, :cols]))
-        s.cache = cache
+        with self._tree_lock:
+            cache = _paged_prefill_chunk(
+                self.model, self.params, s.cache, jnp.asarray(window),
+                jnp.asarray(positions),
+                jnp.asarray(s.page_table[slot:slot + 1, :cols]))
+            s.cache = cache
         self.prefills += 1
         self.prefill_chunk_dispatches += 1
         st.done += take
@@ -1783,13 +1832,14 @@ class Server:
             off + np.arange(len(suffix), dtype=np.int32)
         cols = min(_bucket_pow2(-(-len(p) // ps)), s.max_pages)
         view_tokens = cols * ps
-        cache, tok, key, last = _paged_prefill_admit(
-            self.model, self.params, s.cache, jnp.asarray(window),
-            jnp.asarray(positions), jnp.int32(len(suffix)),
-            jnp.asarray(s.page_table[slot:slot + 1, :cols]),
-            jnp.float32(req.temperature), jnp.int32(req.top_k),
-            jax.random.PRNGKey(req.seed))
-        s.cache = cache
+        with self._tree_lock:
+            cache, tok, key, last = _paged_prefill_admit(
+                self.model, self.params, s.cache, jnp.asarray(window),
+                jnp.asarray(positions), jnp.int32(len(suffix)),
+                jnp.asarray(s.page_table[slot:slot + 1, :cols]),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jax.random.PRNGKey(req.seed))
+            s.cache = cache
         self.prefills += 1
         self.prefill_chunk_dispatches += 1
         st.chunks += 1
@@ -2027,8 +2077,9 @@ class Server:
                 f"{n} at page_size {ps}")
         dst = s.page_table[slot, :n].tolist() \
             + [pool.n_pages] * (n_pad - n)
-        s.cache = _scatter_pages(s.cache, pages_tree,
-                                 jnp.asarray(dst, jnp.int32))
+        with self._tree_lock:
+            s.cache = _scatter_pages(s.cache, pages_tree,
+                                     jnp.asarray(dst, jnp.int32))
         tok, key = _sample_first(
             jnp.asarray(logits), jnp.float32(req.temperature),
             jnp.int32(req.top_k), jax.random.PRNGKey(req.seed))
@@ -2123,17 +2174,31 @@ class Server:
 
     # ------------------------------------------------- live migration
 
-    def _check_migrate(self, snap, p: list) -> None:
+    def _check_migrate(self, req: Request, p: list) -> None:
         """Continuity + geometry of a migrate payload at submit time —
         a mismatch is one request's clean refusal (400 at the
         gateway), not a whole-replica admission crash (the handoff
         precedent). Accepts both forms: a ``SessionSnapshot`` (local
-        owner swap or in-process remote) and the agent wire doc."""
+        owner swap or in-process remote) and the agent wire doc.
+
+        A DELTA doc (suffix-only pages + ``delta.prefix_tokens``,
+        ISSUE-19) is additionally checked against this engine's OWN
+        prefix store: the covering entry is acquired and PINNED in
+        ``_migrate_pins`` so eviction between this check (any thread)
+        and admission (the scheduler thread) cannot free the prefix
+        pages the adopt will alias. A store that no longer covers the
+        assumed prefix raises ``StaleDelta`` — the sender's contract
+        is to re-ship the full payload. The probe is device-store-only
+        (no host-tier page-in: that dispatches device work, and this
+        runs on the HTTP thread)."""
+        snap = req.migrate
+        delta = None
         if isinstance(snap, dict):
             gen = snap.get("generated") or []
             n_tok = int(snap.get("n_tokens", -1))
             prompt = [int(t) for t in snap.get("prompt", ())]
             pages = snap.get("pages")
+            delta = snap.get("delta")
             if not (isinstance(pages, dict) and "leaves" in pages):
                 raise ValueError(
                     "a wire migrate doc carries base64 leaf pages")
@@ -2168,11 +2233,51 @@ class Server:
                 "must not be present")
         ps = self.slots.pool.page_size
         need = -(-n_tok // ps)
-        if have < need:
+        if delta is None:
+            if have < need:
+                raise ValueError(
+                    f"migrate snapshot holds {have} pages, the "
+                    f"session needs {need} at page_size {ps} — "
+                    "mismatched page geometry between source and "
+                    "target")
+            return
+        # ---- delta form: suffix pages only + an assumed prefix
+        pt = int(delta.get("prefix_tokens", 0))
+        if pt <= 0 or pt % ps:
             raise ValueError(
-                f"migrate snapshot holds {have} pages, the session "
-                f"needs {need} at page_size {ps} — mismatched page "
-                "geometry between source and target")
+                f"delta prefix_tokens ({pt}) must be a positive "
+                f"multiple of page_size {ps}")
+        k = pt // ps
+        if k > need - 1:
+            raise ValueError(
+                f"delta prefix covers {k} pages of a {need}-page "
+                "session — at least one page always ships")
+        if have < need - k:
+            raise ValueError(
+                f"delta payload holds {have} pages, the suffix needs "
+                f"{need - k} at page_size {ps}")
+        if self.prefix is None:
+            raise StaleDelta(
+                "delta migrate doc arrived but this engine runs no "
+                "prefix store — nothing can cover the prefix")
+        # the context whose KV the prefix pages must hold: prompt +
+        # generated minus the never-fed-back final token (the
+        # snapshot invariant checked above)
+        ctx = prompt + [int(t) for t in gen][:-1]
+        match, entry = self.prefix.acquire(ctx)
+        if entry is None or match < pt or entry.pages is None \
+                or len(entry.pages) < k:
+            if entry is not None:
+                self.prefix.release(entry)
+            raise StaleDelta(
+                f"adopter covers {match} prefix tokens on-device, the "
+                f"delta assumed {pt} — the sender's radix summary was "
+                "stale; re-ship the full payload")
+        # consumed at admission; released on post-check submit
+        # failure and reset(). A re-sent submit (the agent's
+        # idempotency contract) must not leak the first pin.
+        self._release_migrate_pin(req.id)
+        self._migrate_pins[req.id] = entry
 
     def extract_session(self, request_id, *, wire: bool = False):
         """Freeze a live decode slot into a ``SessionSnapshot`` and
@@ -2234,7 +2339,8 @@ class Server:
                 pages=payload,
                 local=not wire,
                 t_freeze=time.time(),
-                pool=pool if not wire else None)
+                pool=pool if not wire else None,
+                page_size=pool.page_size)
             self._live[slot] = None
             s.evict(slot)
             self.migrations_out += 1
@@ -2257,7 +2363,10 @@ class Server:
         its exact chain position. The next decode round continues as
         if the slot had lived here all along."""
         snap = req.migrate
+        delta_pt = 0
         if isinstance(snap, dict):
+            delta_pt = int((snap.get("delta") or {})
+                           .get("prefix_tokens", 0))
             snap = snapshot_from_doc(snap)
         s = self.slots
         pool = s.pool
@@ -2297,36 +2406,65 @@ class Server:
             s.page_table[slot, n:] = pool.n_pages
             self.migrate_bytes_avoided += n * pool.page_nbytes
         else:
-            granted = pool.reserve(worst)
+            # delta (ISSUE-19): pages [0, k) alias this engine's own
+            # store pages instead of shipping — they need no fresh
+            # allocation, so the reservation shrinks by k
+            k = delta_pt // ps
+            need = worst - k
+            granted = pool.reserve(need)
             while not granted and self.prefix is not None \
                     and self.prefix.evict_one():
-                granted = pool.reserve(worst)
+                # evict_one can never free the pinned covering entry
+                # (its refcount is held by _migrate_pins)
+                granted = pool.reserve(need)
             if not granted:
-                return False
+                return False  # transient; the pin keeps the prefix
             if self.fault_plan is not None:
                 try:
                     self.fault_plan.on_admit(req.id)
                 except BaseException:
-                    pool.cancel(worst)
+                    pool.cancel(need)
                     raise
             slot = self._free_slots()[0]
             pages_tree = snap.pages
             if isinstance(pages_tree, dict) and "leaves" in pages_tree:
                 pages_tree = decode_payload(pages_tree,
                                             self._cache_treedef)
-            s.seed_pages(slot, [], 0, worst)
+            if k:
+                # reconstruct the prefix by refcount-sharing the
+                # entry pinned at _check_migrate time — the same
+                # alias accounting local adoptions use. The seed is
+                # page-aligned by the delta contract, so no CoW fork;
+                # the slot's write positions live in shipped pages.
+                entry = self._migrate_pins.pop(req.id)
+                s.seed_pages(slot, [int(pg) for pg in entry.pages[:k]],
+                             k * ps, need)
+                self.prefix.release(entry)
+            else:
+                s.seed_pages(slot, [], 0, need)
             s.ensure_pages(slot, n_tok)
-            n_pad = payload_pages(pages_tree)
-            if n_pad < n:
+            n_ship = payload_pages(pages_tree)
+            if n_ship < n - k:
                 s.release_pages(slot)
                 raise ValueError(
-                    f"migrate payload holds {n_pad} pages, the "
-                    f"session needs {n} at page_size {ps}")
-            dst = s.page_table[slot, :n].tolist() \
-                + [pool.n_pages] * (n_pad - n)
-            s.cache = _scatter_pages(s.cache, pages_tree,
-                                     jnp.asarray(dst, jnp.int32))
-            self.migrate_pages_moved += n
+                    f"migrate payload holds {n_ship} pages, the "
+                    f"session needs {n - k} at page_size {ps}")
+            # delta payloads arrive trimmed pad-free; re-pad to the
+            # pow2 scatter bucket so migrations compile one scatter
+            # program per bucket, not one per page count
+            n_pad = _bucket_pow2(max(1, n_ship))
+            if n_pad > n_ship:
+                pages_tree = pad_host_pages(pages_tree, n_pad)
+            dst = s.page_table[slot, k:n].tolist() \
+                + [pool.n_pages] * (n_pad - (n - k))
+            with self._tree_lock:
+                s.cache = _scatter_pages(s.cache, pages_tree,
+                                         jnp.asarray(dst, jnp.int32))
+            self.migrate_pages_moved += n - k
+            self.migrate_bytes_wire += (n - k) * pool.page_nbytes
+            if k:
+                self.migrate_bytes_avoided += k * pool.page_nbytes
+                self.migrate_delta_in += 1
         gen = [int(t) for t in snap.generated]
         s.admit(slot, n_tok, gen[-1], snap.temperature, snap.top_k,
                 snap.rng)
@@ -2340,14 +2478,16 @@ class Server:
         self.migrate_freeze_resume_ms += \
             max(0.0, (time.time() - snap.t_freeze) * 1e3)
         if self.timeline is not None:
+            moved = 0 if snap.local else n - (delta_pt // ps)
             est = (0.0, 0.0) if snap.local \
-                else self.cost.host_move(n * pool.page_nbytes)
+                else self.cost.host_move(moved * pool.page_nbytes)
             self._record_dispatch(
                 "migrate_in", t0, (time.monotonic() - t0) * 1e3, occ,
-                n, 0, ("migrate_in", 0 if snap.local else n),
+                n, 0, ("migrate_in", 0 if snap.local else moved),
                 request_id=req.id,
                 tags={"pages": n, "n_tokens": n_tok,
-                      "generated": len(gen), "local": snap.local},
+                      "generated": len(gen), "local": snap.local,
+                      "delta_prefix_pages": delta_pt // ps},
                 work=1, fed=1, est=est)
         return True
 
@@ -2412,9 +2552,10 @@ class Server:
             idx = _padded_pages(pages, sentinel=pool.n_pages)
             n_pad = len(idx)
             payload = pad_host_pages(t_entry.row, n_pad)
-            self.slots.cache = _scatter_pages(
-                self.slots.cache, payload,
-                jnp.asarray(idx, jnp.int32))
+            with self._tree_lock:
+                self.slots.cache = _scatter_pages(
+                    self.slots.cache, payload,
+                    jnp.asarray(idx, jnp.int32))
             logits = jnp.asarray(t_entry.logits) \
                 if t_entry.logits is not None else None
             ok = self.prefix.insert(t_entry.tokens, pages=pages,
@@ -2458,11 +2599,14 @@ class Server:
 
     def step(self) -> list[Result]:
         """One scheduler iteration; returns requests that finished.
-        The whole iteration holds ``_dispatch_lock`` — on a private
-        pool that is a free re-entrant acquire, on a SHARED pool it is
-        the single-writer discipline across every engine lending from
-        the pool (refcounts, page tables, and the one device tree all
-        mutate under it)."""
+        The iteration holds this ENGINE's ``_dispatch_lock`` (its own
+        scheduler state: slots, _live, pending). Co-located engines on
+        a shared pool step CONCURRENTLY (ISSUE-19): the shared device
+        tree is guarded per dispatch by ``_tree_lock`` around each
+        read-dispatch-reassign window, and allocator state by the
+        pool's fine ``_mu`` — unless ``serialize_dispatch=True`` pins
+        the old pool-wide single-writer discipline as the A/B
+        control."""
         with self._dispatch_lock:
             return self._step_locked()
 
@@ -2542,17 +2686,22 @@ class Server:
             t0 = time.monotonic()
             occ = s.n_active
             riders = [lv.request.id for lv in self._live if lv is not None]
-        cache, toks, rng = _decode_chunk(
-            self.model, self.params, s.cache,
-            jnp.asarray(s.last_token), jnp.asarray(s.positions()),
-            jnp.asarray(s.temperature), jnp.asarray(s.top_k),
-            jnp.asarray(s.rng),
-            jnp.asarray(rem) if rem is not None else None, table,
-            n_steps=k, eos_ids=self.eos_ids if freeze else (),
-            freeze=freeze)
+        # the read-dispatch-reassign window on the (possibly shared)
+        # tree: enqueue ONE dispatch against the current version and
+        # reassign — the host sync (np.asarray below) runs OUTSIDE the
+        # lock, so co-located engines' device work overlaps
+        with self._tree_lock:
+            cache, toks, rng = _decode_chunk(
+                self.model, self.params, s.cache,
+                jnp.asarray(s.last_token), jnp.asarray(s.positions()),
+                jnp.asarray(s.temperature), jnp.asarray(s.top_k),
+                jnp.asarray(s.rng),
+                jnp.asarray(rem) if rem is not None else None, table,
+                n_steps=k, eos_ids=self.eos_ids if freeze else (),
+                freeze=freeze)
+            s.cache = cache
         self.steps += k
         self.dispatches += 1
-        s.cache = cache
         toks = np.asarray(toks)  # [b, k]
         # np.array, not asarray: device arrays view as read-only and the
         # next admit writes its slot's key in place
@@ -2792,23 +2941,25 @@ class Server:
             occ = s.n_active
             riders = [lv.request.id for lv in self._live
                       if lv is not None]
-        out = _verify_chunk(
-            self.model, self.params, s.cache, jnp.asarray(toks),
-            jnp.asarray(positions), jnp.asarray(draft_len),
-            jnp.asarray(s.temperature), jnp.asarray(s.top_k),
-            jnp.asarray(s.rng), jnp.asarray(rem) if fused else None,
-            table, window=window, n_steps=k_cont,
-            eos_ids=self.eos_ids if fused else ())
+        with self._tree_lock:
+            out = _verify_chunk(
+                self.model, self.params, s.cache, jnp.asarray(toks),
+                jnp.asarray(positions), jnp.asarray(draft_len),
+                jnp.asarray(s.temperature), jnp.asarray(s.top_k),
+                jnp.asarray(s.rng),
+                jnp.asarray(rem) if fused else None,
+                table, window=window, n_steps=k_cont,
+                eos_ids=self.eos_ids if fused else ())
+            s.cache = out[0]
         if fused:
-            cache, emit, accepted, cont, rng = out
+            _, emit, accepted, cont, rng = out
             cont = np.asarray(cont)
         else:
-            cache, emit, accepted, rng = out
+            _, emit, accepted, rng = out
             cont = None
         self.steps += window + k_cont
         self.dispatches += 1
         self.spec_rounds += 1
-        s.cache = cache
         emit = np.asarray(emit)
         accepted = np.asarray(accepted)
         s.rng = np.array(rng, np.uint32)
@@ -3029,6 +3180,8 @@ class Server:
             "migrations_remote": self.migrations_remote,
             "migrate_pages_moved": self.migrate_pages_moved,
             "migrate_bytes_avoided": self.migrate_bytes_avoided,
+            "migrate_bytes_wire": self.migrate_bytes_wire,
+            "migrate_delta_in": self.migrate_delta_in,
             "migrate_freeze_resume_ms": round(
                 self.migrate_freeze_resume_ms, 3),
         }
@@ -3095,6 +3248,10 @@ class Server:
             # their page reservations are returned by slots.reset()'s
             # evicts
             self._prefilling.clear()
+            # dropped migrate requests never reach admission — their
+            # pinned prefix entries must not stay refcounted forever
+            for rid in list(self._migrate_pins):
+                self._release_migrate_pin(rid)
             self.slots.reset()
 
     def run(self, requests: Iterable[Request] = ()) -> Iterator[Result]:

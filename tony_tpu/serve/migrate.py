@@ -43,8 +43,19 @@ from typing import Any
 
 import numpy as np
 
+from tony_tpu.serve.prefix import summary_match_len
 from tony_tpu.serve.tier import decode_array, encode_array, \
-    encode_payload
+    encode_payload, trim_payload
+
+
+class StaleDelta(ValueError):
+    """A delta (suffix-only) snapshot arrived but the adopter no
+    longer holds the prefix its trim assumed — the radix summary the
+    sender diffed against was stale (heartbeat lag, or the entry was
+    evicted in between). Raised at SUBMIT time, before any slot or
+    page is touched; the sender's contract is to fall back to the
+    full-page payload (gateway/remote.py does, counting the
+    fallback)."""
 
 
 @dataclass
@@ -73,6 +84,9 @@ class SessionSnapshot:
     t_freeze: float        # wall clock at freeze (freeze->resume ms)
     pool: Any = None       # the shared PagePool ids live in (local
     # only) — adopt refuses a snapshot from a different pool
+    page_size: int = 0     # tokens per page at the SOURCE (wire only;
+    # what delta_trim_doc converts summary tokens into page counts
+    # with — 0 means unknown, delta trimming declines)
 
     @property
     def remaining(self) -> int:
@@ -129,6 +143,7 @@ def snapshot_to_doc(snap: SessionSnapshot) -> dict:
         "n_tokens": int(snap.n_tokens),
         "pages": encode_payload(snap.pages),
         "t_freeze": float(snap.t_freeze),
+        "page_size": int(snap.page_size),
     }
 
 
@@ -150,4 +165,46 @@ def snapshot_from_doc(doc: dict) -> SessionSnapshot:
         pages=doc["pages"],
         local=False,
         t_freeze=float(doc["t_freeze"]),
+        page_size=int(doc.get("page_size", 0)),
     )
+
+
+# ----------------------------------------------------- delta migration
+
+
+def delta_trim_doc(doc: dict, summary) -> dict | None:
+    """Prefix-delta trim of a wire snapshot doc against the TARGET's
+    radix summary (the ``[[n_tokens, crc32], ...]`` pairs riding its
+    agent heartbeat since ISSUE-18). When the target already holds a
+    prefix of this session's context, ship only the uncovered SUFFIX
+    pages: the returned doc carries ``delta.prefix_tokens`` (always a
+    page multiple) and a page payload trimmed to ``[k, n)``; the
+    adopter reconstructs pages ``[0, k)`` by refcount-sharing its own
+    store pages — the same alias accounting local adoptions use.
+
+    Returns None when trimming buys nothing (no summary overlap, page
+    size unknown, or the session spans a single page). The diff is
+    advisory: a stale summary makes the ADOPTER raise ``StaleDelta``
+    and the sender re-ships the full doc — correctness never rests on
+    summary freshness.
+
+    At least one page always ships (``k <= n - 1``): the final page is
+    partial in general, and the adopter's boundary arithmetic stays
+    uniform when the suffix is never empty."""
+    ps = int(doc.get("page_size", 0))
+    if ps <= 0 or not summary:
+        return None
+    n_tok = int(doc["n_tokens"])
+    n = -(-n_tok // ps)
+    # the context whose KV the pages hold: prompt + generated minus
+    # the never-fed-back final token (the snapshot invariant)
+    ctx = [int(t) for t in doc["prompt"]]
+    ctx += [int(t) for t in doc["generated"]][:-1]
+    covered = summary_match_len(summary, ctx)
+    k = min(covered // ps, n - 1)
+    if k <= 0:
+        return None
+    out = dict(doc)
+    out["pages"] = trim_payload(doc["pages"], k, n)
+    out["delta"] = {"prefix_tokens": k * ps}
+    return out

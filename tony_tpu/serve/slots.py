@@ -369,11 +369,29 @@ class PagePool:
     ``SlotCache`` delegates its ``cache`` attribute here, so one
     engine's dispatch reassignment is immediately visible to the
     others, and moving a session between two attached engines is a
-    pure page-table/refcount swap with zero KV bytes copied. ``lock``
-    is the single-writer dispatch discipline: engines sharing the pool
-    serialize every device mutation (step, reset, extract, adopt)
-    through it, so the read-dispatch-reassign cycle on the shared tree
-    can never interleave and drop writes.
+    pure page-table/refcount swap with zero KV bytes copied.
+
+    Concurrency is TWO locks at two granularities (ISSUE-19; the old
+    discipline serialized every co-located engine's whole step through
+    one pool-wide writer lock):
+
+    - every allocator mutation (free list, refcounts, the reservation
+      ledger) is atomic under the internal fine lock ``_mu`` — held
+      for microseconds, never across device work — so engines
+      alloc/free/share concurrently and ``free >= reserved`` holds
+      under any interleaving (tests/test_paged.py pins it with a
+      multi-thread churn property test);
+    - ``lock`` guards only the shared device TREE's
+      read-dispatch-reassign window: an engine takes it to read
+      ``pool.cache``, enqueue ONE dispatch against that version, and
+      reassign the result. Page ownership is disjoint by construction
+      (each slot writes only its own table's pages), so two engines'
+      dispatches chain safely through tree versions — engine B's
+      dispatch reads engine A's output buffers, XLA sequences them —
+      and the lock is released before the host ever blocks on the
+      result. What it prevents is two engines reading the SAME version
+      and both reassigning (the second would silently drop the first's
+      writes).
     """
 
     def __init__(self, model, params, n_pages: int, page_size: int,
@@ -383,9 +401,14 @@ class PagePool:
         self.n_pages = int(n_pages)
         self.page_size = int(page_size)
         self.shared = bool(shared)
-        # reentrant: a shared-pool engine's step() may nest an evict /
-        # adopt that takes the pool again on the same thread
+        # the TREE lock (see class docstring): reentrant, because a
+        # shared-pool engine's dispatch window may nest an evict /
+        # adopt that takes it again on the same thread
         self.lock = threading.RLock()
+        # the fine ALLOCATOR lock: free list + refcounts + reservation
+        # ledger mutate atomically under it; reentrant so compound ops
+        # (stats -> cow_shared, reserve -> available) self-nest
+        self._mu = threading.RLock()
         self.cache = paged_cache(model, params, n_pages, page_size,
                                  mesh=mesh)
         self.page_nbytes = page_nbytes(self.cache)
@@ -412,90 +435,98 @@ class PagePool:
 
     def available(self) -> int:
         """Pages grantable to a NEW reservation right now."""
-        return len(self._free) - self.reserved
+        with self._mu:
+            return len(self._free) - self.reserved
 
     def cow_shared(self) -> int:
         """Pages currently held by more than one owner (a slot table
         plus prefix-store entries, or several entries) — the
         copy-on-write sharing the fixed-shape path paid row copies
         for."""
-        return int((self.refcount > 1).sum())
+        with self._mu:
+            return int((self.refcount > 1).sum())
 
     # ------------------------------------------------------ allocation
 
     def reserve(self, n: int) -> bool:
         """Set aside ``n`` future pages; False when they are not there
         (the caller sheds load or frees store pages and retries)."""
-        if n > self.available():
-            return False
-        self.reserved += n
-        return True
+        with self._mu:
+            if n > self.available():
+                return False
+            self.reserved += n
+            return True
 
     def cancel(self, n: int) -> None:
         """Return ``n`` unused reserved pages (evict, or a request
         finishing under its worst case)."""
-        if n > self.reserved:
-            raise ValueError(f"cancel({n}) exceeds reserved "
-                             f"{self.reserved}")
-        self.reserved -= n
+        with self._mu:
+            if n > self.reserved:
+                raise ValueError(f"cancel({n}) exceeds reserved "
+                                 f"{self.reserved}")
+            self.reserved -= n
 
     def alloc(self, n: int, *, from_reservation: bool = False) -> list[int]:
         """Pop ``n`` pages (refcount 1 each). ``from_reservation``
         consumes previously reserved units — guaranteed to succeed by
         the invariant; a bare alloc must fit ``available()``."""
-        if from_reservation:
-            if n > self.reserved:
+        with self._mu:
+            if from_reservation:
+                if n > self.reserved:
+                    raise RuntimeError(
+                        f"alloc({n}) exceeds reservation {self.reserved}"
+                        " — engine reservation accounting bug")
+                self.reserved -= n
+            elif n > self.available():
                 raise RuntimeError(
-                    f"alloc({n}) exceeds reservation {self.reserved} — "
-                    "engine reservation accounting bug")
-            self.reserved -= n
-        elif n > self.available():
-            raise RuntimeError(
-                f"alloc({n}) exceeds available {self.available()}")
-        pages = [self._free.pop() for _ in range(n)]
-        self.refcount[pages] = 1
-        self.allocs += n
-        self.peak_used = max(self.peak_used, self.n_used)
-        return pages
+                    f"alloc({n}) exceeds available {self.available()}")
+            pages = [self._free.pop() for _ in range(n)]
+            self.refcount[pages] = 1
+            self.allocs += n
+            self.peak_used = max(self.peak_used, self.n_used)
+            return pages
 
     def share(self, pages) -> None:
         """One more holder for each of ``pages`` (aliasing a prefix
         entry's pages into a slot table, or pinning a slot's pages
         into a store entry — the refcount bump that replaced
         ``read_slot_row``/``write_slot_row`` copies)."""
-        for p in pages:
-            if self.refcount[p] <= 0:
-                raise ValueError(f"share() of free page {p}")
-            self.refcount[p] += 1
+        with self._mu:
+            for p in pages:
+                if self.refcount[p] <= 0:
+                    raise ValueError(f"share() of free page {p}")
+                self.refcount[p] += 1
 
     def unref(self, pages) -> None:
         """Drop one holder; pages reaching refcount 0 return to the
         free list (their content is junk from that moment)."""
-        for p in pages:
-            if self.refcount[p] <= 0:
-                raise ValueError(f"unref() of free page {p}")
-            self.refcount[p] -= 1
-            if self.refcount[p] == 0:
-                self._free.append(p)
-                self.frees += 1
+        with self._mu:
+            for p in pages:
+                if self.refcount[p] <= 0:
+                    raise ValueError(f"unref() of free page {p}")
+                self.refcount[p] -= 1
+                if self.refcount[p] == 0:
+                    self._free.append(p)
+                    self.frees += 1
 
     # ----------------------------------------------------------- stats
 
     def stats(self) -> dict:
-        return {
-            "total": self.n_pages,
-            "used": self.n_used,
-            "free": self.n_free,
-            "reserved": self.reserved,
-            "cow_shared": self.cow_shared(),
-            "page_size": self.page_size,
-            "page_nbytes": self.page_nbytes,
-            "bytes_resident": self.n_used * self.page_nbytes,
-            "allocs": self.allocs,
-            "frees": self.frees,
-            "forks": self.forks,
-            "peak_used": self.peak_used,
-        }
+        with self._mu:
+            return {
+                "total": self.n_pages,
+                "used": self.n_used,
+                "free": self.n_free,
+                "reserved": self.reserved,
+                "cow_shared": self.cow_shared(),
+                "page_size": self.page_size,
+                "page_nbytes": self.page_nbytes,
+                "bytes_resident": self.n_used * self.page_nbytes,
+                "allocs": self.allocs,
+                "frees": self.frees,
+                "forks": self.forks,
+                "peak_used": self.peak_used,
+            }
 
 
 class SlotCache:
@@ -664,11 +695,16 @@ class SlotCache:
         (fresh,) = self.pool.alloc(1, from_reservation=True)
         self.reserve_left[slot] -= 1
         shared = use[-1]
-        self.cache = _copy_page(self.cache, jnp.int32(shared),
-                                jnp.int32(fresh))
+        # the fork's read-dispatch-reassign window on the (possibly
+        # shared) device tree — see PagePool docstring; reentrant, so
+        # callers already inside their own window nest harmlessly
+        with self.pool.lock:
+            self.cache = _copy_page(self.cache, jnp.int32(shared),
+                                    jnp.int32(fresh))
         self.pool.unref([shared])
         self.page_table[slot, n_alias - 1] = fresh
-        self.pool.forks += 1
+        with self.pool._mu:
+            self.pool.forks += 1
         return True
 
     def ensure_pages(self, slot: int, upto_pos: int) -> None:

@@ -140,11 +140,21 @@ def encode_payload(tree: Any) -> dict:
     """A gathered page payload as JSON-safe wire form. Leaves ride in
     ``tree_flatten`` order; the receiver unflattens against its OWN
     cache treedef — both sides run the same model config, so the
-    structures agree (``decode_payload`` checks the leaf count)."""
+    structures agree (``decode_payload`` checks the leaf count).
+
+    Each PAGED leaf also records its ``page_axis`` so a relay can
+    slice the payload page-wise WITHOUT knowing the cache treedef —
+    the delta-migration trim (``trim_payload``) rides on it."""
     if isinstance(tree, dict) and "leaves" in tree:
         return tree  # already wire form (a pure-router gateway relays)
-    return {"leaves": [encode_array(leaf)
-                       for leaf in jax.tree_util.tree_leaves(tree)]}
+    leaves = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        doc = encode_array(leaf)
+        ax = cache_batch_axis(path, leaf)
+        if ax is not None:
+            doc["page_axis"] = int(ax)
+        leaves.append(doc)
+    return {"leaves": leaves}
 
 
 def decode_payload(doc: dict, treedef) -> Any:
@@ -155,6 +165,37 @@ def decode_payload(doc: dict, treedef) -> Any:
             f"engine's cache has {treedef.num_leaves} — mismatched "
             "model configs between the prefill and decode pools")
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def trim_payload(doc: dict, start: int, stop: int) -> dict:
+    """Slice a WIRE payload to pages ``[start, stop)`` along each
+    leaf's recorded ``page_axis`` — the delta-migration trim: ship
+    only the suffix pages the adopter does not already hold, and drop
+    the gather's pow2 padding while at it (the adopter re-pads to its
+    own scatter bucket). Leaves without a page axis (none today) pass
+    through untouched. Pure reshaping of already-encoded bytes; the
+    values are bitwise."""
+    out = []
+    for d in doc["leaves"]:
+        ax = d.get("page_axis")
+        if ax is None:
+            out.append(d)
+            continue
+        a = decode_array(d)
+        sl = [slice(None)] * a.ndim
+        sl[int(ax)] = slice(int(start), int(stop))
+        trimmed = encode_array(a[tuple(sl)])
+        trimmed["page_axis"] = int(ax)
+        out.append(trimmed)
+    return {"leaves": out}
+
+
+def payload_nbytes(doc: dict) -> int:
+    """Decoded byte size of a wire payload's leaves — what a migration
+    actually ships (modulo base64's fixed 4/3), the number
+    ``migrate_bytes_wire`` and the bench's delta-vs-full ratio count."""
+    return sum(int(np.prod(d["shape"])) * _np_dtype(d["dtype"]).itemsize
+               for d in doc["leaves"])
 
 
 # ------------------------------------------------------------- the tier

@@ -1123,7 +1123,55 @@ EOF
     [ $rc = 0 ] || fail "storm gateway exited $rc after SIGTERM"
     grep -q 'drained clean' "$WORK/storm_stderr.log" || fail "storm gateway did not report a clean drain"
     STGW_PID=''
-    echo "serve-smoke: storm OK (2000/2000 streams over the event edge, zero shed, token-exact spot checks, clean drain)"
+
+    # overload sub-phase (this PR): a deliberately TINY gateway (2
+    # slots, queue 8) takes a burst it cannot absorb — capacity sheds
+    # storm, and the shed_storm alert rule must actually page (before
+    # this rule, a 429 storm moved /stats and the autoscaler but never
+    # the alert bus) while the streams that DID land keep completing
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --serve-batch 2 --chunk-steps 4 --max-queue 8 --max-pending 8 \
+        --alert-shed-storm 20 --alert-shed-window 60 \
+        --alert-interval 0.2 --no-alert-bundles \
+        --port 0 --compile-cache '' \
+        >"$WORK/olstorm_boot.log" 2>"$WORK/olstorm_stderr.log" &
+    STGW_PID=$!
+    OLURL=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        OLURL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/olstorm_boot.log")
+        [ -n "$OLURL" ] && break
+        kill -0 $STGW_PID 2>/dev/null || fail "overload gateway died at boot: $(cat "$WORK/olstorm_stderr.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$OLURL" ] || fail "overload gateway did not print URL within ${BOUND}s"
+    timeout -k 10 "$BOUND" $PY tools/storm.py --base "$OLURL" \
+        --idle 0 --streams 120 --tokens 4 --bursts 2 \
+        --burst-gap 0.05 --check 0 --server-pid $STGW_PID \
+        --timeout "$BOUND" --json "$WORK/olstorm.json" \
+        >"$WORK/olstorm_out.log" 2>&1 \
+        || fail "overload storm.py failed: $(tail -5 "$WORK/olstorm_out.log")"
+    code=$(curl_s "$WORK/olstorm_stats" "$OLURL/stats") || fail "overload stats curl"
+    [ "$code" = 200 ] || fail "overload stats -> $code"
+    $PY - "$WORK/olstorm.json" "$WORK/olstorm_stats" <<'EOF' || fail "shed_storm gates: $(cat "$WORK/olstorm.json")"
+import json, sys
+st = json.load(open(sys.argv[1]))["storm"]
+assert st["completed_200"] > 0, st          # landed streams finished
+assert st["shed"] >= 20, st                 # the storm really shed
+assert st["errors"] == 0, st                # 429/503 only, no 5xx
+stats = json.load(open(sys.argv[2]))
+alerts = stats["alerts"]
+assert alerts["fired"].get("shed_storm", 0) >= 1, alerts["fired"]
+EOF
+    kill -TERM $STGW_PID
+    i=0
+    while kill -0 $STGW_PID 2>/dev/null; do
+        [ $i -ge $BOUND ] && fail "overload gateway did not drain within ${BOUND}s of SIGTERM"
+        sleep 1; i=$((i + 1))
+    done
+    wait $STGW_PID || true
+    STGW_PID=''
+    echo "serve-smoke: storm OK (2000/2000 streams over the event edge, zero shed, token-exact spot checks, shed_storm alert fired under overload, clean drain)"
 }
 
 # ---- migrate round (also standalone: SERVE_SMOKE_ROUNDS=migrate) -----
@@ -1202,6 +1250,102 @@ EOF
     echo "serve-smoke: migrate OK (mid-stream owner swap, token-exact, zero 5xx, fast drain)"
 }
 
+# ---- REBALANCE round (in-process) ------------------------------------
+# The pressure loop end to end: pile every stream onto one replica of
+# a two-engine shared-pool fleet, start the Rebalancer, and watch it
+# notice the skew and migrate a live session to the idle replica —
+# with a GatewayHistory attached so the decision lands in
+# metrics/rebalance.jsonl exactly as an operator would replay it.
+# The pins: >=1 autonomous move, every stream token-identical to its
+# no-rebalance control, zero 5xx, and the decision log on disk.
+rebalance_round() {
+    timeout -k 10 "$BOUND" env JAX_PLATFORMS=cpu WORK="$WORK" $PY - <<'EOF' || fail "rebalance round"
+import json, os, time
+
+import jax, jax.numpy as jnp, numpy as np
+from tony_tpu.gateway import Gateway, GatewayHistory, Rebalancer
+from tony_tpu.gateway.core import GenRequest
+from tony_tpu.models import Transformer, TransformerConfig
+from tony_tpu.serve import Request, Server
+from tony_tpu.serve.faults import FaultPlan
+from tony_tpu.serve.slots import PagePool
+
+cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                        n_layers=2, d_ff=64, max_seq_len=64,
+                        dtype=jnp.float32, attention_backend="reference")
+model = Transformer(cfg)
+params = model.init(jax.random.PRNGKey(0),
+                    jnp.zeros((1, 8), jnp.int32))["params"]
+rng = np.random.default_rng(11)
+prompts = [rng.integers(1, 64, size=9).tolist() for _ in range(3)]
+BUDGET, WEDGE = 40, 0.03
+
+def mk(**kw):
+    return Server(model, params, batch_size=4, eos_id=-1, paged=True,
+                  kv_page_size=8, prefix_cache_mb=0,
+                  fault_plan=FaultPlan.wedge_at(1, WEDGE, times=-1),
+                  **kw)
+
+# no-rebalance controls, one fresh engine, one stream at a time
+ctrl = Server(model, params, batch_size=1, eos_id=-1, paged=True,
+              kv_page_size=8, prefix_cache_mb=0)
+expect = {}
+for i, p in enumerate(prompts):
+    ctrl.submit(Request(list(p), BUDGET, id=f"c{i}", temperature=0.8,
+                        top_k=8, seed=i))
+    expect[i] = list(list(ctrl.run())[0].tokens)
+
+pool = PagePool(model, params, 128, 8, shared=True)
+hist = GatewayHistory(os.path.join(os.environ["WORK"], "rebhist"),
+                      n_replicas=2)
+gw = Gateway([mk(page_pool=pool), mk(page_pool=pool)],
+             history=hist).start()
+try:
+    # pile all three streams onto replica 0
+    gw.replicas[1].outstanding = 500
+    tickets = [gw.submit(GenRequest(list(p), max_new_tokens=BUDGET,
+                                    temperature=0.8, top_k=8, seed=i,
+                                    id=f"s{i}"))
+               for i, p in enumerate(prompts)]
+    deadline = time.monotonic() + 60
+    while any(t._n_emitted < 3 for t in tickets):
+        assert time.monotonic() < deadline, "streams never got going"
+        time.sleep(0.02)
+    assert all(t.replica == 0 for t in tickets), \
+        [t.replica for t in tickets]
+    gw.replicas[1].outstanding = 0
+    # 3/4 vs 0/4 occupancy: gap 0.75, 3 extra sessions — skewed
+    reb = Rebalancer(gw, interval_s=0.05, skew_frac=0.4,
+                     min_sessions=2, stable=2, cooldown_s=0.5).start()
+    while gw.snapshot()["rebalance"]["moves"] < 1:
+        assert time.monotonic() < deadline, "rebalancer never moved"
+        time.sleep(0.02)
+    for i, t in enumerate(tickets):
+        res = t.result(timeout=120)
+        assert list(res.tokens) == expect[i], f"stream s{i} diverged"
+    snap = gw.snapshot()
+    assert snap["shed"] == {}, snap["shed"]  # zero 5xx
+    reb_stats = snap["rebalance"]
+    assert reb_stats["enabled"] and reb_stats["moves"] >= 1, reb_stats
+    moved = [t for t in tickets if t.replica == 1]
+    assert moved, "move counted but no stream changed replica"
+    path = os.path.join(hist.job_dir, "metrics", "rebalance.jsonl")
+    rows = [json.loads(l) for l in open(path)]
+    assert any(r["action"] == "move" for r in rows), rows
+    print("serve-smoke: rebalancer made %d move(s) in %d tick(s); "
+          "%d decision row(s) on disk" %
+          (reb_stats["moves"], reb_stats["ticks"], len(rows)))
+finally:
+    assert gw.drain(timeout=60)
+assert pool.n_used == 0, pool.n_used  # every page accounted for
+EOF
+    echo "serve-smoke: rebalance OK (autonomous move, token-exact, zero 5xx, decisions on disk)"
+}
+
+if [ "${SERVE_SMOKE_ROUNDS:-all}" = rebalance ]; then
+    rebalance_round   # `make rebalance-smoke`: just the rebalancer round
+    exit 0
+fi
 if [ "${SERVE_SMOKE_ROUNDS:-all}" = migrate ]; then
     migrate_round   # `make migrate-smoke`: just the live-migration round
     exit 0
@@ -1600,4 +1744,7 @@ storm_round
 
 # ---- migrate round: freeze a live stream, survivor adopts it ---------
 migrate_round
+
+# ---- rebalance round: skewed fleet -> autonomous session move --------
+rebalance_round
 echo "serve-smoke: ALL OK"
