@@ -99,11 +99,18 @@ SMOKE_TESTS = tests/test_config.py tests/test_session.py \
 #     and autonomously migrates a session to the idle replica —
 #     token-exact vs no-rebalance controls, zero 5xx, the decision
 #     trail in the gateway history's metrics/rebalance.jsonl
+#   make recovery-smoke - just the crash-recovery round of serve-smoke:
+#     a --journal gateway over two agent subprocesses is kill -9'd
+#     mid-stream, the agents park the orphans after --gateway-grace,
+#     a --recover boot replays the WAL and adopts them token-exact
+#     (zero re-prefill), every stream re-fetched byte-identical via
+#     GET /v1/stream/<id>?offset=0 vs a never-crashed control — zero
+#     5xx after restart, clean drain compacts the journal to empty
 
 .PHONY: lint smoke check test bench serve-smoke chaos-smoke \
 	autoscale-smoke goodput-smoke remote-smoke disagg-smoke \
 	autotune-smoke shard-smoke bundle-smoke storm-smoke \
-	migrate-smoke rebalance-smoke
+	migrate-smoke rebalance-smoke recovery-smoke
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -160,3 +167,6 @@ migrate-smoke:
 
 rebalance-smoke:
 	PY=$(PY) SERVE_SMOKE_ROUNDS=rebalance sh tools/serve_smoke.sh
+
+recovery-smoke:
+	PY=$(PY) SERVE_SMOKE_ROUNDS=recovery sh tools/serve_smoke.sh

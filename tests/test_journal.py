@@ -1,0 +1,152 @@
+"""Durable ticket journal (ISSUE-20): the gateway's write-ahead log.
+
+Pure-python unit coverage for the WAL primitives restart recovery
+rests on: replay is idempotent (same file, same map, twice), a torn
+final line — the append a SIGKILL cut mid-write — is tolerated and
+costs at most that one row, and compaction on clean drain drops every
+terminated request so a cleanly-drained gateway leaves an empty
+journal behind. The end-to-end story (SIGKILL the gateway, replay,
+adopt) lives in test_gateway.py and the recovery smoke round.
+"""
+
+import json
+import os
+
+import pytest
+
+from tony_tpu.gateway import journal as jr
+
+REQ = {"prompt": [1, 2, 3], "max_new_tokens": 8, "temperature": 0.0,
+       "top_k": 0, "seed": 0, "stream": True}
+
+
+def _journal(tmp_path, fsync="batch"):
+    return jr.TicketJournal(str(tmp_path / "journal.ndjson"),
+                            fsync=fsync)
+
+
+def test_fsync_policy_validated(tmp_path):
+    with pytest.raises(ValueError, match="fsync policy"):
+        jr.TicketJournal(str(tmp_path / "j.ndjson"), fsync="sometimes")
+
+
+def test_roundtrip_live_entry(tmp_path):
+    j = _journal(tmp_path)
+    j.admit("r1", REQ, 123.0)
+    j.route("r1", 2, "127.0.0.1:9999")
+    j.emit("r1", 3)
+    j.emit("r1", 7)
+    j.close()
+    entries = jr.replay(j.path)
+    e = entries["r1"]
+    assert e.live
+    assert e.request["prompt"] == [1, 2, 3]
+    assert e.request["max_new_tokens"] == 8
+    assert e.replica == 2 and e.host == "127.0.0.1:9999"
+    assert e.offset == 7          # max of the emit rows
+    assert e.t_admit == 123.0
+
+
+def test_terminal_rows_mark_dead(tmp_path):
+    j = _journal(tmp_path)
+    j.admit("done", REQ, 1.0)
+    j.done("done")
+    j.admit("shed", REQ, 2.0)
+    j.shed("shed", 503)
+    j.admit("live", REQ, 3.0)
+    j.close()
+    entries = jr.replay(j.path)
+    assert not entries["done"].live
+    assert not entries["shed"].live
+    assert entries["live"].live
+
+
+def test_replay_idempotent(tmp_path):
+    j = _journal(tmp_path)
+    j.admit("a", REQ, 1.0)
+    j.route("a", 0, None)
+    j.emit("a", 5)
+    j.close()
+
+    def shape(entries):
+        return {rid: (e.live, e.offset, e.replica, e.host)
+                for rid, e in entries.items()}
+
+    first = shape(jr.replay(j.path))
+    second = shape(jr.replay(j.path))
+    assert first == second == {"a": (True, 5, 0, None)}
+
+
+def test_torn_tail_tolerated(tmp_path):
+    j = _journal(tmp_path)
+    j.admit("a", REQ, 1.0)
+    j.emit("a", 4)
+    j.close()
+    # SIGKILL mid-append: the final line is cut in half
+    with open(j.path, "a", encoding="utf-8") as f:
+        f.write('{"ev": "emit", "rid": "a", "of')
+    entries = jr.replay(j.path)
+    assert entries["a"].live
+    assert entries["a"].offset == 4   # the torn row is simply absent
+
+
+def test_missing_file_is_empty(tmp_path):
+    assert jr.replay(str(tmp_path / "nope.ndjson")) == {}
+
+
+def test_compact_drops_terminated(tmp_path):
+    j = _journal(tmp_path)
+    for rid in ("a", "b", "c"):
+        j.admit(rid, REQ, 1.0)
+        j.route(rid, 1, "h:1")
+    j.emit("a", 6)
+    j.done("b")
+    j.shed("c", 503)
+    kept = j.compact()
+    assert kept == 1
+    entries = jr.replay(j.path)
+    assert set(entries) == {"a"}
+    assert entries["a"].offset == 6
+    assert entries["a"].host == "h:1"
+    # the journal keeps accepting appends after a compact
+    j.done("a")
+    assert j.compact() == 0
+    j.close()
+    assert jr.replay(j.path) == {}
+
+
+def test_clean_drain_leaves_empty_file(tmp_path):
+    j = _journal(tmp_path)
+    j.admit("a", REQ, 1.0)
+    j.done("a")
+    j.close(compact=True)
+    assert os.path.getsize(j.path) == 0
+    assert jr.replay(j.path) == {}
+
+
+def test_compact_is_atomic_rewrite(tmp_path):
+    j = _journal(tmp_path)
+    j.admit("a", REQ, 1.0)
+    j.compact()
+    assert not os.path.exists(j.path + ".tmp")
+    j.close()
+
+
+def test_fsync_off_still_durable_after_close(tmp_path):
+    j = _journal(tmp_path, fsync="off")
+    j.admit("a", REQ, 1.0)
+    j.close()
+    assert jr.replay(j.path)["a"].live
+
+
+def test_find_latest_picks_newest(tmp_path):
+    root = tmp_path / "history"
+    for app, t in (("application_1", 100.0), ("application_2", 200.0)):
+        d = root / "intermediate" / app
+        d.mkdir(parents=True)
+        p = d / "journal.ndjson"
+        p.write_text(json.dumps({"ev": "admit", "rid": app}) + "\n")
+        os.utime(p, (t, t))
+    assert jr.find_latest(str(root)).endswith(
+        os.path.join("application_2", "journal.ndjson"))
+    assert jr.find_latest(str(tmp_path / "none")) is None

@@ -247,6 +247,39 @@ def build_parser() -> argparse.ArgumentParser:
                    help="where POST /debug/profile drops its xplane "
                         "captures (default: <history job dir>/profiles "
                         "with --history, else ./profiles)")
+    p.add_argument("--journal", action="store_true",
+                   help="arm the durable ticket journal (ISSUE-20): a "
+                        "write-ahead NDJSON log of every admit/route/"
+                        "emit-offset/terminal under the history job "
+                        "dir, compacted away on clean drain — the "
+                        "record --recover replays after a crash. "
+                        "Needs --history for a place to land")
+    p.add_argument("--journal-fsync", default="batch",
+                   choices=("always", "batch", "off"),
+                   help="journal durability: 'always' fsyncs every "
+                        "append, 'batch' (default) fsyncs admits and "
+                        "terminals while emit offsets ride the page "
+                        "cache, 'off' never fsyncs")
+    p.add_argument("--recover", action="store_true",
+                   help="crash recovery boot: replay the newest "
+                        "journal under the --history root and "
+                        "re-admit every still-live request — parked "
+                        "agent sessions are adopted mid-stream "
+                        "(token-exact, zero re-prefill), local ones "
+                        "re-run from the prompt; clients resume via "
+                        "GET /v1/stream/<id>?offset=. A no-op when "
+                        "the previous boot drained clean")
+    p.add_argument("--park-ttl", type=float, default=60.0,
+                   help="seconds a terminal request stays resumable "
+                        "at the gateway (GET /v1/stream/<id>) and a "
+                        "launched agent keeps orphaned sessions "
+                        "adoptable")
+    p.add_argument("--agent-grace", type=float, default=0.0,
+                   help="launched agents: seconds of gateway silence "
+                        "before their in-flight slots freeze into "
+                        "parked snapshots (forwarded as the replica "
+                        "CLI's --gateway-grace; 0 = park only "
+                        "finished results)")
     p.add_argument("--trace-capacity", type=int, default=256,
                    help="recent request traces kept for "
                         "GET /debug/trace/<request_id>; 0 disables "
@@ -608,6 +641,12 @@ def agent_argv(args, index: int) -> list:
             "--host-share", str(max(1, args.replicas,
                                     getattr(args, "autoscale_max", 0)
                                     or 0)),
+            # crash-safety knobs (ISSUE-20): launched agents keep
+            # orphans adoptable exactly as long as the gateway keeps
+            # terminals resumable, and freeze in-flight slots after
+            # --agent-grace of gateway silence
+            "--park-ttl", str(getattr(args, "park_ttl", 60.0)),
+            "--gateway-grace", str(getattr(args, "agent_grace", 0.0)),
             "--port", "0"]
     if getattr(args, "mesh", "").strip():
         argv += ["--mesh", args.mesh,
@@ -727,9 +766,25 @@ def build_gateway(args, model, params, eos, *, metrics_store=None):
     if args.history:
         history = GatewayHistory(args.history,
                                  n_replicas=len(servers))
+    journal = None
+    if getattr(args, "journal", False) or getattr(args, "recover",
+                                                  False):
+        # the WAL lands in THIS boot's history job dir (next to
+        # requests.jsonl); --recover implies journaling — a recovered
+        # gateway that did not journal would be unrecoverable itself
+        if history is None:
+            raise SystemExit("--journal/--recover need --history for "
+                             "a place to put the journal")
+        from tony_tpu.gateway.journal import TicketJournal
+
+        journal = TicketJournal(
+            os.path.join(history.job_dir, "journal.ndjson"),
+            fsync=getattr(args, "journal_fsync", "batch"))
     trace_capacity = getattr(args, "trace_capacity", 256)
     return Gateway(servers, max_queue=args.max_queue,
                    default_ttl_s=args.default_ttl,
+                   journal=journal,
+                   park_ttl_s=getattr(args, "park_ttl", 60.0),
                    metrics_store=metrics_store, history=history,
                    max_attempts=args.max_attempts,
                    stall_timeout_s=args.stall_timeout,
@@ -924,8 +979,31 @@ def main(argv=None) -> int:
     from tony_tpu.gateway import GatewayEdge, GatewayHTTP
     from tony_tpu.metrics import MetricsStore
 
+    # --recover: find the DEAD boot's journal BEFORE build_gateway
+    # creates this boot's (fresh, newest-mtime) one — the replay must
+    # see the previous incarnation's record, not our empty file
+    recover_entries = None
+    if getattr(args, "recover", False):
+        from tony_tpu.gateway import journal as journal_mod
+
+        prev = journal_mod.find_latest(args.history) \
+            if args.history else None
+        recover_entries = journal_mod.replay(prev) if prev else {}
+        n_live = sum(1 for e in recover_entries.values() if e.live)
+        print(f"recovery: replayed "
+              f"{prev or '(no previous journal)'} — "
+              f"{n_live} live request(s)", file=sys.stderr, flush=True)
+
     gateway = build_gateway(args, model, params, eos,
                             metrics_store=MetricsStore()).start()
+    if recover_entries is not None:
+        report = gateway.recover_from_journal(recover_entries)
+        print(f"recovery: {report['adopted']} adopted mid-stream, "
+              f"{report['rerun']} re-run from prompt, "
+              f"{report['finished']} finished results, "
+              f"{report['shed']} shed "
+              f"({report.get('wall_ms', 0):.0f}ms)",
+              file=sys.stderr, flush=True)
     scaler = build_scaler(args, gateway, model, params, eos)
     if scaler is not None:
         scaler.start()

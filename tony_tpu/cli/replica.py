@@ -88,6 +88,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-timeout", type=float, default=120.0,
                    help="max seconds to finish in-flight work on "
                         "SIGTERM")
+    p.add_argument("--park-ttl", type=float, default=60.0,
+                   help="seconds a parked session (orphaned snapshot "
+                        "or finished-but-undelivered result) stays "
+                        "adoptable before it is reaped")
+    p.add_argument("--gateway-grace", type=float, default=0.0,
+                   help="seconds of gateway silence before in-flight "
+                        "slots freeze into parked snapshots (0 "
+                        "disables the watchdog; in-flight work runs "
+                        "to completion and parks as results)")
     p.add_argument("--compile-cache",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "tony_tpu", "compile-cache"),
@@ -141,7 +150,9 @@ def main(argv=None) -> int:
             "engine fault injection ARMED on this agent (replica %d) "
             "via TONY_SERVE_FAULTS", args.replica_index)
     agent = ReplicaAgent(server, agent_id=args.agent_id or None,
-                         profile_dir=args.profile_dir or None)
+                         profile_dir=args.profile_dir or None,
+                         park_ttl_s=args.park_ttl,
+                         gateway_grace_s=args.gateway_grace)
     http = AgentHTTP(agent, host=args.host, port=args.port).start()
     if args.port_file:
         tmp = args.port_file + ".tmp"

@@ -116,6 +116,7 @@ of role tasks onto a container pool). The pieces, front to back:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -312,6 +313,23 @@ class Ticket:
         self._shed_exc_cls: type | None = None  # result()'s exception
         #                                         class, when the status
         #                                         alone is ambiguous
+        # crash-safe control plane (ISSUE-20): the absolute token
+        # sequence emitted so far — what GET /v1/stream/<id>?offset=
+        # serves a client that reconnects (possibly across a gateway
+        # restart). Invariant: len(_tokens) == _n_emitted, both
+        # advanced together under _emit_lock; recovery seeds both from
+        # an adopted snapshot's ``generated`` prefix. ``_journal`` is
+        # the gateway's write-ahead log when one is armed; ``t_terminal``
+        # stamps done/shed so the resume registry can reap the ticket
+        # after the park TTL.
+        self._tokens: list[int] = []
+        self._journal = None
+        self.t_terminal: float | None = None
+        # the terminal shed, replayable: a client that reconnects
+        # after its request was shed gets the same status/reason the
+        # live stream carried, not a 404
+        self._shed_status: int | None = None
+        self._shed_reason = ""
 
     # estimate used by least-outstanding-tokens routing: the work a
     # replica signs up for when it accepts this ticket
@@ -356,9 +374,15 @@ class Ticket:
                 return
             new = tokens[cur - start:]
             self._n_emitted = cur + len(new)
+            self._tokens.extend(new)  # the resume buffer (ISSUE-20)
             if self.t_first is None:
                 self.t_first = now
             self._emit(("tokens", new))
+        j = self._journal
+        if j is not None:
+            # outside the emit lock (the journal has its own): the
+            # cumulative offset row is idempotent — replay takes the max
+            j.emit(self.request.id, self._n_emitted)
 
     def result(self, timeout: float | None = None):
         """Block until the request finishes; returns the
@@ -518,6 +542,14 @@ class _Replica:
         self._next_id = 0
         self._tl_cursor = 0  # dispatch-timeline read position (tracing)
         self._probe_first = False  # scale-up: earn admission via probe
+        # orders the failure-claim against the breaker (ISSUE-20):
+        # _fail_replica holds this across the ticket steal + failover
+        # (including the park-adoption probe of the agent), and
+        # _recover takes it before its hard engine reset — without the
+        # handshake, a lease expiry detected on the monitor thread
+        # races the replica thread's breaker entry, and the reset
+        # wipes the very agent session _claim_parked came to adopt
+        self.fail_lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop,
                                         name=f"gateway-replica-{index}",
                                         daemon=True)
@@ -557,6 +589,14 @@ class _Replica:
             self._enq_times.append(ticket.t_queued)
             self.outstanding += ticket.cost
             self.cv.notify()
+        j = ticket._journal
+        if j is not None:
+            # WAL route row (ISSUE-20): which replica — and for remote
+            # ones, which HOST — this placement landed on, so a
+            # recovering gateway knows where to look for the parked
+            # session. Outside the cv (the journal has its own lock).
+            j.route(ticket.request.id, self.index,
+                    None if self.host == "local" else self.host)
 
     @property
     def n_queued(self) -> int:
@@ -789,21 +829,26 @@ class _Replica:
             req = ticket.request
             engine_id = self._next_id
             self._next_id += 1
+            engine_req = Request(
+                list(req.prompt), req.max_new_tokens,
+                temperature=req.temperature, top_k=req.top_k,
+                seed=req.seed, id=engine_id,
+                # role-split plumbing: a prefill-pool replica runs
+                # admission/prefill only (the result is a page
+                # handoff); a ticket carrying a handoff payload
+                # admits it instead of prefilling
+                prefill_only=self.role == "prefill",
+                handoff=ticket.handoff,
+                # a migrated-in session resumes mid-stream: the
+                # engine arms a slot from the snapshot instead of
+                # prefilling (serve/migrate.py)
+                migrate=ticket.migrate)
+            # the GATEWAY request id rides along (ISSUE-20): remote
+            # stubs ship it so the agent can park an orphaned session
+            # under the one id a restarted gateway still knows
+            engine_req.rid = req.id
             try:
-                self.server.submit(Request(
-                    list(req.prompt), req.max_new_tokens,
-                    temperature=req.temperature, top_k=req.top_k,
-                    seed=req.seed, id=engine_id,
-                    # role-split plumbing: a prefill-pool replica runs
-                    # admission/prefill only (the result is a page
-                    # handoff); a ticket carrying a handoff payload
-                    # admits it instead of prefilling
-                    prefill_only=self.role == "prefill",
-                    handoff=ticket.handoff,
-                    # a migrated-in session resumes mid-stream: the
-                    # engine arms a slot from the snapshot instead of
-                    # prefilling (serve/migrate.py)
-                    migrate=ticket.migrate))
+                self.server.submit(engine_req)
             except QueueFull:
                 # engine bound hit (shouldn't happen: we feed at most
                 # free-slot many) — put it back and stop admitting.
@@ -1020,7 +1065,10 @@ class _Replica:
                     attempts=ticket.attempts)
                 self.gateway._export_trace(ticket)
             self.gateway._record_done(self, metrics)
+            ticket.t_terminal = now
             ticket._emit(("done", res, metrics))
+            if ticket._journal is not None:
+                ticket._journal.done(ticket.request.id)
 
     def _request_metrics(self, ticket: Ticket, res, now: float) -> dict:
         n_out = len(res.tokens)
@@ -1091,7 +1139,12 @@ class _Replica:
             # state flip + terminal emit together: a previous owner's
             # late token delta can't land after the final shed event
             ticket.state = SHED
+            ticket.t_terminal = time.monotonic()
+            ticket._shed_status = status
+            ticket._shed_reason = reason
             ticket._emit(("shed", status, reason))
+        if ticket._journal is not None:
+            ticket._journal.shed(ticket.request.id, status)
 
     # ------------------------------------------------- breaker recovery
 
@@ -1106,13 +1159,22 @@ class _Replica:
         replica — parked out of the rotation until shutdown. Returns
         False when the gateway is stopping: the thread exits."""
         gw = self.gateway
+        first = True
         while True:
             try:
-                self.server.reset()  # pending + _live + slots together:
-                # slots alone would leave engine ghosts decoding phantom
-                # results for tickets now re-running elsewhere
+                # first lap: wait out any in-flight _fail_replica (the
+                # lease-expiry route runs on the monitor thread) — its
+                # _claim_parked must adopt the agent-side session
+                # BEFORE this hard reset wipes it (ISSUE-20)
+                with self.fail_lock if first \
+                        else contextlib.nullcontext():
+                    self.server.reset()  # pending + _live + slots
+                # together: slots alone would leave engine ghosts
+                # decoding phantom results for tickets now re-running
+                # elsewhere
             except Exception:
                 log.exception("replica %d engine reset failed", self.index)
+            first = False
             if self.consecutive_failures >= gw.quarantine_after:
                 with self.cv:
                     if self.state != QUARANTINED:
@@ -1327,6 +1389,20 @@ class _Stats:
         # one is a mid-stream crash whose victim resumed token-exact
         # with no recompute
         self.migrate_lease_adoptions = 0
+        # crash recovery (ISSUE-20): ``--recover`` boots that replayed
+        # a journal, and what happened to each live entry — adopted
+        # mid-stream off a parked agent session (zero re-prefill),
+        # re-run from the prompt (local engine died with the process),
+        # or materialized from a finished-but-undelivered result.
+        # ``park_adoptions`` counts the FAILOVER flavor: a live-crash
+        # failover that found the victim's session parked on its agent
+        # and resumed it instead of re-running.
+        self.recoveries = 0
+        self.sessions_adopted = 0
+        self.sessions_rerun = 0
+        self.recovered_finished = 0
+        self.recovery_wall_ms = 0.0
+        self.park_adoptions = 0
         # the flight recorder (ISSUE-15): alert-triggered debug
         # bundles dumped into the history job dir
         self.bundles_written = 0
@@ -1607,7 +1683,8 @@ class Gateway:
                  prefix_affinity: bool = True,
                  autotune: bool = False,
                  autotune_interval_s: float = 1.0,
-                 autotune_config: dict | None = None):
+                 autotune_config: dict | None = None,
+                 journal=None, park_ttl_s: float = 60.0):
         if not servers:
             raise ValueError("gateway needs at least one replica server")
         # disaggregated prefill/decode (ISSUE-12): ``roles`` names each
@@ -1701,6 +1778,17 @@ class Gateway:
         #                             in-flight extract before falling
         #                             back to re-run-from-prompt
         self._drain_done: bool | None = None
+        # crash-safe control plane (ISSUE-20): ``journal`` is the
+        # write-ahead TicketJournal every admit/route/emit/terminal
+        # rides (None = off); ``_resume`` is the request-id -> Ticket
+        # registry behind GET /v1/stream/<id>?offset= — every admitted
+        # ticket registers, terminals stay fetchable for ``park_ttl_s``
+        # (the client-side twin of the agent's park TTL), then reap.
+        self.journal = journal
+        self.park_ttl_s = max(1.0, float(park_ttl_s))
+        self._resume: dict = {}
+        self._resume_lock = threading.Lock()
+        self._t_recovered: float | None = None  # alert signal stamp
         self._host_cache: tuple[float, dict] | None = None
         self._tpu_discoverer = None
         self._started = False
@@ -1837,6 +1925,16 @@ class Gateway:
             # a profile capture left mid-flight (operator armed it,
             # traffic stopped) is finalized so its xplane files land
             self.profiler.close()
+            if self.journal is not None:
+                # clean drain COMPACTS the WAL (every request reached
+                # a terminal -> empty file; the next --recover finds
+                # nothing to do); a drain that timed out leaves the
+                # journal whole — those stragglers are exactly what
+                # recovery should see
+                try:
+                    self.journal.close(compact=ok)
+                except Exception:
+                    log.exception("journal close failed")
             if self.history is not None:
                 self.history.close("SUCCEEDED" if ok else "KILLED",
                                    self.stats.snapshot())
@@ -2045,6 +2143,24 @@ class Gateway:
             sig["completed"] = self.stats.completed
             sig["tokens_out"] = self.stats.tokens_out
         sig["states"] = [r.state for r in live]
+        # the connection-plane's sheds (ISSUE-20 satellite, closing a
+        # ROADMAP-3 gap): 429s the EDGE refused at its connection cap
+        # never reached admission, so without this row a pure
+        # connection storm was invisible to the shed-storm alert
+        edge = self._edge_stats
+        conn_sheds = 0
+        if edge is not None:
+            try:
+                conn_sheds = int(
+                    (edge() or {}).get("conn_limit_sheds", 0))
+            except Exception:
+                conn_sheds = 0
+        sig["edge_conn_limit_sheds"] = conn_sheds
+        # a recent --recover boot (fires the one-shot recovery alert:
+        # operators should KNOW the gateway came back from a crash)
+        t_rec = self._t_recovered
+        sig["recovered_ago_s"] = None if t_rec is None \
+            else round(time.monotonic() - t_rec, 3)
         fleet = self.fleet_goodput(live)
         if fleet:
             sig["goodput_useful"] = fleet.get("useful_fraction")
@@ -2381,6 +2497,26 @@ class Gateway:
                     trace.add("http_receive", request.t_receive,
                               ticket.t_submit, attempt=False)
                 ticket.trace = trace
+            # WAL + resume registry (ISSUE-20): the admit row lands
+            # BEFORE the enqueue so the journal never misses a routed
+            # request, and the ticket registers for client resume —
+            # GET /v1/stream/<id>?offset= works for every admitted
+            # request, crash or no crash
+            if self.journal is not None:
+                ticket._journal = self.journal
+                self.journal.admit(request.id, {
+                    "prompt": prompt,
+                    "max_new_tokens": request.max_new_tokens,
+                    "temperature": request.temperature,
+                    "top_k": request.top_k, "seed": request.seed,
+                    **({"session": request.session}
+                       if request.session else {}),
+                    **({"tenant": request.tenant}
+                       if request.tenant else {}),
+                    **({"priority": request.priority}
+                       if request.priority else {}),
+                }, time.time())
+            self._register_resume(ticket)
             tried: set[int] = set()
             while True:
                 try:
@@ -2389,6 +2525,7 @@ class Gateway:
                     self.quotas.refund(request.tenant, cost)  # zero
                     # service delivered: the bucket must not pay
                     self.stats_shed(503)
+                    self._abandon_resume(ticket, 503)
                     raise
                 try:
                     # enqueue INSIDE the gateway lock: the bound check
@@ -2405,6 +2542,7 @@ class Gateway:
                 except GatewayClosed:  # the drain race
                     self.quotas.refund(request.tenant, cost)
                     self.stats_shed(503)
+                    self._abandon_resume(ticket, 503)
                     raise
         with self.stats.lock:
             self.stats.accepted += 1
@@ -2550,29 +2688,34 @@ class Gateway:
         fencing token — stale output from the old epoch is discarded),
         steal EVERY ticket it holds, and fail them over. Idempotent
         under the race of both routes firing: the epoch check makes the
-        second caller a no-op."""
-        with replica.cv:
-            if replica.epoch != epoch or replica.state != HEALTHY:
-                return  # already handled (exception-vs-watchdog race)
-            replica.epoch += 1
-            replica.state = BROKEN
-            replica.failures += 1
-            replica.consecutive_failures += 1
-            admitted = list(replica._tickets.values())
-            replica._tickets.clear()
-            queued = replica.queue.steal_all()  # WFQ service order;
-            # tickets keep their tier, so the survivor's queue
-            # re-applies the same fairness
-            replica.outstanding = 0
-            replica.cv.notify_all()
-        wd = self._watchdog  # snapshot (see _beat)
-        if wd is not None:
-            wd.unregister(str(replica.index))
-        with self.stats.lock:
-            self.stats.replica_failures += 1
-        log.error("%s: failing over %d admitted + %d queued ticket(s)",
-                  reason, len(admitted), len(queued))
-        self._failover(replica, admitted, queued, reason)
+        second caller a no-op. ``fail_lock`` is held through the whole
+        steal + failover so the replica thread's breaker entry
+        (``_recover``'s hard engine reset) cannot wipe the agent-side
+        sessions while ``_claim_parked`` is still adopting them."""
+        with replica.fail_lock:
+            with replica.cv:
+                if replica.epoch != epoch or replica.state != HEALTHY:
+                    return  # already handled (exception-vs-watchdog
+                    #         race)
+                replica.epoch += 1
+                replica.state = BROKEN
+                replica.failures += 1
+                replica.consecutive_failures += 1
+                admitted = list(replica._tickets.values())
+                replica._tickets.clear()
+                queued = replica.queue.steal_all()  # WFQ service
+                # order; tickets keep their tier, so the survivor's
+                # queue re-applies the same fairness
+                replica.outstanding = 0
+                replica.cv.notify_all()
+            wd = self._watchdog  # snapshot (see _beat)
+            if wd is not None:
+                wd.unregister(str(replica.index))
+            with self.stats.lock:
+                self.stats.replica_failures += 1
+            log.error("%s: failing over %d admitted + %d queued "
+                      "ticket(s)", reason, len(admitted), len(queued))
+            self._failover(replica, admitted, queued, reason)
 
     def _failover(self, replica: _Replica, admitted: list,
                   queued: list, reason: str) -> None:
@@ -2615,6 +2758,8 @@ class Gateway:
                 continue
             if any(ticket is t for t in admitted):
                 self._claim_snapshot(ticket)
+                if ticket.migrate is None:
+                    self._claim_parked(replica, ticket)
             self._requeue(replica, ticket, reason)
 
     def _claim_snapshot(self, ticket: Ticket) -> None:
@@ -2654,6 +2799,42 @@ class Gateway:
                                  time.monotonic() - lease.t0, 3))
         log.warning("failover adopted an in-flight migrate snapshot "
                     "(token-exact resume, no recompute)")
+
+    def _claim_parked(self, replica: _Replica, ticket: Ticket) -> None:
+        """The parked-session check (ISSUE-20, closing the ROADMAP-4
+        residue): before a failover re-runs an admitted ticket from
+        its prompt, ask the failed replica's AGENT for the session —
+        a lease that expired because the gateway-side transport
+        flapped (not because the agent died) leaves the agent holding
+        a perfectly good live slot or parked snapshot. Adopting it
+        pins the invariants the chaos rounds check: ONE attempt
+        charged (the failover already did), ZERO re-prefill, and a
+        token-exact resumed stream. Any error falls through to the
+        ordinary re-run — still token-exact, just slower."""
+        server = replica.server
+        adopt = getattr(server, "adopt_parked", None) \
+            if server is not None else None
+        if adopt is None:
+            return  # local replica: its engine died with its slots
+        try:
+            resp = adopt(ticket.request.id)
+        except Exception as e:
+            log.debug("failover park check for %r on %s failed: %r",
+                      ticket.request.id, replica.host, e)
+            return
+        if resp is None or resp.get("snapshot") is None:
+            return  # unknown / reaped / finished-elsewhere: re-run
+        ticket.migrate = resp["snapshot"]
+        with self.stats.lock:
+            self.stats.park_adoptions += 1
+            self.stats.migrations += 1
+        if ticket.trace is not None:
+            ticket.trace.add("park_adopt", time.monotonic(),
+                             attempt=False, host=replica.host,
+                             offset=resp.get("offset"))
+        log.warning("failover adopted the PARKED session for %r off "
+                    "agent %s (token-exact resume, no re-prefill)",
+                    ticket.request.id, replica.host)
 
     def _requeue(self, replica: _Replica, ticket: Ticket,
                  reason: str) -> None:
@@ -2877,6 +3058,329 @@ class Gateway:
                                             found[0][1], epoch)
         return False
 
+    # ------------------------------------- restart recovery (ISSUE-20)
+
+    def _register_resume(self, ticket: Ticket) -> None:
+        """Every admitted ticket joins the resume registry behind
+        ``GET /v1/stream/<id>?offset=`` — reconnects work crash or no
+        crash. Terminal tickets stay fetchable for ``park_ttl_s``
+        (the client-side twin of the agent's park TTL) and are reaped
+        opportunistically here: registrations happen at traffic rate,
+        so the registry can never grow past traffic + one TTL."""
+        now = time.monotonic()
+        with self._resume_lock:
+            dead = [rid for rid, t in self._resume.items()
+                    if t.t_terminal is not None
+                    and now - t.t_terminal > self.park_ttl_s]
+            for rid in dead:
+                del self._resume[rid]
+            self._resume[ticket.request.id] = ticket
+
+    def _abandon_resume(self, ticket: Ticket, status: int) -> None:
+        """A submit that sheds AFTER its admit row landed (no healthy
+        replica, the drain race): close the WAL entry and drop the
+        registration — the client got a synchronous error, there is
+        nothing to resume and nothing for ``--recover`` to re-run."""
+        with ticket._emit_lock:
+            ticket.state = SHED
+            ticket.t_terminal = time.monotonic()
+            ticket._shed_status = status
+        if ticket._journal is not None:
+            ticket._journal.shed(ticket.request.id, status)
+        with self._resume_lock:
+            self._resume.pop(ticket.request.id, None)
+
+    def resume_ticket(self, rid) -> Ticket | None:
+        with self._resume_lock:
+            return self._resume.get(rid)
+
+    def resume_events(self, rid, offset: int = 0,
+                      keepalive_s: float = 15.0):
+        """The resumable-stream generator behind
+        ``GET /v1/stream/<request_id>?offset=N`` (both edges frame
+        it): yield the absolute token windows past the client's own
+        cursor, then the terminal line. Reads the ticket's resume
+        buffer (``_tokens``) under its emit lock instead of consuming
+        the single-consumer ``events`` queue, so a resumed stream
+        never races the original consumer — N watchers of one request
+        all see the same bytes. First yield is ``{"gone": True}`` for
+        an unknown/reaped id (the edge 404s); a client whose request
+        finished while it was away gets the buffered suffix plus the
+        terminal immediately."""
+        ticket = self.resume_ticket(rid)
+        if ticket is None:
+            yield {"gone": True}
+            return
+        sent = max(0, int(offset))
+        last = time.monotonic()
+        while True:
+            with ticket._emit_lock:
+                total = len(ticket._tokens)
+                state = ticket.state
+                window = list(ticket._tokens[sent:]) if sent < total \
+                    else None
+                metrics = ticket.metrics
+                shed = (ticket._shed_status, ticket._shed_reason)
+            if window:
+                yield {"offset": sent, "token_ids": window}
+                sent += len(window)
+                last = time.monotonic()
+                continue
+            if state == SHED:
+                yield {"shed": True, "status": shed[0] or 503,
+                       "reason": shed[1]}
+                return
+            if state == DONE and metrics is not None:
+                yield {"done": True, "metrics": metrics}
+                return
+            now = time.monotonic()
+            if keepalive_s and now - last >= keepalive_s:
+                yield {"keepalive": True}
+                last = now
+            time.sleep(0.02)
+
+    def recover_from_journal(self, entries: dict) -> dict:
+        """Boot-time crash recovery (``--recover``): the TonY-AM-
+        restart analog for serving. ``entries`` is a replayed journal
+        (``journal.replay``); every LIVE entry — admitted, never
+        terminal — is re-admitted under its ORIGINAL request id:
+
+        - remote replicas first sync epochs PAST the dead gateway's
+          (``sync_recovery_epoch`` — never ``reset()``, which would
+          wipe the very sessions we came back for), so the first
+          adopt fences out any stale second adopter;
+        - a session the journaled host PARKED (or still runs — the
+          agent freezes it on the spot) is adopted and resumes
+          mid-stream, token-exact, zero re-prefill, no attempt
+          charged;
+        - a request that FINISHED into the void comes back as its
+          buffered result, immediately terminal;
+        - everything else re-runs from the prompt, charged one
+          attempt — deterministic decode makes the re-run
+          byte-identical, and the resume buffer serves whatever
+          suffix the client is missing.
+
+        Call after ``start()``. Returns the recovery report (also
+        folded into stats/alerts)."""
+        t0 = time.monotonic()
+        live = sorted((e for e in entries.values() if e.live),
+                      key=lambda e: e.t_admit)
+        report = {"live": len(live), "adopted": 0, "rerun": 0,
+                  "finished": 0, "shed": 0}
+        by_host: dict[str, _Replica] = {}
+        for r in self.replicas:
+            if r.retired or r.server is None:
+                continue
+            sync = getattr(r.server, "sync_recovery_epoch", None)
+            if sync is not None:
+                try:
+                    sync()
+                except Exception as e:
+                    log.warning("recovery epoch sync failed for "
+                                "replica %d (%s): %r", r.index,
+                                r.host, e)
+                by_host[r.host] = r
+        # adopts can hold an agent's control connection for seconds
+        # (freeze-for-adopt waits out the current dispatch), starving
+        # the heartbeats queued behind them — mask lease expiries for
+        # the duration so recovery can't fail over the very replicas
+        # it is adopting from
+        for r in by_host.values():
+            pause = getattr(r.server, "pause_lease", None)
+            if pause is not None:
+                pause()
+        for e in live:
+            doc = e.request or {}
+            request = GenRequest(
+                prompt=list(doc.get("prompt", [])),
+                max_new_tokens=int(doc.get("max_new_tokens", 64)),
+                temperature=float(doc.get("temperature", 0.0)),
+                top_k=int(doc.get("top_k", 0)),
+                seed=int(doc.get("seed", 0)),
+                id=e.rid,
+                session=doc.get("session"),
+                tenant=doc.get("tenant"),
+                priority=doc.get("priority"))
+            resp = None
+            replica = by_host.get(e.host) if e.host else None
+            if replica is not None:
+                try:
+                    resp = replica.server.adopt_parked(e.rid)
+                except Exception as exc:
+                    log.warning("recovery adopt of %r from %s failed "
+                                "(%r); re-running from the prompt",
+                                e.rid, e.host, exc)
+            if resp is not None and resp.get("finished"):
+                self._recover_finished(request, resp, e)
+                report["finished"] += 1
+                continue
+            snap = resp.get("snapshot") if resp is not None else None
+            mode = "adopt" if snap is not None else "rerun"
+            ticket = Ticket(request, None)
+            weights = self.tier_weights if self.tier_weights \
+                is not None else _DEFAULT_WEIGHTS
+            ticket.tier = request.priority \
+                if request.priority in weights else DEFAULT_TIER
+            ticket.tenant = request.tenant
+            if snap is not None:
+                # resume mid-stream: the wire snapshot carries the
+                # full generated prefix — seed the resume buffer AND
+                # the emit cursor from it, so the engine's re-emission
+                # of the absolute window dedups exactly and a client
+                # resuming at any offset <= the journaled one finds
+                # its suffix in the buffer (the journal may be AHEAD
+                # of what the client's socket actually delivered)
+                gen = [int(t) for t in snap.get("generated", [])]
+                ticket.migrate = snap
+                ticket._tokens = list(gen)
+                ticket._n_emitted = len(gen)
+            else:
+                # token-exact re-run from the prompt, charged one
+                # attempt — the journaled offset is NOT seeded: the
+                # engine regenerates from 0 and the buffer refills
+                # byte-identically (deterministic decode)
+                ticket.attempts = 1
+            if self.traces is not None:
+                trace = RequestTrace(request.id, t0=ticket.t_submit)
+                trace.root.tags.update(
+                    prompt_len=len(request.prompt),
+                    max_new_tokens=request.max_new_tokens,
+                    priority=ticket.tier, recovered=True)
+                trace.add("recover", ticket.t_submit, attempt=False,
+                          mode=mode, journal_offset=e.offset,
+                          host=e.host)
+                ticket.trace = trace
+            if self.journal is not None:
+                # fresh WAL rows in the NEW journal: a second crash
+                # recovers from THIS boot's record (find_latest picks
+                # the newest journal; the old one is left stale)
+                ticket._journal = self.journal
+                self.journal.admit(e.rid, doc, time.time())
+            self._register_resume(ticket)
+            tried: set[int] = set()
+            while True:
+                try:
+                    target = self._route(ticket, tried)
+                except NoHealthyReplicas:
+                    self._shed_ticket(
+                        self.replicas[0], ticket, 503,
+                        "no healthy replica at recovery",
+                        exc=NoHealthyReplicas)
+                    report["shed"] += 1
+                    break
+                try:
+                    target.enqueue(ticket, force=True)
+                except (GatewayClosed, _ReplicaUnhealthy):
+                    tried.add(target.index)
+                    continue
+                report["adopted" if mode == "adopt" else "rerun"] += 1
+                break
+        for r in by_host.values():
+            resume_lease = getattr(r.server, "resume_lease", None)
+            if resume_lease is not None:
+                resume_lease()
+        wall_ms = round((time.monotonic() - t0) * 1e3, 3)
+        report["wall_ms"] = wall_ms
+        self._t_recovered = time.monotonic()
+        with self.stats.lock:
+            self.stats.recoveries += 1
+            self.stats.accepted += report["adopted"] + report["rerun"]
+            self.stats.sessions_adopted += report["adopted"]
+            self.stats.sessions_rerun += report["rerun"]
+            self.stats.recovered_finished += report["finished"]
+            self.stats.recovery_wall_ms += wall_ms
+        if live:
+            log.warning(
+                "recovered %d journaled request(s) in %.0fms: "
+                "%d adopted mid-stream, %d re-run from prompt, "
+                "%d finished results, %d shed", len(live), wall_ms,
+                report["adopted"], report["rerun"],
+                report["finished"], report["shed"])
+        return report
+
+    def _recover_finished(self, request: GenRequest, resp: dict,
+                          entry) -> None:
+        """A request that FINISHED while the gateway was dead: the
+        agent buffered the undelivered result — materialize it as an
+        immediately-terminal ticket so the client's resume fetches the
+        whole stream + done line. Bypasses ``_record_done`` on
+        purpose: the latency fields a live completion carries
+        (queue_wait/ttft/tpot) do not exist for a result that crossed
+        a crash, and a fabricated zero would poison the histograms."""
+        from tony_tpu.serve.agent import result_from_doc
+
+        res = result_from_doc({**resp["result"], "id": request.id})
+        ticket = Ticket(request, None)
+        ticket.tier = request.priority if request.priority \
+            else DEFAULT_TIER
+        ticket.tenant = request.tenant
+        metrics = {
+            "id": request.id, "recovered": True,
+            "tokens_in": len(res.prompt),
+            "tokens_out": len(res.tokens),
+            "finish_reason": res.finish_reason,
+            "attempts": 0,
+        }
+        with ticket._emit_lock:
+            ticket._tokens = list(res.tokens)
+            ticket._n_emitted = len(res.tokens)
+            ticket.metrics = metrics
+            ticket.state = DONE
+            ticket.t_terminal = time.monotonic()
+            ticket._emit(("done", res, metrics))
+        self._register_resume(ticket)
+        if self.journal is not None:
+            # admit + done into the NEW journal: a second crash must
+            # not try to adopt a session this boot already closed
+            self.journal.admit(request.id, entry.request or {},
+                               time.time())
+            self.journal.done(request.id)
+
+    def kill(self) -> None:
+        """Die the way SIGKILL would — for chaos harnesses that crash
+        an IN-PROCESS gateway (bench extras.recovery): no drain, no
+        journal compaction (the WAL must survive exactly as the crash
+        left it), and above all NO agent resets or epoch bumps — a
+        dead process cannot POST /v1/reset, so neither may this path,
+        or it would wipe the very parked sessions recovery exists to
+        adopt. Remote transports are closed FIRST so any replica
+        thread racing into its breaker sees a dead wire (logged,
+        harmless), exactly like the real thing."""
+        for loop in (self.scaler, self.rebalancer, self._alert_loop,
+                     self._autotune_loop):
+            if loop is not None:
+                try:
+                    loop.stop()
+                except Exception:
+                    pass
+        wd = self._watchdog
+        self._watchdog = None
+        if wd is not None:
+            wd.stop()
+        self._closed = True
+        for r in self.replicas:
+            server = r.server
+            if server is not None \
+                    and getattr(server, "transport", None) is not None:
+                try:
+                    server.close(drain_agent=False)
+                except Exception:
+                    pass
+        for r in self.replicas:
+            with r.cv:
+                r._stop = True
+                r._tickets.clear()
+                r.queue.steal_all()
+                r.outstanding = 0
+                r.cv.notify_all()
+        for r in self.replicas:
+            r.join(2.0)
+        if self.journal is not None:
+            self.journal.close()  # flush, never compact
+        with self._resume_lock:
+            self._resume.clear()
+        self._drain_done = False
+
     def _shed_ticket(self, replica: _Replica, ticket: Ticket,
                      status: int, reason: str,
                      exc: type | None = None) -> None:
@@ -2898,9 +3402,14 @@ class Gateway:
             # event the client treats as final
             ticket.state = SHED
             ticket._shed_exc_cls = exc
+            ticket.t_terminal = time.monotonic()
+            ticket._shed_status = status
+            ticket._shed_reason = reason
             replica.shed += 1
             self._record_shed(replica, status, tier=ticket.tier)
             ticket._emit(("shed", status, reason))
+        if ticket._journal is not None:
+            ticket._journal.shed(ticket.request.id, status)
 
     def _note_probe(self, replica: _Replica) -> None:
         with self.stats.lock:
@@ -3109,8 +3618,24 @@ class Gateway:
                 "migrations": self.stats.migrations,
                 "migrate_lease_adoptions":
                     self.stats.migrate_lease_adoptions,
+                "park_adoptions": self.stats.park_adoptions,
                 "roles": {r.index: r.role for r in live}
                 if self.roles else None,
+            }
+            # crash recovery (ISSUE-20): journaling state + what the
+            # last --recover boot did — always present so a dashboard
+            # can pin "journal on, 0 recoveries" as the healthy shape
+            with self._resume_lock:
+                n_resume = len(self._resume)
+            out["recovery"] = {
+                "journal": self.journal is not None,
+                "resumable": n_resume,
+                "recoveries": self.stats.recoveries,
+                "sessions_adopted": self.stats.sessions_adopted,
+                "sessions_rerun": self.stats.sessions_rerun,
+                "recovered_finished": self.stats.recovered_finished,
+                "recovery_wall_ms": round(
+                    self.stats.recovery_wall_ms, 3),
             }
         with self.stats.lock:
             tiers = sorted(set(self.stats.completed_by_tier)

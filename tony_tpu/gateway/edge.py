@@ -62,6 +62,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
+from urllib.parse import parse_qsl, unquote
 
 from tony_tpu.gateway.core import Gateway, Shed
 from tony_tpu.gateway.http import (STREAM_KEEPALIVE_S, finish_doc,
@@ -425,6 +426,8 @@ class GatewayEdge:
                     200, text.encode(),
                     "text/plain; version=0.0.4; charset=utf-8"))
                 return False
+            if path.startswith("/v1/stream/"):
+                return await self._resume(path, query, writer)
             route = await loop.run_in_executor(
                 self._pool, get_route, self.gateway, path)
             if route is None:
@@ -494,6 +497,101 @@ class GatewayEdge:
             return await self._respond_unary(ticket, q, writer)
         finally:
             aborted.set()  # detach: late events have no reader
+
+    # ---------------------------------------------------------- resume
+
+    async def _resume(self, path: str, query: str, writer) -> bool:
+        """GET /v1/stream/<request_id>?offset=N (ISSUE-20): re-attach
+        to a request's absolute token sequence. The gateway's
+        ``resume_events`` is a blocking poll generator; parking it on
+        the tiny shared executor would starve routing, so each resume
+        gets a dedicated daemon pump thread that forwards docs onto an
+        asyncio queue (same call_soon_threadsafe handoff as the
+        generate path) and stops at the terminal line or when the
+        watcher disconnects."""
+        rid = unquote(path[len("/v1/stream/"):])
+        if not rid:
+            await self._write(writer,
+                              _json_response(404, {"error": "not found"}))
+            return True
+        offset = 0
+        for key, val in parse_qsl(query):
+            if key == "offset":
+                try:
+                    offset = int(val)
+                except ValueError:
+                    await self._write(writer, _json_response(
+                        400, {"error": "offset must be an integer"}))
+                    return True
+        if offset < 0:
+            await self._write(writer, _json_response(
+                400, {"error": "offset must be >= 0"}))
+            return True
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        aborted = threading.Event()
+
+        def pump():
+            gen = self.gateway.resume_events(
+                rid, offset, keepalive_s=self.keepalive_s)
+            try:
+                for doc in gen:
+                    if aborted.is_set():
+                        return
+                    try:
+                        loop.call_soon_threadsafe(q.put_nowait, doc)
+                    except RuntimeError:
+                        return  # loop closed mid-shutdown
+                    if doc.get("gone") or doc.get("done") \
+                            or doc.get("shed"):
+                        return
+            finally:
+                try:
+                    loop.call_soon_threadsafe(q.put_nowait, None)
+                except RuntimeError:
+                    pass
+
+        threading.Thread(target=pump, daemon=True,
+                         name=f"resume-{rid[:12]}").start()
+        try:
+            first = await q.get()
+            if first is None or first.get("gone"):
+                await self._write(writer, _json_response(
+                    404,
+                    {"error": f"unknown or reaped request {rid!r}"}))
+                return True
+            st = self.stats
+            st.active_streams += 1
+            try:
+                await self._write(writer, _STREAM_HEAD)
+                doc = first
+                while doc is not None:
+                    if doc.get("shed"):
+                        await self._write(writer, _chunk(
+                            {"id": rid, "request_id": rid,
+                             "error": doc.get("reason", "shed"),
+                             "status": doc.get("status", 503)})
+                            + b"0\r\n\r\n")
+                        return True
+                    if doc.get("done"):
+                        await self._write(writer, _chunk(
+                            {"id": rid, "request_id": rid, "done": True,
+                             "metrics": doc.get("metrics") or {}})
+                            + b"0\r\n\r\n")
+                        return False
+                    if doc.get("keepalive"):
+                        st.keepalives_sent += 1
+                    doc.setdefault("id", rid)
+                    doc.setdefault("request_id", rid)
+                    await self._write(writer, _chunk(doc))
+                    doc = await q.get()
+                # pump died without a terminal line (shutdown): close
+                await self._write(writer, b"0\r\n\r\n")
+                return True
+            finally:
+                st.active_streams -= 1
+        finally:
+            aborted.set()  # detach: the pump stops at its next doc
 
     async def _respond_unary(self, ticket, q, writer) -> bool:
         """Unary waits on the SAME event queue the stream path uses —

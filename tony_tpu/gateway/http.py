@@ -38,6 +38,17 @@ between them. Endpoints:
                       numbers /stats carries: counters, gauges, and
                       lifetime TTFT/TPOT/queue-wait/e2e histograms —
                       what an autoscaler or scrape agent consumes
+  GET  /v1/stream/<request_id>?offset=N   resume a stream (ISSUE-20):
+                      chunked NDJSON of the request's ABSOLUTE token
+                      sequence from offset N — {"request_id",
+                      "offset", "token_ids"} windows, keepalives, then
+                      the terminal {"done": true, "metrics"} line (or
+                      the shed line with its status/reason). Works for
+                      any admitted request — a dropped connection, a
+                      second watcher, or a client reconnecting after a
+                      gateway crash+--recover all land here; finished
+                      requests stay resumable for --park-ttl. Unknown
+                      or reaped ids 404.
   GET  /debug/trace   {"request_ids": [...]} — recently traced requests
   GET  /debug/traces  the browsable listing: buffered trace ids PLUS
                       terminal tags (outcome, finish_reason, tokens,
@@ -99,6 +110,7 @@ lines (tests pin this).
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import math
@@ -325,11 +337,13 @@ class GatewayHandler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------- GET
 
     def do_GET(self):
-        path = self.path.partition("?")[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
             from tony_tpu.obs import prometheus_text
 
             return self._send_text(200, prometheus_text(self.gateway))
+        if path.startswith("/v1/stream/"):
+            return self._respond_resume(path, query)
         route = get_route(self.gateway, path)
         if route is None:
             return self._send(404, {"error": "not found"})
@@ -447,6 +461,53 @@ class GatewayHandler(BaseHTTPRequestHandler):
                 else:
                     self._send(status, {"error": reason})
                 return
+
+    def _respond_resume(self, path: str, query: str) -> None:
+        """GET /v1/stream/<request_id>?offset=N — re-attach to a live
+        (or recently finished) request's ABSOLUTE token sequence from
+        offset N. Unlike _respond_stream this never consumes the
+        ticket's single-consumer event queue: it polls the resume
+        buffer, so any number of watchers (including a client
+        reconnecting after a gateway crash + --recover) can follow the
+        same request without stealing each other's deltas."""
+        rid = unquote(path[len("/v1/stream/"):])
+        if not rid:
+            return self._send(404, {"error": "not found"})
+        offset = 0
+        for key, val in parse_qsl(query):
+            if key == "offset":
+                try:
+                    offset = int(val)
+                except ValueError:
+                    return self._send(
+                        400, {"error": "offset must be an integer"})
+        if offset < 0:
+            return self._send(400, {"error": "offset must be >= 0"})
+        gen = self.gateway.resume_events(rid, offset,
+                                         keepalive_s=self.keepalive_s)
+        first = next(gen)
+        if first.get("gone"):
+            return self._send(
+                404, {"error": f"unknown or reaped request {rid!r}"})
+        try:
+            self._start_stream()
+            for doc in itertools.chain([first], gen):
+                if doc.get("shed"):
+                    self._chunk({"id": rid, "request_id": rid,
+                                 "error": doc.get("reason", "shed"),
+                                 "status": doc.get("status", 503)})
+                    break
+                if doc.get("done"):
+                    self._chunk({"id": rid, "request_id": rid,
+                                 "done": True,
+                                 "metrics": doc.get("metrics") or {}})
+                    break
+                doc.setdefault("id", rid)
+                doc.setdefault("request_id", rid)
+                self._chunk(doc)
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # watcher went away; the request itself is unaffected
 
     def _start_stream(self) -> None:
         self.send_response(200)

@@ -460,6 +460,7 @@ class RemoteServer:
         self._closed = False
         self._on_dead = None
         self._monitor = None
+        self._lease_paused = False  # recovery masks expiries (ISSUE-20)
         self._hb_thread: threading.Thread | None = None
         # transport observability
         self._stats_lock = threading.Lock()
@@ -761,7 +762,28 @@ class RemoteServer:
                     self._obs_stream_seen)[-4096:])
         self.timeline.push(records, summary or {})
 
+    def pause_lease(self) -> None:
+        """Mask lease expiries — crash recovery's adopt calls can hold
+        the ONE control connection for whole seconds (a freeze-for-
+        adopt waits out the engine's current dispatch), starving the
+        heartbeat GETs behind them; expiring the lease for that would
+        fail over the very replica recovery is adopting from. Paused
+        expiries re-arm the entry instead of firing the supervisor."""
+        self._lease_paused = True
+
+    def resume_lease(self) -> None:
+        self._lease_paused = False
+        if self._monitor is not None:
+            self._monitor.register("agent")
+
     def _lease_expired(self, task_id: str) -> None:
+        if getattr(self, "_lease_paused", False):
+            log.info("agent %s lease lapsed during recovery — masked "
+                     "(control connection busy with adopts)",
+                     self.host_addr)
+            if self._monitor is not None:
+                self._monitor.register("agent")
+            return
         reason = (f"agent {self.host_addr} lease expired: no heartbeat "
                   f"for {self.lease_s:.1f}s")
         with self._stats_lock:
@@ -807,6 +829,13 @@ class RemoteServer:
             "temperature": request.temperature, "top_k": request.top_k,
             "seed": request.seed, "epoch": self.epoch,
         }
+        # the GATEWAY request id (ISSUE-20), distinct from the
+        # per-replica engine id above: the agent parks orphaned
+        # sessions under it, so a RESTARTED gateway — which only
+        # remembers its own journal's ids — can adopt them back
+        rid = getattr(request, "rid", None)
+        if rid is not None:
+            doc["rid"] = rid
         path = "/v1/submit"
         if request.prefill_only:
             doc["prefill_only"] = True
@@ -1017,6 +1046,50 @@ class RemoteServer:
             if t is not None:
                 t.confirmed = confirmed
                 self._cond.notify_all()
+
+    # ------------------------------------- restart recovery (ISSUE-20)
+
+    def list_parked(self) -> list:
+        """GET /v1/parked: the sessions this agent would hand a
+        (re)connecting gateway — parked orphan snapshots plus
+        finished-but-undelivered results. Read-only, no epoch fence."""
+        resp = self.transport.call("GET", "/v1/parked", None,
+                                   epoch=self.epoch)
+        return list(resp.get("parked") or [])
+
+    def adopt_parked(self, rid):
+        """POST /v1/adopt: take one parked session back by GATEWAY
+        request id. Returns the raw response doc — ``snapshot`` (wire
+        form, feed it to a requeue as ``request.migrate``) or
+        ``finished`` + ``result`` — or None on 404 (unknown/reaped:
+        the caller re-runs from the prompt). 409 (a second adopter on
+        a stale epoch) raises ConnectionError like every other fenced
+        call."""
+        try:
+            resp = self.transport.call(
+                "POST", "/v1/adopt", {"id": rid, "epoch": self.epoch},
+                epoch=self.epoch, request=rid,
+                timeout=max(self.transport.read_timeout_s, 30.0))
+        except AgentHTTPError as e:
+            if e.status == 404:
+                return None
+            if e.status == 409:
+                with self._stats_lock:
+                    self.stale_epoch_drops += 1
+            raise ConnectionError(str(e)) from e
+        return resp if resp.get("found") else None
+
+    def sync_recovery_epoch(self) -> int:
+        """Fence out the PREVIOUS gateway incarnation: read the
+        agent's current epoch off /healthz and adopt one past it, so
+        our first fenced call bumps the agent forward and any stale
+        stream line (or a second recovering gateway racing us on the
+        old epoch) is refused by the ordinary PR-5/11 machinery. A
+        recovering gateway must NOT ``reset()`` — that would wipe the
+        very tickets and parked sessions it came back for."""
+        hz = self.transport.call("GET", "/healthz", None)
+        self.epoch = max(self.epoch, int(hz.get("epoch", 0)) + 1)
+        return self.epoch
 
     def _ensure_channel(self) -> None:
         with self._stats_lock:

@@ -45,6 +45,9 @@ Default rules (thresholds overridable via ``default_rules()``):
 | ``goodput_collapse``  | per-tick useful fraction under               |
 |                       | ``collapse_frac`` x its trailing baseline    |
 |                       | while tokens are flowing                     |
+| ``gateway_recovery``  | the gateway restarted through ``--recover``  |
+|                       | within the last ``recovery_recent_s``        |
+|                       | (repeat firings = a crash loop)              |
 """
 
 from __future__ import annotations
@@ -276,7 +279,13 @@ class ShedStormRule(Rule):
     ``BreakerFlapRule``: a fixed-length ring at sub-second alert
     intervals would silently shrink the window. Before this rule, a
     storm's sheds moved /stats and the autoscaler but never the alert
-    bus — the one surface operators actually page on."""
+    bus — the one surface operators actually page on.
+
+    Counts BOTH planes (ISSUE-20 satellite, closing the ROADMAP-3
+    residue): admission-layer capacity sheds AND the network edge's
+    connection-cap 429s (``edge_conn_limit_sheds``) — a pure
+    connection storm bounces off the edge without ever reaching
+    admission, and used to be invisible here."""
 
     def __init__(self, storm_count: int = 50,
                  storm_window_s: float = 10.0, **kw):
@@ -285,11 +294,12 @@ class ShedStormRule(Rule):
                          message="capacity sheds storming", **kw)
         self.storm_count = max(1, storm_count)
         self.storm_window_s = storm_window_s
-        self._samples: deque = deque()  # (t, shed_capacity_total)
+        self._samples: deque = deque()  # (t, sheds incl. edge)
 
     def evaluate(self, signals):
         now = signals.get("now", time.monotonic())
-        shed = signals.get("shed_capacity_total", 0)
+        shed = signals.get("shed_capacity_total", 0) \
+            + signals.get("edge_conn_limit_sheds", 0)
         self._samples.append((now, shed))
         horizon = now - self.storm_window_s
         while self._samples and self._samples[0][0] < horizon:
@@ -299,6 +309,28 @@ class ShedStormRule(Rule):
             return {"sheds_in_window": recent,
                     "window_s": self.storm_window_s,
                     "threshold": self.storm_count}
+        return None
+
+
+class GatewayRecoveryRule(Rule):
+    """The gateway came back from a CRASH (``--recover`` replayed a
+    journal) within the last ``recent_s`` — informational, but an
+    operator should KNOW the process died and restarted even when
+    recovery made it invisible to clients: repeated firings are a
+    crash loop. Fires immediately (``fire_after=1``) and resolves on
+    its own once the recovery ages out of the window."""
+
+    def __init__(self, recent_s: float = 60.0, **kw):
+        kw.setdefault("severity", "warning")
+        super().__init__("gateway_recovery",
+                         message="gateway restarted from crash "
+                                 "recovery", **kw)
+        self.recent_s = recent_s
+
+    def evaluate(self, signals):
+        ago = signals.get("recovered_ago_s")
+        if ago is not None and ago <= self.recent_s:
+            return {"recovered_ago_s": ago}
         return None
 
 
@@ -384,6 +416,8 @@ def default_rules(thresholds: dict | None = None) -> list[Rule]:
                       storm_window_s=t.get("shed_storm_window_s", 10.0)),
         GoodputCollapseRule(
             collapse_frac=t.get("collapse_frac", 0.5)),
+        GatewayRecoveryRule(
+            recent_s=t.get("recovery_recent_s", 60.0)),
     ]
 
 

@@ -48,6 +48,19 @@ behind ``gateway/remote.RemoteServer``):
                       ticket (the stream continues from the adopting
                       replica), and the session's pages/sampler state
                       leave in wire form.
+  GET  /v1/parked     orphaned-session parking (ISSUE-20): every
+                      session a (re)connecting gateway can adopt —
+                      in-flight slots frozen by the gateway-liveness
+                      watchdog (the gateway's heartbeat went silent
+                      past ``gateway_grace_s``) plus finished-but-
+                      undelivered results, each held ``park_ttl_s``.
+  POST /v1/adopt      ``{"id": rid, "epoch"}`` -> the parked session's
+                      wire snapshot (or its finished result) — the
+                      restart-recovery hand-off. The epoch fence is
+                      the double-adopt guard: a second gateway on a
+                      stale epoch gets 409, never a second copy; an
+                      unknown/reaped rid gets 404 and the caller
+                      re-runs from the prompt.
   POST /v1/reset      ``{"epoch"}``: adopt the (newer) epoch, hard-
                       reset the engine, drop every ticket — the
                       gateway's breaker recovery calls this before a
@@ -123,7 +136,10 @@ log = logging.getLogger(__name__)
 # how long a finished ticket's tokens+result stay fetchable, so a
 # client that lost its connection right before the done line can
 # reconnect and still collect the result (resume-by-offset covers the
-# tokens; this covers the terminal line)
+# tokens; this covers the terminal line). ISSUE-20 generalizes this
+# into the agent's PARK TTL: orphaned in-flight sessions (gateway
+# lease gone silent) freeze into wire snapshots and stay adoptable
+# for the same window.
 FINISHED_KEEP_S = 60.0
 
 
@@ -138,14 +154,26 @@ class _Ticket:
     terminal-line fragment gather scans only the request's own tail of
     the ring, never the whole ring."""
 
-    __slots__ = ("id", "tokens", "result", "t_done", "seq0")
+    __slots__ = ("id", "tokens", "result", "t_done", "seq0", "rid",
+                 "epoch")
 
-    def __init__(self, request_id, seq0: int = 0):
+    def __init__(self, request_id, seq0: int = 0, rid=None,
+                 epoch: int = 0):
         self.id = request_id
         self.tokens: list[int] = []
         self.result: dict | None = None
         self.t_done: float | None = None
         self.seq0 = seq0
+        # the GATEWAY's request id (ISSUE-20), when the submit carried
+        # one — the agent keys tickets by the gateway's per-replica
+        # engine id, but parking must be addressable by the id a
+        # RESTARTED gateway still knows: the one in its journal
+        self.rid = rid
+        # the epoch the submit arrived under: the idempotence guard is
+        # scoped to it, because a RESTARTED gateway's engine-id counter
+        # starts over — its id 1 colliding with the dead incarnation's
+        # finished-but-retained id 1 is a fresh request, not a retry
+        self.epoch = epoch
 
 
 def result_doc(res: Result) -> dict:
@@ -203,12 +231,25 @@ class ReplicaAgent:
 
     def __init__(self, server: Server, *, agent_id: str | None = None,
                  keepalive_s: float = 0.5,
-                 profile_dir: str | None = None):
+                 profile_dir: str | None = None,
+                 park_ttl_s: float | None = None,
+                 gateway_grace_s: float = 0.0):
         from tony_tpu.profiler import ServeProfiler
 
         self.server = server
         self.agent_id = agent_id or f"agent-{uuid.uuid4().hex[:8]}"
         self.keepalive_s = max(0.05, keepalive_s)
+        # orphaned-session parking (ISSUE-20): how long a parked
+        # snapshot or finished-but-undelivered result stays adoptable
+        # (generalizes FINISHED_KEEP_S), and how long the gateway may
+        # go silent before in-flight slots freeze into parked
+        # snapshots instead of decoding into the void (0 = watchdog
+        # off: slots run to completion and park as finished results)
+        self.park_ttl_s = FINISHED_KEEP_S if park_ttl_s is None \
+            else max(1.0, float(park_ttl_s))
+        self.gateway_grace_s = max(0.0, float(gateway_grace_s))
+        self._last_contact = time.monotonic()
+        self._parked: dict = {}  # rid -> {snapshot, epoch, offset, t_park}
         # on-demand xplane captures (POST /v1/profile — the remote half
         # of the gateway's /debug/profile fan-out): polled once per
         # WORKING stepper iteration; an un-armed poll is one attribute
@@ -251,6 +292,10 @@ class ReplicaAgent:
     def check_epoch(self, epoch: int) -> None:
         """Adopt a newer epoch, refuse an older one (409 upstream).
         Under the condition lock so adopt-vs-adopt can't interleave."""
+        # every epoch-carrying call is gateway contact: the parking
+        # watchdog's liveness signal (ISSUE-20). A STALE call counts
+        # too — a gateway on an old epoch is alive, just fenced.
+        self._last_contact = time.monotonic()
         with self._cond:
             if epoch < self.epoch:
                 raise _StaleEpoch(
@@ -285,14 +330,23 @@ class ReplicaAgent:
             # prefill and resumes decode at the exact position
             migrate=doc.get("migrate"))
         with self._cond:
-            # IDEMPOTENT on the request id: the stub retries connect
-            # errors, and a reset that lands after the agent processed
-            # the submit but before the stub read the 200 would
-            # otherwise enqueue the same request twice (double slot +
-            # page consumption under one id)
-            if req.id in self._tickets:
+            # IDEMPOTENT on the request id WITHIN the epoch: the stub
+            # retries connect errors, and a reset that lands after the
+            # agent processed the submit but before the stub read the
+            # 200 would otherwise enqueue the same request twice
+            # (double slot + page consumption under one id). A
+            # colliding id under an OLDER epoch is a different gateway
+            # incarnation (ISSUE-20: a restarted gateway's engine-id
+            # counter starts over, and finished tickets of the dead
+            # one linger for the reconnect grace) — evict the stale
+            # record and admit fresh, or the recovered dispatch would
+            # stream a dead gateway's result
+            held = self._tickets.get(req.id)
+            if held is not None and held.epoch >= self.epoch:
                 return {"ok": True, "id": req.id, "epoch": self.epoch,
                         "duplicate": True}
+            if held is not None:
+                del self._tickets[req.id]
             # ticket registered UNDER the lock before the engine sees
             # the request: a stream connecting right after the 200 must
             # find it. seq0 read BEFORE the engine submit: any record
@@ -301,7 +355,9 @@ class ReplicaAgent:
             seq0 = tl.seq if tl is not None else 0
             self.server.submit(req)  # engine submit() is thread-safe;
             # inside our lock only to pair with the ticket insert
-            self._tickets[req.id] = _Ticket(req.id, seq0)
+            self._tickets[req.id] = _Ticket(req.id, seq0,
+                                            rid=doc.get("rid"),
+                                            epoch=self.epoch)
             self._cond.notify_all()
         return {"ok": True, "id": req.id, "epoch": self.epoch}
 
@@ -332,6 +388,10 @@ class ReplicaAgent:
         return {"drained": bool(ok), "epoch": self.epoch}
 
     def healthz(self) -> dict:
+        # the heartbeat IS the gateway's liveness signal to us: the
+        # inverse of the PR-11 lease (they watch our stepper_age_s,
+        # we watch their heartbeat cadence)
+        self._last_contact = time.monotonic()
         server = self.server
         return {
             "ok": self.failed is None,
@@ -353,6 +413,8 @@ class ReplicaAgent:
             # ...] of cached prefixes, so the gateway's prefix-affinity
             # probe can score THIS remote replica instead of assuming 0
             "prefix_summary": server.prefix_summary(),
+            "n_parked": len(self._parked),
+            "park_ttl_s": self.park_ttl_s,
             "counters": server.counters(),
             # this process's monotonic clock, read in-handler: the
             # gateway brackets the call and estimates the clock offset
@@ -386,6 +448,141 @@ class ReplicaAgent:
             self._cond.notify_all()
         return {"found": True, "snapshot": snapshot_to_doc(snap),
                 "epoch": self.epoch}
+
+    # ------------------------------------- orphan parking (ISSUE-20)
+
+    def parked(self) -> dict:
+        """GET /v1/parked: every session a (re)connecting gateway can
+        adopt — frozen in-flight snapshots AND finished-but-undelivered
+        results (both held through the park TTL). No epoch fence:
+        listing is read-only, and a recovering gateway needs it BEFORE
+        it knows what epoch to adopt with."""
+        now = time.monotonic()
+        with self._cond:
+            rows = [{"rid": rid, "epoch": p["epoch"],
+                     "offset": p["offset"], "finished": False,
+                     "age_s": round(now - p["t_park"], 3)}
+                    for rid, p in self._parked.items()]
+            rows += [{"rid": t.rid if t.rid is not None else t.id,
+                      "epoch": self.epoch, "offset": len(t.tokens),
+                      "finished": True,
+                      "age_s": round(now - t.t_done, 3)}
+                     for t in self._tickets.values()
+                     if t.result is not None]
+        return {"parked": rows, "epoch": self.epoch,
+                "park_ttl_s": self.park_ttl_s}
+
+    def adopt(self, doc: dict) -> dict:
+        """POST /v1/adopt ``{"id": rid, "epoch"}``: hand one parked
+        session to the calling gateway. The epoch fence IS the
+        double-adopt guard: the first adopter arrives with a bumped
+        epoch the agent adopts; a second gateway still on the old one
+        gets 409, never a second copy. Resolution order — a parked
+        snapshot, then a still-live slot (frozen on the spot, so a
+        recovering gateway never waits out the watchdog grace), then a
+        finished-but-undelivered result; ``found: false`` (404
+        upstream) when the rid is unknown or the TTL already reaped
+        it, and the caller re-runs from the prompt."""
+        from tony_tpu.serve.migrate import snapshot_to_doc
+
+        self.check_epoch(int(doc.get("epoch", 0)))
+        rid = doc.get("id")
+        with self._cond:
+            p = self._parked.pop(rid, None)
+        if p is not None:
+            return {"found": True, "snapshot": p["snapshot"],
+                    "offset": p["offset"], "epoch": self.epoch}
+        engine_id = finished = None
+        with self._cond:
+            for t in self._tickets.values():
+                if t.rid == rid or t.id == rid:
+                    if t.result is not None:
+                        finished = t
+                    else:
+                        engine_id = t.id
+                    break
+        if finished is not None:
+            with self._cond:
+                self._tickets.pop(finished.id, None)
+                self._cond.notify_all()
+            return {"found": True, "finished": True,
+                    "result": finished.result, "epoch": self.epoch}
+        if engine_id is not None:
+            snap = self.server.extract_session(engine_id, wire=True)
+            if snap is not None:
+                with self._cond:
+                    self._tickets.pop(engine_id, None)
+                    self._cond.notify_all()
+                return {"found": True,
+                        "snapshot": snapshot_to_doc(snap),
+                        "offset": len(snap.generated),
+                        "epoch": self.epoch}
+        return {"found": False, "epoch": self.epoch}
+
+    def _watchdog_tick(self) -> None:
+        """One stepper-loop beat of the parking machinery: reap parked
+        entries past the TTL (the pages they held were gathered to
+        host memory at freeze time — reaping is a dict delete), then
+        freeze orphans once the gateway has been silent past the
+        grace."""
+        now = time.monotonic()
+        with self._cond:
+            dead = [rid for rid, p in self._parked.items()
+                    if now - p["t_park"] > self.park_ttl_s]
+            for rid in dead:
+                del self._parked[rid]
+        if dead:
+            log.info("agent %s reaped %d parked session(s) past the "
+                     "%.0fs park TTL", self.agent_id, len(dead),
+                     self.park_ttl_s)
+        if self.gateway_grace_s <= 0 or self.draining \
+                or self.failed is not None:
+            return
+        if now - self._last_contact <= self.gateway_grace_s:
+            return
+        self._park_orphans()
+
+    def _park_orphans(self) -> None:
+        """Freeze every live decode slot into a parked wire snapshot —
+        the gateway lease went silent, so instead of decoding into the
+        void (and then aborting), the sessions park token-exact and
+        wait for a recovering gateway's /v1/adopt. Runs on the stepper
+        thread; ``extract_session`` lands each freeze at a dispatch
+        boundary. Requests still pending (no slot yet) keep running
+        and park later — as live slots on a future tick, or as
+        finished-but-undelivered results."""
+        from tony_tpu.serve.migrate import snapshot_to_doc
+
+        with self._cond:
+            live = [(t.id, t.rid) for t in self._tickets.values()
+                    if t.result is None]
+        n = 0
+        for engine_id, rid in live:
+            try:
+                snap = self.server.extract_session(engine_id, wire=True)
+            except Exception:
+                log.exception("freeze-for-parking failed (%r)",
+                              engine_id)
+                continue
+            if snap is None:
+                continue  # pending / mid-prefill: nothing frozen yet
+            key = rid if rid is not None else engine_id
+            with self._cond:
+                self._parked[key] = {
+                    "snapshot": snapshot_to_doc(snap),
+                    "epoch": self.epoch,
+                    "offset": len(snap.generated),
+                    "t_park": time.monotonic(),
+                }
+                self._tickets.pop(engine_id, None)
+                self._cond.notify_all()
+            n += 1
+        if n:
+            log.warning(
+                "agent %s: gateway silent %.1fs — parked %d in-flight "
+                "session(s) (TTL %.0fs)", self.agent_id,
+                time.monotonic() - self._last_contact, n,
+                self.park_ttl_s)
 
     def obs(self, cursor: int) -> dict:
         """GET /v1/obs payload: incremental timeline records past
@@ -443,6 +640,10 @@ class ReplicaAgent:
     def _loop(self) -> None:
         while not self._stop.is_set():
             self.last_step_beat = time.monotonic()
+            # BEFORE the idle short-circuit: a fully-parked agent is
+            # idle (its slots were extracted), but the TTL reap and
+            # the gateway-liveness watchdog must still run
+            self._watchdog_tick()
             with self._cond:
                 cmds, self._cmds = self._cmds, []
                 busy = bool(self.server.n_active or self.server.n_pending)
@@ -508,9 +709,10 @@ class ReplicaAgent:
                     t.result = result_doc(res)
                     t.t_done = now
                 # prune finished tickets past the reconnect grace
+                # (the park TTL, ISSUE-20 — FINISHED_KEEP_S default)
                 for rid in [rid for rid, t in self._tickets.items()
                             if t.t_done is not None
-                            and now - t.t_done > FINISHED_KEEP_S]:
+                            and now - t.t_done > self.park_ttl_s]:
                     del self._tickets[rid]
                 self._cond.notify_all()
 
@@ -526,6 +728,10 @@ class ReplicaAgent:
         offset = max(0, int(offset))
         last_emit = time.monotonic()
         while True:
+            # each lap follows a frame the caller consumed (or is the
+            # first): a gateway actively reading this stream is NOT
+            # silent — refresh the parking watchdog's liveness signal
+            self._last_contact = time.monotonic()
             with self._cond:
                 t = self._tickets.get(request_id)
                 if t is None:
@@ -542,12 +748,22 @@ class ReplicaAgent:
                     yield {"error": self.failed, "failed": True,
                            "epoch": self.epoch}
                     return
-                tokens = t.tokens[offset:]
-                result = t.result
-                if not tokens and result is None:
+                if t.epoch != epoch:
+                    # a DEAD incarnation's leftover still holds this
+                    # engine id (restarted gateways restart their id
+                    # counters; finished tickets are retained a park
+                    # TTL for reconnects): serving ITS tokens would
+                    # hand the caller another request's output. The
+                    # fresh submit that evicts it is in flight — wait.
                     self._cond.wait(timeout=self.keepalive_s)
+                    tokens, result = [], None
+                else:
                     tokens = t.tokens[offset:]
                     result = t.result
+                    if not tokens and result is None:
+                        self._cond.wait(timeout=self.keepalive_s)
+                        tokens = t.tokens[offset:]
+                        result = t.result
             if tokens:
                 yield {"offset": offset, "token_ids": tokens,
                        "epoch": self.epoch}
@@ -599,13 +815,23 @@ class ReplicaAgent:
         offsets = {rid: max(0, int(off)) for rid, off in resume.items()}
         with self._cond:
             # finished tickets the caller did not ask to resume were
-            # delivered before this channel opened — never re-stream
+            # delivered before this channel opened — never re-stream.
+            # Epoch-scoped: a DEAD incarnation's finished ticket under
+            # a colliding id must not block the fresh ticket that will
+            # evict it from ever joining this channel.
             done_sent = {rid for rid, t in self._tickets.items()
-                         if t.result is not None and rid not in offsets}
+                         if t.result is not None and rid not in offsets
+                         and t.epoch == epoch}
         yield {"channel": True, "resumed": len(offsets),
                "epoch": self.epoch}
         last_emit = time.monotonic()
         while True:
+            # each lap follows frames the gateway's demux consumed: an
+            # actively-read channel IS gateway contact — refresh the
+            # parking watchdog so slow control calls (a wedged adopt
+            # monopolizing the control connection) can't orphan
+            # sessions the gateway is demonstrably streaming
+            self._last_contact = time.monotonic()
             token_frames: list = []
             done_rids: list = []
             terminal: dict | None = None
@@ -617,9 +843,12 @@ class ReplicaAgent:
                     terminal = {"error": self.failed, "failed": True,
                                 "epoch": self.epoch}
                 else:
-                    # new submits join the channel from offset 0
-                    for rid in self._tickets:
-                        if rid not in offsets and rid not in done_sent:
+                    # new submits join the channel from offset 0 —
+                    # only THIS epoch's; a dead incarnation's retained
+                    # tickets are adopt/reconnect state, not streams
+                    for rid, t in self._tickets.items():
+                        if rid not in offsets and rid not in done_sent \
+                                and t.epoch == epoch:
                             offsets[rid] = 0
                     for rid in list(offsets):
                         t = self._tickets.get(rid)
@@ -631,6 +860,15 @@ class ReplicaAgent:
                                 {"rid": rid, "gone": True,
                                  "epoch": self.epoch})
                             del offsets[rid]
+                            continue
+                        if t.epoch != epoch:
+                            # a stale-incarnation leftover under an id
+                            # the stub's resume named: the in-flight
+                            # submit that evicts it hasn't landed yet.
+                            # Serving its tokens/result would deliver
+                            # ANOTHER request's output onto this one —
+                            # skip the lap; the fresh ticket replaces
+                            # it at this same id momentarily.
                             continue
                         off = offsets[rid]
                         tokens = t.tokens[off:]
@@ -700,6 +938,8 @@ class AgentHandler(BaseHTTPRequestHandler):
             return self._send(200, self.agent.obs(cursor))
         if path == "/v1/profile":
             return self._send(200, self.agent.profiler.status())
+        if path == "/v1/parked":
+            return self._send(200, self.agent.parked())
         if path.startswith("/v1/stream/"):
             return self._stream(unquote(path[len("/v1/stream/"):]),
                                 dict(parse_qsl(query)))
@@ -737,6 +977,24 @@ class AgentHandler(BaseHTTPRequestHandler):
                 return self._send(400, {"error": "migrate_in body "
                                         "needs a 'migrate' snapshot"})
             return self._submit(body)
+        if path == "/v1/adopt":
+            # restart recovery's hand-off (ISSUE-20): a parked (or
+            # still-live, or finished-undelivered) session leaves for
+            # the calling gateway. 404 = unknown/reaped (caller
+            # re-runs from the prompt); 409 = the epoch fence caught
+            # a second adopter on a stale epoch
+            try:
+                out = self.agent.adopt(body)
+            except _StaleEpoch as e:
+                return self._send(409, {"error": str(e),
+                                        "epoch": self.agent.epoch})
+            except (ValueError, TypeError, KeyError) as e:
+                return self._send(400, {"error": str(e),
+                                        "kind": "ValueError"})
+            except RuntimeError as e:
+                return self._send(503, {"error": str(e),
+                                        "kind": "Unavailable"})
+            return self._send(200 if out.get("found") else 404, out)
         if path == "/v1/migrate_out":
             try:
                 return self._send(200, self.agent.migrate_out(body))

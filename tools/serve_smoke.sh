@@ -51,6 +51,13 @@
 # no-migration control, zero 5xx, zero pages copied, and the
 # retiring drain returns in freeze-time instead of decoding the
 # remaining budget to completion (`make migrate-smoke`).
+# Plus a RECOVERY round (ISSUE-20): a --journal gateway over two real
+# agent subprocesses is kill -9'd mid-stream; the agents park the
+# orphaned sessions after --gateway-grace, a fresh `--recover` boot
+# replays the WAL and adopts them (zero re-prefill), and every
+# request's stream is re-fetched via GET /v1/stream/<id>?offset=0
+# byte-identical to a never-crashed control — zero 5xx after restart
+# (`make recovery-smoke`).
 #
 # Usage: tools/serve_smoke.sh       (repo root; `make serve-smoke`)
 #        SERVE_SMOKE_ROUNDS=chaos tools/serve_smoke.sh
@@ -69,6 +76,8 @@
 #                                   (connection-storm round only; `make storm-smoke`)
 #        SERVE_SMOKE_ROUNDS=migrate tools/serve_smoke.sh
 #                                   (live-migration round only; `make migrate-smoke`)
+#        SERVE_SMOKE_ROUNDS=recovery tools/serve_smoke.sh
+#                                   (crash-recovery round only; `make recovery-smoke`)
 set -u
 
 PY=${PY:-python}
@@ -93,7 +102,12 @@ SHGW_PID=''
 SHCTRL_PID=''
 BGW_PID=''
 STGW_PID=''
-trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID $RGW_PID $RCTRL_PID $DGW_PID $DCTRL_PID $AT_PID $ATCTRL_PID $SHGW_PID $SHCTRL_PID $BGW_PID $STGW_PID 2>/dev/null; kill -9 $AGENT0_PID $AGENT1_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
+KGW_PID=''
+KGW2_PID=''
+KCTRL_PID=''
+KAGENT0_PID=''
+KAGENT1_PID=''
+trap 'kill $GW_PID $CTRL_PID $CHAOS_PID $PAGED_PID $SCALE_PID $GP_PID $PORTAL_PID $RGW_PID $RCTRL_PID $DGW_PID $DCTRL_PID $AT_PID $ATCTRL_PID $SHGW_PID $SHCTRL_PID $BGW_PID $STGW_PID $KGW_PID $KGW2_PID $KCTRL_PID 2>/dev/null; kill -9 $AGENT0_PID $AGENT1_PID $KAGENT0_PID $KAGENT1_PID 2>/dev/null; rm -rf "$WORK"' EXIT INT TERM
 
 fail() { echo "serve-smoke: FAIL: $1" >&2; exit 1; }
 
@@ -374,6 +388,215 @@ EOF
     wait $RCTRL_PID 2>/dev/null
     RCTRL_PID=''
     echo "serve-smoke: remote OK (kill -9 one of 2 agents -> zero 5xx, token-exact vs local control, corpse quarantined, survivor drained clean)"
+}
+
+# ---- recovery round (also standalone: SERVE_SMOKE_ROUNDS=recovery) ---
+# ISSUE-20 crash-safe control plane: a --journal gateway routing to two
+# real agent subprocesses is kill -9'd MID-STREAM. The orphaned agents
+# park the in-flight sessions once --gateway-grace expires (or buffer
+# results that finish into the void), a fresh boot with --recover
+# replays the WAL and re-attaches the parked KV token-exact (zero
+# re-prefill); every crashed request's stream is then fetched from the
+# NEW gateway via GET /v1/stream/<id>?offset=0 and compared
+# byte-for-byte against a never-crashed local control gateway. Zero
+# 5xx after restart, and a clean SIGTERM drain compacts the journal
+# back to empty.
+recovery_round() {
+    # engine wedge (~0.05s/token, timing-only — never alters tokens)
+    # so the SIGKILL and the parking grace both land mid-stream
+    KFAULTS='[{"op": "wedge", "dispatch": 1, "seconds": 0.05, "times": -1}]'
+    TONY_SERVE_FAULTS="$KFAULTS" JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.replica --demo-model \
+        --serve-batch 4 --port 0 --port-file "$WORK/kagent0.port" \
+        --replica-index 0 --compile-cache '' \
+        --gateway-grace 0.5 --park-ttl 120 \
+        >"$WORK/kagent0.log" 2>&1 &
+    KAGENT0_PID=$!
+    TONY_SERVE_FAULTS="$KFAULTS" JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.replica --demo-model \
+        --serve-batch 4 --port 0 --port-file "$WORK/kagent1.port" \
+        --replica-index 1 --compile-cache '' \
+        --gateway-grace 0.5 --park-ttl 120 \
+        >"$WORK/kagent1.log" 2>&1 &
+    KAGENT1_PID=$!
+    i=0
+    while [ $i -lt $BOUND ]; do
+        [ -f "$WORK/kagent0.port" ] && [ -f "$WORK/kagent1.port" ] && break
+        kill -0 $KAGENT0_PID 2>/dev/null || fail "recovery agent 0 died at boot: $(cat "$WORK/kagent0.log")"
+        kill -0 $KAGENT1_PID 2>/dev/null || fail "recovery agent 1 died at boot: $(cat "$WORK/kagent1.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -f "$WORK/kagent0.port" ] && [ -f "$WORK/kagent1.port" ] || fail "recovery agents did not bind within ${BOUND}s"
+    KA0=$(awk '{print $1 ":" $2}' "$WORK/kagent0.port")
+    KA1=$(awk '{print $1 ":" $2}' "$WORK/kagent1.port")
+    echo "serve-smoke: recovery agents at $KA0 and $KA1"
+
+    # the journaling gateway (the crash victim) and the never-crashed
+    # local-replica CONTROL its outputs are compared against
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --agents "$KA0,$KA1" \
+        --serve-batch 4 --port 0 --compile-cache '' \
+        --agent-heartbeat 0.2 --agent-lease-misses 3 \
+        --journal --history "$WORK/khist" \
+        >"$WORK/kgw_boot.log" 2>"$WORK/kgw_stderr.log" &
+    KGW_PID=$!
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --demo-model \
+        --replicas 1 --serve-batch 4 --port 0 --compile-cache '' \
+        >"$WORK/kctrl_boot.log" 2>&1 &
+    KCTRL_PID=$!
+    KURL=''; KCTRL_URL=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        KURL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/kgw_boot.log")
+        KCTRL_URL=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/kctrl_boot.log")
+        [ -n "$KURL" ] && [ -n "$KCTRL_URL" ] && break
+        kill -0 $KGW_PID 2>/dev/null || fail "recovery gateway died at boot: $(cat "$WORK/kgw_stderr.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$KURL" ] && [ -n "$KCTRL_URL" ] || fail "recovery/control gateways did not print URLs within ${BOUND}s"
+    echo "serve-smoke: recovery gateway at $KURL (journal under $WORK/khist)"
+
+    # warm both fleets so the SIGKILL lands mid-decode, not mid-compile
+    code=$(curl_s "$WORK/kwarm" "$KURL/v1/generate" '{"token_ids": [9, 9], "max_new_tokens": 2}') || fail "recovery warm curl"
+    [ "$code" = 200 ] || fail "recovery warm -> $code"
+    curl_s "$WORK/kcwarm" "$KCTRL_URL/v1/generate" '{"token_ids": [9, 9], "max_new_tokens": 2}' >/dev/null || fail "recovery control warm curl"
+
+    # 6 in-flight requests (STRING ids — the resume URL carries the id
+    # verbatim), then the headline move: SIGKILL the whole gateway
+    KPIDS=''
+    n=0
+    while [ $n -lt 6 ]; do
+        curl_s "$WORK/krec_$n" "$KURL/v1/generate" \
+            "{\"token_ids\": [$((1 + n)), 2, 3], \"max_new_tokens\": 48, \"id\": \"r$n\"}" \
+            >"$WORK/krec_${n}.code" 2>/dev/null &
+        KPIDS="$KPIDS $!"
+        n=$((n + 1))
+    done
+    sleep 1
+    kill -9 $KGW_PID
+    echo "serve-smoke: kill -9 the gateway mid-stream (6 requests in flight)"
+    wait $KPIDS 2>/dev/null   # the clients die with the socket — fine
+    wait $KGW_PID 2>/dev/null
+    KGW_PID=''
+    # gateway-liveness grace (0.5s) expires -> the agents park the
+    # orphaned sessions; give the watchdog a couple of beats
+    sleep 2
+
+    # restart against the SAME history root: --recover replays the WAL
+    # left exactly as the crash abandoned it
+    JAX_PLATFORMS=cpu $PY -m tony_tpu.cli.gateway --agents "$KA0,$KA1" \
+        --serve-batch 4 --port 0 --compile-cache '' \
+        --agent-heartbeat 0.2 --agent-lease-misses 3 \
+        --journal --history "$WORK/khist" --recover \
+        >"$WORK/kgw2_boot.log" 2>"$WORK/kgw2_stderr.log" &
+    KGW2_PID=$!
+    KURL2=''
+    i=0
+    while [ $i -lt $BOUND ]; do
+        KURL2=$(sed -n 's/.*gateway at \(http:[^ ]*\).*/\1/p' "$WORK/kgw2_boot.log")
+        [ -n "$KURL2" ] && break
+        kill -0 $KGW2_PID 2>/dev/null || fail "recovered gateway died at boot: $(cat "$WORK/kgw2_stderr.log")"
+        sleep 1; i=$((i + 1))
+    done
+    [ -n "$KURL2" ] || fail "recovered gateway did not come up within ${BOUND}s: $(cat "$WORK/kgw2_stderr.log")"
+    grep -q 'recovery: replayed' "$WORK/kgw2_stderr.log" || fail "no WAL replay line on the --recover boot: $(cat "$WORK/kgw2_stderr.log")"
+    # the recovery report: all 6 accounted for, at least one session
+    # adopted mid-stream (parked KV re-attached, zero re-prefill),
+    # none shed
+    $PY - "$WORK/kgw2_stderr.log" <<'EOF' || fail "recovery report wrong: $(cat "$WORK/kgw2_stderr.log")"
+import re, sys
+text = open(sys.argv[1]).read()
+m = re.search(r"recovery: (\d+) adopted mid-stream, (\d+) re-run from "
+              r"prompt, (\d+) finished results, (\d+) shed", text)
+assert m, text
+adopted, rerun, finished, shed = map(int, m.groups())
+assert adopted >= 1, \
+    f"nothing adopted mid-stream ({adopted=} {rerun=} {finished=})"
+assert adopted + rerun + finished == 6, (adopted, rerun, finished)
+assert shed == 0, f"{shed} journaled request(s) shed during recovery"
+EOF
+    echo "serve-smoke: $(grep 'adopted mid-stream' "$WORK/kgw2_stderr.log")"
+
+    # every crashed stream resumes on the NEW gateway from offset 0,
+    # byte-identical to the gateway that never crashed
+    n=0
+    while [ $n -lt 6 ]; do
+        curl_s "$WORK/kctrl_$n" "$KCTRL_URL/v1/generate" \
+            "{\"token_ids\": [$((1 + n)), 2, 3], \"max_new_tokens\": 48, \"id\": \"c$n\"}" \
+            >/dev/null || fail "recovery control request $n curl"
+        code=$(curl_s "$WORK/kres_$n" "$KURL2/v1/stream/r$n?offset=0") || fail "resume r$n curl"
+        [ "$code" = 200 ] || fail "resume r$n -> $code (every journaled request must be resumable)"
+        $PY - "$WORK/kres_$n" "$WORK/kctrl_$n" <<'EOF' || fail "resumed stream r$n differs from the never-crashed control"
+import json, sys
+toks, done = [], None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line:
+        continue
+    doc = json.loads(line)
+    if doc.get("keepalive"):
+        continue
+    if doc.get("done"):
+        done = doc
+        break
+    assert "error" not in doc, doc
+    assert doc["offset"] == len(toks), (doc["offset"], len(toks))
+    toks.extend(doc["token_ids"])
+assert done is not None, "resume stream ended without a done line"
+ctrl = json.load(open(sys.argv[2]))
+assert toks == ctrl["token_ids"][3:], (toks, ctrl["token_ids"])
+EOF
+        n=$((n + 1))
+    done
+
+    # zero 5xx after restart + the recovery ledger on /stats
+    curl_s "$WORK/kgw2_stats" "$KURL2/stats" >/dev/null || fail "recovered gateway stats curl"
+    $PY - "$WORK/kgw2_stats" <<'EOF' || fail "recovered gateway stats wrong: $(cat "$WORK/kgw2_stats")"
+import json, sys
+stats = json.load(open(sys.argv[1]))
+assert stats["shed"] == {}, stats["shed"]    # zero 5xx, whole restart
+rec = stats["recovery"]
+assert rec["journal"] is True, rec
+assert rec["recoveries"] == 1, rec
+assert rec["sessions_adopted"] >= 1, rec
+assert rec["sessions_adopted"] + rec["sessions_rerun"] \
+    + rec["recovered_finished"] == 6, rec
+EOF
+
+    # clean drain: gateway exit 0 and the journal compacts to empty
+    # (nothing for a NEXT --recover boot to replay), agents drain clean
+    kill -TERM $KGW2_PID
+    i=0
+    while kill -0 $KGW2_PID 2>/dev/null; do
+        [ $i -ge $BOUND ] && fail "recovered gateway did not drain within ${BOUND}s"
+        sleep 1; i=$((i + 1))
+    done
+    wait $KGW2_PID; rc=$?
+    [ $rc = 0 ] || fail "recovered gateway exited $rc after SIGTERM"
+    KGW2_PID=''
+    $PY - "$WORK/khist" <<'EOF' || fail "journal did not compact on clean drain"
+import sys
+from tony_tpu.gateway import journal
+path = journal.find_latest(sys.argv[1])
+assert path is not None, "no journal left under the history root"
+entries = journal.replay(path)
+assert entries == {}, \
+    f"{len(entries)} entr(ies) survived a clean drain: {sorted(entries)}"
+EOF
+    kill -TERM $KAGENT0_PID $KAGENT1_PID
+    for pid in $KAGENT0_PID $KAGENT1_PID; do
+        i=0
+        while kill -0 $pid 2>/dev/null; do
+            [ $i -ge $BOUND ] && fail "recovery agent did not drain within ${BOUND}s"
+            sleep 1; i=$((i + 1))
+        done
+        wait $pid; rc=$?
+        [ $rc = 0 ] || fail "recovery agent exited $rc after SIGTERM"
+    done
+    KAGENT0_PID=''; KAGENT1_PID=''
+    grep -q "agent drained clean" "$WORK/kagent0.log" || fail "recovery agent 0 did not report a clean drain"
+    grep -q "agent drained clean" "$WORK/kagent1.log" || fail "recovery agent 1 did not report a clean drain"
+    kill -TERM $KCTRL_PID
+    wait $KCTRL_PID 2>/dev/null
+    KCTRL_PID=''
+    echo "serve-smoke: recovery OK (kill -9 the gateway mid-stream -> WAL replayed, parked sessions adopted token-exact, zero 5xx after restart, clean drain compacts the journal)"
 }
 
 # ---- bundle round (also standalone: SERVE_SMOKE_ROUNDS=bundle) -------
@@ -1378,6 +1601,10 @@ if [ "${SERVE_SMOKE_ROUNDS:-all}" = remote ]; then
     remote_round   # `make remote-smoke`: just the remote-replica round
     exit 0
 fi
+if [ "${SERVE_SMOKE_ROUNDS:-all}" = recovery ]; then
+    recovery_round   # `make recovery-smoke`: just the crash-recovery round
+    exit 0
+fi
 if [ "${SERVE_SMOKE_ROUNDS:-all}" = bundle ]; then
     bundle_round   # `make bundle-smoke`: just the flight-recorder round
     exit 0
@@ -1747,4 +1974,7 @@ migrate_round
 
 # ---- rebalance round: skewed fleet -> autonomous session move --------
 rebalance_round
+
+# ---- recovery round: kill -9 the gateway, --recover replays the WAL --
+recovery_round
 echo "serve-smoke: ALL OK"
